@@ -1,0 +1,17 @@
+"""das4whales_tpu — TPU-native DAS bioacoustics framework.
+
+A ground-up JAX/XLA rebuild of the capability surface of DAS4Whales
+(github.com/leabouffaut/DAS4Whales): ingest interrogator recordings into a
+``[channel x time]`` strain tensor, filter in the frequency-wavenumber
+domain, detect baleen-whale calls with three detector families
+(matched-filter, spectrogram correlation, Gabor/image), localize sources by
+TDOA least squares, and visualize — with jit+vmap kernels instead of
+per-channel Python loops and ``jax.sharding`` meshes instead of dask chunks.
+"""
+
+__version__ = "0.1.0"
+
+from . import config  # noqa: F401
+from . import ops  # noqa: F401
+from . import models  # noqa: F401
+from .config import AcquisitionMetadata, ChannelSelection  # noqa: F401

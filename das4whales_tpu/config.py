@@ -1,0 +1,178 @@
+"""Core types and configuration for das4whales_tpu.
+
+The reference package (DAS4Whales) threads a plain metadata dict with keys
+``fs, dx, ns, n, GL, nx, scale_factor`` through every function
+(cf. reference src/das4whales/data_handle.py:106) and hardcodes scientific
+constants inside the entry-point scripts (channel ranges, passbands, sound
+speeds; cf. reference scripts/main_mfdetect.py:25,46-53). Here both become
+explicit, typed, immutable configuration objects: hashable dataclasses that
+can be closed over by ``jax.jit`` as static arguments, plus a set of named
+scientific defaults that preserve the reference's semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Mapping
+
+
+@dataclass(frozen=True)
+class AcquisitionMetadata:
+    """Immutable DAS acquisition parameters.
+
+    Mirrors the metadata-dict contract of the reference
+    (data_handle.py:71-110): ``fs`` sampling frequency [Hz], ``dx`` channel
+    spacing [m], ``nx`` number of channels, ``ns`` number of time samples,
+    ``n`` fiber refractive index, ``gauge_length`` [m], and ``scale_factor``
+    converting raw interrogator counts to strain.
+    """
+
+    fs: float
+    dx: float
+    nx: int
+    ns: int
+    n: float = 1.4681
+    gauge_length: float = 51.0
+    scale_factor: float = 1.0
+    interrogator: str = "optasense"
+
+    @property
+    def duration(self) -> float:
+        """File duration in seconds."""
+        return self.ns / self.fs
+
+    @property
+    def cable_span(self) -> float:
+        """Total sensed cable length in meters."""
+        return self.nx * self.dx
+
+    def to_dict(self) -> dict:
+        """Export as the reference-compatible metadata dict
+        (keys fs/dx/ns/n/GL/nx/scale_factor, data_handle.py:106)."""
+        return {
+            "fs": self.fs,
+            "dx": self.dx,
+            "ns": self.ns,
+            "n": self.n,
+            "GL": self.gauge_length,
+            "nx": self.nx,
+            "scale_factor": self.scale_factor,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping, interrogator: str = "optasense") -> "AcquisitionMetadata":
+        """Build from a reference-style metadata dict."""
+        return cls(
+            fs=float(d["fs"]),
+            dx=float(d["dx"]),
+            nx=int(d["nx"]),
+            ns=int(d["ns"]),
+            n=float(d.get("n", 1.4681)),
+            gauge_length=float(d.get("GL", 51.0)),
+            scale_factor=float(d.get("scale_factor", 1.0)),
+            interrogator=interrogator,
+        )
+
+
+@dataclass(frozen=True)
+class ChannelSelection:
+    """Strided channel selection ``[start, stop, step]`` in channel indices.
+
+    The reference passes a bare 3-list around (``selected_channels``,
+    data_handle.py:180-230); callers convert from meters by integer-dividing
+    by ``dx`` (main_mfdetect.py:25-34). Both conventions live here.
+    """
+
+    start: int
+    stop: int
+    step: int = 1
+
+    @classmethod
+    def from_meters(cls, start_m: float, stop_m: float, step_m: float, dx: float) -> "ChannelSelection":
+        """Convert a selection expressed in meters along the cable into
+        channel indices (reference caller-side idiom, main_mfdetect.py:30-34)."""
+        return cls(int(start_m // dx), int(stop_m // dx), int(step_m // dx))
+
+    @classmethod
+    def from_list(cls, sel) -> "ChannelSelection":
+        if isinstance(sel, ChannelSelection):
+            return sel
+        return cls(int(sel[0]), int(sel[1]), int(sel[2]))
+
+    def to_list(self) -> list:
+        return [self.start, self.stop, self.step]
+
+    def n_channels(self, nx: int | None = None) -> int:
+        stop = self.stop if nx is None else min(self.stop, nx)
+        return max(0, -(-(stop - self.start) // self.step))
+
+    @property
+    def spacing(self) -> int:
+        """Effective inter-channel stride in raw-channel units."""
+        return self.step
+
+    def distances(self, dx: float, n: int):
+        """Distance axis [m] for the selected channels
+        (reference axis convention, data_handle.py:228)."""
+        import numpy as np
+
+        return (np.arange(n) * self.step + self.start) * dx
+
+
+@dataclass(frozen=True)
+class FkFilterConfig:
+    """f-k filter design parameters.
+
+    Defaults are the reference's scientific baseline: an apparent-speed fan
+    of 1400-1450 m/s (stop/pass) up to 3400-3500 m/s and a 15-25 Hz fin-whale
+    passband (dsp.py:85,174,308). The entry-point scripts override to
+    1350/1450-3300/3450 m/s and 14-30 Hz (main_mfdetect.py:46-47).
+    """
+
+    cs_min: float = 1400.0
+    cp_min: float = 1450.0
+    cp_max: float = 3400.0
+    cs_max: float = 3500.0
+    fmin: float = 15.0
+    fmax: float = 25.0
+
+
+@dataclass(frozen=True)
+class CallTemplateConfig:
+    """Chirp call-template parameters (detect.py:68-93)."""
+
+    fmin: float
+    fmax: float
+    duration: float
+    window: bool = True
+    method: str = "hyperbolic"
+
+
+# Scientific defaults preserved from the reference entry-point scripts.
+
+#: Canonical working channel selection, meters along the OOI RCA North cable
+#: (main_mfdetect.py:25): start, stop, step.
+SELECTED_CHANNELS_M = (20000.0, 65000.0, 5.0)
+
+#: Script-level f-k fan + passband (main_mfdetect.py:46-47).
+SCRIPT_FK = FkFilterConfig(cs_min=1350.0, cp_min=1450.0, cp_max=3300.0, cs_max=3450.0, fmin=14.0, fmax=30.0)
+
+#: Fin-whale 20-Hz call note templates (main_mfdetect.py:72-73).
+FIN_HF_NOTE = CallTemplateConfig(fmin=17.8, fmax=28.8, duration=0.68)
+FIN_LF_NOTE = CallTemplateConfig(fmin=14.7, fmax=21.8, duration=0.78)
+
+#: Spectrogram-correlation kernels (main_spectrodetect.py:91-92).
+SPECTRO_HF_KERNEL = {"f0": 27.0, "f1": 17.0, "dur": 0.8, "bdwidth": 4.0}
+SPECTRO_LF_KERNEL = {"f0": 20.0, "f1": 14.0, "dur": 1.2, "bdwidth": 4.0}
+
+#: Reference sound speed in sea water [m/s] used by the image detector and
+#: localization (main_gabordetect.py, loc.py).
+C0_WATER = 1500.0
+
+
+def as_metadata(metadata) -> AcquisitionMetadata:
+    """Accept either an AcquisitionMetadata or a reference-style dict."""
+    if isinstance(metadata, AcquisitionMetadata):
+        return metadata
+    return AcquisitionMetadata.from_dict(metadata)

@@ -1,0 +1,3 @@
+"""Detector families and localization models."""
+
+from . import templates  # noqa: F401

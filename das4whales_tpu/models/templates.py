@@ -1,0 +1,70 @@
+"""Whale-call template synthesis (chirps).
+
+Parity targets: reference ``detect.gen_linear_chirp``,
+``gen_hyperbolic_chirp`` and ``gen_template_fincall`` (detect.py:20-93),
+which wrap ``scipy.signal.chirp``. The chirp phase laws are evaluated in
+closed form in jnp so template generation is jittable and differentiable
+(templates can be optimized against data — something the reference's scipy
+path cannot do).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.spectral import hann_window
+
+
+def _time_vector(duration: float, fs: float) -> np.ndarray:
+    """``np.arange(0, duration, 1/fs)`` — the reference's sample grid
+    (detect.py:39,63)."""
+    return np.arange(0, duration, 1.0 / fs)
+
+
+def gen_linear_chirp(fmin: float, fmax: float, duration: float, fs: float) -> jnp.ndarray:
+    """Linear down-swept chirp from fmax to fmin.
+
+    Matches ``scipy.signal.chirp(t, f0=fmax, f1=fmin, t1=duration,
+    method='linear')`` (detect.py:20-41).
+    """
+    t = jnp.asarray(_time_vector(duration, fs))
+    f0, f1, t1 = fmax, fmin, duration
+    phase = 2.0 * jnp.pi * (f0 * t + 0.5 * (f1 - f0) / t1 * t * t)
+    return jnp.cos(phase)
+
+
+def gen_hyperbolic_chirp(fmin: float, fmax: float, duration: float, fs: float) -> jnp.ndarray:
+    """Hyperbolic down-swept chirp from fmax to fmin.
+
+    Matches ``scipy.signal.chirp(t, f0=fmax, f1=fmin, t1=duration,
+    method='hyperbolic')`` (detect.py:44-65): instantaneous frequency
+    ``f(t) = f0*f1*t1 / ((f0-f1)*t + f1*t1)``.
+    """
+    t = jnp.asarray(_time_vector(duration, fs))
+    f0, f1, t1 = fmax, fmin, duration
+    if f0 == f1:
+        return jnp.cos(2 * jnp.pi * f0 * t)
+    sing = -f1 * t1 / (f0 - f1)
+    phase = 2.0 * jnp.pi * (-sing * f0) * jnp.log(jnp.abs(1.0 - t / sing))
+    return jnp.cos(phase)
+
+
+def gen_template_fincall(
+    time: np.ndarray,
+    fs: float,
+    fmin: float = 15.0,
+    fmax: float = 25.0,
+    duration: float = 1.0,
+    window: bool = True,
+) -> jnp.ndarray:
+    """Fin-whale call template: Hann-windowed hyperbolic chirp zero-padded
+    to the length of ``time``.
+
+    Parity: reference ``detect.gen_template_fincall`` (detect.py:68-93).
+    """
+    chirp = gen_hyperbolic_chirp(fmin, fmax, duration, fs)
+    if window:
+        chirp = chirp * hann_window(chirp.shape[0], periodic=False, dtype=chirp.dtype)
+    template = jnp.zeros(np.shape(time), dtype=chirp.dtype)
+    return template.at[: chirp.shape[0]].set(chirp)

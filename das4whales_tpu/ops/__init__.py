@@ -1,0 +1,3 @@
+"""Device-side DSP kernels (jit/vmap-first)."""
+
+from . import fk, filters, peaks, spectral, xcorr  # noqa: F401

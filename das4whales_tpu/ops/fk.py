@@ -1,0 +1,334 @@
+"""Frequency-wavenumber (f-k) filter design and application.
+
+TPU-native rebuild of the reference's f-k stack (dsp.py:85-702,725-786,
+883-953). The reference designs each mask with a Python loop over the 12k
+frequency (or 22k wavenumber) bins and compresses the result with
+``sparse.COO``; here every designer is a broadcasted closed-form evaluation
+on the full ``[k x f]`` grid — one vectorized expression, no loops — and the
+mask stays dense (on TPU a dense bf16/f32 mask is a cheap elementwise
+multiply and regenerating it is microseconds, cf. SURVEY.md §2.3).
+
+Design happens host-side in float64 numpy (design-once / apply-many, like
+the Butterworth coefficients); application is a jitted 2-D FFT -> mask ->
+inverse round trip on device.
+
+Mask-value parity with the reference loops is exact: the same transition
+expressions are evaluated on the same fftshifted axes, with later-assignment
+-wins semantics reproduced by nested ``where``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.signal as sp
+from scipy import ndimage
+
+from ..config import ChannelSelection
+
+
+def fk_axes(trace_shape: Tuple[int, int], selected_channels, dx: float, fs: float):
+    """fftshifted frequency [Hz] and wavenumber [1/m] axes for a
+    ``[channel x time]`` block (reference convention, dsp.py:129-130)."""
+    sel = ChannelSelection.from_list(selected_channels)
+    nnx, nns = trace_shape
+    freq = np.fft.fftshift(np.fft.fftfreq(nns, d=1 / fs))
+    knum = np.fft.fftshift(np.fft.fftfreq(nnx, d=sel.step * dx))
+    return freq, knum
+
+
+def _sine_ramp(x, lo, hi):
+    """sin(pi/2 * (x - lo) / (hi - lo)) with safe division."""
+    denom = np.where(hi == lo, 1.0, hi - lo)
+    return np.sin(0.5 * np.pi * (x - lo) / denom)
+
+
+def fk_filter_design(
+    trace_shape, selected_channels, dx, fs,
+    cs_min=1400.0, cp_min=1450.0, cp_max=3400.0, cs_max=3500.0,
+) -> np.ndarray:
+    """Speed-fan f-k filter with sine transition bands.
+
+    Parity: reference ``dsp.fk_filter_design`` (dsp.py:85-171) — passband for
+    apparent speeds in ``[cp_min, cp_max]``, sine ramps over
+    ``[cs_min, cp_min]`` and ``[cp_max, cs_max]``, and rows with
+    ``|k| < 0.005`` zeroed. The reference's per-wavenumber loop becomes one
+    broadcast over the ``[k x f]`` grid.
+    """
+    freq, knum = fk_axes(trace_shape, selected_channels, dx, fs)
+    K = knum[:, None]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        speed = np.abs(freq[None, :] / K)
+
+    m = np.ones_like(speed)
+    up = (speed >= cs_min) & (speed <= cp_min)
+    down = (speed >= cp_max) & (speed <= cs_max)
+    with np.errstate(invalid="ignore"):
+        m = np.where(up, _sine_ramp(np.where(up, speed, 0.0), cs_min, cp_min), m)
+        m = np.where(down, 1.0 - _sine_ramp(np.where(down, speed, 0.0), cp_max, cs_max), m)
+    m = np.where(speed >= cs_max, 0.0, m)
+    m = np.where(speed < cs_min, 0.0, m)
+    m = np.where(np.abs(K) < 0.005, 0.0, m)
+    return m
+
+
+def _bandpass_H_sine(freq, fmin, fmax, df_taper=4.0) -> np.ndarray:
+    """Sine-tapered bandpass frequency response (dsp.py:214-231)."""
+    fpmin, fpmax = fmin - df_taper, fmax + df_taper
+    H = np.zeros_like(freq)
+    rup = (freq >= fpmin) & (freq <= fmin)
+    H[rup] = np.sin(0.5 * np.pi * (freq[rup] - fpmin) / (fmin - fpmin))
+    H[(freq >= fmin) & (freq <= fmax)] = 1.0
+    rdo = (freq >= fmax) & (freq <= fpmax)
+    H[rdo] = np.cos(0.5 * np.pi * (freq[rdo] - fmax) / (fmax - fpmax))
+    return H
+
+
+def _col_range_mask(freq, fpmin, fpmax) -> np.ndarray:
+    """Boolean over frequency bins replicating the reference's
+    ``range(argmax(freq>=fpmin), argmax(freq>=fpmax))`` column loop bounds."""
+    ns = len(freq)
+    fmin_idx = int(np.argmax(freq >= fpmin))
+    fmax_idx = int(np.argmax(freq >= fpmax))
+    idx = np.arange(ns)
+    return (idx >= fmin_idx) & (idx < fmax_idx)
+
+
+def hybrid_filter_design(
+    trace_shape, selected_channels, dx, fs,
+    cs_min=1400.0, cp_min=1450.0, fmin=15.0, fmax=25.0,
+) -> np.ndarray:
+    """Infinite-wave-speed bandpass f-k hybrid filter, sine tapers.
+
+    Parity: reference ``dsp.hybrid_filter_design`` (dsp.py:174-305):
+    sine-tapered bandpass H(f) replicated along k, multiplied per frequency
+    column by a highpass-in-speed fan with sine ramps between ``cs_min`` and
+    ``cp_min``, then symmetrized with ``M += fliplr(M)``.
+    """
+    freq, knum = fk_axes(trace_shape, selected_channels, dx, fs)
+    H = _bandpass_H_sine(freq, fmin, fmax, df_taper=4.0)
+    M = np.tile(H, (len(knum), 1))
+
+    in_cols = _col_range_mask(freq, fmin - 4.0, fmax + 4.0)
+    K = knum[:, None]
+    ks = freq / cs_min  # [f]
+    kp = freq / cp_min
+    valid = ks != kp
+
+    m1 = (K >= -ks) & (K <= -kp)  # f+ k- ramp
+    m2 = (K <= ks) & (K >= kp)    # f+ k+ ramp (reference's -knum form)
+    pb = (K < kp) & (K > -kp)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        v1 = -_sine_ramp(K, -ks, -ks + (kp - ks))  # -sin(pi/2 (K+ks)/(kp-ks))
+        v2 = _sine_ramp(K, ks, ks + (kp - ks))     # sin(pi/2 (K-ks)/(kp-ks))
+    col = np.where(pb, 1.0, np.where(m2 & valid, v2, np.where(m1 & valid, v1, 0.0)))
+    M = np.where(in_cols[None, :], M * col, M)
+    M += np.fliplr(M)
+    return M
+
+
+def butterworth_bandpass_H(freq, fs, fmin, fmax, order=8) -> np.ndarray:
+    """One-sided squared Butterworth magnitude over the fftshifted frequency
+    axis: zeros on the negative half, ``|freqz|^2`` on the positive half
+    (reference construction, dsp.py:348-349)."""
+    ns = len(freq)
+    b, a = sp.butter(order, [fmin / (fs / 2), fmax / (fs / 2)], "bp")
+    H_pos = np.abs(sp.freqz(b, a, worN=ns // 2)[1]) ** 2
+    return np.concatenate((np.zeros(ns - ns // 2), H_pos))
+
+
+def hybrid_ninf_filter_design(
+    trace_shape, selected_channels, dx, fs,
+    cs_min=1400.0, cp_min=1450.0, cp_max=3400.0, cs_max=3500.0,
+    fmin=15.0, fmax=25.0,
+) -> np.ndarray:
+    """Band-limited (non-infinite speed) bandpass f-k hybrid filter.
+
+    Parity: reference ``dsp.hybrid_ninf_filter_design`` (dsp.py:308-454) —
+    the flagship filter of ``main_mfdetect.py:46``. Butterworth-8 squared
+    magnitude along f (positive half only), speed fan with sine ramps from
+    ``cs_max -> cp_max`` (low-k edge) and ``cp_min -> cs_min`` (high-k
+    edge), then two symmetrizations ``M += fliplr(M); M += flipud(M)``.
+    """
+    freq, knum = fk_axes(trace_shape, selected_channels, dx, fs)
+    H = butterworth_bandpass_H(freq, fs, fmin, fmax, order=8)
+    M = np.tile(H, (len(knum), 1))
+
+    in_cols = _col_range_mask(freq, fmin - 14.0, fmax + 14.0)
+    K = knum[:, None]
+    ks_min = freq / cs_max
+    kp_min = freq / cp_max
+    ks_max = freq / cs_min
+    kp_max = freq / cp_min
+    v_up_valid = ks_min != kp_min
+    v_do_valid = ks_max != kp_max
+
+    m_up = (K >= ks_min) & (K <= kp_min)
+    m_do = (K >= kp_max) & (K <= ks_max)
+    pb = (K > kp_min) & (K < kp_max)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        v_up = _sine_ramp(K, ks_min, ks_min + (kp_min - ks_min))
+        # reference: -sin(pi/2 (K - ks_max)/(ks_max - kp_max))
+        v_do = -_sine_ramp(K, ks_max, ks_max + (ks_max - kp_max))
+    col = np.where(pb, 1.0, np.where(m_do & v_do_valid, v_do, np.where(m_up & v_up_valid, v_up, 0.0)))
+    M = np.where(in_cols[None, :], M * col, M)
+    M += np.fliplr(M)
+    M += np.flipud(M)
+    return M
+
+
+def hybrid_gs_filter_design(
+    trace_shape, selected_channels, dx, fs,
+    cs_min=1400.0, cp_min=1450.0, fmin=15.0, fmax=25.0, sigma=20.0,
+) -> np.ndarray:
+    """Infinite-wave-speed filter with Gaussian-smoothed edges.
+
+    Parity: reference ``dsp.hybrid_gs_filter_design`` (dsp.py:457-579):
+    binary passband H(f) on [fmin, fmax], per-column binary speed passband
+    ``|k| < f/cp_min``, symmetrize with fliplr, then a sigma=20 Gaussian
+    smooth. (The reference's dangling taper-mask assignments at
+    dsp.py:524-529 are dead code and intentionally not reproduced.)
+    """
+    freq, knum = fk_axes(trace_shape, selected_channels, dx, fs)
+    H = ((freq >= fmin) & (freq <= fmax)).astype(float)
+    M = np.tile(H, (len(knum), 1))
+
+    in_cols = _col_range_mask(freq, fmin - 4.0, fmax + 4.0)
+    K = knum[:, None]
+    kp = freq / cp_min
+    col = ((K < kp) & (K > -kp)).astype(float)
+    M = np.where(in_cols[None, :], M * col, M)
+    M += np.fliplr(M)
+    M = ndimage.gaussian_filter(M, sigma)
+    return M
+
+
+def hybrid_ninf_gs_filter_design(
+    trace_shape, selected_channels, dx, fs,
+    cs_min=1400.0, cp_min=1450.0, cp_max=3400.0, cs_max=3500.0,
+    fmin=15.0, fmax=25.0, sigma=20.0,
+) -> np.ndarray:
+    """Band-limited filter with Gaussian-smoothed edges.
+
+    Parity: reference ``dsp.hybrid_ninf_gs_filter_design`` (dsp.py:582-702):
+    binary passband in f, per-column binary annulus
+    ``-f/cp_min < k < -f/cp_max``, Gaussian smooth (sigma=20) *before* the
+    fliplr/flipud symmetrizations — order preserved from the reference.
+    """
+    freq, knum = fk_axes(trace_shape, selected_channels, dx, fs)
+    H = ((freq >= fmin) & (freq <= fmax)).astype(float)
+    M = np.tile(H, (len(knum), 1))
+
+    in_cols = _col_range_mask(freq, fmin - 4.0, fmax + 4.0)
+    K = knum[:, None]
+    kp_min = freq / cp_min
+    kp_max = freq / cp_max
+    col = ((K > -kp_min) & (K < -kp_max)).astype(float)
+    M = np.where(in_cols[None, :], M * col, M)
+    M = ndimage.gaussian_filter(M, sigma)
+    M += np.fliplr(M)
+    M += np.flipud(M)
+    return M
+
+
+def speed_fan_mask(
+    trace_shape, fs, dx, c_min, c_max, tint=1.0, xint=1.0, sigma=20.0,
+) -> np.ndarray:
+    """Gaussian-smoothed binary speed-fan mask, min-max normalized.
+
+    Parity: the mask inside reference ``dsp.fk_filt`` (dsp.py:883-953) and
+    its dask chunk variant (tools.py:27-52, which uses sigma=40): keep
+    ``c_min < |f/k| < c_max``, smooth, normalize to [0, 1].
+    """
+    nx, ns = trace_shape
+    f = np.fft.fftshift(np.fft.fftfreq(ns, d=tint / fs))
+    k = np.fft.fftshift(np.fft.fftfreq(nx, d=xint * dx))
+    ff, kk = np.meshgrid(f, k)
+    g = 1.0 * ((ff < kk * c_min) & (ff < -kk * c_min))
+    g2 = 1.0 * ((ff < kk * c_max) & (ff < -kk * c_max))
+    g = g + np.fliplr(g)
+    g = g - (g2 + np.fliplr(g2))
+    g = ndimage.gaussian_filter(g, sigma)
+    g = (g - g.min()) / (g.max() - g.min())
+    return g
+
+
+# ---------------------------------------------------------------------------
+# Application (device, jitted)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def fk_filter_apply(trace: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Apply a pre-designed f-k mask: ``real(ifft2(ifftshift(fftshift(fft2(x)) * M)))``.
+
+    Parity: reference ``dsp.fk_filter_filt`` / ``fk_filter_sparsefilt``
+    (dsp.py:725-786) minus the sparse round trip. One fused XLA program on
+    TPU; no host transfers.
+    """
+    fk = jnp.fft.fftshift(jnp.fft.fft2(trace))
+    filtered = jnp.fft.ifft2(jnp.fft.ifftshift(fk * mask.astype(fk.real.dtype)))
+    return filtered.real.astype(trace.dtype)
+
+
+def _point_reflect(m: jnp.ndarray) -> jnp.ndarray:
+    """``m[(-i) % N, (-j) % M]`` — spectral point reflection in fft order."""
+    for ax in (0, 1):
+        m = jnp.roll(jnp.flip(m, axis=ax), 1, axis=ax)
+    return m
+
+
+@jax.jit
+def fk_filter_apply_rfft(trace: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Half-spectrum fast path: rFFT along time + full FFT along channels.
+
+    Mathematically *identical* to ``fk_filter_apply``: taking ``.real`` of
+    the full complex pipeline is equivalent to applying the Hermitian part
+    of the mask, ``(M(k,f) + M(-k,-f)) / 2``. This path symmetrizes the mask
+    explicitly, keeps only the non-negative-frequency half of the spectrum
+    (rfft2 layout), and reconstructs with irfft — halving FFT flops and
+    spectrum memory.
+    """
+    nnx, nns = trace.shape
+    mu = jnp.fft.ifftshift(mask).astype(trace.dtype)  # [k x f], fft order
+    msym = 0.5 * (mu + _point_reflect(mu))
+    mask_half = msym[:, : nns // 2 + 1]
+    spec = jnp.fft.fft(jnp.fft.rfft(trace, axis=1), axis=0)  # k x f_half
+    spec = spec * mask_half.astype(spec.real.dtype)
+    out = jnp.fft.irfft(jnp.fft.ifft(spec, axis=0), n=nns, axis=1)
+    return out.real.astype(trace.dtype)
+
+
+def fk_filt(
+    data: jnp.ndarray, tint, fs, xint, dx, c_min, c_max, sigma: float = 20.0,
+) -> jnp.ndarray:
+    """Design-and-apply Gaussian speed-fan filter in one call.
+
+    Parity: reference ``dsp.fk_filt`` (dsp.py:883-953).
+    """
+    mask = speed_fan_mask(data.shape, fs, dx, c_min, c_max, tint=tint, xint=xint, sigma=sigma)
+    return fk_filter_apply(data, jnp.asarray(mask))
+
+
+def compression_report(mask: np.ndarray, itemsize: int = 8, verbose: bool = True):
+    """Report dense vs sparse storage of an f-k mask.
+
+    Capability parity with reference ``tools.disp_comprate`` (tools.py:239-257),
+    which reports the ``sparse.COO`` savings. On TPU the mask is kept dense
+    (elementwise multiply is HBM-bandwidth-trivial), but the report remains
+    for cost observability.
+    """
+    mask = np.asarray(mask)
+    nnz = int(np.count_nonzero(mask))
+    sparse_gib = nnz * itemsize / 1024**3
+    dense_gib = mask.size * itemsize / 1024**3
+    ratio = dense_gib / sparse_gib if sparse_gib > 0 else float("inf")
+    pct = abs(dense_gib - sparse_gib) * 100 / dense_gib if dense_gib else 0.0
+    if verbose:
+        print(f"The size of the sparse filter is {sparse_gib:.4f} Gib")
+        print(f"The size of the dense filter is {dense_gib:.2f} Gib")
+        print(f"The compression ratio is {ratio:.2f} ({pct:.1f} %)")
+    return {"sparse_gib": sparse_gib, "dense_gib": dense_gib, "ratio": ratio, "pct": pct}

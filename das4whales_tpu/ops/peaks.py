@@ -1,0 +1,200 @@
+"""Vectorized peak picking with exact scipy prominence semantics.
+
+The reference picks detection times with ``scipy.signal.find_peaks(...,
+prominence=threshold)`` inside per-channel Python loops (detect.py:169-274),
+parallelized at best with a ThreadPoolExecutor that loses channel order
+(detect.py:242-246). Prominence is an inherently sequential-looking
+definition (walk away from each peak until a higher sample), which SURVEY.md
+§7 flags as a hard part of the TPU port.
+
+This module computes *exact* scipy ``find_peaks`` + prominence results for
+every sample of every channel simultaneously:
+
+* plateau-aware local maxima via an associative "carry last differing
+  value" scan (``lax.associative_scan``) — O(N log N) depth-parallel;
+* prominences via binary-lifting over precomputed sliding window max/min
+  tables (sparse tables): for each sample, a greedy high-to-low descent
+  skips power-of-two blocks whose max does not exceed the peak, folding in
+  their mins — exactly scipy's walk-until-higher with min tracking, in
+  O(N log N) fully-batched gathers instead of a per-peak walk.
+
+Outputs are dense boolean masks + per-sample prominences (fixed shapes, jit
+friendly); host-side helpers convert to the reference's ragged
+list-of-index-arrays and (channel, time) tuple formats.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _carry_last_flagged(values: jnp.ndarray, flags: jnp.ndarray, init: jnp.ndarray):
+    """For each i, the most recent ``values[j]`` (j <= i) where ``flags[j]``,
+    else ``init``. Associative scan along the last axis."""
+    def combine(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av), af | bf
+
+    v, f = jax.lax.associative_scan(combine, (values, flags), axis=-1)
+    return jnp.where(f, v, init)
+
+
+def local_maxima(x: jnp.ndarray) -> jnp.ndarray:
+    """Boolean mask of local maxima with scipy plateau semantics.
+
+    Matches ``scipy.signal._peak_finding_utils._local_maxima_1d``: a maximum
+    is a run of equal samples strictly greater than the samples on both
+    sides; the reported index is the floor-midpoint of the run. Runs touching
+    either signal edge are not maxima.
+    """
+    n = x.shape[-1]
+    idx = jnp.arange(n)
+
+    xl = jnp.concatenate([x[..., :1], x[..., :-1]], axis=-1)  # x[i-1]
+    diff_l = jnp.concatenate(
+        [jnp.zeros(x.shape[:-1] + (1,), bool), x[..., 1:] != x[..., :-1]], axis=-1
+    )
+    # previous differing value; +inf sentinel at the leading edge so
+    # edge-touching runs never qualify
+    inf = jnp.asarray(jnp.inf, x.dtype)
+    prev_diff = _carry_last_flagged(xl, diff_l, inf)
+    # run start index
+    # run start index (leading run starts at 0; it has prev_diff = +inf so it
+    # is excluded from peaks regardless)
+    run_start = _carry_last_flagged(
+        jnp.broadcast_to(idx, x.shape), diff_l, jnp.asarray(0)
+    )
+
+    # mirror for the right side
+    xr = jnp.flip(x, axis=-1)
+    diff_r = jnp.concatenate(
+        [jnp.zeros(x.shape[:-1] + (1,), bool), xr[..., 1:] != xr[..., :-1]], axis=-1
+    )
+    xrl = jnp.concatenate([xr[..., :1], xr[..., :-1]], axis=-1)
+    next_diff = jnp.flip(_carry_last_flagged(xrl, diff_r, inf), axis=-1)
+    run_end = (n - 1) - jnp.flip(
+        _carry_last_flagged(jnp.broadcast_to(idx, x.shape), diff_r, jnp.asarray(0)),
+        axis=-1,
+    )
+
+    is_peak_run = (prev_diff < x) & (next_diff < x)
+    mid = (run_start + run_end) // 2
+    return is_peak_run & (idx == mid)
+
+
+def _window_tables(x: jnp.ndarray, levels: int):
+    """Sparse tables of sliding-window max and min: level k holds the
+    max/min over the window of length 2^k ending at each index."""
+    tmax = [x]
+    tmin = [x]
+    for k in range(1, levels + 1):
+        half = 1 << (k - 1)
+        prev_max, prev_min = tmax[-1], tmin[-1]
+        pad_max = jnp.pad(
+            prev_max, [(0, 0)] * (x.ndim - 1) + [(half, 0)], constant_values=-jnp.inf
+        )[..., : x.shape[-1]]
+        pad_min = jnp.pad(
+            prev_min, [(0, 0)] * (x.ndim - 1) + [(half, 0)], constant_values=jnp.inf
+        )[..., : x.shape[-1]]
+        tmax.append(jnp.maximum(prev_max, pad_max))
+        tmin.append(jnp.minimum(prev_min, pad_min))
+    return tmax, tmin
+
+
+def _one_sided_base_min(x: jnp.ndarray, levels: int) -> jnp.ndarray:
+    """For each index i: min(x[j+1..i]) where j is the nearest index < i with
+    x[j] > x[i] (or the signal start if none) — scipy's left-base minimum.
+
+    Greedy binary-lifting descent over the window tables; each level is one
+    batched gather + compare, so the whole signal resolves in
+    O(levels) = O(log N) vectorized steps.
+    """
+    n = x.shape[-1]
+    tmax, tmin = _window_tables(x, levels)
+    tmax_s = jnp.stack(tmax)  # [levels+1, ..., n]
+    tmin_s = jnp.stack(tmin)
+
+    pos = jnp.broadcast_to(jnp.arange(n), x.shape)
+    base_min = jnp.full_like(x, jnp.inf)
+
+    for k in range(levels, -1, -1):
+        width = 1 << k
+        can = pos >= (width - 1)  # block fully inside the signal
+        gpos = jnp.clip(pos, 0, n - 1)
+        blk_max = jnp.take_along_axis(tmax_s[k], gpos, axis=-1)
+        blk_min = jnp.take_along_axis(tmin_s[k], gpos, axis=-1)
+        skip = can & (blk_max <= x)
+        base_min = jnp.where(skip, jnp.minimum(base_min, blk_min), base_min)
+        pos = jnp.where(skip, pos - width, pos)
+
+    return base_min
+
+
+@jax.jit
+def peak_prominences_dense(x: jnp.ndarray) -> jnp.ndarray:
+    """Prominence of every sample, treating it as a peak.
+
+    At indices where ``local_maxima`` is True this equals
+    ``scipy.signal.peak_prominences`` exactly (wlen=None).
+    """
+    n = x.shape[-1]
+    levels = max(1, int(np.ceil(np.log2(n))))
+    left_min = _one_sided_base_min(x, levels)
+    right_min = jnp.flip(_one_sided_base_min(jnp.flip(x, axis=-1), levels), axis=-1)
+    return x - jnp.maximum(left_min, right_min)
+
+
+@jax.jit
+def find_peaks_prominence(x: jnp.ndarray, threshold) -> jnp.ndarray:
+    """Boolean mask of peaks with prominence >= threshold.
+
+    Exact-parity vectorized equivalent of
+    ``scipy.signal.find_peaks(x, prominence=threshold)[0]`` applied along the
+    last axis of a batched array.
+    """
+    mask = local_maxima(x)
+    prom = peak_prominences_dense(x)
+    return mask & (prom >= threshold)
+
+
+# ---------------------------------------------------------------------------
+# Reference-shaped outputs (host side)
+# ---------------------------------------------------------------------------
+
+def mask_to_pick_lists(mask) -> List[np.ndarray]:
+    """Dense peak mask -> ragged list of per-channel index arrays
+    (the reference's ``pick_times``/``pick_times_env`` output shape,
+    detect.py:169-274 — with channel order preserved, unlike
+    ``pick_times_par``'s as_completed ordering bug at detect.py:244-245)."""
+    mask = np.asarray(mask)
+    return [np.nonzero(row)[0] for row in np.atleast_2d(mask)]
+
+
+def convert_pick_times(peaks_indexes_m) -> np.ndarray:
+    """Ragged pick lists -> stacked (channel_idx[], time_idx[]) array.
+
+    Parity: reference ``detect.convert_pick_times`` (detect.py:277-303).
+    Also accepts a dense boolean mask directly.
+    """
+    if isinstance(peaks_indexes_m, (np.ndarray, jnp.ndarray)) and np.asarray(peaks_indexes_m).dtype == bool:
+        chan, time = np.nonzero(np.asarray(peaks_indexes_m))
+        return np.asarray([chan, time])
+    chan: list = []
+    time: list = []
+    for i, picks in enumerate(peaks_indexes_m):
+        chan.extend([i] * len(picks))
+        time.extend(list(picks))
+    return np.asarray([chan, time])
+
+
+def select_picked_times(idx_tp, tstart: float, tend: float, fs: float):
+    """Restrict picks to a time window (reference ``detect.select_picked_times``,
+    detect.py:306-330)."""
+    sel = (idx_tp[1] >= tstart * fs) & (idx_tp[1] <= tend * fs)
+    return idx_tp[0][sel], idx_tp[1][sel]

@@ -1,0 +1,104 @@
+"""Cross-correlation kernels for matched-filter detection.
+
+The reference computes its cross-correlogram with a per-channel Python loop
+over ``scipy.signal.correlate`` (detect.py:140-166, the hottest loop in the
+flagship pipeline per SURVEY.md §3.1). Here the whole ``[channel x time]``
+block correlates against the template in one batched rFFT product: the
+template spectrum is computed once and broadcast against all channels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _xcorr_full_len(n: int, m: int) -> int:
+    """FFT length for a linear (non-circular) correlation of n and m."""
+    need = n + m - 1
+    # round up to the next even size; FFT sizes here are products of small
+    # primes for typical DAS shapes (e.g. 24000 = 2^5*3*5^3)
+    return need + (need % 2)
+
+
+@jax.jit
+def shift_xcorr(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Positive-lag full cross-correlation of two equal-length 1-D signals.
+
+    Parity: reference ``detect.shift_xcorr`` (detect.py:96-112) —
+    ``correlate(x, y, 'full')[len(x)-1:]``.
+    """
+    n, m = x.shape[-1], y.shape[-1]
+    nfft = _xcorr_full_len(n, m)
+    X = jnp.fft.rfft(x, nfft)
+    Y = jnp.fft.rfft(y, nfft)
+    corr = jnp.fft.irfft(X * jnp.conj(Y), nfft)
+    return corr[..., :n]
+
+
+@jax.jit
+def shift_nxcorr(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Std-normalized positive-lag cross-correlation.
+
+    Parity: reference ``detect.shift_nxcorr`` (detect.py:115-137).
+    """
+    corr = shift_xcorr(x, y)
+    return corr / (jnp.std(x) * jnp.std(y) * x.shape[-1])
+
+
+@jax.jit
+def compute_cross_correlogram(data: jnp.ndarray, template: jnp.ndarray) -> jnp.ndarray:
+    """Matched-filter cross-correlogram over all channels.
+
+    Parity: reference ``detect.compute_cross_correlogram``
+    (detect.py:140-166): per-channel demean + peak normalization, template
+    demean + peak normalization, then positive-lag full correlation. The
+    reference's tqdm channel loop (detect.py:163-164) becomes a single
+    batched FFT over the channel axis.
+    """
+    norm_data = data - jnp.mean(data, axis=-1, keepdims=True)
+    norm_data = norm_data / jnp.max(jnp.abs(data), axis=-1, keepdims=True)
+    t = template - jnp.mean(template)
+    t = t / jnp.max(jnp.abs(template))
+
+    n, m = data.shape[-1], t.shape[-1]
+    nfft = _xcorr_full_len(n, m)
+    X = jnp.fft.rfft(norm_data, nfft, axis=-1)
+    Y = jnp.fft.rfft(t, nfft)
+    corr = jnp.fft.irfft(X * jnp.conj(Y), nfft, axis=-1)
+    return corr[..., :n].astype(data.dtype)
+
+
+@jax.jit
+def fftconvolve_same_time(x: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+    """FFT convolution along the last (time) axis, ``mode='same'``, batched
+    over leading axes. Replaces the reference's
+    ``scipy.signal.fftconvolve(..., mode='same', axes=1)`` calls
+    (detect.py:597, improcess.py:219)."""
+    n, m = x.shape[-1], kernel.shape[-1]
+    nfft = _xcorr_full_len(n, m)
+    X = jnp.fft.rfft(x, nfft, axis=-1)
+    K = jnp.fft.rfft(kernel, nfft, axis=-1)
+    full = jnp.fft.irfft(X * K, nfft, axis=-1)[..., : n + m - 1]
+    start = (m - 1) // 2
+    return full[..., start : start + n]
+
+
+@jax.jit
+def fftconvolve2d_same(x: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+    """2-D FFT convolution, ``mode='same'``, batched over leading axes.
+
+    Replaces ``scipy.signal.fftconvolve(image, kernel, mode='same')``
+    (improcess.py:219) and ``cv2.filter2D``-style correlations when the
+    kernel is flipped by the caller.
+    """
+    n1, n2 = x.shape[-2], x.shape[-1]
+    m1, m2 = kernel.shape[-2], kernel.shape[-1]
+    s1, s2 = n1 + m1 - 1, n2 + m2 - 1
+    X = jnp.fft.rfft2(x, (s1, s2))
+    K = jnp.fft.rfft2(kernel, (s1, s2))
+    full = jnp.fft.irfft2(X * K, (s1, s2))
+    a1, a2 = (m1 - 1) // 2, (m2 - 1) // 2
+    return full[..., a1 : a1 + n1, a2 : a2 + n2]

@@ -1,0 +1,31 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the standard stand-in for a TPU
+pod slice when only one physical chip is available) with x64 enabled so
+golden-array parity tests against scipy/numpy float64 references are exact.
+Device-side kernels are dtype-polymorphic, so the same code paths run in
+float32/bfloat16 on real TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# This image's sitecustomize imports jax and registers a TPU backend at
+# interpreter start, so the env var alone is too late — force the platform
+# through the live config as well (must happen before first backend use).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

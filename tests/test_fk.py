@@ -1,0 +1,186 @@
+"""Parity tests for the f-k filter designers and appliers.
+
+Each vectorized designer is checked against an independent loop-based oracle
+implementing the reference's published mask semantics (dsp.py:85-702), and
+the appliers are checked against the numpy fft2 pipeline (dsp.py:725-786).
+"""
+
+import numpy as np
+import scipy.signal as sp
+from scipy import ndimage
+
+from das4whales_tpu.ops import fk
+
+SHAPE = (64, 200)  # [channels x time], even lengths as in all real files
+SEL = [100, 420, 5]
+DX = 2.042
+FS = 200.0
+
+
+def _axes(shape, sel, dx, fs):
+    freq = np.fft.fftshift(np.fft.fftfreq(shape[1], d=1 / fs))
+    knum = np.fft.fftshift(np.fft.fftfreq(shape[0], d=sel[2] * dx))
+    return freq, knum
+
+
+def oracle_fk_filter_design(shape, sel, dx, fs, cs_min, cp_min, cp_max, cs_max):
+    """Loop oracle for the MATLAB-derived speed-fan filter (dsp.py:85-171)."""
+    freq, knum = _axes(shape, sel, dx, fs)
+    M = np.zeros((len(knum), len(freq)))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        for i, k in enumerate(knum):
+            if abs(k) < 0.005:
+                continue
+            line = np.ones(len(freq))
+            speed = np.abs(freq / k)
+            m = (speed >= cs_min) & (speed <= cp_min)
+            line[m] = np.sin(0.5 * np.pi * (speed[m] - cs_min) / (cp_min - cs_min))
+            m = (speed >= cp_max) & (speed <= cs_max)
+            line[m] = 1 - np.sin(0.5 * np.pi * (speed[m] - cp_max) / (cs_max - cp_max))
+            line[speed >= cs_max] = 0
+            line[speed < cs_min] = 0
+            M[i] = line
+    return M
+
+
+def oracle_hybrid(shape, sel, dx, fs, cs_min, cp_min, fmin, fmax):
+    """Loop oracle for the infinite-speed hybrid filter (dsp.py:174-305)."""
+    freq, knum = _axes(shape, sel, dx, fs)
+    fpmin, fpmax = fmin - 4.0, fmax + 4.0
+    H = np.zeros(len(freq))
+    m = (freq >= fpmin) & (freq <= fmin)
+    H[m] = np.sin(0.5 * np.pi * (freq[m] - fpmin) / (fmin - fpmin))
+    H[(freq >= fmin) & (freq <= fmax)] = 1
+    m = (freq >= fmax) & (freq <= fpmax)
+    H[m] = np.cos(0.5 * np.pi * (freq[m] - fmax) / (fmax - fpmax))
+    M = np.tile(H, (len(knum), 1))
+    i0, i1 = np.argmax(freq >= fpmin), np.argmax(freq >= fpmax)
+    for i in range(i0, i1):
+        col = np.zeros(len(knum))
+        ks, kp = freq[i] / cs_min, freq[i] / cp_min
+        if ks != kp:
+            m = (knum >= -ks) & (knum <= -kp)
+            col[m] = -np.sin(0.5 * np.pi * (knum[m] + ks) / (kp - ks))
+            m = (-knum >= -ks) & (-knum <= -kp)
+            col[m] = np.sin(0.5 * np.pi * (knum[m] - ks) / (kp - ks))
+        col[(knum < kp) & (knum > -kp)] = 1
+        M[:, i] *= col
+    M += np.fliplr(M)
+    return M
+
+
+def oracle_hybrid_ninf(shape, sel, dx, fs, cs_min, cp_min, cp_max, cs_max, fmin, fmax):
+    """Loop oracle for the band-limited hybrid filter (dsp.py:308-454)."""
+    freq, knum = _axes(shape, sel, dx, fs)
+    ns = len(freq)
+    b, a = sp.butter(8, [fmin / (fs / 2), fmax / (fs / 2)], "bp")
+    H = np.concatenate((np.zeros(ns // 2), np.abs(sp.freqz(b, a, worN=ns // 2)[1]) ** 2))
+    M = np.tile(H, (len(knum), 1))
+    fpmin, fpmax = fmin - 14.0, fmax + 14.0
+    i0, i1 = np.argmax(freq >= fpmin), np.argmax(freq >= fpmax)
+    for i in range(i0, i1):
+        col = np.zeros(len(knum))
+        ks_min, kp_min = freq[i] / cs_max, freq[i] / cp_max
+        ks_max, kp_max = freq[i] / cs_min, freq[i] / cp_min
+        if ks_min != kp_min:
+            m = (knum >= ks_min) & (knum <= kp_min)
+            col[m] = np.sin(0.5 * np.pi * (knum[m] - ks_min) / (kp_min - ks_min))
+        if ks_max != kp_max:
+            m = (knum >= kp_max) & (knum <= ks_max)
+            col[m] = -np.sin(0.5 * np.pi * (knum[m] - ks_max) / (ks_max - kp_max))
+        col[(knum > kp_min) & (knum < kp_max)] = 1
+        M[:, i] *= col
+    M += np.fliplr(M)
+    M += np.flipud(M)
+    return M
+
+
+def test_fk_filter_design_parity():
+    got = fk.fk_filter_design(SHAPE, SEL, DX, FS, 1400, 1450, 3400, 3500)
+    want = oracle_fk_filter_design(SHAPE, SEL, DX, FS, 1400, 1450, 3400, 3500)
+    np.testing.assert_allclose(got, want, atol=1e-12)
+    assert got.shape == SHAPE
+
+
+def test_hybrid_filter_design_parity():
+    got = fk.hybrid_filter_design(SHAPE, SEL, DX, FS, 1400.0, 1450.0, 15.0, 25.0)
+    want = oracle_hybrid(SHAPE, SEL, DX, FS, 1400.0, 1450.0, 15.0, 25.0)
+    np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+def test_hybrid_ninf_filter_design_parity():
+    args = (1350.0, 1450.0, 3300.0, 3450.0, 14.0, 30.0)
+    got = fk.hybrid_ninf_filter_design(SHAPE, SEL, DX, FS, *args)
+    want = oracle_hybrid_ninf(SHAPE, SEL, DX, FS, *args)
+    np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+def test_hybrid_gs_filter_design_properties():
+    got = fk.hybrid_gs_filter_design(SHAPE, SEL, DX, FS)
+    assert got.shape == SHAPE
+    assert np.all(np.isfinite(got))
+    # smoothing keeps the mask roughly within the [0, ~2] symmetrized range
+    assert got.min() > -1e-9 and got.max() < 2.5
+
+
+def test_hybrid_ninf_gs_filter_design_properties():
+    got = fk.hybrid_ninf_gs_filter_design(SHAPE, SEL, DX, FS)
+    assert got.shape == SHAPE
+    assert np.all(np.isfinite(got))
+
+
+def test_speed_fan_mask_matches_reference_formula():
+    got = fk.speed_fan_mask(SHAPE, FS, DX, 1400.0, 3400.0, tint=1.0, xint=1.0)
+    # reference formula (dsp.py:918-945)
+    f = np.fft.fftshift(np.fft.fftfreq(SHAPE[1], d=1 / FS))
+    k = np.fft.fftshift(np.fft.fftfreq(SHAPE[0], d=DX))
+    ff, kk = np.meshgrid(f, k)
+    g = 1.0 * ((ff < kk * 1400.0) & (ff < -kk * 1400.0))
+    g2 = 1.0 * ((ff < kk * 3400.0) & (ff < -kk * 3400.0))
+    g += np.fliplr(g)
+    g -= g2 + np.fliplr(g2)
+    g = ndimage.gaussian_filter(g, 20)
+    g = (g - g.min()) / (g.max() - g.min())
+    np.testing.assert_allclose(got, g, atol=1e-12)
+
+
+def test_fk_filter_apply_matches_numpy(rng):
+    trace = rng.standard_normal(SHAPE)
+    mask = fk.hybrid_ninf_filter_design(SHAPE, SEL, DX, FS)
+    got = np.asarray(fk.fk_filter_apply(trace, mask))
+    fkspec = np.fft.fftshift(np.fft.fft2(trace))
+    want = np.fft.ifft2(np.fft.ifftshift(fkspec * mask)).real
+    np.testing.assert_allclose(got, want, atol=1e-10)
+
+
+def test_fk_filter_apply_rfft_equals_full(rng):
+    trace = rng.standard_normal(SHAPE)
+    mask = fk.hybrid_ninf_filter_design(SHAPE, SEL, DX, FS)
+    full = np.asarray(fk.fk_filter_apply(trace, mask))
+    half = np.asarray(fk.fk_filter_apply_rfft(trace, mask))
+    np.testing.assert_allclose(half, full, atol=1e-10)
+
+
+def test_fk_filter_preserves_inband_plane_wave():
+    """A 20 Hz plane wave at 1500 m/s passes; a slow wave is rejected."""
+    nx, ns = 128, 512
+    sel = [0, nx, 1]
+    dxs = 8.0
+    fs = 200.0
+    x = np.arange(nx) * dxs
+    t = np.arange(ns) / fs
+    inband = np.sin(2 * np.pi * 20.0 * (t[None, :] - x[:, None] / 1500.0))
+    slow = np.sin(2 * np.pi * 20.0 * (t[None, :] - x[:, None] / 300.0))
+    mask = fk.hybrid_filter_design((nx, ns), sel, dxs, fs, 1400.0, 1450.0, 15.0, 25.0)
+    out_in = np.asarray(fk.fk_filter_apply(inband, mask))
+    out_slow = np.asarray(fk.fk_filter_apply(slow, mask))
+    assert np.std(out_in) > 0.5 * np.std(inband)
+    assert np.std(out_slow) < 0.05 * np.std(slow)
+
+
+def test_compression_report(capsys):
+    mask = fk.hybrid_ninf_filter_design(SHAPE, SEL, DX, FS)
+    rep = fk.compression_report(mask)
+    assert rep["ratio"] > 1.0
+    out = capsys.readouterr().out
+    assert "compression ratio" in out
