@@ -1,0 +1,11 @@
+"""Host-side ingest: interrogator files, downloads, geometry, synthesis."""
+
+from . import coords, download, hdf5, interrogators, synth, tdms  # noqa: F401
+from .download import dl_file  # noqa: F401
+from .hdf5 import StrainBlock, load_das_data, raw2strain, write_optasense  # noqa: F401
+from .interrogators import get_acquisition_parameters  # noqa: F401
+
+
+def hello_world_das_package():
+    """Smoke-test greeting (reference data_handle.py:21-22)."""
+    print("Yepee! You now have access to all the functionalities of the das4whales_tpu package!")

@@ -1,0 +1,30 @@
+"""Cable geometry ingest (host side).
+
+Single implementation of the cable-coordinate loader the reference
+duplicates in two modules (data_handle.py:258-279 and map.py:20-42 —
+a documented quirk, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+def load_cable_coordinates(filepath: str, dx: float) -> pd.DataFrame:
+    """Load cable coordinates from a headerless CSV of
+    ``chan_idx, lat, lon, depth`` rows; adds the along-cable position in
+    meters (reference data_handle.py:258-279)."""
+    df = pd.read_csv(filepath, delimiter=",", header=None)
+    df.columns = ["chan_idx", "lat", "lon", "depth"]
+    df["chan_m"] = df["chan_idx"] * dx
+    return df
+
+
+def cable_positions_xyz(df: pd.DataFrame, utm_zone: int = 10) -> np.ndarray:
+    """Cable coordinates as a ``[channel x 3]`` UTM (x, y, depth) array —
+    the geometry input of the TDOA localizer (loc.py:57)."""
+    from ..plot.geo import latlon_to_utm
+
+    x, y = latlon_to_utm(df["lon"].to_numpy(), df["lat"].to_numpy(), zone=utm_zone)
+    return np.stack([x, y, df["depth"].to_numpy()], axis=1)

@@ -1,0 +1,150 @@
+"""OptaSense HDF5 ingest (host side).
+
+Parity targets: reference ``data_handle.get_metadata_optasense``
+(data_handle.py:71-110), ``load_das_data`` (data_handle.py:180-230) and
+``raw2strain`` (data_handle.py:157-177). The raw HDF5 read stays on the
+host; demean + scale-to-strain runs as a jitted device kernel so the large
+float conversion happens on TPU, not in numpy.
+
+Also provides a schema-faithful *writer* so synthetic fixtures and golden
+tests can run fully offline (the reference has no offline test asset,
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass
+from datetime import datetime, timezone
+from typing import Tuple
+
+import h5py
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import AcquisitionMetadata, ChannelSelection, as_metadata
+
+#: OptaSense interferometric conversion constants (data_handle.py:104):
+#: 1550.12 nm laser, 0.78 photoelastic scaling.
+_LASER_WAVELENGTH_M = 1550.12e-9
+_PHOTOELASTIC = 0.78
+
+
+def optasense_scale_factor(n: float, gauge_length: float) -> float:
+    """Raw counts -> strain conversion (data_handle.py:104)."""
+    return (2 * np.pi) / 2**16 * _LASER_WAVELENGTH_M / (_PHOTOELASTIC * 4 * np.pi * n * gauge_length)
+
+
+def get_metadata_optasense(filepath: str) -> AcquisitionMetadata:
+    """Read acquisition parameters from an OptaSense HDF5 file."""
+    if not os.path.exists(filepath):
+        raise FileNotFoundError(f"File {filepath} not found")
+    with h5py.File(filepath, "r") as fp:
+        acq = fp["Acquisition"]
+        raw = acq["Raw[0]"]
+        fs = float(raw.attrs["OutputDataRate"])
+        dx = float(acq.attrs["SpatialSamplingInterval"])
+        ns = int(raw["RawDataTime"].attrs["Count"])
+        n = float(acq["Custom"].attrs["Fibre Refractive Index"])
+        gl = float(acq.attrs["GaugeLength"])
+        nx = int(raw.attrs["NumberOfLoci"])
+    return AcquisitionMetadata(
+        fs=fs, dx=dx, nx=nx, ns=ns, n=n, gauge_length=gl,
+        scale_factor=optasense_scale_factor(n, gl), interrogator="optasense",
+    )
+
+
+@functools.partial(jax.jit, static_argnames=())
+def raw2strain(trace: jnp.ndarray, scale_factor: float) -> jnp.ndarray:
+    """Demean each channel and scale raw counts to strain
+    (data_handle.py:157-177) — on device, one fused kernel."""
+    trace = trace - jnp.mean(trace, axis=-1, keepdims=True)
+    return trace * scale_factor
+
+
+@dataclass
+class StrainBlock:
+    """A loaded ``[channel x time]`` strain block with its axes.
+
+    Iterable as ``(trace, tx, dist, t0_utc)`` for drop-in parity with the
+    reference ``load_das_data`` return convention (data_handle.py:180-230).
+    """
+
+    trace: jnp.ndarray
+    tx: np.ndarray
+    dist: np.ndarray
+    t0_utc: datetime
+    metadata: AcquisitionMetadata | None = None
+    selection: ChannelSelection | None = None
+
+    def __iter__(self):
+        return iter((self.trace, self.tx, self.dist, self.t0_utc))
+
+
+def load_das_data(
+    filename: str,
+    selected_channels,
+    metadata,
+    *,
+    dtype=jnp.float32,
+    device=None,
+) -> StrainBlock:
+    """Load a strided channel selection as strain, with time/distance axes.
+
+    Parity: reference ``data_handle.load_das_data`` (data_handle.py:180-230),
+    except the conditioning runs on device and the default dtype is float32
+    (strain magnitudes ~1e-9 are comfortably inside f32's normal range; pass
+    ``dtype=jnp.float64`` on CPU for bit-level parity studies).
+    """
+    if not os.path.exists(filename):
+        raise FileNotFoundError(f"File {filename} not found")
+    meta = as_metadata(metadata)
+    sel = ChannelSelection.from_list(selected_channels)
+
+    with h5py.File(filename, "r") as fp:
+        raw = fp["Acquisition/Raw[0]/RawData"]
+        block = raw[sel.start : sel.stop : sel.step, :]
+        t_us = int(fp["Acquisition/Raw[0]/RawDataTime"][0])
+
+    arr = jnp.asarray(block, dtype=dtype)
+    if device is not None:
+        arr = jax.device_put(arr, device)
+    trace = raw2strain(arr, meta.scale_factor)
+
+    nnx, nns = trace.shape
+    tx = np.arange(nns) / meta.fs
+    dist = (np.arange(nnx) * sel.step + sel.start) * meta.dx
+    t0 = datetime.fromtimestamp(t_us * 1e-6, tz=timezone.utc).replace(tzinfo=None)
+    return StrainBlock(trace=trace, tx=tx, dist=dist, t0_utc=t0, metadata=meta, selection=sel)
+
+
+def write_optasense(
+    filepath: str,
+    raw_data: np.ndarray,
+    fs: float,
+    dx: float,
+    gauge_length: float = 51.05,
+    n: float = 1.4681,
+    t0_us: int = 1_636_000_000_000_000,
+) -> str:
+    """Write a ``[channel x time]`` int raw block in the OptaSense HDF5
+    schema the reader (and the reference) expects. Used for synthetic
+    fixtures and data export."""
+    raw_data = np.asarray(raw_data)
+    nx, ns = raw_data.shape
+    with h5py.File(filepath, "w") as fp:
+        acq = fp.create_group("Acquisition")
+        acq.attrs["SpatialSamplingInterval"] = dx
+        acq.attrs["GaugeLength"] = gauge_length
+        custom = acq.create_group("Custom")
+        custom.attrs["Fibre Refractive Index"] = n
+        raw = acq.create_group("Raw[0]")
+        raw.attrs["OutputDataRate"] = fs
+        raw.attrs["NumberOfLoci"] = nx
+        raw.create_dataset("RawData", data=raw_data.astype(np.int32))
+        times = (t0_us + np.arange(ns) * 1e6 / fs).astype(np.int64)
+        dt = raw.create_dataset("RawDataTime", data=times)
+        dt.attrs["Count"] = ns
+    return filepath

@@ -1,0 +1,97 @@
+"""Synthetic DAS data generation (fixtures, recall tests, benchmarks).
+
+The reference has no offline test asset — integration runs against a live
+OOI URL (SURVEY.md §4). This module synthesizes physically plausible DAS
+scenes: background noise plus fin-whale-style chirps arriving across the
+array at a chosen apparent speed, written through the real OptaSense-schema
+writer so the full ingest path is exercised offline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from ..config import AcquisitionMetadata
+from .hdf5 import optasense_scale_factor, write_optasense
+
+
+@dataclass
+class SyntheticCall:
+    """One injected call: onset at ``t0`` [s] at the channel nearest
+    ``x0_m`` [m], propagating across channels at ``speed`` [m/s]."""
+
+    t0: float
+    x0_m: float
+    fmin: float = 17.8
+    fmax: float = 28.8
+    duration: float = 0.68
+    amplitude: float = 1.0
+    speed: float = 1500.0
+
+
+@dataclass
+class SyntheticScene:
+    fs: float = 200.0
+    dx: float = 2.042
+    nx: int = 512
+    ns: int = 12000
+    gauge_length: float = 51.05
+    n: float = 1.4681
+    noise_rms: float = 0.05
+    calls: Sequence[SyntheticCall] = field(default_factory=list)
+    seed: int = 0
+
+    @property
+    def metadata(self) -> AcquisitionMetadata:
+        return AcquisitionMetadata(
+            fs=self.fs, dx=self.dx, nx=self.nx, ns=self.ns, n=self.n,
+            gauge_length=self.gauge_length,
+            scale_factor=optasense_scale_factor(self.n, self.gauge_length),
+            interrogator="optasense",
+        )
+
+
+def _hyperbolic_chirp(fmin, fmax, duration, fs):
+    t = np.arange(0, duration, 1 / fs)
+    f0, f1, t1 = fmax, fmin, duration
+    sing = -f1 * t1 / (f0 - f1)
+    y = np.cos(2 * np.pi * (-sing * f0) * np.log(np.abs(1 - t / sing)))
+    return y * np.hanning(len(y))
+
+
+def synthesize_scene(scene: SyntheticScene) -> np.ndarray:
+    """Render the scene as a float ``[channel x time]`` amplitude block
+    (unit scale; convert to raw counts with ``to_raw_counts``)."""
+    rng = np.random.default_rng(scene.seed)
+    data = scene.noise_rms * rng.standard_normal((scene.nx, scene.ns))
+    x = np.arange(scene.nx) * scene.dx
+    for call in scene.calls:
+        chirp = _hyperbolic_chirp(call.fmin, call.fmax, call.duration, scene.fs) * call.amplitude
+        delays = call.t0 + np.abs(x - call.x0_m) / call.speed
+        onsets = np.round(delays * scene.fs).astype(int)
+        L = len(chirp)
+        for ch in range(scene.nx):
+            s = onsets[ch]
+            if 0 <= s and s + L <= scene.ns:
+                data[ch, s : s + L] += chirp
+    return data
+
+
+def to_raw_counts(amplitude_block: np.ndarray, metadata: AcquisitionMetadata, counts_scale: float = 1000.0) -> np.ndarray:
+    """Quantize a unit-scale amplitude block to int32 raw counts such that
+    loading + ``raw2strain`` recovers ``amplitude_block * counts_scale *
+    scale_factor`` strain."""
+    return np.round(amplitude_block * counts_scale).astype(np.int32)
+
+
+def write_synthetic_file(filepath: str, scene: SyntheticScene, counts_scale: float = 1000.0) -> str:
+    """Render a scene and write it through the OptaSense-schema HDF5 writer."""
+    block = synthesize_scene(scene)
+    raw = to_raw_counts(block, scene.metadata, counts_scale)
+    return write_optasense(
+        filepath, raw, fs=scene.fs, dx=scene.dx,
+        gauge_length=scene.gauge_length, n=scene.n,
+    )

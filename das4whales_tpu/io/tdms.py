@@ -1,0 +1,301 @@
+"""Minimal native TDMS (National Instruments) reader/writer.
+
+The reference reads Silixa interrogator files through the third-party
+``nptdms`` wheel (data_handle.py:113-154). That package is not part of this
+framework's dependency set, so this module implements the TDMS container
+format directly from the public specification: segment lead-ins, ToC flags,
+object metadata with raw-data indexes, property tables, and contiguous
+(non-interleaved) raw data chunks — everything a Silixa DAS file uses.
+
+Scope (asserted, not silently wrong): little-endian, non-interleaved,
+non-DAQmx segments with numeric channel data; properties of numeric,
+string, bool and timestamp types.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta
+from typing import Dict
+
+import numpy as np
+
+# ToC flag bits
+_TOC_METADATA = 1 << 1
+_TOC_NEW_OBJ_LIST = 1 << 2
+_TOC_RAW_DATA = 1 << 3
+_TOC_INTERLEAVED = 1 << 5
+_TOC_BIG_ENDIAN = 1 << 6
+_TOC_DAQMX = 1 << 7
+
+# TDMS dtype ids -> numpy dtypes
+_TDMS_DTYPES = {
+    1: np.dtype("int8"),
+    2: np.dtype("int16"),
+    3: np.dtype("int32"),
+    4: np.dtype("int64"),
+    5: np.dtype("uint8"),
+    6: np.dtype("uint16"),
+    7: np.dtype("uint32"),
+    8: np.dtype("uint64"),
+    9: np.dtype("float32"),
+    10: np.dtype("float64"),
+}
+_NUMPY_TO_TDMS = {v: k for k, v in _TDMS_DTYPES.items()}
+_TYPE_STRING = 0x20
+_TYPE_BOOL = 0x21
+_TYPE_TIMESTAMP = 0x44
+
+_EPOCH_1904 = datetime(1904, 1, 1)
+
+
+def _parse_path(path: str):
+    """TDMS object path -> tuple of unescaped components.
+
+    ``/`` is the file root, ``/'Group'`` a group, ``/'Group'/'Chan'`` a
+    channel; quotes inside names are doubled.
+    """
+    if path == "/":
+        return ()
+    parts = []
+    assert path.startswith("/"), path
+    rest = path[1:]
+    while rest:
+        assert rest.startswith("'"), path
+        end = 1
+        while True:
+            end = rest.index("'", end)
+            if rest[end : end + 2] == "''":
+                end += 2
+                continue
+            break
+        parts.append(rest[1:end].replace("''", "'"))
+        rest = rest[end + 1 :]
+        if rest.startswith("/"):
+            rest = rest[1:]
+    return tuple(parts)
+
+
+class _Cursor:
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def read(self, n: int) -> bytes:
+        out = self.buf[self.pos : self.pos + n]
+        if len(out) != n:
+            raise EOFError("truncated TDMS data")
+        self.pos += n
+        return out
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.read(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.read(8))[0]
+
+    def string(self) -> str:
+        return self.read(self.u32()).decode("utf-8")
+
+    def value(self, type_id: int):
+        if type_id in _TDMS_DTYPES:
+            dt = _TDMS_DTYPES[type_id]
+            return np.frombuffer(self.read(dt.itemsize), dtype=dt)[0].item()
+        if type_id == _TYPE_STRING:
+            return self.string()
+        if type_id == _TYPE_BOOL:
+            return bool(self.read(1)[0])
+        if type_id == _TYPE_TIMESTAMP:
+            frac = struct.unpack("<Q", self.read(8))[0]
+            secs = struct.unpack("<q", self.read(8))[0]
+            return _EPOCH_1904 + timedelta(seconds=secs + frac / 2**64)
+        raise NotImplementedError(f"TDMS property type 0x{type_id:x}")
+
+
+@dataclass
+class _RawIndex:
+    dtype: np.dtype
+    n_values: int
+
+
+@dataclass
+class TdmsObject:
+    path: tuple
+    properties: dict = field(default_factory=dict)
+    data_parts: list = field(default_factory=list)
+
+    @property
+    def data(self) -> np.ndarray:
+        if not self.data_parts:
+            return np.empty(0)
+        return np.concatenate(self.data_parts)
+
+
+class TdmsFile:
+    """Parsed TDMS file: root/group properties and channel data arrays."""
+
+    def __init__(self):
+        self.objects: Dict[tuple, TdmsObject] = {}
+
+    @property
+    def properties(self) -> dict:
+        obj = self.objects.get(())
+        return obj.properties if obj else {}
+
+    def groups(self):
+        return sorted({p[0] for p in self.objects if len(p) >= 1})
+
+    def channels(self, group: str):
+        return [p[1] for p in sorted(self.objects) if len(p) == 2 and p[0] == group]
+
+    def __getitem__(self, group: str) -> Dict[str, np.ndarray]:
+        return {c: self.objects[(group, c)].data for c in self.channels(group)}
+
+    def group_properties(self, group: str) -> dict:
+        obj = self.objects.get((group,))
+        return obj.properties if obj else {}
+
+    @classmethod
+    def read(cls, filepath: str) -> "TdmsFile":
+        with open(filepath, "rb") as f:
+            buf = f.read()
+        self = cls()
+        pos = 0
+        # raw-data object order + indexes carry over between segments
+        active: list[tuple] = []
+        indexes: Dict[tuple, _RawIndex] = {}
+        while pos < len(buf):
+            if len(buf) - pos < 28:
+                break  # trailing padding
+            tag, toc, _version, next_off, raw_off = struct.unpack(
+                "<4sIIQQ", buf[pos : pos + 28]
+            )
+            if tag != b"TDSm":
+                raise ValueError(f"bad TDMS segment tag at byte {pos}")
+            if toc & _TOC_BIG_ENDIAN:
+                raise NotImplementedError("big-endian TDMS segments")
+            if toc & _TOC_DAQMX:
+                raise NotImplementedError("DAQmx raw data")
+            data_start = pos + 28 + raw_off
+            seg_end = pos + 28 + next_off
+            if next_off == 0xFFFFFFFFFFFFFFFF:  # crashed writer: data to EOF
+                seg_end = len(buf)
+
+            if toc & _TOC_METADATA:
+                cur = _Cursor(buf, pos + 28)
+                if toc & _TOC_NEW_OBJ_LIST:
+                    active = []
+                n_objects = cur.u32()
+                for _ in range(n_objects):
+                    path = _parse_path(cur.string())
+                    obj = self.objects.setdefault(path, TdmsObject(path))
+                    idx_len = cur.u32()
+                    if idx_len == 0xFFFFFFFF:
+                        pass  # no raw data for this object
+                    elif idx_len == 0x00000000:
+                        if path not in active:
+                            active.append(path)  # reuse previous index
+                    else:
+                        type_id = cur.u32()
+                        dim = cur.u32()
+                        n_values = cur.u64()
+                        if type_id == _TYPE_STRING:
+                            cur.u64()  # total bytes; string channels unsupported below
+                            raise NotImplementedError("string channel data")
+                        if dim != 1:
+                            raise NotImplementedError("multi-dimensional TDMS arrays")
+                        indexes[path] = _RawIndex(_TDMS_DTYPES[type_id], n_values)
+                        if path not in active:
+                            active.append(path)
+                    n_props = cur.u32()
+                    for _ in range(n_props):
+                        name = cur.string()
+                        type_id = cur.u32()
+                        obj.properties[name] = cur.value(type_id)
+
+            if toc & _TOC_RAW_DATA:
+                if toc & _TOC_INTERLEAVED:
+                    raise NotImplementedError("interleaved raw data")
+                chunk = sum(
+                    indexes[p].dtype.itemsize * indexes[p].n_values for p in active
+                )
+                dpos = data_start
+                while chunk > 0 and dpos + chunk <= seg_end:
+                    for p in active:
+                        ix = indexes[p]
+                        nbytes = ix.dtype.itemsize * ix.n_values
+                        arr = np.frombuffer(buf[dpos : dpos + nbytes], dtype=ix.dtype)
+                        self.objects[p].data_parts.append(arr)
+                        dpos += nbytes
+            pos = seg_end
+        return self
+
+
+def write_tdms(
+    filepath: str,
+    root_properties: dict,
+    group: str,
+    channels: Dict[str, np.ndarray],
+) -> str:
+    """Write a single-segment, non-interleaved TDMS file (for fixtures,
+    tests, and data export)."""
+
+    def enc_string(s: str) -> bytes:
+        raw = s.encode("utf-8")
+        return struct.pack("<I", len(raw)) + raw
+
+    def enc_path(parts) -> bytes:
+        if not parts:
+            return enc_string("/")
+        return enc_string("/" + "/".join("'" + p.replace("'", "''") + "'" for p in parts))
+
+    def enc_prop(name: str, value) -> bytes:
+        out = enc_string(name)
+        if isinstance(value, bool):
+            return out + struct.pack("<I", _TYPE_BOOL) + struct.pack("<B", value)
+        if isinstance(value, (int, np.integer)):
+            return out + struct.pack("<I", 3) + struct.pack("<i", int(value))
+        if isinstance(value, (float, np.floating)):
+            return out + struct.pack("<I", 10) + struct.pack("<d", float(value))
+        if isinstance(value, str):
+            return out + struct.pack("<I", _TYPE_STRING) + enc_string(value)
+        if isinstance(value, datetime):
+            delta = value - _EPOCH_1904
+            secs = int(delta.total_seconds())
+            frac = int((delta.total_seconds() - secs) * 2**64)
+            return out + struct.pack("<I", _TYPE_TIMESTAMP) + struct.pack("<Qq", frac, secs)
+        raise TypeError(f"unsupported property type {type(value)}")
+
+    meta = b""
+    n_objects = 2 + len(channels)
+    meta += struct.pack("<I", n_objects)
+    # root object with properties
+    meta += enc_path(())
+    meta += struct.pack("<I", 0xFFFFFFFF)
+    meta += struct.pack("<I", len(root_properties))
+    for k, v in root_properties.items():
+        meta += enc_prop(k, v)
+    # group object
+    meta += enc_path((group,))
+    meta += struct.pack("<I", 0xFFFFFFFF)
+    meta += struct.pack("<I", 0)
+    # channel objects
+    raw = b""
+    for name, arr in channels.items():
+        arr = np.ascontiguousarray(arr)
+        type_id = _NUMPY_TO_TDMS[arr.dtype]
+        meta += enc_path((group, name))
+        meta += struct.pack("<I", 20)  # index block length
+        meta += struct.pack("<I", type_id)
+        meta += struct.pack("<I", 1)
+        meta += struct.pack("<Q", arr.size)
+        meta += struct.pack("<I", 0)  # no channel properties
+        raw += arr.tobytes()
+
+    toc = _TOC_METADATA | _TOC_NEW_OBJ_LIST | _TOC_RAW_DATA
+    lead = struct.pack("<4sIIQQ", b"TDSm", toc, 4713, len(meta) + len(raw), len(meta))
+    with open(filepath, "wb") as f:
+        f.write(lead + meta + raw)
+    return filepath

@@ -1,3 +1,4 @@
 """Detector families and localization models."""
 
-from . import templates  # noqa: F401
+from . import matched_filter, templates  # noqa: F401
+from .matched_filter import MatchedFilterDetector  # noqa: F401

@@ -163,6 +163,24 @@ def find_peaks_prominence(x: jnp.ndarray, threshold) -> jnp.ndarray:
     return mask & (prom >= threshold)
 
 
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def find_peaks_prominence_blocked(x: jnp.ndarray, threshold, block_size: int = 1024) -> jnp.ndarray:
+    """Channel-blocked variant of ``find_peaks_prominence`` for large
+    ``[channel x time]`` inputs.
+
+    The prominence descent holds O(log N) window tables per channel; at the
+    full 22k-channel OOI selection that transient would exceed HBM, so
+    channels are processed in blocks via ``lax.map`` (sequential over
+    blocks, fully vectorized within a block).
+    """
+    c, n = x.shape
+    nblocks = -(-c // block_size)
+    pad = nblocks * block_size - c
+    xp = jnp.pad(x, ((0, pad), (0, 0))).reshape(nblocks, block_size, n)
+    out = jax.lax.map(lambda blk: find_peaks_prominence(blk, threshold), xp)
+    return out.reshape(nblocks * block_size, n)[:c]
+
+
 # ---------------------------------------------------------------------------
 # Reference-shaped outputs (host side)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,128 @@
+"""I/O layer tests: OptaSense HDF5 round trip, TDMS parser, synthesis."""
+
+import numpy as np
+import pytest
+
+from das4whales_tpu import io as dio
+from das4whales_tpu.config import AcquisitionMetadata, ChannelSelection
+from das4whales_tpu.io import synth, tdms
+from das4whales_tpu.io.interrogators import (
+    get_acquisition_parameters,
+    get_metadata_silixa,
+    load_silixa_data,
+    silixa_scale_factor,
+)
+
+
+def test_hello_world(capsys):
+    dio.hello_world_das_package()
+    assert "das4whales" in capsys.readouterr().out
+
+
+def test_bad_interrogator_raises():
+    with pytest.raises(ValueError):
+        get_acquisition_parameters("nope.h5", interrogator="quantum")
+
+
+def test_missing_file_raises():
+    with pytest.raises(FileNotFoundError):
+        get_acquisition_parameters("definitely_missing.h5", interrogator="optasense")
+    with pytest.raises(FileNotFoundError):
+        dio.load_das_data("definitely_missing.h5", [0, 10, 1], AcquisitionMetadata(200, 2, 10, 10))
+
+
+def test_mars_alcatel_are_informative_stubs():
+    with pytest.raises(NotImplementedError):
+        get_acquisition_parameters(__file__, interrogator="mars")
+    with pytest.raises(NotImplementedError):
+        get_acquisition_parameters(__file__, interrogator="alcatel")
+
+
+def test_optasense_roundtrip(tmp_path, rng):
+    raw = rng.integers(-30000, 30000, size=(64, 500)).astype(np.int32)
+    path = dio.write_optasense(str(tmp_path / "synthetic.h5"), raw, fs=200.0, dx=2.042)
+    meta = get_acquisition_parameters(path, "optasense")
+    assert meta.fs == 200.0
+    assert meta.nx == 64 and meta.ns == 500
+    assert meta.scale_factor == pytest.approx(
+        (2 * np.pi) / 2**16 * 1550.12e-9 / (0.78 * 4 * np.pi * meta.n * meta.gauge_length)
+    )
+
+    sel = [4, 60, 2]
+    block = dio.load_das_data(path, sel, meta, dtype=np.float64)
+    trace, tx, dist, t0 = block
+    want = raw[4:60:2].astype(np.float64)
+    want = (want - want.mean(axis=1, keepdims=True)) * meta.scale_factor
+    np.testing.assert_allclose(np.asarray(trace), want, rtol=1e-12)
+    assert tx[1] - tx[0] == pytest.approx(1 / 200.0)
+    np.testing.assert_allclose(dist, (np.arange(28) * 2 + 4) * meta.dx)
+    assert t0.year >= 2021
+
+
+def test_channel_selection_helpers():
+    sel = ChannelSelection.from_meters(20000, 65000, 5, dx=2.042)
+    assert sel.to_list() == [int(20000 // 2.042), int(65000 // 2.042), int(5 // 2.042)]
+    assert ChannelSelection(0, 10, 3).n_channels() == 4
+
+
+def test_tdms_roundtrip(tmp_path, rng):
+    props = {
+        "SamplingFrequency[Hz]": 1000.0,
+        "SpatialResolution[m]": 1.02,
+        "FibreIndex": 1.468,
+        "GaugeLength": 10.0,
+        "name": "synthetic silixa",
+        "ok": True,
+        "count": 7,
+    }
+    chans = {str(i): rng.integers(-2000, 2000, size=300).astype(np.int16) for i in range(8)}
+    path = tdms.write_tdms(str(tmp_path / "synthetic.tdms"), props, "Measurement", chans)
+
+    f = tdms.TdmsFile.read(path)
+    assert f.properties["SamplingFrequency[Hz]"] == 1000.0
+    assert f.properties["name"] == "synthetic silixa"
+    assert f.properties["ok"] is True
+    assert f.properties["count"] == 7
+    got = f["Measurement"]
+    assert sorted(got) == sorted(chans)
+    for k in chans:
+        np.testing.assert_array_equal(got[k], chans[k])
+
+    meta = get_metadata_silixa(path)
+    assert meta.fs == 1000.0 and meta.nx == 8 and meta.ns == 300
+    assert meta.scale_factor == pytest.approx(silixa_scale_factor(1000.0, 10.0))
+    data = load_silixa_data(path)
+    assert data.shape == (8, 300)
+
+
+def test_tdms_multisegment(tmp_path, rng):
+    """Segments appended with 'same as previous' raw index concatenate."""
+    import struct
+
+    chans = {"0": rng.standard_normal(100).astype(np.float64)}
+    path = tdms.write_tdms(str(tmp_path / "m.tdms"), {}, "G", chans)
+    # hand-append a raw-data-only segment reusing the previous object list
+    extra = rng.standard_normal(100).astype(np.float64)
+    raw = extra.tobytes()
+    lead = struct.pack("<4sIIQQ", b"TDSm", (1 << 3), 4713, len(raw), 0)
+    with open(path, "ab") as fh:
+        fh.write(lead + raw)
+    f = tdms.TdmsFile.read(path)
+    np.testing.assert_array_equal(f["G"]["0"], np.concatenate([chans["0"], extra]))
+
+
+def test_synthetic_scene_recovery(tmp_path):
+    scene = synth.SyntheticScene(
+        nx=64, ns=3000, noise_rms=0.02,
+        calls=[synth.SyntheticCall(t0=3.0, x0_m=60.0, amplitude=1.0)],
+    )
+    path = synth.write_synthetic_file(str(tmp_path / "scene.h5"), scene)
+    meta = get_acquisition_parameters(path, "optasense")
+    block = dio.load_das_data(path, [0, 64, 1], meta, dtype=np.float64)
+    trace = np.asarray(block.trace)
+    assert trace.shape == (64, 3000)
+    # the injected call dominates the envelope at the injection channel
+    ch = int(round(60.0 / scene.dx))
+    onset = int(3.0 * scene.fs)
+    seg = trace[ch, onset : onset + int(0.68 * scene.fs)]
+    assert np.std(seg) > 5 * np.std(trace[ch, :onset])
