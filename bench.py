@@ -1,0 +1,150 @@
+"""Benchmark: f-k filter + matched-filter detection on a 60 s OOI-scale block.
+
+Measures the flagship pipeline (bandpass -> hybrid_ninf f-k filter -> two
+matched-filter cross-correlograms -> envelope -> prominence peak picking)
+on an OOI-RCA-shaped synthetic block (~22k channels x 12k samples, 200 Hz,
+60 s — tutorial.md:56-62) on the available accelerator, against the
+reference's CPU algorithm stack (scipy filtfilt + numpy fft2 + per-channel
+FFT correlation + scipy find_peaks) timed on a channel subset and scaled
+linearly (every stage is linear in channels).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <ch*samples/s/chip>, "unit": ..., "vs_baseline": <speedup vs CPU>}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _make_block(nx, ns, fs, dx, seed=0):
+    """OOI-scale noise block with a handful of injected fin-call chirps."""
+    rng = np.random.default_rng(seed)
+    block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
+    t = np.arange(0, 0.68, 1 / fs)
+    f0, f1 = 28.8, 17.8
+    sing = -f1 * 0.68 / (f0 - f1)
+    chirp = (np.cos(2 * np.pi * (-sing * f0) * np.log(np.abs(1 - t / sing))) * np.hanning(len(t))).astype(np.float32)
+    for k in range(6):
+        ch = (k + 1) * nx // 8
+        onset = int((4 + 8 * k) * fs)
+        if onset + len(chirp) < ns:
+            block[ch, onset : onset + len(chirp)] += 5e-9 * chirp
+    return block
+
+
+def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048):
+    import jax
+    import jax.numpy as jnp
+
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    meta = AcquisitionMetadata(fs=fs, dx=dx, nx=nx, ns=ns)
+    det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns), peak_block=peak_block)
+    block = _make_block(nx, ns, fs, dx)
+    x = jax.device_put(jnp.asarray(block))
+
+    def run():
+        res = det(x)
+        jax.block_until_ready(res.trf_fk)
+        return res
+
+    run()  # compile (design reuse means this cost amortizes across files)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = run()
+        times.append(time.perf_counter() - t0)
+    n_picks = sum(int(v.shape[1]) for v in res.picks.values())
+    return min(times), n_picks, str(jax.devices()[0])
+
+
+def bench_cpu_reference(nx, ns, fs, dx):
+    """The reference's algorithm stack (scipy/numpy, float64) on [nx x ns]."""
+    import scipy.signal as sp
+
+    from das4whales_tpu.ops import fk as fk_ops
+
+    block = _make_block(nx, ns, fs, dx).astype(np.float64)
+    mask = fk_ops.hybrid_ninf_filter_design(
+        (nx, ns), [0, nx, 1], dx, fs, 1350, 1450, 3300, 3450, 14, 30
+    )
+    time_v = np.arange(ns) / fs
+    t = np.arange(0, 0.68, 1 / fs)
+    f0, f1 = 28.8, 17.8
+    sing = -f1 * 0.68 / (f0 - f1)
+    tmpl = np.zeros(ns)
+    c = np.cos(2 * np.pi * (-sing * f0) * np.log(np.abs(1 - t / sing))) * np.hanning(len(t))
+    tmpl[: len(c)] = c
+
+    t0 = time.perf_counter()
+    b, a = sp.butter(8, [14 / (fs / 2), 30 / (fs / 2)], "bp")
+    tr = sp.filtfilt(b, a, block, axis=1)
+    fk_spec = np.fft.fftshift(np.fft.fft2(tr))
+    trf = np.fft.ifft2(np.fft.ifftshift(fk_spec * mask)).real
+    norm = (trf - trf.mean(axis=1, keepdims=True)) / np.max(np.abs(trf), axis=1, keepdims=True)
+    tn = (tmpl - tmpl.mean()) / np.max(np.abs(tmpl))
+    n_picks = 0
+    for _ in range(2):  # HF + LF templates
+        corr = np.empty_like(norm)
+        for i in range(nx):
+            corr[i] = sp.correlate(norm[i], tn, mode="full", method="fft")[ns - 1 :]
+        thres = 0.45 * corr.max()
+        for i in range(nx):
+            env = np.abs(sp.hilbert(corr[i]))
+            n_picks += len(sp.find_peaks(env, prominence=thres)[0])
+    return time.perf_counter() - t0, n_picks
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="small shapes (CI smoke)")
+    ap.add_argument("--no-cpu", action="store_true", help="skip CPU baseline; report cached ratio")
+    args = ap.parse_args()
+
+    fs, dx = 200.0, 2.042
+    if args.quick:
+        nx, ns, cpu_nx = 1024, 3000, 256
+        peak_block = 512
+    else:
+        # 22050 = 2 * 3^2 * 5^2 * 7^2 (FFT-friendly), ~= the 22039-channel
+        # canonical OOI working selection (tutorial.md:71-88)
+        nx, ns, cpu_nx = 22050, 12000, 1050
+        peak_block = 2048
+
+    wall, n_picks, device = bench_tpu(nx, ns, fs, dx, peak_block=peak_block)
+    value = nx * ns / wall
+
+    if args.no_cpu:
+        cpu_rate = None
+        vs = float("nan")
+    else:
+        cpu_wall, _ = bench_cpu_reference(cpu_nx, ns, fs, dx)
+        cpu_rate = cpu_nx * ns / cpu_wall  # linear-in-channels extrapolation
+        vs = value / cpu_rate
+
+    print(
+        json.dumps(
+            {
+                "metric": "OOI-RCA 60s chunk: fk_filter+mf_detect wall-clock; ch*samples/s/chip",
+                "value": round(value, 1),
+                "unit": "ch*samples/s/chip",
+                "vs_baseline": round(vs, 2),
+                "wall_s": round(wall, 4),
+                "shape": [nx, ns],
+                "n_picks": n_picks,
+                "device": device,
+                "cpu_ref_rate": round(cpu_rate, 1) if cpu_rate else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

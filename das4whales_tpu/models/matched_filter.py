@@ -160,12 +160,16 @@ class MatchedFilterDetector:
         bp_band=(14.0, 30.0),
         templates: Dict[str, CallTemplateConfig] | None = None,
         peak_block: int = 1024,
+        pick_mode: str = "sparse",
+        max_peaks: int = 256,
     ):
         self.metadata = as_metadata(metadata)
         self.design = design_matched_filter(
             trace_shape, selected_channels, self.metadata, fk_config, bp_band, templates
         )
         self.peak_block = peak_block
+        self.pick_mode = pick_mode
+        self.max_peaks = max_peaks
         self._mask_dev = jnp.asarray(self.design.fk_mask)
         self._gain_dev = jnp.asarray(self.design.bp_gain)
         self._templates_dev = jnp.asarray(self.design.templates)
@@ -188,12 +192,29 @@ class MatchedFilterDetector:
         names = self.design.template_names
         correlograms, peak_masks, picks, thr_out, snr = {}, {}, {}, {}, {}
         for i, name in enumerate(names):
-            mask = peak_ops.find_peaks_prominence_blocked(env[i], thresholds[i], self.peak_block)
-            mask_np = np.asarray(mask)
             correlograms[name] = corr[i]
-            peak_masks[name] = mask_np
-            picks[name] = peak_ops.convert_pick_times(mask_np)
             thr_out[name] = float(thresholds[i])
+            if self.pick_mode == "sparse":
+                # TPU production route: envelope peaks are nonnegative, so
+                # the height prefilter is exact (see ops.peaks)
+                pos, _, _, sel, saturated = peak_ops.find_peaks_sparse(
+                    env[i], thresholds[i], max_peaks=self.max_peaks
+                )
+                picks[name] = peak_ops.sparse_to_pick_times(pos, sel)
+                if bool(np.asarray(saturated).any()):
+                    import warnings
+
+                    warnings.warn(
+                        f"peak capacity saturated for template {name}; "
+                        f"raise max_peaks (now {self.max_peaks})"
+                    )
+            else:
+                mask = peak_ops.find_peaks_prominence_blocked(
+                    env[i], thresholds[i], self.peak_block
+                )
+                mask_np = np.asarray(mask)
+                peak_masks[name] = mask_np
+                picks[name] = peak_ops.convert_pick_times(mask_np)
             if with_snr:
                 snr[name] = spectral.snr_tr_array(corr[i], env=True)
         return MatchedFilterResult(

@@ -163,6 +163,151 @@ def find_peaks_prominence(x: jnp.ndarray, threshold) -> jnp.ndarray:
     return mask & (prom >= threshold)
 
 
+# ---------------------------------------------------------------------------
+# Sparse candidate path (TPU production route)
+# ---------------------------------------------------------------------------
+#
+# The dense binary-lifting descent above is exact for every sample but leans
+# on per-element gathers along the time axis, which TPUs execute serially
+# (~40 ms per gather on a v5e for a 3M-element block — measured). The
+# detection pipelines only ever need peaks above a threshold, so the
+# production route is: (1) plateau-aware local maxima (cheap, elementwise),
+# (2) top-k tallest candidates per channel, (3) *exact* scipy prominences
+# for those candidates via a sqrt-decomposition of the time axis — block
+# max/min tables plus per-candidate elementwise scans over the block axis
+# and within-block offsets. The only gathers are contiguous block-row
+# fetches over the ~sqrt(N) block axis, which the TPU handles well.
+#
+# For nonnegative signals (Hilbert envelopes — what the reference picks on,
+# detect.py:192) a peak's prominence never exceeds its height, so
+# prefiltering candidates by height >= threshold is exact: the result
+# equals scipy.find_peaks(x, prominence=threshold) whenever the number of
+# candidates above threshold fits in max_peaks (saturation is reported).
+
+
+def _block_stats(x: jnp.ndarray, nb: int):
+    """Reshape [..., N] -> [..., B, nb] with per-block max/min."""
+    n = x.shape[-1]
+    b = -(-n // nb)
+    pad = b * nb - n
+    if pad:
+        xpad = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)], constant_values=-jnp.inf)
+    else:
+        xpad = x
+    xb = xpad.reshape(x.shape[:-1] + (b, nb))
+    return xb, jnp.max(xb, axis=-1), jnp.where(jnp.isneginf(xb), jnp.inf, xb).min(axis=-1)
+
+
+def _one_sided_base_min_sparse(xb, block_max, block_min, pos, h, nb: int):
+    """Exact scipy left-base minimum for candidate positions.
+
+    ``xb``: [C, B, nb] blocked signal; ``pos``: [C, K] candidate sample
+    indices; ``h``: [C, K] candidate heights. Returns [C, K] minima of
+    x over (j, pos] where j is the last index < pos with x[j] > h.
+    """
+    C, B, _ = xb.shape
+    bp = pos // nb                      # [C, K] block of the candidate
+    tp = pos % nb
+    offs = jnp.arange(nb)               # [nb]
+    blocks = jnp.arange(B)              # [B]
+
+    def block_gather(idx):
+        # [C, 1, B, nb] gathered at [C, K, 1, 1] along the block axis
+        return jnp.take_along_axis(xb[:, None], idx[:, :, None, None], axis=2)[:, :, 0, :]
+
+    # own-block values: contiguous row gather over the (small) block axis
+    ob = block_gather(bp)  # [C, K, nb]
+
+    inf = jnp.asarray(jnp.inf, xb.dtype)
+    big = jnp.asarray(jnp.finfo(xb.dtype).max, xb.dtype)
+
+    # 1) previous-greater inside the candidate's own block (offsets < tp)
+    m_own_mask = (offs < tp[..., None]) & (ob > h[..., None])
+    has_own = m_own_mask.any(axis=-1)
+    j_own = jnp.max(jnp.where(m_own_mask, offs, -1), axis=-1)              # [C,K]
+    seg_own = (offs > j_own[..., None]) & (offs <= tp[..., None])
+    min_own = jnp.min(jnp.where(seg_own, ob, inf), axis=-1)
+
+    # 2) previous-greater in an earlier block
+    bmask = (blocks < bp[..., None]) & (block_max[:, None, :] > h[..., None])  # [C,K,B]
+    has_blk = bmask.any(axis=-1)
+    bprev = jnp.max(jnp.where(bmask, blocks, 0), axis=-1)                  # [C,K]
+    pb = block_gather(bprev)
+    pb_mask = pb > h[..., None]
+    j_pb = jnp.max(jnp.where(pb_mask, offs, -1), axis=-1)
+    min_pb_suffix = jnp.min(jnp.where(offs > j_pb[..., None], pb, inf), axis=-1)
+
+    # full blocks strictly between bprev and bp (or all blocks < bp if no
+    # previous-greater exists)
+    lo = jnp.where(has_blk, bprev, -1)
+    mid_mask = (blocks > lo[..., None]) & (blocks < bp[..., None])
+    min_mid = jnp.min(jnp.where(mid_mask, block_min[:, None, :], inf), axis=-1)
+
+    # own-block prefix up to and including the candidate
+    min_own_prefix = jnp.min(jnp.where(offs <= tp[..., None], ob, inf), axis=-1)
+
+    other = jnp.minimum(jnp.where(has_blk, min_pb_suffix, big), jnp.minimum(min_mid, min_own_prefix))
+    return jnp.where(has_own, min_own, other)
+
+
+@functools.partial(jax.jit, static_argnames=("max_peaks", "nb"))
+def find_peaks_sparse(
+    x: jnp.ndarray,
+    threshold,
+    max_peaks: int = 256,
+    nb: int = 128,
+    prefilter_height: bool = True,
+):
+    """Threshold-prominence peak picking via the sparse candidate route.
+
+    Returns ``(positions, heights, prominences, selected, saturated)``:
+    ``positions`` [C, max_peaks] sample indices sorted ascending per channel
+    (invalid slots hold N), ``selected`` the boolean validity mask, and
+    ``saturated`` a per-channel flag set when more than ``max_peaks`` local
+    maxima passed the height prefilter (only then can picks be missed).
+
+    For nonnegative inputs this matches
+    ``scipy.signal.find_peaks(x, prominence=threshold)`` exactly whenever
+    ``saturated`` is False.
+    """
+    C, N = x.shape
+    thr = jnp.asarray(threshold)
+    thr_bc = jnp.broadcast_to(thr, (C,)) if thr.ndim <= 1 else thr
+
+    mask = local_maxima(x)
+    if prefilter_height:
+        mask = mask & (x >= thr_bc[:, None])
+    cand_scores = jnp.where(mask, x, -jnp.inf)
+    heights, pos = jax.lax.top_k(cand_scores, max_peaks)          # [C, K]
+    valid = jnp.isfinite(heights)
+    n_cand = jnp.sum(mask, axis=-1)
+    saturated = n_cand > max_peaks
+
+    xb, bmax, bmin = _block_stats(x, nb)
+    left_min = _one_sided_base_min_sparse(xb, bmax, bmin, pos, heights, nb)
+    xf = jnp.flip(x, axis=-1)
+    xbf, bmaxf, bminf = _block_stats(xf, nb)
+    right_min = _one_sided_base_min_sparse(xbf, bmaxf, bminf, (N - 1) - pos, heights, nb)
+
+    prom = heights - jnp.maximum(left_min, right_min)
+    selected = valid & (prom >= thr_bc[:, None])
+
+    # order by position per channel for reference-compatible pick lists
+    pos_sorted_key = jnp.where(selected, pos, N)
+    order = jnp.argsort(pos_sorted_key, axis=-1)
+    take = lambda a: jnp.take_along_axis(a, order, axis=-1)
+    return take(pos_sorted_key), take(heights), take(prom), take(selected), saturated
+
+
+def sparse_to_pick_times(positions, selected) -> np.ndarray:
+    """Sparse picks -> stacked (channel_idx[], time_idx[]) array in the
+    reference's row-major order (detect.py:277-303)."""
+    positions = np.asarray(positions)
+    selected = np.asarray(selected)
+    chan, slot = np.nonzero(selected)
+    return np.asarray([chan, positions[chan, slot]])
+
+
 @functools.partial(jax.jit, static_argnames=("block_size",))
 def find_peaks_prominence_blocked(x: jnp.ndarray, threshold, block_size: int = 1024) -> jnp.ndarray:
     """Channel-blocked variant of ``find_peaks_prominence`` for large

@@ -1,0 +1,6 @@
+"""Multi-chip scale-out: meshes, distributed FFT, sharded pipelines."""
+
+from . import fft, mesh, pipeline  # noqa: F401
+from .mesh import make_mesh, shard_block  # noqa: F401
+from .fft import sharded_fk_apply  # noqa: F401
+from .pipeline import make_sharded_mf_step  # noqa: F401

@@ -1,0 +1,127 @@
+"""Distributed 2-D FFT and f-k filtering over a channel-sharded mesh.
+
+The reference's single biggest array op is the monolithic
+``fft2``/``ifft2`` of the 22k x 12k strain block (dsp.py:748-786). To scale
+that across chips the channel axis is sharded and the transform runs as a
+pencil decomposition (cf. "Large-Scale Discrete Fourier Transform on TPUs",
+PAPERS.md):
+
+1. rFFT along time — fully local (time axis unsharded);
+2. ``all_to_all`` transpose over the ``channel`` mesh axis: the local
+   frequency axis is scattered, the channel axis gathered;
+3. FFT along channels — now fully local;
+4. multiply the (frequency-sharded) f-k mask;
+5. inverse channel FFT, ``all_to_all`` back, inverse rFFT.
+
+The only communication is the two all_to_alls, which ride ICI. The result
+is *exactly* the single-device ``fk_filter_apply_rfft`` (no chunk-boundary
+error — contrast with the reference's per-chunk dask filtering whose
+boundary error is acknowledged at tools.py:166).
+
+Functions ending in ``_local`` are shard_map bodies (take an ``axis_name``);
+the top-level helpers wrap them for direct use.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+def _axis_size(axis_name: str) -> int:
+    return jax.lax.axis_size(axis_name)
+
+
+def prepare_mask_half(mask: np.ndarray, nns: int, pad_f: int = 0) -> np.ndarray:
+    """Hermitian-symmetrize an fftshifted ``[k x f]`` mask and keep the
+    rfft half ``[k x nns//2+1]`` (fft order along k), optionally zero-padded
+    along f to a multiple of the mesh axis size."""
+    mu = np.fft.ifftshift(np.asarray(mask))
+    pr = mu
+    for ax in (0, 1):
+        pr = np.roll(np.flip(pr, axis=ax), 1, axis=ax)
+    msym = 0.5 * (mu + pr)
+    half = msym[:, : nns // 2 + 1]
+    if pad_f:
+        half = np.pad(half, ((0, 0), (0, pad_f)))
+    return half
+
+
+def fk_apply_local(trace: jnp.ndarray, mask_half: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """shard_map body: f-k filter a channel-sharded ``[..., C/P, T]`` block
+    against an f-sharded half mask ``[..., K, F_pad/P]``."""
+    p = _axis_size(axis_name)
+    nns = trace.shape[-1]
+    nf = nns // 2 + 1
+    pad_f = (-nf) % p
+
+    spec = jnp.fft.rfft(trace, axis=-1)  # [..., C/P, F]
+    if pad_f:
+        widths = [(0, 0)] * (spec.ndim - 1) + [(0, pad_f)]
+        spec = jnp.pad(spec, widths)
+    # transpose: scatter F, gather C  -> [..., C, Fp/P]
+    spec = jax.lax.all_to_all(
+        spec, axis_name, split_axis=spec.ndim - 1, concat_axis=spec.ndim - 2, tiled=True
+    )
+    spec = jnp.fft.fft(spec, axis=-2)
+    spec = spec * mask_half.astype(spec.real.dtype)
+    spec = jnp.fft.ifft(spec, axis=-2)
+    # transpose back: scatter C, gather F -> [..., C/P, Fp]
+    spec = jax.lax.all_to_all(
+        spec, axis_name, split_axis=spec.ndim - 2, concat_axis=spec.ndim - 1, tiled=True
+    )
+    if pad_f:
+        spec = spec[..., :nf]
+    out = jnp.fft.irfft(spec, n=nns, axis=-1)
+    return out.real.astype(trace.dtype)
+
+
+def sharded_fk_apply(
+    trace, mask, mesh: Mesh, channel_axis: str = "channel"
+):
+    """f-k filter a ``[channel x time]`` block sharded over ``channel_axis``.
+
+    ``mask`` is the fftshifted design matrix from any ops.fk designer.
+    Numerically identical to ``ops.fk.fk_filter_apply_rfft`` on one device.
+    """
+    nnx, nns = trace.shape
+    p = mesh.shape[channel_axis]
+    if nnx % p:
+        raise ValueError(f"channel count {nnx} not divisible by mesh axis {channel_axis}={p}")
+    nf = nns // 2 + 1
+    pad_f = (-nf) % p
+    mask_half = jnp.asarray(prepare_mask_half(mask, nns, pad_f))
+
+    fn = shard_map(
+        functools.partial(fk_apply_local, axis_name=channel_axis),
+        mesh=mesh,
+        in_specs=(P(channel_axis, None), P(None, channel_axis)),
+        out_specs=P(channel_axis, None),
+    )
+    return jax.jit(fn)(trace, mask_half)
+
+
+def pfft2(x, mesh: Mesh, channel_axis: str = "channel"):
+    """Distributed complex 2-D FFT of a channel-sharded block; returns the
+    spectrum sharded over the *frequency* axis (natural pencil layout
+    ``[K, F/P]`` restored to ``[K/P is not applied; layout [K, F] sharded
+    on F]``)."""
+    nnx, nns = x.shape
+    p = mesh.shape[channel_axis]
+    if nnx % p or nns % p:
+        raise ValueError("both axes must be divisible by the mesh axis size")
+
+    def body(xs):
+        s = jnp.fft.fft(xs, axis=-1)
+        s = jax.lax.all_to_all(s, channel_axis, split_axis=1, concat_axis=0, tiled=True)
+        return jnp.fft.fft(s, axis=-2)
+
+    fn = shard_map(
+        body, mesh=mesh, in_specs=(P(channel_axis, None),), out_specs=P(None, channel_axis)
+    )
+    return jax.jit(fn)(x)

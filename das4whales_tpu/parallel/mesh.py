@@ -1,0 +1,64 @@
+"""Device-mesh construction for sharded DAS pipelines.
+
+The reference's entire scale-out story is dask ``map_blocks`` chunking on a
+single machine (dask_wrap.py, tools.py; SURVEY.md §2.4). The TPU-native
+equivalent is a ``jax.sharding.Mesh`` with named axes:
+
+* ``file``  — data parallelism over independent 60 s files (the natural DP
+  unit, SURVEY.md §5.8);
+* ``channel`` — sequence/space parallelism over the channel axis within a
+  file (collectives ride ICI inside a slice).
+
+On a single host the same meshes are testable with
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` CPU devices.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    shape: Sequence[int] | None = None,
+    axis_names: Sequence[str] = ("file", "channel"),
+    devices=None,
+) -> Mesh:
+    """Build a mesh over the available devices.
+
+    With ``shape=None`` all devices go to the *last* axis (pure channel
+    parallelism) — the common single-slice layout for one large file.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if shape is None:
+        shape = (1,) * (len(axis_names) - 1) + (n,)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def channel_sharding(mesh: Mesh, channel_axis: str = "channel", ndim: int = 2) -> NamedSharding:
+    """NamedSharding placing the channel (leading) axis of a
+    ``[channel x time]`` block across ``channel_axis``."""
+    spec = [None] * ndim
+    spec[0] = channel_axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def file_channel_sharding(mesh: Mesh, file_axis: str = "file", channel_axis: str = "channel") -> NamedSharding:
+    """Sharding for a ``[file x channel x time]`` batch."""
+    return NamedSharding(mesh, P(file_axis, channel_axis, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_block(x, mesh: Mesh, channel_axis: str = "channel"):
+    """Place a ``[channel x time]`` array on the mesh, channel-sharded."""
+    return jax.device_put(x, channel_sharding(mesh, channel_axis, np.ndim(x)))

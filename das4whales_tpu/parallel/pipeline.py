@@ -1,0 +1,122 @@
+"""Sharded end-to-end detection steps (multi-file x multi-chip).
+
+Composes the full matched-filter pipeline inside one ``shard_map`` over a
+``(file, channel)`` mesh: data parallelism over independent files, channel
+parallelism within each file, with the two ``all_to_all`` transposes of the
+distributed f-k transform as the only communication (plus one ``pmax`` for
+the per-file threshold). This is the TPU-native replacement of the
+reference's per-file serial loop + dask chunking (SURVEY.md §2.4, §5.8).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops import peaks as peak_ops
+from ..ops import spectral, xcorr
+from ..ops.filters import _odd_ext
+from .fft import fk_apply_local, prepare_mask_half
+
+
+def _bp_local(trace: jnp.ndarray, gain: jnp.ndarray, padlen: int) -> jnp.ndarray:
+    """Zero-phase bandpass along time (local to every shard)."""
+    ext = _odd_ext(trace, padlen)
+    spec = jnp.fft.rfft(ext, axis=-1)
+    y = jnp.fft.irfft(spec * gain.astype(spec.real.dtype), n=ext.shape[-1], axis=-1)
+    return y[..., padlen:-padlen].astype(trace.dtype)
+
+
+def _mf_body(
+    trace, mask_half, bp_gain, templates, *, bp_padlen: int, channel_axis: str,
+    relative_threshold: float, hf_factor: float,
+):
+    """shard_map body. Local shapes: trace [B/Pf, C/Pc, T], mask_half
+    [K, Fpad/Pc], bp_gain [Fext], templates [nT, T]."""
+    tr_bp = _bp_local(trace, bp_gain, bp_padlen)
+    trf_fk = fk_apply_local(tr_bp, mask_half, channel_axis)
+
+    corr = jax.vmap(lambda t: xcorr.compute_cross_correlogram(trf_fk, t))(templates)
+    env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
+
+    # per-file threshold: global max over templates/channels/time of the file
+    local_max = jnp.max(corr, axis=(0, 2, 3))                     # [B/Pf]
+    file_max = jax.lax.pmax(local_max, channel_axis)
+    thres = relative_threshold * file_max                          # [B/Pf]
+    factors = jnp.ones(templates.shape[0]).at[0].set(hf_factor)    # HF first
+    thr = thres[None, :, None, None] * factors[:, None, None, None]
+
+    peak_mask = peak_ops.local_maxima(env) & (
+        peak_ops.peak_prominences_dense(env) >= thr
+    )
+    return trf_fk, corr, env, peak_mask, thres
+
+
+def make_sharded_mf_step(
+    design,
+    mesh: Mesh,
+    file_axis: str = "file",
+    channel_axis: str = "channel",
+    relative_threshold: float = 0.5,
+    hf_factor: float = 0.9,
+):
+    """Build the jitted multi-chip detection step for a
+    ``[file x channel x time]`` batch.
+
+    ``design`` is a ``models.matched_filter.MatchedFilterDesign``. The
+    returned callable maps a sharded batch to
+    ``(trf_fk, correlograms, envelopes, peak_masks, thresholds)`` with
+    matching shardings — ready for ``jax.jit`` ahead-of-time compilation on
+    any mesh shape, including the single-chip degenerate mesh.
+    """
+    nnx, nns = design.trace_shape
+    pc = mesh.shape[channel_axis]
+    if nnx % pc:
+        raise ValueError(f"channels {nnx} not divisible by {channel_axis}={pc}")
+    nf = nns // 2 + 1
+    pad_f = (-nf) % pc
+    mask_half = jnp.asarray(prepare_mask_half(design.fk_mask, nns, pad_f), dtype=jnp.float32)
+    bp_gain = jnp.asarray(design.bp_gain)
+    templates = jnp.asarray(design.templates)
+
+    body = functools.partial(
+        _mf_body,
+        bp_padlen=design.bp_padlen,
+        channel_axis=channel_axis,
+        relative_threshold=relative_threshold,
+        hf_factor=hf_factor,
+    )
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(file_axis, channel_axis, None),   # trace batch
+            P(None, channel_axis),              # mask (f-sharded)
+            P(None),                            # bp gain (replicated)
+            P(None, None),                      # templates (replicated)
+        ),
+        out_specs=(
+            P(file_axis, channel_axis, None),         # trf_fk
+            P(None, file_axis, channel_axis, None),   # corr
+            P(None, file_axis, channel_axis, None),   # env
+            P(None, file_axis, channel_axis, None),   # peak mask
+            P(file_axis),                             # thresholds
+        ),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(trace_batch):
+        return fn(trace_batch, mask_half, bp_gain, templates)
+
+    return step
+
+
+def input_sharding(mesh: Mesh, file_axis="file", channel_axis="channel") -> NamedSharding:
+    return NamedSharding(mesh, P(file_axis, channel_axis, None))

@@ -46,6 +46,52 @@ def test_find_peaks_batched(rng):
         np.testing.assert_array_equal(np.nonzero(mask[i])[0], want)
 
 
+def test_find_peaks_sparse_matches_scipy_on_envelopes(rng):
+    """Sparse candidate route == scipy on nonnegative envelope-like data."""
+    import scipy.signal as ssp
+
+    sos = ssp.butter(4, [0.1, 0.3], "bp", output="sos")
+    for trial in range(4):
+        noise = ssp.sosfiltfilt(sos, rng.standard_normal(900))
+        x = np.abs(ssp.hilbert(noise))  # band-limited envelope, like the pipeline
+        thr = np.percentile(x, 75) * 0.5
+        pos, heights, prom, sel, saturated = peaks.find_peaks_sparse(
+            x[None, :], thr, max_peaks=128, nb=64
+        )
+        assert not bool(np.asarray(saturated)[0])
+        got = np.asarray(pos)[0][np.asarray(sel)[0]]
+        want = ssp.find_peaks(x, prominence=thr)[0]
+        np.testing.assert_array_equal(np.sort(got), want)
+        # prominences agree too
+        want_prom = ssp.peak_prominences(x, want)[0]
+        got_prom = np.asarray(prom)[0][np.asarray(sel)[0]]
+        np.testing.assert_allclose(np.sort(got_prom), np.sort(want_prom), atol=1e-9)
+
+
+def test_find_peaks_sparse_batched_and_ordering(rng):
+    x = np.abs(rng.standard_normal((5, 400))) + 0.01
+    thr = 0.8
+    pos, _, _, sel, saturated = peaks.find_peaks_sparse(x, thr, max_peaks=256, nb=32)
+    assert not np.asarray(saturated).any()
+    tp = peaks.sparse_to_pick_times(pos, sel)
+    import scipy.signal as ssp
+
+    want_ch, want_t = [], []
+    for i in range(5):
+        pk = ssp.find_peaks(x[i], prominence=thr)[0]
+        want_ch.extend([i] * len(pk))
+        want_t.extend(pk)
+    np.testing.assert_array_equal(tp, np.asarray([want_ch, want_t]))
+
+
+def test_find_peaks_sparse_saturation_flag(rng):
+    # alternating sawtooth: every other sample is a peak -> saturates K=8
+    x = np.tile(np.array([0.0, 1.0]), 50)[None, :] + 0.001 * rng.standard_normal((1, 100))
+    x = np.abs(x)
+    _, _, _, _, saturated = peaks.find_peaks_sparse(x, 0.0001, max_peaks=8, nb=16)
+    assert bool(np.asarray(saturated)[0])
+
+
 def test_pick_list_helpers(rng):
     x = rng.standard_normal((3, 200))
     mask = np.asarray(peaks.find_peaks_prominence(x, 0.5))
