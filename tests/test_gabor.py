@@ -1,0 +1,82 @@
+"""End-to-end test of the Gabor/image detector family on a synthetic scene."""
+
+import numpy as np
+import pytest
+
+from das4whales_tpu.config import AcquisitionMetadata
+from das4whales_tpu.models import templates
+from das4whales_tpu.models.gabor import GaborDetector, design_gabor, gabor_mask, masked_matched_filter
+
+
+def _scene(rng, nx=128, ns=3000, fs=200.0, dx=8.0):
+    time = np.arange(ns) / fs
+    x = np.arange(nx) * dx
+    call = np.asarray(templates.gen_template_fincall(time, fs, 17.8, 28.8, 0.68))
+    data = 0.02 * rng.standard_normal((nx, ns))
+    L = int(0.68 * fs)
+    onsets = (5.0 + np.abs(x - 400.0) / 1500.0) * fs
+    for ch in range(nx):
+        s = int(onsets[ch])
+        data[ch, s : s + L] += call[:L]
+    return data.astype(np.float32), time, x
+
+
+def test_gabor_mask_highlights_call_region(rng):
+    meta = AcquisitionMetadata(fs=200.0, dx=8.0, nx=128, ns=3000)
+    data, time, x = _scene(rng)
+    design = design_gabor(meta, [0, 128, 1], bin_factor=0.25, threshold1=None, threshold2=None)
+
+    # data-driven thresholds for the synthetic scene: the script's absolute
+    # constants (9100/150) are tuned for the OOI file
+    from das4whales_tpu.models.gabor import _gabor_score
+    from das4whales_tpu.ops import image as img_ops
+    import jax.numpy as jnp
+
+    image = img_ops.trace2image(jnp.asarray(data))
+    imagebin = img_ops.binning(image, 0.25, 0.25)
+    score = np.asarray(_gabor_score(imagebin, jnp.asarray(design.gabor_up, np.float32), jnp.asarray(design.gabor_down, np.float32)))
+    design.threshold1 = float(np.percentile(score, 98))
+    design.threshold2 = 1.0
+
+    score_out, mask, masked_tr = gabor_mask(jnp.asarray(data), design)
+    mask = np.asarray(mask)
+    assert mask.any(), "mask is empty"
+    masked_tr = np.asarray(masked_tr)
+    # energy concentrates at call onset region after masking
+    onset_col = int(5.0 * 200.0)
+    in_window = np.abs(masked_tr[:, onset_col - 100 : onset_col + 400]).mean()
+    out_window = np.abs(masked_tr[:, :800]).mean()
+    assert in_window > 2 * out_window
+
+
+def test_masked_matched_filter_matches_scipy(rng):
+    import scipy.signal as sp
+
+    x = np.abs(rng.standard_normal((6, 500)))
+    x[2] = 0.0  # fully masked channel stays zero
+    note = rng.standard_normal(81)
+    got = np.asarray(masked_matched_filter(x, note))
+    for i in range(6):
+        if np.max(x[i]) > 0:
+            want = sp.correlate(x[i] / np.max(x[i]), note, mode="same", method="fft")
+            np.testing.assert_allclose(got[i], want, atol=1e-6)
+        else:
+            np.testing.assert_allclose(got[i], 0.0, atol=1e-12)
+
+
+def test_gabor_detector_end_to_end(rng):
+    meta = AcquisitionMetadata(fs=200.0, dx=8.0, nx=128, ns=3000)
+    data, time, x = _scene(rng)
+    det = GaborDetector(meta, [0, 128, 1], bin_factor=0.25, threshold1=2000.0, threshold2=1.0)
+    out = det(data)
+    assert out["masked_trace"].shape == data.shape
+    picks = out["picks"]["HF"]
+    assert picks.shape[0] == 2
+    assert picks.shape[1] > 0
+    # picks concentrate near the true onsets (within 0.5 s)
+    onset_samples = (5.0 + np.abs(np.arange(128) * 8.0 - 400.0) / 1500.0) * 200.0
+    matched = 0
+    for ch, t in zip(picks[0], picks[1]):
+        if abs(t - onset_samples[ch]) < 100:
+            matched += 1
+    assert matched / picks.shape[1] > 0.5

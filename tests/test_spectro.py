@@ -1,0 +1,111 @@
+"""Parity + recall tests for the spectrogram-correlation detector."""
+
+import numpy as np
+import scipy.signal as sp
+import pytest
+
+from das4whales_tpu.models import spectro, templates
+from das4whales_tpu.config import SPECTRO_HF_KERNEL
+
+
+def test_sliced_spectrogram_shapes_and_norm(rng):
+    fs = 200.0
+    x = rng.standard_normal(4000)
+    p, ff, tt = spectro.sliced_spectrogram(x, fs, 10.0, 35.0, 160, 8)
+    assert np.all((ff >= 10.0) & (ff <= 35.0))
+    assert p.shape == (len(ff), len(tt))
+    # normalization is by the full (pre-slice) spectrogram max
+    assert np.asarray(p).max() <= 1.0 + 1e-9
+
+
+def test_buildkernel_matches_reference_math():
+    fs = 200.0
+    dur, f0, f1, bw = 0.8, 27.0, 17.0, 4.0
+    tt = np.linspace(0, 60, 1501)
+    ff = np.linspace(5.0, 39.0, 28)
+    tvec, fvec, ker = spectro.buildkernel(f0, f1, bw, dur, ff, tt, fs, 5.0, 39.0)
+    # time support equals bins inside one call duration (detect.py:456)
+    n_expected = np.size(np.nonzero((tt < dur * 8) & (tt > dur * 7)))
+    assert ker.shape == (len(ff), n_expected)
+    # hat function oracle at a probe bin
+    j = n_expected // 2
+    contour = f0 * f1 * dur / ((f0 - f1) * tvec[j] + f1 * dur)
+    x = ff - contour
+    want = (1 - x**2 / bw**2) * np.exp(-(x**2) / (2 * bw**2)) * np.hanning(n_expected)[j]
+    np.testing.assert_allclose(ker[:, j], want, atol=1e-12)
+    # kernel peaks on the contour
+    assert abs(ff[ker[:, j].argmax()] - contour) <= (ff[1] - ff[0])
+
+
+def test_xcorr2d_matches_scipy(rng):
+    spec = np.abs(rng.standard_normal((28, 300)))
+    ker = rng.standard_normal((28, 21))
+    got = np.asarray(spectro.xcorr2d(spec, ker))
+    conv = sp.fftconvolve(spec, np.flip(ker, axis=1), mode="same", axes=1)
+    want = np.sum(conv, axis=0)
+    want[want < 0] = 0
+    want /= np.median(spec) * ker.shape[1]
+    np.testing.assert_allclose(got, want, atol=1e-8)
+
+
+def test_nxcorr2d_matches_scipy(rng):
+    spec = np.abs(rng.standard_normal((16, 100)))
+    ker = rng.standard_normal((5, 9))
+    got = np.asarray(spectro.nxcorr2d(spec, ker))
+    corr = sp.correlate(spec, ker, mode="same", method="fft") / (
+        np.std(spec) * np.std(ker) * spec.shape[1]
+    )
+    want = np.max(corr, axis=0)
+    np.testing.assert_allclose(got, want, atol=1e-8)
+
+
+def test_spectrocorr_recall(rng):
+    """Injected chirps produce correlogram maxima at the right channel/time."""
+    fs = 200.0
+    ns, nx = 6000, 24
+    time = np.arange(ns) / fs
+    call = np.asarray(templates.gen_template_fincall(time, fs, 17.0, 27.0, 0.8))
+    data = 0.05 * rng.standard_normal((nx, ns))
+    chan, t_on = 17, 10.0
+    onset = int(t_on * fs)
+    L = int(0.8 * fs)
+    data[chan, onset : onset + L] += call[:L]
+
+    corr = np.asarray(
+        spectro.compute_cross_correlogram_spectrocorr(
+            data, fs, (14.0, 30.0), SPECTRO_HF_KERNEL, 0.8, 0.95
+        )
+    )
+    assert corr.shape[0] == nx
+    ci, ti = np.unravel_index(np.argmax(corr), corr.shape)
+    assert ci == chan
+    spectro_fs = corr.shape[1] / time[-1]
+    # kernel correlation peaks near the call center
+    assert abs(ti / spectro_fs - (t_on + 0.4)) < 1.0
+
+
+def test_effective_band_widening():
+    fmin, fmax = spectro.effective_band((14.0, 30.0), SPECTRO_HF_KERNEL)
+    # f1=17, bw=4: fmax-f1=13 >= 8 -> unchanged; f0=27, f0-fmin=13 >= 8 -> unchanged
+    assert (fmin, fmax) == (14.0, 30.0)
+    fmin2, fmax2 = spectro.effective_band((25.0, 18.0), SPECTRO_HF_KERNEL)
+    assert fmax2 == 17.0 + 3 * 4.0
+    assert fmin2 == 27.0 - 3 * 4.0
+
+
+def test_xcorr_sliding_matches_loop_oracle(rng):
+    Sxx = np.abs(rng.standard_normal((12, 80)))
+    ker = rng.standard_normal((12, 9))
+    t = np.linspace(0, 10, 80)
+    got_t, got_v = spectro.xcorr_sliding(t, None, Sxx, np.zeros(9), np.zeros(12), ker)
+    # loop oracle (detect.py:637-645 semantics)
+    n, m = Sxx.shape[1], ker.shape[1]
+    want = np.zeros(n - m + 1)
+    for i in range(n - m + 1):
+        want[i] = np.sum(ker * Sxx[:, i : i + m])
+    want /= np.median(Sxx) * m
+    want[0] = 0
+    want[-1] = 0
+    want[want < 0] = 0
+    np.testing.assert_allclose(np.asarray(got_v), want, atol=1e-8)
+    np.testing.assert_allclose(got_t, t[int(m / 2) - 1 : -int(np.ceil(m / 2))])
