@@ -13,6 +13,7 @@ __version__ = "0.1.0"
 
 from . import config  # noqa: F401
 from . import io  # noqa: F401
+from . import loc  # noqa: F401
 from . import ops  # noqa: F401
 from . import models  # noqa: F401
 from .config import AcquisitionMetadata, ChannelSelection  # noqa: F401

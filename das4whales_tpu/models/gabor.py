@@ -76,12 +76,10 @@ def gabor_mask(
     score = _gabor_score(imagebin, up, down)
     binary = (score > design.threshold1).astype(trf_fk.dtype)
     mask_binned = _gabor_score(binary, up, down) > design.threshold2
-    # upsample the mask back to full resolution and apply smoothly
-    mask_full = img_ops.binning(
-        mask_binned.astype(trf_fk.dtype), 1 / design.bin_factor, 1 / design.bin_factor
+    # upsample the mask back to the exact trace shape in one resize
+    mask_full = jax.image.resize(
+        mask_binned.astype(trf_fk.dtype), trf_fk.shape, method="linear", antialias=False
     )
-    # match the exact trace shape (integer rounding of the two resizes)
-    mask_full = jax.image.resize(mask_full, trf_fk.shape, method="linear", antialias=False)
     masked_tr = img_ops.apply_smooth_mask(trf_fk, mask_full)
     return score, mask_binned, masked_tr
 
@@ -140,8 +138,8 @@ class GaborDetector:
         maxv = max(float(jnp.max(c)) for c in correlograms.values())
         thres = 0.5 * maxv
         picks = {}
-        for i, (name, corr) in enumerate(correlograms.items()):
-            thr = thres * (0.9 if i == 0 else 1.0)  # HF picked at 0.9*thres
+        for name, corr in correlograms.items():
+            thr = thres * (0.9 if name == "HF" else 1.0)  # HF picked at 0.9*thres
             env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
             pos, _, _, sel, _ = peak_ops.find_peaks_sparse(env, thr, max_peaks=self.max_peaks)
             picks[name] = peak_ops.sparse_to_pick_times(pos, sel)

@@ -96,7 +96,10 @@ def nxcorr2d(spectro: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
     (reference ``detect.nxcorr2d``, detect.py:544-576)."""
     flipped = jnp.flip(jnp.flip(kernel, axis=-1), axis=-2)
     conv = xcorr.fftconvolve2d_same(spectro, flipped)
-    corr = conv / (jnp.std(spectro) * jnp.std(kernel) * spectro.shape[-1])
+    # per-channel std over the (freq, time) plane — the reference computes
+    # std of each channel's spectrogram inside its channel loop
+    std = jnp.std(spectro, axis=(-2, -1), keepdims=True)
+    corr = conv / (std * jnp.std(kernel) * spectro.shape[-1])
     return jnp.max(corr, axis=-2)
 
 

@@ -88,12 +88,24 @@ def gabor_filt_design(theta_c0: float, ksize: int = 100, sigma: float = 4.0,
     return up, np.flipud(up)
 
 
-@jax.jit
-def filter2d_same(img: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+@functools.partial(jax.jit, static_argnames=("border",))
+def filter2d_same(img: jnp.ndarray, kernel: jnp.ndarray, border: str = "reflect") -> jnp.ndarray:
     """Correlation (cv2.filter2D semantics: the kernel is NOT flipped) in
-    'same' geometry. FFT-based, batched over leading axes."""
+    'same' geometry. FFT-based, batched over leading axes.
+
+    ``border='reflect'`` (numpy reflect == cv2's default BORDER_REFLECT_101)
+    matches ``cv2.filter2D``'s edge handling; ``border='constant'``
+    zero-pads like scipy's fftconvolve."""
     flipped = jnp.flip(jnp.flip(kernel, axis=-1), axis=-2)
-    return fftconvolve2d_same(img, flipped)
+    if border == "constant":
+        return fftconvolve2d_same(img, flipped)
+    m1, m2 = kernel.shape[-2], kernel.shape[-1]
+    a1, a2 = (m1 - 1) // 2, (m2 - 1) // 2
+    b1, b2 = m1 - 1 - a1, m2 - 1 - a2
+    pad = [(0, 0)] * (img.ndim - 2) + [(a1, b1), (a2, b2)]
+    x = jnp.pad(img, pad, mode=border)
+    out = fftconvolve2d_same(x, flipped)
+    return out[..., b1 : b1 + img.shape[-2], b2 : b2 + img.shape[-1]]
 
 
 def _gaussian_1d(sigma: float, radius: int) -> np.ndarray:
@@ -319,7 +331,7 @@ def hough_lines(
     acc = np.asarray(acc)
 
     lines = []
-    for ti, ri in zip(*np.nonzero(acc.T >= threshold)[::-1] if False else np.nonzero(acc >= threshold)):
+    for ti, ri in zip(*np.nonzero(acc >= threshold)):
         theta, rho = thetas[ti], rhos[ri]
         c, s = np.cos(theta), np.sin(theta)
         # walk the line across the image
@@ -405,5 +417,9 @@ def apply_smooth_mask(array: jnp.ndarray, mask: jnp.ndarray, sigma: float = 1.5,
     ``compat=True`` reproduces the reference's raw-mask multiply.
     """
     smoothed = gaussian_filter2d(mask.astype(array.dtype), sigma)
-    smoothed = (smoothed - jnp.min(smoothed)) / (jnp.max(smoothed) - jnp.min(smoothed))
+    # Uniform mask (e.g. no detections -> all zeros): min == max, so the
+    # renormalization would be 0/0; pass the mask through unscaled instead.
+    lo, hi = jnp.min(smoothed), jnp.max(smoothed)
+    span = hi - lo
+    smoothed = jnp.where(span > 0, (smoothed - lo) / jnp.where(span > 0, span, 1.0), smoothed)
     return array * (mask if compat else smoothed)

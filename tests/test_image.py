@@ -48,9 +48,14 @@ def test_filter2d_matches_cv2(rng):
     cv2 = pytest.importorskip("cv2")
     img = rng.standard_normal((40, 50))
     ker = rng.standard_normal((7, 7))
+    # default border matches cv2.filter2D's default (BORDER_REFLECT_101)
     got = np.asarray(im.filter2d_same(img, ker))
-    want = cv2.filter2D(img, cv2.CV_64F, ker, borderType=cv2.BORDER_CONSTANT)
+    want = cv2.filter2D(img, cv2.CV_64F, ker)
     np.testing.assert_allclose(got, want, atol=1e-8)
+    # constant border matches BORDER_CONSTANT
+    got_c = np.asarray(im.filter2d_same(img, ker, border="constant"))
+    want_c = cv2.filter2D(img, cv2.CV_64F, ker, borderType=cv2.BORDER_CONSTANT)
+    np.testing.assert_allclose(got_c, want_c, atol=1e-8)
 
 
 def test_gaussian_filter2d_matches_scipy(rng):
@@ -163,3 +168,14 @@ def test_apply_smooth_mask_fixed_and_compat(rng):
     # fixed path multiplies by the smoothed mask: nonzero just outside the box
     assert abs(fixed[4, 12]) > 0
     assert compat[4, 12] == 0
+
+
+def test_apply_smooth_mask_uniform_mask_no_nan(rng):
+    """All-zero mask (quiet data, no detections) must yield zeros, not NaN
+    from the 0/0 renormalization."""
+    x = rng.standard_normal((20, 30))
+    zeros = np.asarray(im.apply_smooth_mask(x, np.zeros((20, 30))))
+    np.testing.assert_allclose(zeros, 0.0, atol=1e-12)
+    ones = np.asarray(im.apply_smooth_mask(x, np.ones((20, 30))))
+    assert np.all(np.isfinite(ones))
+    np.testing.assert_allclose(ones, x, atol=1e-8)

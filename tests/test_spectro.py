@@ -59,6 +59,23 @@ def test_nxcorr2d_matches_scipy(rng):
     np.testing.assert_allclose(got, want, atol=1e-8)
 
 
+def test_nxcorr2d_batched_normalizes_per_channel(rng):
+    """Batched input must normalize each channel by its own spectrogram std
+    (the reference computes std inside its per-channel loop) — a loud
+    channel must not suppress a quiet one."""
+    spec = np.abs(rng.standard_normal((3, 16, 100)))
+    spec[0] *= 50.0  # loud channel
+    ker = rng.standard_normal((5, 9))
+    got = np.asarray(spectro.nxcorr2d(spec, ker))
+    for c in range(3):
+        want = np.max(
+            sp.correlate(spec[c], ker, mode="same", method="fft")
+            / (np.std(spec[c]) * np.std(ker) * spec.shape[-1]),
+            axis=0,
+        )
+        np.testing.assert_allclose(got[c], want, atol=1e-8)
+
+
 def test_spectrocorr_recall(rng):
     """Injected chirps produce correlogram maxima at the right channel/time."""
     fs = 200.0
