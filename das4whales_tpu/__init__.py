@@ -17,3 +17,15 @@ from . import loc  # noqa: F401
 from . import ops  # noqa: F401
 from . import models  # noqa: F401
 from .config import AcquisitionMetadata, ChannelSelection  # noqa: F401
+
+
+def __getattr__(name):
+    # viz needs matplotlib (an optional extra); load it on first use so a
+    # base install can run detection/localization headless.
+    if name in ("viz", "parallel", "workflows"):
+        import importlib
+
+        module = importlib.import_module(f".{name}", __name__)
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

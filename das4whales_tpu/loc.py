@@ -214,7 +214,8 @@ def mirror_guesses(cable_pos, Ti, c0, offsets=(500.0, 2000.0, 6000.0), z0=-60.0)
     for d in offsets:
         for sgn in (+1.0, -1.0):
             xy = p0[:2] + sgn * d * norm
-            guesses.append(np.array([xy[0], xy[1], z0, t0]))
+            # a source at range d emits ~d/c0 before the earliest arrival
+            guesses.append(np.array([xy[0], xy[1], z0, t0 - d / c0]))
     return np.stack(guesses)
 
 

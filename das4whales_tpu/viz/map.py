@@ -1,0 +1,218 @@
+"""Bathymetry maps, cable geometry plots, and geodesy (reference map.py:20-310).
+
+Deviations from the reference, on purpose:
+
+- ``load_bathymetry`` honors its ``filepath`` argument (the reference
+  hardcodes ``'data/GMRT_OOI_RCA_Cables.grd'`` and ignores the argument,
+  map.py:65) and reads GMT/GMRT ``.grd`` grids with scipy's netCDF-3
+  reader or h5py (netCDF-4) — no xarray dependency.
+- ``latlon_to_utm`` implements the WGS84 → UTM transverse-Mercator
+  projection natively (Snyder/Krüger series, <1 mm in-zone error) instead
+  of calling pyproj (reference map.py:302-309); it is vectorized over
+  arrays.
+- Plot functions return the Figure and only ``show()`` on interactive
+  backends (see viz.plot).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+import matplotlib.pyplot as plt
+import matplotlib.colors as mcolors
+from matplotlib.colors import LightSource
+
+from .plot import _finish
+
+# WGS84 ellipsoid
+_A = 6378137.0
+_F = 1.0 / 298.257223563
+_E2 = _F * (2.0 - _F)
+_EP2 = _E2 / (1.0 - _E2)
+_K0 = 0.9996
+
+
+def load_cable_coordinates(filepath: str, dx: float) -> pd.DataFrame:
+    """Cable geometry CSV → dataframe with chan_idx/lat/lon/depth/chan_m
+    columns (reference map.py:20-42)."""
+    df = pd.read_csv(filepath, delimiter=",", header=None)
+    df.columns = ["chan_idx", "lat", "lon", "depth"]
+    df["chan_m"] = df["chan_idx"] * dx
+    return df
+
+
+def _read_grd(filepath: str):
+    """Read a GMT/GMRT ``.grd`` grid (netCDF-3 classic or netCDF-4/HDF5).
+
+    Returns ``(z, x_range, y_range, dimension)`` as host arrays.
+    """
+    try:
+        from scipy.io import netcdf_file
+
+        with netcdf_file(filepath, "r", mmap=False) as ds:
+            return (
+                ds.variables["z"][:].copy(),
+                ds.variables["x_range"][:].copy(),
+                ds.variables["y_range"][:].copy(),
+                ds.variables["dimension"][:].copy(),
+            )
+    except (TypeError, ValueError, OSError):
+        import h5py
+
+        with h5py.File(filepath, "r") as ds:
+            return (
+                np.asarray(ds["z"]),
+                np.asarray(ds["x_range"]),
+                np.asarray(ds["y_range"]),
+                np.asarray(ds["dimension"]),
+            )
+
+
+def load_bathymetry(filepath: str):
+    """Load a GMRT bathymetry grid (reference map.py:45-94).
+
+    Returns ``(bathy, xlon, ylat)`` where ``bathy[i, j]`` is the depth at
+    ``(xlon[j], ylat[i])``.
+    """
+    z, x_range, y_range, dimension = _read_grd(filepath)
+    bathy = np.asarray(z, dtype=np.float64)
+
+    dim = np.flip(np.asarray(dimension)).astype(int)
+    bathy = np.flipud(bathy.reshape(dim))
+
+    bathy = bathy[~np.isnan(bathy).all(axis=1)]
+    bathy = bathy[:, ~np.isnan(bathy).all(axis=0)]
+
+    x0, xf = np.asarray(x_range, dtype=np.float64)
+    y0, yf = np.asarray(y_range, dtype=np.float64)
+    xlon = np.linspace(x0, xf, bathy.shape[1])
+    ylat = np.linspace(y0, yf, bathy.shape[0])
+    return bathy, xlon, ylat
+
+
+def flatten_bathy(bathy: np.ndarray, threshold: float) -> np.ndarray:
+    """Clamp the bathymetry above ``threshold`` (reference map.py:97-118)."""
+    return np.minimum(bathy, threshold)
+
+
+def latlon_to_utm(lon, lat, zone: int = 10, northern: bool = True):
+    """WGS84 lon/lat → UTM easting/northing for a given zone.
+
+    Native transverse-Mercator series (Snyder 1987 eqs. 3-21/8-9..8-13);
+    replaces the reference's pyproj EPSG:326xx transform (map.py:280-310).
+    Accepts scalars or arrays.
+    """
+    lon = np.asarray(lon, dtype=np.float64)
+    lat = np.asarray(lat, dtype=np.float64)
+    phi = np.radians(lat)
+    lam = np.radians(lon)
+    lam0 = np.radians(zone * 6.0 - 183.0)
+
+    sin_phi = np.sin(phi)
+    cos_phi = np.cos(phi)
+    n_rad = _A / np.sqrt(1.0 - _E2 * sin_phi**2)
+    t = np.tan(phi) ** 2
+    c = _EP2 * cos_phi**2
+    a_term = cos_phi * (lam - lam0)
+
+    e4 = _E2 * _E2
+    e6 = e4 * _E2
+    m = _A * (
+        (1 - _E2 / 4 - 3 * e4 / 64 - 5 * e6 / 256) * phi
+        - (3 * _E2 / 8 + 3 * e4 / 32 + 45 * e6 / 1024) * np.sin(2 * phi)
+        + (15 * e4 / 256 + 45 * e6 / 1024) * np.sin(4 * phi)
+        - (35 * e6 / 3072) * np.sin(6 * phi)
+    )
+
+    easting = (
+        _K0 * n_rad * (
+            a_term
+            + (1 - t + c) * a_term**3 / 6
+            + (5 - 18 * t + t**2 + 72 * c - 58 * _EP2) * a_term**5 / 120
+        )
+        + 500000.0
+    )
+    northing = _K0 * (
+        m
+        + n_rad * np.tan(phi) * (
+            a_term**2 / 2
+            + (5 - t + 9 * c + 4 * c**2) * a_term**4 / 24
+            + (61 - 58 * t + t**2 + 600 * c - 330 * _EP2) * a_term**6 / 720
+        )
+    )
+    if not northern:
+        northing = northing + 10000000.0
+    return easting, northing
+
+
+def _undersea_cmap():
+    """Blues below sea level, white above (reference map.py:139-145)."""
+    colors_undersea = plt.cm.Blues_r(np.linspace(0, 0.5, 100))
+    colors_land = np.array([[1, 1, 1, 1]] * 40)
+    return mcolors.LinearSegmentedColormap.from_list(
+        "custom_cmap", np.vstack((colors_undersea, colors_land)))
+
+
+def plot_cables2D(df_north, df_south, bathy, xlon, ylat, show=None):
+    """Hillshaded 2-D bathymetry with the two cable routes
+    (reference map.py:121-191). Accepts dataframes (lon/lat columns) or
+    (x, y) array pairs in UTM meters."""
+    custom_cmap = _undersea_cmap()
+    extent = [xlon[0], xlon[-1], ylat[0], ylat[-1]]
+    ls = LightSource(azdeg=350, altdeg=45)
+
+    fig = plt.figure(figsize=(14, 7))
+    ax = plt.gca()
+    rgb = ls.shade(bathy, cmap=custom_cmap, vert_exag=0.1, blend_mode="overlay")
+    ax.imshow(rgb, extent=extent, aspect="equal", origin="lower")
+
+    frames = isinstance(df_north, pd.DataFrame)
+    if frames:
+        ax.plot(df_north["lon"], df_north["lat"], "tab:red", label="North cable")
+        ax.plot(df_south["lon"], df_south["lat"], "tab:orange", label="South cable")
+    else:
+        ax.plot(df_north[0], df_north[1], "tab:red", label="North cable")
+        ax.plot(df_south[0], df_south[1], "tab:orange", label="South cable")
+
+    ax.contour(bathy, levels=[0], colors="k", extent=extent)
+
+    im = ax.imshow(bathy, cmap=custom_cmap, extent=extent, aspect="equal", origin="lower")
+    plt.colorbar(im, ax=ax, label="Depth [m]", aspect=50, pad=0.1, orientation="horizontal")
+    im.remove()
+
+    plt.xlabel("Longitude" if frames else "UTM x [m]")
+    plt.ylabel("Latitude" if frames else "UTM y [m]")
+    plt.legend(loc="upper center")
+    plt.tight_layout()
+    return _finish(fig, show)
+
+
+def _plot_cables3d(df_north, df_south, bathy, x, y, cols, labels, show):
+    fig = plt.figure(figsize=(16, 10))
+    ax = fig.add_subplot(111, projection="3d")
+    X, Y = np.meshgrid(x, y)
+    rstride = max(X.shape[0] // 100, 1)
+    cstride = max(X.shape[1] // 50, 1)
+    ax.plot_surface(X, Y, bathy, cmap="Blues_r", alpha=0.7, antialiased=True,
+                    rstride=rstride, cstride=cstride)
+    cx, cy = cols
+    ax.plot(df_north[cx], df_north[cy], df_north["depth"], "tab:red", label="North cable", lw=4)
+    ax.plot(df_south[cx], df_south[cy], df_south["depth"], "tab:orange", label="South cable", lw=4)
+    ax.set_xlabel(labels[0])
+    ax.set_ylabel(labels[1])
+    ax.set_zlabel("Depth [m]")
+    ax.set_aspect("equalxy")
+    ax.legend()
+    return _finish(fig, show)
+
+
+def plot_cables3D(df_north, df_south, bathy, xlon, ylat, show=None):
+    """3-D bathymetry surface + cables in lon/lat (reference map.py:194-234)."""
+    return _plot_cables3d(df_north, df_south, bathy, xlon, ylat,
+                          ("lon", "lat"), ("Longitude", "Latitude"), show)
+
+
+def plot_cables3D_m(df_north, df_south, bathy, x, y, show=None):
+    """3-D bathymetry surface + cables in UTM meters (reference map.py:237-277)."""
+    return _plot_cables3d(df_north, df_south, bathy, x, y,
+                          ("x", "y"), ("x [m]", "y [m]"), show)

@@ -1,0 +1,326 @@
+"""Host-side visualization of device arrays (reference plot.py:17-617).
+
+Figures are built with matplotlib from arrays brought back to host memory;
+envelopes are computed on-device with the framework's FFT Hilbert transform
+(``ops.spectral.envelope``) instead of per-call scipy. Every function
+returns the :class:`matplotlib.figure.Figure` (the reference returns None
+and always calls ``plt.show()``); we only ``show()`` on interactive
+backends so the same code runs headless in tests and batch workflows.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+
+import matplotlib
+import matplotlib.pyplot as plt
+import matplotlib.ticker as tkr
+import numpy as np
+
+from ..ops.spectral import envelope, fx_transform, instant_freq
+from .cmaps import import_roseus
+
+
+def _finish(fig, show: bool | None):
+    if show is None:
+        show = matplotlib.get_backend().lower() not in ("agg", "pdf", "svg", "ps", "template")
+    if show:
+        plt.show()
+    return fig
+
+
+def _env_np(trace) -> np.ndarray:
+    """|Hilbert envelope| on device, returned as a host array."""
+    return np.asarray(envelope(np.asarray(trace)))
+
+
+def _utc_title(file_begin_time_utc, title: str | None = None):
+    if isinstance(file_begin_time_utc, datetime):
+        stamp = file_begin_time_utc.strftime("%Y-%m-%d %H:%M:%S")
+        return stamp + " / " + title if isinstance(title, str) else stamp
+    return None
+
+
+def plot_rawdata(trace, time, dist, fig_size=(12, 10), show=None):
+    """Raw t-x panel, signed strain in RdBu (reference plot.py:17-40)."""
+    trace = np.asarray(trace)
+    fig = plt.figure(figsize=fig_size)
+    wv = plt.imshow(
+        trace * 1e9, aspect="auto", cmap="RdBu",
+        extent=[min(time), max(time), min(dist) * 1e-3, max(dist) * 1e-3],
+        origin="lower", vmin=-500, vmax=500,
+    )
+    plt.title("Raw DAS data")
+    plt.ylabel("Distance [km]")
+    plt.xlabel("Time [s]")
+    bar = fig.colorbar(wv, aspect=30, pad=0.015)
+    bar.set_label(label="Strain [-] x$10^{-9}$)")
+    return _finish(fig, show)
+
+
+def plot_tx(trace, time, dist, file_begin_time_utc=0, fig_size=(12, 10),
+            v_min=None, v_max=None, show=None):
+    """t-x waterfall of |strain|·1e9 in turbo (reference plot.py:43-92)."""
+    trace = np.asarray(trace)
+    fig = plt.figure(figsize=fig_size)
+    shw = plt.imshow(
+        np.abs(trace) * 1e9,
+        extent=[time[0], time[-1], dist[0] * 1e-3, dist[-1] * 1e-3],
+        aspect="auto", origin="lower", cmap="turbo", vmin=v_min, vmax=v_max,
+    )
+    plt.ylabel("Distance (km)")
+    plt.xlabel("Time (s)")
+    bar = fig.colorbar(shw, aspect=30, pad=0.015)
+    bar.set_label("Strain Envelope (x$10^{-9}$)")
+    t = _utc_title(file_begin_time_utc)
+    if t:
+        plt.title(t, loc="right")
+    plt.tight_layout()
+    return _finish(fig, show)
+
+
+def plot_fx(trace, dist, fs, file_begin_time_utc=0, win_s=2, nfft=4096,
+            fig_size=(12, 10), f_min=0, f_max=100, v_min=None, v_max=None, show=None):
+    """Windowed f-x panels, 3 rows of per-window spectra (reference plot.py:95-187).
+
+    The per-window f-x transform runs on device in one batched rFFT
+    (``ops.spectral.fx_transform``) instead of a window-at-a-time loop.
+    """
+    trace = np.asarray(trace)
+    nb_subplots = int(np.ceil(trace.shape[1] / (win_s * fs)))
+    freq = np.fft.fftshift(np.fft.fftfreq(nfft, d=1 / fs))
+
+    rows = 3
+    cols = int(np.ceil(nb_subplots / rows))
+    fig, axes = plt.subplots(rows, cols, figsize=fig_size, squeeze=False)
+
+    shw = None
+    for ind in range(nb_subplots):
+        seg = trace[:, int(ind * win_s * fs): int((ind + 1) * win_s * fs)]
+        fx = np.asarray(fx_transform(seg, nfft))
+        r, c = ind // cols, ind % cols
+        ax = axes[r][c]
+        shw = ax.imshow(
+            fx, extent=[freq[0], freq[-1], dist[0] * 1e-3, dist[-1] * 1e-3],
+            aspect="auto", origin="lower", cmap="jet", vmin=v_min, vmax=v_max,
+        )
+        ax.set_xlim([f_min, f_max])
+        if r == rows - 1:
+            ax.set_xlabel("Frequency (Hz)")
+        else:
+            ax.set_xticks([])
+            ax.xaxis.set_tick_params(labelbottom=False)
+        if c == 0:
+            ax.set_ylabel("Distance (km)")
+        else:
+            ax.set_yticks([])
+            ax.yaxis.set_tick_params(labelleft=False)
+
+    t = _utc_title(file_begin_time_utc)
+    if t:
+        plt.title(t, loc="right")
+    if shw is not None:
+        bar = fig.colorbar(shw, ax=axes.ravel().tolist())
+        bar.set_label("Strain (x$10^{-9}$)")
+    return _finish(fig, show)
+
+
+def plot_spectrogram(p, tt, ff, fig_size=(17, 5), v_min=None, v_max=None,
+                     f_min=None, f_max=None, show=None):
+    """Single-channel spectrogram in roseus (reference plot.py:190-229)."""
+    fig, ax = plt.subplots(figsize=fig_size)
+    shw = ax.pcolormesh(np.asarray(tt), np.asarray(ff), np.asarray(p),
+                        shading="auto", cmap=import_roseus(), vmin=v_min, vmax=v_max)
+    ax.set_ylim(f_min, f_max)
+    ax.set_xlabel("Time (s)")
+    ax.set_ylabel("Frequency (Hz)")
+    bar = fig.colorbar(shw, aspect=30, pad=0.015)
+    bar.set_label("dB (strain x$10^{-9}$)")
+    return _finish(fig, show)
+
+
+def plot_3calls(channel, time, t1, t2, t3, show=None):
+    """One overview + three 2 s zoom panels (reference plot.py:232-289)."""
+    channel = np.asarray(channel)
+    time = np.asarray(time)
+    fig = plt.figure(figsize=(12, 4))
+
+    plt.subplot(211)
+    plt.plot(time, channel, ls="-")
+    plt.xlim([time[0], time[-1]])
+    plt.ylabel("strain [-]")
+    plt.grid()
+    plt.tight_layout()
+
+    for pos, t0 in zip((234, 235, 236), (t1, t2, t3)):
+        plt.subplot(pos)
+        plt.plot(time, channel)
+        plt.xlim([t0, t0 + 2.0])
+        plt.xlabel("time [s]")
+        if pos == 234:
+            plt.ylabel("strain [-]")
+        plt.grid()
+        plt.tight_layout()
+    return _finish(fig, show)
+
+
+def design_mf(trace, hnote, lnote, th, tl, time, fs, show=None):
+    """Template-design panels: measured call vs template waveform and
+    instantaneous frequency for the HF and LF notes (reference
+    plot.py:292-370; merged into one 2x2 figure)."""
+    trace = np.asarray(trace)
+    hnote = np.asarray(hnote)
+    lnote = np.asarray(lnote)
+    time = np.asarray(time)
+
+    nf = int(th * fs)
+    nl = int(tl * fs)
+    dummy_chan = np.zeros_like(hnote)
+    dummy_chan[nf:] = hnote[:-nf]
+    dummy_chan[nl:] = lnote[:-nl]
+
+    fi = np.asarray(instant_freq(trace, fs))
+    fi_mf = np.asarray(instant_freq(dummy_chan, fs))
+
+    fig, axes = plt.subplots(2, 2, figsize=(18, 8))
+    for row, (t0, flims) in enumerate(zip((th, tl), ((15.0, 35.0), (12.0, 28.0)))):
+        ax = axes[row][0]
+        ax.plot(time, (trace - trace.mean() * row) / np.max(np.abs(trace)),
+                label="normalized measured fin call")
+        ax.plot(time, (dummy_chan - dummy_chan.mean() * row) / np.max(np.abs(dummy_chan)),
+                label="template")
+        ax.set_title(f"fin whale call template - {'HF' if row == 0 else 'LF'} note")
+        ax.set_xlabel("Time (seconds)")
+        ax.set_ylabel("Amplitude")
+        ax.set_xlim(t0 - 0.5, t0 + 1.5)
+        ax.grid()
+        ax.legend()
+
+        ax = axes[row][1]
+        ax.plot(time[1:], fi, label="measured fin call")
+        ax.plot(time[1:], fi_mf, label="template")
+        ax.set_xlim([t0 - 0.5, t0 + 1.5])
+        ax.set_ylim(list(flims))
+        ax.set_xlabel("Time (seconds)")
+        ax.set_ylabel("Instantaneous frequency [Hz]")
+        ax.legend()
+        ax.grid()
+    plt.tight_layout()
+    return _finish(fig, show)
+
+
+def _detection_panel(trace, time, dist, picks, fig_size=(12, 10),
+                     file_begin_time_utc=None, show=None):
+    """Shared envelope-waterfall-with-scatter body of the three
+    ``detection_*`` plots (reference plot.py:373-505). ``picks`` is a list
+    of (peaks_idx, time_scale_hz, dist_fn, color, marker, label)."""
+    fig = plt.figure(figsize=fig_size)
+    cplot = plt.imshow(
+        _env_np(trace) * 1e9,
+        extent=[time[0], time[-1], dist[0] / 1e3, dist[-1] / 1e3],
+        cmap="jet", origin="lower", aspect="auto", vmin=0, vmax=0.4, alpha=0.35,
+    )
+    for peaks_idx, rate_hz, to_km, color, marker, label in picks:
+        plt.scatter(np.asarray(peaks_idx[1]) / rate_hz, to_km(np.asarray(peaks_idx[0])),
+                    color=color, marker=marker, label=label)
+    bar = fig.colorbar(cplot, aspect=30, pad=0.015)
+    bar.set_label("Strain Envelope [-] (x$10^{-9}$)")
+    plt.xlabel("Time [s]")
+    plt.ylabel("Distance [km]")
+    plt.legend(loc="upper right")
+    t = _utc_title(file_begin_time_utc)
+    if t:
+        plt.title(t, loc="right")
+    plt.tight_layout()
+    return _finish(fig, show)
+
+
+def _pick_to_km(selected_channels, dx):
+    start, _, step = selected_channels
+    return lambda chan_idx: (chan_idx * step + start) * dx / 1e3
+
+
+def detection_mf(trace, peaks_idx_HF, peaks_idx_LF, time, dist, fs, dx,
+                 selected_channels, file_begin_time_utc=None, show=None):
+    """Matched-filter picks over the envelope waterfall (reference plot.py:373-415)."""
+    km = _pick_to_km(selected_channels, dx)
+    return _detection_panel(
+        trace, time, dist,
+        [(peaks_idx_HF, fs, km, "red", ".", "HF_note"),
+         (peaks_idx_LF, fs, km, "green", ".", "LF_note")],
+        file_begin_time_utc=file_begin_time_utc, show=show)
+
+
+def detection_spectcorr(trace, peaks_idx_HF, peaks_idx_LF, time, dist, spectro_fs,
+                        dx, selected_channels, file_begin_time_utc=None, show=None):
+    """Spectrogram-correlation picks; time axis in spectrogram hops rescaled
+    by ``spectro_fs`` (reference plot.py:418-461)."""
+    km = _pick_to_km(selected_channels, dx)
+    return _detection_panel(
+        trace, time, dist,
+        [(peaks_idx_HF, spectro_fs, km, "red", "x", "HF call"),
+         (peaks_idx_LF, spectro_fs, km, "green", ".", "LF_note")],
+        file_begin_time_utc=file_begin_time_utc, show=show)
+
+
+def detection_grad(trace, peaks_idx, time, dist, fs, dx, selected_channels,
+                   file_begin_time_utc=None, show=None):
+    """Gabor/gradient-detector picks (reference plot.py:464-505)."""
+    km = _pick_to_km(selected_channels, dx)
+    return _detection_panel(
+        trace, time, dist,
+        [(peaks_idx, fs, km, "red", "x", "Fin call")],
+        file_begin_time_utc=file_begin_time_utc, show=show)
+
+
+def snr_matrix(snr_m, time, dist, vmax, file_begin_time_utc=None, title=None, show=None):
+    """Local-SNR waterfall in turbo (reference plot.py:508-539)."""
+    fig = plt.figure(figsize=(12, 10))
+    snrp = plt.imshow(
+        np.asarray(snr_m), extent=[time[0], time[-1], dist[0] / 1e3, dist[-1] / 1e3],
+        cmap="turbo", origin="lower", aspect="auto", vmin=0, vmax=vmax,
+    )
+    bar = fig.colorbar(snrp, aspect=30, pad=0.015)
+    bar.set_label("SNR [dB]")
+    bar.ax.yaxis.set_major_formatter(tkr.FormatStrFormatter("%.0f"))
+    plt.xlabel("Time [s]")
+    plt.ylabel("Distance [km]")
+    t = _utc_title(file_begin_time_utc, title)
+    if t:
+        plt.title(t, loc="right")
+    plt.tight_layout()
+    return _finish(fig, show)
+
+
+def plot_cross_correlogramHL(corr_m_HF, corr_m_LF, time, dist, maxv, minv=0,
+                             file_begin_time_utc=None, show=None):
+    """HF/LF correlogram envelopes side by side (reference plot.py:542-581)."""
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(16, 8), constrained_layout=True)
+    ext = [time[0], time[-1], dist[0] / 1e3, dist[-1] / 1e3]
+    im1 = ax1.imshow(_env_np(corr_m_HF), extent=ext, cmap="turbo", origin="lower",
+                     aspect="auto", vmin=minv, vmax=maxv)
+    ax1.set_xlabel("Time [s]")
+    ax1.set_ylabel("Distance [km]")
+    ax1.set_title("HF note", loc="right")
+    ax2.imshow(_env_np(corr_m_LF), extent=ext, cmap="turbo", origin="lower",
+               aspect="auto", vmin=minv, vmax=maxv)
+    ax2.set_xlabel("Time [s]")
+    ax2.set_title("LF note", loc="right")
+    cbar = fig.colorbar(im1, ax=[ax1, ax2], orientation="horizontal", aspect=50, pad=0.02)
+    cbar.set_label("Cross-correlation envelope []")
+    return _finish(fig, show)
+
+
+def plot_cross_correlogram(corr_m, time, dist, maxv, minv=0,
+                           file_begin_time_utc=None, show=None):
+    """Single correlogram envelope (reference plot.py:584-617)."""
+    fig, ax = plt.subplots(figsize=(12, 10), constrained_layout=True)
+    im = ax.imshow(_env_np(corr_m),
+                   extent=[time[0], time[-1], dist[0] / 1e3, dist[-1] / 1e3],
+                   cmap="turbo", origin="lower", aspect="auto", vmin=minv, vmax=maxv)
+    ax.set_xlabel("Time [s]")
+    ax.set_ylabel("Distance [km]")
+    ax.set_title("Cross-correlogram", loc="right")
+    cbar = fig.colorbar(im, ax=ax, orientation="horizontal", aspect=50, pad=0.02)
+    cbar.set_label("Cross-correlation envelope []")
+    return _finish(fig, show)

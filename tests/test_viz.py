@@ -1,0 +1,140 @@
+"""Viz layer: plot functions run headless and return figures; colormaps are
+well-formed; map/geodesy round-trips (native UTM vs known golden points,
+synthetic GMRT .grd ingest)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+from das4whales_tpu import viz
+
+
+@pytest.fixture
+def tiny_block(rng):
+    nx, ns = 16, 400
+    fs, dx = 200.0, 8.0
+    trace = rng.standard_normal((nx, ns)) * 1e-9
+    time = np.arange(ns) / fs
+    dist = np.arange(nx) * dx
+    return trace, time, dist, fs, dx
+
+
+def test_cmaps_wellformed():
+    for cmap in (viz.import_roseus(), viz.import_parula()):
+        table = np.asarray(cmap.colors)
+        assert table.shape == (256, 3)
+        assert table.min() >= 0.0 and table.max() <= 1.0
+    # endpoints match the documented anchor colors
+    r = np.asarray(viz.import_roseus().colors)
+    assert np.allclose(r[0], [0.005, 0.004, 0.004], atol=1e-6)
+    assert np.allclose(r[-1], [0.998, 0.983, 0.977], atol=1e-6)
+    p = np.asarray(viz.import_parula().colors)
+    assert np.allclose(p[0], [0.242, 0.150, 0.660], atol=1e-6)
+
+
+def test_plot_panels_run_headless(tiny_block):
+    trace, time, dist, fs, dx = tiny_block
+    figs = [
+        viz.plot_rawdata(trace, time, dist, show=False),
+        viz.plot_tx(trace, time, dist, show=False),
+        viz.plot_fx(trace, dist, fs, nfft=256, show=False),
+        viz.snr_matrix(np.abs(trace) * 1e9, time, dist, vmax=30, show=False),
+        viz.plot_cross_correlogram(trace, time, dist, maxv=1, show=False),
+        viz.plot_cross_correlogramHL(trace, trace, time, dist, maxv=1, show=False),
+        viz.plot_3calls(trace[0], time, 0.1, 0.5, 1.0, show=False),
+    ]
+    for fig in figs:
+        assert fig is not None
+    plt.close("all")
+
+
+def test_detection_panels(tiny_block):
+    trace, time, dist, fs, dx = tiny_block
+    picks = (np.array([1, 5, 9]), np.array([40, 120, 300]))
+    sel = [0, trace.shape[0], 1]
+    for fig in (
+        viz.detection_mf(trace, picks, picks, time, dist, fs, dx, sel, show=False),
+        viz.detection_spectcorr(trace, picks, picks, time, dist, 50.0, dx, sel, show=False),
+        viz.detection_grad(trace, picks, time, dist, fs, dx, sel, show=False),
+    ):
+        assert fig is not None
+    plt.close("all")
+
+
+def test_design_mf_and_spectrogram(tiny_block):
+    trace, time, dist, fs, dx = tiny_block
+    from das4whales_tpu.models.templates import gen_template_fincall
+
+    note = np.asarray(gen_template_fincall(time, fs, fmin=15.0, fmax=25.0, duration=0.7))
+    fig = viz.design_mf(trace[0], note, note, 0.2, 0.9, time, fs, show=False)
+    assert fig is not None
+
+    p = np.random.default_rng(0).standard_normal((64, 40))
+    fig = viz.plot_spectrogram(p, np.arange(40), np.arange(64), show=False)
+    assert fig is not None
+    plt.close("all")
+
+
+def test_latlon_to_utm_golden():
+    # Central meridian of zone 10 (123W): easting is exactly 500 km and
+    # northing is k0 x the WGS84 meridian arc (4984944.38 m at 45N).
+    e, n = viz.latlon_to_utm(-123.0, 45.0, zone=10)
+    assert abs(e - 500000.0) < 1e-6
+    assert abs(n - 0.9996 * 4984944.38) < 0.5
+    # Published UTM sample point (CN Tower, zone 17): 630084 E, 4833438 N.
+    e, n = viz.latlon_to_utm(-79.387139, 43.642567, zone=17)
+    assert abs(e - 630084) < 2.0
+    assert abs(n - 4833438) < 2.0
+
+
+def test_latlon_to_utm_vectorized():
+    lon = np.array([-125.3, -124.8, -124.1])
+    lat = np.array([44.3, 44.6, 44.9])
+    e, n = viz.latlon_to_utm(lon, lat, zone=10)
+    assert e.shape == lon.shape and n.shape == lat.shape
+    assert np.all(np.diff(e) > 0) and np.all(np.diff(n) > 0)
+
+
+def test_load_bathymetry_grd(tmp_path):
+    # Synthetic GMRT-style netCDF-3 .grd: z flattened row-major, dimension
+    # stored (nx, ny) as GMT does, x/y ranges in degrees.
+    from scipy.io import netcdf_file
+
+    ny, nx = 12, 20
+    z = np.linspace(-2800, 150, ny * nx).astype(np.float64)
+    path = tmp_path / "test.grd"
+    with netcdf_file(str(path), "w") as ds:
+        ds.createDimension("side", 2)
+        ds.createDimension("xysize", ny * nx)
+        xr = ds.createVariable("x_range", "d", ("side",))
+        xr[:] = [-126.0, -124.0]
+        yr = ds.createVariable("y_range", "d", ("side",))
+        yr[:] = [44.0, 45.0]
+        dim = ds.createVariable("dimension", "i", ("side",))
+        dim[:] = [nx, ny]
+        zv = ds.createVariable("z", "d", ("xysize",))
+        zv[:] = z
+
+    bathy, xlon, ylat = viz.load_bathymetry(str(path))
+    assert bathy.shape == (ny, nx)
+    assert xlon.shape == (nx,) and ylat.shape == (ny,)
+    assert xlon[0] == -126.0 and xlon[-1] == -124.0
+    # flipud applied: row 0 of the file ends up as the last row
+    assert np.isclose(bathy[-1, 0], z[0])
+
+    flat = viz.map.flatten_bathy(bathy, 0.0)
+    assert flat.max() <= 0.0
+
+
+def test_load_cable_coordinates(tmp_path):
+    path = tmp_path / "cable.txt"
+    np.savetxt(path, np.column_stack([np.arange(5), np.linspace(44, 45, 5),
+                                      np.linspace(-126, -125, 5), -np.ones(5) * 100]),
+               delimiter=",")
+    df = viz.load_cable_coordinates(str(path), dx=2.0)
+    assert list(df.columns) == ["chan_idx", "lat", "lon", "depth", "chan_m"]
+    assert df["chan_m"].iloc[-1] == 8.0
