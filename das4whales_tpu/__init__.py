@@ -16,6 +16,7 @@ from . import io  # noqa: F401
 from . import loc  # noqa: F401
 from . import ops  # noqa: F401
 from . import models  # noqa: F401
+from . import utils  # noqa: F401
 from .config import AcquisitionMetadata, ChannelSelection  # noqa: F401
 
 

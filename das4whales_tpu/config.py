@@ -42,6 +42,13 @@ class AcquisitionMetadata:
         """File duration in seconds."""
         return self.ns / self.fs
 
+    def with_shape(self, nx: int, ns: int) -> "AcquisitionMetadata":
+        """Copy with the block shape a strided selection actually produced
+        (nx/ns describe the loaded array, not the raw file)."""
+        import dataclasses
+
+        return dataclasses.replace(self, nx=int(nx), ns=int(ns))
+
     @property
     def cable_span(self) -> float:
         """Total sensed cable length in meters."""

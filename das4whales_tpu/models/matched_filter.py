@@ -38,9 +38,11 @@ from ..ops import fk as fk_ops
 from ..ops import peaks as peak_ops
 from ..ops import spectral, xcorr
 from ..ops.filters import zero_phase_gain
+from ..utils.checkpoint import register_design
 from .templates import gen_template_fincall
 
 
+@register_design
 @dataclass
 class MatchedFilterDesign:
     """Precomputed, shape-specific design artifacts (host numpy)."""

@@ -1,0 +1,80 @@
+"""Checkpointing of design artifacts.
+
+The reference recomputes filters/templates every run and its tutorial
+explicitly motivates design-once/apply-many reuse across files
+(tutorial.md:93; SURVEY.md §5.4). Design dataclasses here are flat bags of
+numpy arrays + static Python fields, so checkpoints are a single ``.npz``:
+array fields stored natively, static fields in an embedded JSON header.
+No pickle — files are portable and safe to load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Type
+
+import numpy as np
+
+_REGISTRY: Dict[str, Type] = {}
+
+
+def register_design(cls: Type) -> Type:
+    """Register a dataclass so checkpoints can name their type."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _builtin(value):
+    if isinstance(value, tuple):
+        return list(value)
+    if isinstance(value, (np.integer,)):
+        return int(value)
+    if isinstance(value, (np.floating,)):
+        return float(value)
+    return value
+
+
+def save_design(path: str, design: Any) -> str:
+    """Write a design dataclass to ``path`` (.npz). Returns the path."""
+    if not dataclasses.is_dataclass(design):
+        raise TypeError(f"save_design expects a dataclass, got {type(design)}")
+    arrays = {}
+    static: Dict[str, Any] = {}
+    for f in dataclasses.fields(design):
+        value = getattr(design, f.name)
+        if isinstance(value, np.ndarray) or hasattr(value, "__array_namespace__") or (
+            hasattr(value, "shape") and hasattr(value, "dtype")
+        ):
+            arrays[f.name] = np.asarray(value)
+        else:
+            static[f.name] = _builtin(value)
+    header = json.dumps({"type": type(design).__name__, "static": static})
+    np.savez(path, __header__=np.frombuffer(header.encode(), dtype=np.uint8), **arrays)
+    return path
+
+
+def load_design(path: str, cls: Type | None = None) -> Any:
+    """Load a design checkpoint written by :func:`save_design`.
+
+    ``cls`` overrides the registry lookup (needed only for unregistered
+    types)."""
+    with np.load(path) as data:
+        header = json.loads(bytes(data["__header__"].tobytes()).decode())
+        fields: Dict[str, Any] = dict(header["static"])
+        for key in data.files:
+            if key != "__header__":
+                fields[key] = data[key]
+    if cls is None:
+        cls = _REGISTRY.get(header["type"])
+        if cls is None:
+            raise KeyError(
+                f"design type {header['type']!r} is not registered; pass cls= explicitly")
+    # dataclasses with tuple-typed fields get lists back from JSON; coerce
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        value = fields[f.name]
+        if isinstance(value, list):
+            value = tuple(value)
+        kwargs[f.name] = value
+    return cls(**kwargs)

@@ -1,0 +1,38 @@
+"""Structured logging (replaces the reference's print()-only observability,
+SURVEY.md §5.5)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+
+
+def get_logger(name: str = "das4whales_tpu", level: int = logging.INFO) -> logging.Logger:
+    """Package logger with a single stderr handler (idempotent)."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+        logger.addHandler(handler)
+        logger.setLevel(level)
+        logger.propagate = False
+    return logger
+
+
+def log_metadata(metadata, logger: logging.Logger | None = None) -> None:
+    """Log an acquisition-metadata summary (the reference prints this by
+    hand in every script prologue, main_mfdetect.py:16-22)."""
+    log = logger or get_logger()
+    meta = metadata if isinstance(metadata, dict) else getattr(metadata, "__dict__", {})
+    fs = meta.get("fs")
+    dx = meta.get("dx")
+    nx = meta.get("nx")
+    ns = meta.get("ns")
+    log.info(
+        "acquisition: fs=%s Hz, dx=%s m, nx=%s channels, ns=%s samples (%s s, %.1f km)",
+        fs, dx, nx, ns,
+        None if not (fs and ns) else ns / fs,
+        0.0 if not (dx and nx) else nx * dx / 1e3,
+    )

@@ -1,0 +1,87 @@
+"""Profiling and progress surfaces.
+
+The reference's only observability is tqdm bars around hot channel loops
+(detect.py:163,191,270,705; SURVEY.md §5.1). Those loops are gone (they
+are single XLA programs here), so the equivalents are: real device
+profiles via ``jax.profiler`` traces, named trace annotations for the
+pipeline stages, a wall-clock timer that accounts for async dispatch, and
+a progress wrapper for the remaining host-side loops (files in a
+campaign, channels exported, ...).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture a jax.profiler trace viewable in TensorBoard/Perfetto."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span that shows up on the device timeline (use around pipeline
+    stages inside a step)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def block_and_time(fn, *args, repeats: int = 3, **kwargs):
+    """Best-of-``repeats`` wall time of ``fn(*args)`` with the result tree
+    blocked to completion (JAX dispatch is async; un-blocked timing lies).
+
+    Returns ``(best_seconds, last_result)``. The first call is excluded
+    when it is the slowest (compile amortization)."""
+    times = []
+    result = None
+    for _ in range(max(repeats, 1) + 1):
+        t0 = time.perf_counter()
+        result = fn(*args, **kwargs)
+        jax.block_until_ready(result)
+        times.append(time.perf_counter() - t0)
+    return min(times[1:]), result
+
+
+@dataclass
+class StageTimer:
+    """Accumulates named wall-clock spans across a run (host-side)."""
+
+    totals: Dict[str, float] = field(default_factory=dict)
+    counts: Dict[str, int] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> str:
+        lines = [
+            f"  {name:<28s} {self.totals[name]:8.3f} s  (x{self.counts[name]})"
+            for name in sorted(self.totals, key=self.totals.get, reverse=True)
+        ]
+        return "\n".join(lines)
+
+
+def progress(iterable: Iterable, desc: str | None = None, total: int | None = None) -> Iterator:
+    """tqdm when available (the reference's surface), plain passthrough
+    otherwise — host loops only; device work never needs this."""
+    try:
+        from tqdm import tqdm
+
+        return tqdm(iterable, desc=desc, total=total)
+    except ImportError:
+        return iter(iterable)

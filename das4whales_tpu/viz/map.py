@@ -80,14 +80,17 @@ def load_bathymetry(filepath: str):
     dim = np.flip(np.asarray(dimension)).astype(int)
     bathy = np.flipud(bathy.reshape(dim))
 
-    bathy = bathy[~np.isnan(bathy).all(axis=1)]
-    bathy = bathy[:, ~np.isnan(bathy).all(axis=0)]
-
     x0, xf = np.asarray(x_range, dtype=np.float64)
     y0, yf = np.asarray(y_range, dtype=np.float64)
     xlon = np.linspace(x0, xf, bathy.shape[1])
     ylat = np.linspace(y0, yf, bathy.shape[0])
-    return bathy, xlon, ylat
+
+    # drop all-NaN no-data borders, keeping the coordinate axes aligned
+    # with the surviving rows/cols (the reference re-spans the original
+    # range over the trimmed grid, shifting every coordinate, map.py:79-93)
+    keep_rows = ~np.isnan(bathy).all(axis=1)
+    keep_cols = ~np.isnan(bathy).all(axis=0)
+    return bathy[keep_rows][:, keep_cols], xlon[keep_cols], ylat[keep_rows]
 
 
 def flatten_bathy(bathy: np.ndarray, threshold: float) -> np.ndarray:
@@ -176,9 +179,10 @@ def plot_cables2D(df_north, df_south, bathy, xlon, ylat, show=None):
 
     ax.contour(bathy, levels=[0], colors="k", extent=extent)
 
-    im = ax.imshow(bathy, cmap=custom_cmap, extent=extent, aspect="equal", origin="lower")
-    plt.colorbar(im, ax=ax, label="Depth [m]", aspect=50, pad=0.1, orientation="horizontal")
-    im.remove()
+    mappable = plt.cm.ScalarMappable(
+        norm=mcolors.Normalize(np.nanmin(bathy), np.nanmax(bathy)), cmap=custom_cmap)
+    plt.colorbar(mappable, ax=ax, label="Depth [m]", aspect=50, pad=0.1,
+                 orientation="horizontal")
 
     plt.xlabel("Longitude" if frames else "UTM x [m]")
     plt.ylabel("Latitude" if frames else "UTM y [m]")

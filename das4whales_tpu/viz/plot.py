@@ -38,7 +38,7 @@ def _utc_title(file_begin_time_utc, title: str | None = None):
     if isinstance(file_begin_time_utc, datetime):
         stamp = file_begin_time_utc.strftime("%Y-%m-%d %H:%M:%S")
         return stamp + " / " + title if isinstance(title, str) else stamp
-    return None
+    return title
 
 
 def plot_rawdata(trace, time, dist, fig_size=(12, 10), show=None):
@@ -54,7 +54,7 @@ def plot_rawdata(trace, time, dist, fig_size=(12, 10), show=None):
     plt.ylabel("Distance [km]")
     plt.xlabel("Time [s]")
     bar = fig.colorbar(wv, aspect=30, pad=0.015)
-    bar.set_label(label="Strain [-] x$10^{-9}$)")
+    bar.set_label(label="Strain [-] (x$10^{-9}$)")
     return _finish(fig, show)
 
 
@@ -176,8 +176,8 @@ def design_mf(trace, hnote, lnote, th, tl, time, fs, show=None):
     nf = int(th * fs)
     nl = int(tl * fs)
     dummy_chan = np.zeros_like(hnote)
-    dummy_chan[nf:] = hnote[:-nf]
-    dummy_chan[nl:] = lnote[:-nl]
+    dummy_chan[nf:] = hnote[: hnote.size - nf]
+    dummy_chan[nl:] = lnote[: lnote.size - nl]
 
     fi = np.asarray(instant_freq(trace, fs))
     fi_mf = np.asarray(instant_freq(dummy_chan, fs))

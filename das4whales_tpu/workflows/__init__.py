@@ -1,0 +1,7 @@
+"""End-to-end workflows (the reference's ``scripts/main_*.py`` entry
+points, SURVEY.md §2.2) — each is a ``main(url=None, outdir=None, ...)``
+callable that runs offline on a synthetic OOI-like scene when no URL/file
+is given."""
+
+from . import bathynoise, common, fkcomp, gabordetect, mfdetect, plots, spectrodetect  # noqa: F401
+from .common import acquire, default_scene  # noqa: F401

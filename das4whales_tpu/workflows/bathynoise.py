@@ -1,0 +1,93 @@
+"""Bathymetry/noise workflow (reference ``scripts/main_bathynoise.py``):
+join cable geometry with strain data and compute per-channel noise
+statistics — median/mean/std of the envelope, ``SNR_1d = 20 log10(std/med)``
+(main_bathynoise.py:183-194), and the noise power profile vs distance over a
+quiet time window (main_bathynoise.py:250-258). Stats run on device in one
+jitted program over all channels."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.matched_filter import MatchedFilterDetector
+from ..ops.spectral import envelope
+from .common import acquire, maybe_savefig
+
+
+@jax.jit
+def channel_noise_stats(trf_fk: jnp.ndarray):
+    """Per-channel envelope median/mean, trace std, and SNR_1d [dB]."""
+    env = envelope(trf_fk)
+    med = jnp.median(env, axis=-1)
+    mean = jnp.mean(env, axis=-1)
+    std = jnp.std(trf_fk, axis=-1)
+    snr_1d = 20.0 * jnp.log10(std / med)
+    return {"med": med, "mean": mean, "std": std, "snr_1d": snr_1d}
+
+
+@functools.partial(jax.jit, static_argnames=("i0", "i1"))
+def noise_power_profile(trf_fk: jnp.ndarray, i0: int, i1: int, ref: float = 1e-11):
+    """Mean noise power per channel over samples [i0, i1), in dB re
+    ``ref^2`` (main_bathynoise.py:255-257)."""
+    noise = trf_fk[:, i0:i1]
+    power = jnp.mean(noise * noise, axis=-1)
+    power_db = 10.0 * jnp.log10(power / ref**2)
+    noise_mean = jnp.mean(envelope(noise), axis=-1)
+    return power_db, noise_mean
+
+
+def main(url: str | None = None, outdir: str | None = None, show: bool = False,
+         selected_channels_m=None, tnoise=(0.0, 5.0), cable_depth_csv: str | None = None):
+    block, meta, sel = acquire(url, selected_channels_m=selected_channels_m)
+
+    mf = MatchedFilterDetector(meta, sel, tuple(block.trace.shape))
+    trf_fk = mf.filter_block(block.trace)
+
+    stats = {k: np.asarray(v) for k, v in channel_noise_stats(trf_fk).items()}
+    i0, i1 = (int(t * meta.fs) for t in tnoise)
+    power_db, noise_mean = noise_power_profile(trf_fk, i0, i1)
+    stats["noise_power_db"] = np.asarray(power_db)
+    stats["noise_mean"] = np.asarray(noise_mean)
+
+    depths = None
+    if cable_depth_csv is not None:
+        from ..viz.map import load_cable_coordinates
+
+        df = load_cable_coordinates(cable_depth_csv, meta.dx)
+        # nearest geometry sample for each selected channel (by distance)
+        depths = np.interp(block.dist, df["chan_m"].to_numpy(), df["depth"].to_numpy())
+        stats["depth"] = depths
+
+    figures = {}
+    if outdir is not None or show:
+        import matplotlib.pyplot as plt
+
+        fig, ax1 = plt.subplots(figsize=(12, 5))
+        ax1.plot(block.dist / 1e3, stats["noise_power_db"], label="noise power")
+        ax1.set_xlabel("Distance [km]")
+        ax1.set_ylabel("Noise power [dB re 1e-22]")
+        if depths is not None:
+            ax2 = ax1.twinx()
+            ax2.plot(block.dist / 1e3, depths, "tab:orange", alpha=0.6, label="depth")
+            ax2.set_ylabel("Depth [m]")
+        fig.tight_layout()
+        figures["noise_profile"] = maybe_savefig(fig, outdir, "bathynoise_profile.png")
+
+        fig, ax = plt.subplots(figsize=(12, 5))
+        ax.plot(block.dist / 1e3, stats["snr_1d"])
+        ax.set_xlabel("Distance [km]")
+        ax.set_ylabel("SNR_1d [dB]")
+        fig.tight_layout()
+        figures["snr_1d"] = maybe_savefig(fig, outdir, "bathynoise_snr1d.png")
+
+    return {"stats": stats, "trf_fk": trf_fk, "block": block, "figures": figures}
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else None, outdir="out_bathynoise")

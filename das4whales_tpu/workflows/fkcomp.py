@@ -1,0 +1,58 @@
+"""f-k filter comparison workflow (reference ``scripts/main_fkcomp.py:64-125``):
+design all four hybrid filter variants on the same block, apply each, and
+compare the resulting SNR matrices."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..config import SCRIPT_FK
+from ..ops import fk as fk_ops
+from ..ops.spectral import snr_tr_array
+from .common import acquire, maybe_savefig
+
+_DESIGNERS = {
+    "hybrid": lambda shape, sel, dx, fs, c: fk_ops.hybrid_filter_design(
+        shape, sel, dx, fs, c.cs_min, c.cp_min, c.fmin, c.fmax),
+    "hybrid_ninf": lambda shape, sel, dx, fs, c: fk_ops.hybrid_ninf_filter_design(
+        shape, sel, dx, fs, c.cs_min, c.cp_min, c.cp_max, c.cs_max, c.fmin, c.fmax),
+    "hybrid_gs": lambda shape, sel, dx, fs, c: fk_ops.hybrid_gs_filter_design(
+        shape, sel, dx, fs, c.cs_min, c.cp_min, c.fmin, c.fmax),
+    "hybrid_ninf_gs": lambda shape, sel, dx, fs, c: fk_ops.hybrid_ninf_gs_filter_design(
+        shape, sel, dx, fs, c.cs_min, c.cp_min, c.cp_max, c.cs_max, c.fmin, c.fmax),
+}
+
+
+def main(url: str | None = None, outdir: str | None = None, show: bool = False,
+         selected_channels_m=None, fk_config=SCRIPT_FK):
+    block, meta, sel = acquire(url, selected_channels_m=selected_channels_m)
+    shape = tuple(block.trace.shape)
+
+    filtered, snr, reports, figures = {}, {}, {}, {}
+    for name, designer in _DESIGNERS.items():
+        mask = designer(shape, sel, meta.dx, meta.fs, fk_config)
+        reports[name] = fk_ops.compression_report(mask, verbose=False)
+        trf = fk_ops.fk_filter_apply_rfft(block.trace, jnp.asarray(mask))
+        filtered[name] = trf
+        snr[name] = snr_tr_array(trf, env=True)
+        if outdir is not None or show:
+            from .. import viz
+
+            fig = viz.snr_matrix(np.asarray(snr[name]), block.tx, block.dist,
+                                 vmax=30, title=name, show=show)
+            figures[name] = maybe_savefig(fig, outdir, f"fkcomp_snr_{name}.png")
+
+    return {
+        "filtered": filtered,
+        "snr": snr,
+        "compression": reports,
+        "block": block,
+        "figures": figures,
+    }
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else None, outdir="out_fkcomp")

@@ -1,0 +1,43 @@
+"""Gabor/image detection workflow (reference ``scripts/main_gabordetect.py``,
+SURVEY.md §3.3): prologue + f-k filter, then envelope→image, oriented Gabor
+scoring at the sound-speed slope, binned mask, masked matched filter, picks."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.gabor import GaborDetector
+from ..models.matched_filter import MatchedFilterDetector
+from .common import acquire, maybe_savefig
+
+
+def main(url: str | None = None, outdir: str | None = None, show: bool = False,
+         selected_channels_m=None):
+    block, meta, sel = acquire(url, selected_channels_m=selected_channels_m)
+
+    mf = MatchedFilterDetector(meta, sel, tuple(block.trace.shape))
+    trf_fk = mf.filter_block(block.trace)
+
+    det = GaborDetector(meta.with_shape(*block.trace.shape), sel)
+    res = det(trf_fk)
+
+    figures = {}
+    if outdir is not None or show:
+        from .. import viz
+
+        names = list(res["picks"])
+        fig = viz.detection_grad(
+            np.asarray(trf_fk), res["picks"][names[0]], block.tx, block.dist,
+            meta.fs, meta.dx, sel, file_begin_time_utc=block.t0_utc, show=show)
+        figures["detection"] = maybe_savefig(fig, outdir, "gabor_detection.png")
+
+    res["trf_fk"] = trf_fk
+    res["block"] = block
+    res["figures"] = figures
+    return res
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else None, outdir="out_gabordetect")

@@ -1,0 +1,64 @@
+"""Flagship matched-filter detection workflow (reference
+``scripts/main_mfdetect.py``, SURVEY.md §3.1): load → bandpass → hybrid_ninf
+f-k filter → HF/LF matched-filter cross-correlograms → SNR → envelope peak
+picking → detection overlay. The whole device path is two XLA programs via
+:class:`~das4whales_tpu.models.matched_filter.MatchedFilterDetector`."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.matched_filter import MatchedFilterDetector
+from ..utils.profiling import StageTimer
+from .common import acquire, maybe_savefig
+
+
+def main(url: str | None = None, outdir: str | None = None, show: bool = False,
+         selected_channels_m=None, with_snr: bool = True):
+    """Run the full pipeline; returns a result dict (picks are (2, n)
+    [channel_idx, time_idx] arrays per template)."""
+    timer = StageTimer()
+    with timer.stage("acquire"):
+        block, meta, sel = acquire(url, selected_channels_m=selected_channels_m)
+
+    with timer.stage("design"):
+        det = MatchedFilterDetector(meta, sel, tuple(block.trace.shape))
+        det.design.sparsity_report(verbose=True)  # tools.disp_comprate parity
+
+    with timer.stage("detect"):
+        res = det(block.trace, with_snr=with_snr)
+
+    figures = {}
+    if outdir is not None or show:
+        from .. import viz
+
+        fig = viz.plot_tx(np.asarray(res.trf_fk), block.tx, block.dist,
+                          file_begin_time_utc=block.t0_utc, show=show)
+        figures["tx"] = maybe_savefig(fig, outdir, "mf_tx.png")
+        for name, snr in res.snr.items():
+            fig = viz.snr_matrix(np.asarray(snr), block.tx, block.dist, vmax=30,
+                                 title=name, show=show)
+            figures[f"snr_{name}"] = maybe_savefig(fig, outdir, f"mf_snr_{name}.png")
+        names = list(res.picks)
+        fig = viz.detection_mf(
+            np.asarray(res.trf_fk), res.picks[names[0]], res.picks[names[-1]],
+            block.tx, block.dist, meta.fs, meta.dx, sel,
+            file_begin_time_utc=block.t0_utc, show=show)
+        figures["detection"] = maybe_savefig(fig, outdir, "mf_detection.png")
+
+    print(timer.report())
+    return {
+        "picks": res.picks,
+        "thresholds": res.thresholds,
+        "trf_fk": res.trf_fk,
+        "correlograms": res.correlograms,
+        "block": block,
+        "figures": figures,
+        "timings": timer.totals,
+    }
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else None, outdir="out_mfdetect")

@@ -1,0 +1,71 @@
+"""Basic load/filter/visualize workflow (reference ``scripts/main_plots.py``
+and the tutorial flow, SURVEY.md §3.4): load → bandpass → f-k filter →
+t-x plot → best-channel spectrogram → template-design panel → optional
+5x-rate audio export of the best channel."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..models.matched_filter import MatchedFilterDetector
+from ..models.templates import gen_template_fincall
+from ..ops.spectral import spectrogram
+from ..utils.audio import export_audio
+from .common import acquire, maybe_savefig
+
+
+def main(url: str | None = None, outdir: str | None = None, show: bool = False,
+         selected_channels_m=None, audio: bool = True):
+    block, meta, sel = acquire(url, selected_channels_m=selected_channels_m)
+
+    mf = MatchedFilterDetector(meta, sel, tuple(block.trace.shape))
+    trf_fk = mf.filter_block(block.trace)
+
+    # best channel by peak envelope amplitude (main_mfdetect.py:61 idiom)
+    tr_np = np.asarray(trf_fk)
+    best = int(np.argmax(np.max(np.abs(tr_np), axis=1)))
+    p, tt, ff = spectrogram(trf_fk[best], meta.fs)
+
+    figures = {}
+    if outdir is not None or show:
+        from .. import viz
+
+        fig = viz.plot_tx(tr_np, block.tx, block.dist,
+                          file_begin_time_utc=block.t0_utc, show=show)
+        figures["tx"] = maybe_savefig(fig, outdir, "plots_tx.png")
+        fig = viz.plot_fx(tr_np[:: max(len(tr_np) // 64, 1)], block.dist[:: max(len(tr_np) // 64, 1)],
+                          meta.fs, nfft=512, show=show)
+        figures["fx"] = maybe_savefig(fig, outdir, "plots_fx.png")
+        fig = viz.plot_spectrogram(np.asarray(p), np.asarray(tt), np.asarray(ff),
+                                   f_min=10, f_max=35, show=show)
+        figures["spectrogram"] = maybe_savefig(fig, outdir, "plots_spectrogram.png")
+
+        time = block.tx
+        hf = np.asarray(gen_template_fincall(time, meta.fs, 17.8, 28.8, 0.68))
+        lf = np.asarray(gen_template_fincall(time, meta.fs, 14.7, 21.8, 0.78))
+        t_peak = float(np.argmax(np.abs(tr_np[best])) / meta.fs)
+        fig = viz.design_mf(tr_np[best], hf, lf, t_peak, t_peak, time, meta.fs, show=show)
+        figures["design_mf"] = maybe_savefig(fig, outdir, "plots_design_mf.png")
+
+    audio_path = None
+    if audio and outdir is not None:
+        os.makedirs(outdir, exist_ok=True)
+        audio_path = export_audio(tr_np[best], meta.fs,
+                                  os.path.join(outdir, f"channel_{best}_x5.wav"), speed=5.0)
+
+    return {
+        "trf_fk": trf_fk,
+        "best_channel": best,
+        "spectrogram": (p, tt, ff),
+        "block": block,
+        "figures": figures,
+        "audio": audio_path,
+    }
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else None, outdir="out_plots")

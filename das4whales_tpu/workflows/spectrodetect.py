@@ -1,0 +1,49 @@
+"""Spectrogram-correlation detection workflow (reference
+``scripts/main_spectrodetect.py``, SURVEY.md §3.2): same prologue and f-k
+filtering as the matched-filter flow, then per-channel sliced spectrograms
+cross-correlated with HF/LF hat kernels, picks at the spectrogram rate."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.matched_filter import MatchedFilterDetector
+from ..models.spectro import SpectroCorrDetector
+from .common import acquire, maybe_savefig
+
+
+def main(url: str | None = None, outdir: str | None = None, show: bool = False,
+         selected_channels_m=None, threshold: float = 14.0):
+    block, meta, sel = acquire(url, selected_channels_m=selected_channels_m)
+
+    mf = MatchedFilterDetector(meta, sel, tuple(block.trace.shape))
+    trf_fk = mf.filter_block(block.trace)
+
+    det = SpectroCorrDetector(meta.with_shape(*block.trace.shape), threshold=threshold)
+    correlograms, picks, spectro_fs = det(trf_fk)
+
+    figures = {}
+    if outdir is not None or show:
+        from .. import viz
+
+        names = list(picks)
+        fig = viz.detection_spectcorr(
+            np.asarray(trf_fk), picks[names[0]], picks[names[-1]],
+            block.tx, block.dist, spectro_fs, meta.dx, sel,
+            file_begin_time_utc=block.t0_utc, show=show)
+        figures["detection"] = maybe_savefig(fig, outdir, "spectro_detection.png")
+
+    return {
+        "picks": picks,
+        "correlograms": correlograms,
+        "spectro_fs": spectro_fs,
+        "trf_fk": trf_fk,
+        "block": block,
+        "figures": figures,
+    }
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else None, outdir="out_spectrodetect")

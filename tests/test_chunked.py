@@ -1,0 +1,105 @@
+"""Chunked ops vs scipy/unchunked golden references (reference tools.py)."""
+
+import numpy as np
+import scipy.signal as sp
+
+from das4whales_tpu.ops import chunked
+
+
+def test_detrend_linear_parity(rng):
+    x = rng.standard_normal((4, 300)) + np.linspace(0, 5, 300) + 2.0
+    got = np.asarray(chunked.detrend_linear(x))
+    want = sp.detrend(x, axis=-1)
+    np.testing.assert_allclose(got, want, atol=1e-10)
+
+
+def test_welch_psd_scipy_parity(rng):
+    fs = 200.0
+    x = rng.standard_normal((3, 3000))
+    got = np.asarray(chunked.welch_psd(x, fs, nperseg=256))
+    f_ref, want = sp.welch(x, fs=fs, nperseg=256)
+    np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-12)
+    np.testing.assert_allclose(chunked.welch_freqs(fs, 256), f_ref)
+
+
+def test_welch_psd_sine_peak():
+    fs = 200.0
+    t = np.arange(4096) / fs
+    x = np.sin(2 * np.pi * 25.0 * t)
+    pxx = np.asarray(chunked.welch_psd(x, fs, nperseg=512))
+    f = chunked.welch_freqs(fs, 512)
+    assert abs(f[np.argmax(pxx)] - 25.0) < fs / 512
+
+
+def test_spec_chunked_psd(rng):
+    fs = 200.0
+    x = rng.standard_normal(9000)
+    out = np.asarray(chunked.spec(x, fs, chunk=3000, nperseg=1024))
+    assert out.shape == (3, 513)  # reference template shape (tools.py:224)
+    # each chunk PSD matches scipy on that chunk
+    _, want = sp.welch(x[:3000], fs=fs, nperseg=1024)
+    np.testing.assert_allclose(out[0], want, rtol=1e-8, atol=1e-12)
+
+
+def test_energy_time_domain(rng):
+    x = rng.standard_normal((5, 1000))
+    out = np.asarray(chunked.energy_time_domain(x, chunk=250))
+    assert out.shape == (5, 4)
+    np.testing.assert_allclose(out[:, 0], np.sum(x[:, :250] ** 2, axis=-1), rtol=1e-10)
+    # Parseval: total chunk energy equals rFFT-domain energy
+    seg = x[:, :250]
+    spec_e = (np.abs(np.fft.fft(seg, axis=-1)) ** 2).sum(axis=-1) / 250
+    np.testing.assert_allclose(out[:, 0], spec_e, rtol=1e-10)
+
+
+def test_filtfilt_chunked_exact_interior(rng):
+    fs = 200.0
+    b, a = sp.butter(4, [14 / (fs / 2), 30 / (fs / 2)], "bp")
+    x = rng.standard_normal((3, 2000))
+    whole = sp.filtfilt(b, a, x, axis=-1)
+    got = np.asarray(chunked.filtfilt_chunked(b, a, x, chunk=500))
+    # interior chunk boundaries are exact to halo decay; the reference's
+    # dask variant has O(1) errors here (tools.py:166)
+    np.testing.assert_allclose(got, whole, atol=1e-8)
+
+
+def test_sosfiltfilt_chunked(rng):
+    fs = 200.0
+    sos = sp.butter(8, [14 / (fs / 2), 30 / (fs / 2)], "bp", output="sos")
+    x = rng.standard_normal((2, 2400))
+    whole = sp.sosfiltfilt(sos, x, axis=-1)
+    got = np.asarray(chunked.sosfiltfilt_chunked(sos, x, chunk=600))
+    np.testing.assert_allclose(got, whole, atol=1e-7)
+
+
+def test_fk_filt_chunked_matches_per_chunk_reference(rng):
+    from scipy import ndimage
+
+    fs, dx = 200.0, 8.0
+    nx, ns, chunk = 24, 512, 256
+    x = rng.standard_normal((nx, ns))
+
+    got = np.asarray(chunked.fk_filt_chunked(x, chunk, 1.0, fs, 1.0, dx, 1400.0, 3500.0))
+
+    # independent numpy re-implementation of the reference chunk kernel
+    # (tools.py:27-52): detrend -> fft2 -> smoothed fan -> ifft2
+    f = np.fft.fftshift(np.fft.fftfreq(chunk, d=1.0 / fs))
+    k = np.fft.fftshift(np.fft.fftfreq(nx, d=dx))
+    ff, kk = np.meshgrid(f, k)
+    g = 1.0 * ((ff < kk * 1400.0) & (ff < -kk * 1400.0))
+    g2 = 1.0 * ((ff < kk * 3500.0) & (ff < -kk * 3500.0))
+    g = g + np.fliplr(g) - (g2 + np.fliplr(g2))
+    g = ndimage.gaussian_filter(g, 40.0)
+    g = (g - g.min()) / (g.max() - g.min())
+    for c in range(ns // chunk):
+        blk = sp.detrend(x[:, c * chunk : (c + 1) * chunk])
+        spec = np.fft.fftshift(np.fft.fft2(blk)) * g
+        want = np.fft.ifft2(np.fft.ifftshift(spec)).real
+        np.testing.assert_allclose(got[:, c * chunk : (c + 1) * chunk], want, atol=1e-8)
+
+
+def test_disp_comprate_reexport():
+    mask = np.zeros((10, 10))
+    mask[4:6, 4:6] = 1.0
+    rep = chunked.disp_comprate(mask, verbose=False)
+    assert rep["ratio"] == 25.0

@@ -1,0 +1,72 @@
+"""utils: audio export round-trip, design checkpointing round-trip,
+profiling timers, logging."""
+
+import logging
+
+import numpy as np
+
+from das4whales_tpu import utils
+from das4whales_tpu.config import AcquisitionMetadata
+from das4whales_tpu.models.matched_filter import MatchedFilterDesign, design_matched_filter
+
+
+def test_audio_roundtrip(tmp_path, rng):
+    fs = 200.0
+    x = rng.standard_normal(1200) * 1e-9
+    path = utils.export_audio(x, fs, str(tmp_path / "chan.wav"), speed=5.0)
+    y, rate = utils.read_audio(path)
+    assert rate == 1000  # 5x time compression (tutorial audio capability)
+    assert y.shape == x.shape
+    # normalized waveform preserved to 16-bit quantization
+    assert np.max(np.abs(y - x / np.max(np.abs(x)))) < 1e-3
+
+
+def test_design_checkpoint_roundtrip(tmp_path):
+    meta = AcquisitionMetadata(fs=200.0, dx=8.0, nx=32, ns=256)
+    design = design_matched_filter((32, 256), [0, 32, 1], meta)
+    path = utils.save_design(str(tmp_path / "design.npz"), design)
+    loaded = utils.load_design(path)
+    assert isinstance(loaded, MatchedFilterDesign)
+    assert loaded.template_names == design.template_names
+    assert loaded.trace_shape == design.trace_shape
+    assert loaded.bp_padlen == design.bp_padlen
+    np.testing.assert_array_equal(loaded.fk_mask, design.fk_mask)
+    np.testing.assert_array_equal(loaded.templates, design.templates)
+
+
+def test_block_and_time():
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.sum(x * x)
+
+    dt, result = utils.block_and_time(f, jnp.arange(1000.0), repeats=2)
+    assert dt >= 0.0
+    assert float(result) == float(np.sum(np.arange(1000.0) ** 2))
+
+
+def test_stage_timer():
+    timer = utils.StageTimer()
+    with timer.stage("a"):
+        pass
+    with timer.stage("a"):
+        pass
+    with timer.stage("b"):
+        pass
+    assert timer.counts["a"] == 2 and timer.counts["b"] == 1
+    assert "a" in timer.report()
+
+
+def test_logger_and_metadata(caplog):
+    log = utils.get_logger("das4whales_tpu.test")
+    log.addHandler(caplog.handler)  # package logger does not propagate to root
+    try:
+        with caplog.at_level(logging.INFO, logger="das4whales_tpu.test"):
+            utils.log_metadata({"fs": 200.0, "dx": 2.042, "nx": 1000, "ns": 12000}, logger=log)
+    finally:
+        log.removeHandler(caplog.handler)
+    assert any("fs=200.0" in r.message for r in caplog.records)
+
+
+def test_progress_passthrough():
+    assert list(utils.progress(range(5), desc="x")) == [0, 1, 2, 3, 4]

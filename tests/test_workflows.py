@@ -1,0 +1,105 @@
+"""End-to-end workflow tests on small offline synthetic scenes.
+
+Every workflow runs its full pipeline (ingest through figures) headless;
+the matched-filter flow must recall the injected calls."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+from das4whales_tpu.io import synth
+from das4whales_tpu import workflows
+
+
+@pytest.fixture
+def small_scene():
+    calls = [
+        synth.SyntheticCall(t0=4.0, x0_m=400.0, fmin=17.8, fmax=28.8, duration=0.68, amplitude=6.0),
+        synth.SyntheticCall(t0=10.0, x0_m=900.0, fmin=14.7, fmax=21.8, duration=0.78, amplitude=6.0),
+    ]
+    return synth.SyntheticScene(nx=96, ns=3000, dx=12.0, calls=calls, seed=3)
+
+
+def _run(wf_main, tmp_path, scene, **kwargs):
+    filepath = synth.write_synthetic_file(str(tmp_path / "scene.h5"), scene)
+    return wf_main(filepath, outdir=str(tmp_path / "out"),
+                   selected_channels_m=(0.0, scene.nx * scene.dx, scene.dx), **kwargs)
+
+
+def test_mfdetect_recalls_injected_calls(tmp_path, small_scene):
+    res = _run(workflows.mfdetect.main, tmp_path, small_scene)
+    assert set(res["picks"]) == {"HF", "LF"}
+    # the HF call at t0=4.0s near channel 400/12 must be picked within 0.5 s
+    hf = np.asarray(res["picks"]["HF"])
+    fs = 200.0
+    assert hf.shape[0] == 2 and hf.shape[1] > 0
+    assert np.min(np.abs(hf[1] / fs - 4.0)) < 0.5
+    lf = np.asarray(res["picks"]["LF"])
+    assert np.min(np.abs(lf[1] / fs - 10.0)) < 0.5
+    assert res["figures"]["detection"] is not None
+    assert res["timings"]["detect"] > 0
+
+
+def test_spectrodetect_runs(tmp_path, small_scene):
+    res = _run(workflows.spectrodetect.main, tmp_path, small_scene, threshold=5.0)
+    assert res["spectro_fs"] > 0
+    assert set(res["picks"]) == {"HF", "LF"}
+    assert res["figures"]["detection"] is not None
+
+
+def test_gabordetect_runs(tmp_path, small_scene):
+    res = _run(workflows.gabordetect.main, tmp_path, small_scene)
+    assert "picks" in res and len(res["picks"]) == 2
+    assert res["figures"]["detection"] is not None
+
+
+def test_fkcomp_four_variants(tmp_path, small_scene):
+    res = _run(workflows.fkcomp.main, tmp_path, small_scene)
+    assert set(res["filtered"]) == {"hybrid", "hybrid_ninf", "hybrid_gs", "hybrid_ninf_gs"}
+    for name, trf in res["filtered"].items():
+        assert trf.shape == (96, 3000)
+        assert np.isfinite(np.asarray(trf)).all()
+    assert all(r["ratio"] > 1 for r in res["compression"].values())
+
+
+def test_plots_workflow_with_audio(tmp_path, small_scene):
+    res = _run(workflows.plots.main, tmp_path, small_scene)
+    assert res["figures"]["tx"] is not None
+    assert res["figures"]["spectrogram"] is not None
+    assert res["audio"] is not None
+    from das4whales_tpu.utils.audio import read_audio
+
+    y, rate = read_audio(res["audio"])
+    assert rate == 1000 and len(y) == small_scene.ns
+
+
+def test_bathynoise_stats(tmp_path, small_scene):
+    # cable depth CSV covering the selection
+    import pandas as pd
+
+    n = 100
+    csv = tmp_path / "cable.csv"
+    pd.DataFrame({
+        0: np.arange(n), 1: np.linspace(44, 45, n),
+        2: np.linspace(-126, -125, n), 3: -np.linspace(100, 600, n),
+    }).to_csv(csv, header=False, index=False)
+
+    res = _run(workflows.bathynoise.main, tmp_path, small_scene,
+               cable_depth_csv=str(csv))
+    stats = res["stats"]
+    assert stats["snr_1d"].shape == (96,)
+    assert np.isfinite(stats["noise_power_db"]).all()
+    assert "depth" in stats
+    assert res["figures"]["noise_profile"] is not None
+
+
+def test_offline_synthetic_fallback(tmp_path, monkeypatch):
+    # url=None must synthesize a scene and run without network
+    monkeypatch.chdir(tmp_path)
+    scene = workflows.default_scene(nx=64, ns=2000)
+    res = workflows.mfdetect.main(None, selected_channels_m=(0.0, 64 * 2.042, 2.042),
+                                  with_snr=False)
+    assert "picks" in res
