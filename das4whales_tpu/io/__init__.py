@@ -1,9 +1,10 @@
 """Host-side ingest: interrogator files, downloads, geometry, synthesis."""
 
-from . import coords, download, hdf5, interrogators, synth, tdms  # noqa: F401
+from . import coords, download, hdf5, interrogators, native, stream, synth, tdms  # noqa: F401
 from .download import dl_file  # noqa: F401
 from .hdf5 import StrainBlock, load_das_data, raw2strain, write_optasense  # noqa: F401
 from .interrogators import get_acquisition_parameters  # noqa: F401
+from .stream import stream_file_batches, stream_strain_blocks  # noqa: F401
 
 
 def hello_world_das_package():

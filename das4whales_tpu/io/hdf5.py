@@ -90,6 +90,7 @@ def load_das_data(
     *,
     dtype=jnp.float32,
     device=None,
+    engine: str = "auto",
 ) -> StrainBlock:
     """Load a strided channel selection as strain, with time/distance axes.
 
@@ -97,26 +98,69 @@ def load_das_data(
     except the conditioning runs on device and the default dtype is float32
     (strain magnitudes ~1e-9 are comfortably inside f32's normal range; pass
     ``dtype=jnp.float64`` on CPU for bit-level parity studies).
+
+    ``engine`` selects the bulk-read path: ``"native"`` uses the C++ ingest
+    engine (threaded pread + fused conditioning, see ``io.native``),
+    ``"h5py"`` the pure-Python path, ``"auto"`` picks native when the
+    dataset layout and dtype allow it.
     """
     if not os.path.exists(filename):
         raise FileNotFoundError(f"File {filename} not found")
     meta = as_metadata(metadata)
     sel = ChannelSelection.from_list(selected_channels)
 
+    if engine == "native" and dtype != jnp.float32:
+        raise ValueError("engine='native' produces float32; pass dtype=jnp.float32")
+    native_spec = None
     with h5py.File(filename, "r") as fp:
         raw = fp["Acquisition/Raw[0]/RawData"]
-        block = raw[sel.start : sel.stop : sel.step, :]
         t_us = int(fp["Acquisition/Raw[0]/RawDataTime"][0])
+        if engine in ("auto", "native") and dtype == jnp.float32:
+            from . import native as native_mod
 
-    arr = jnp.asarray(block, dtype=dtype)
-    if device is not None:
-        arr = jax.device_put(arr, device)
-    trace = raw2strain(arr, meta.scale_factor)
+            layout = native_mod.contiguous_layout(raw) if native_mod.available() else None
+            if layout is not None:
+                native_spec = (layout[0], layout[1], raw.shape[0], raw.shape[1])
+            elif engine == "native":
+                raise ValueError(
+                    f"engine='native' but {filename} is not natively readable "
+                    "(chunked/compressed dataset, unsupported dtype, or build failure)"
+                )
+        if native_spec is None:
+            block = raw[sel.start : sel.stop : sel.step, :]
 
+    if native_spec is not None:
+        from . import native as native_mod
+
+        offset, disk_dtype, nx_disk, ns_disk = native_spec
+        # fused read+demean+scale in C++; result is already strain
+        host = native_mod.read_strided(
+            filename, offset, disk_dtype, nx_disk, ns_disk,
+            sel.start, min(sel.stop, nx_disk), sel.step,
+            fuse=True, scale=meta.scale_factor,
+        )
+        trace = jnp.asarray(host)
+        if device is not None:
+            trace = jax.device_put(trace, device)
+    else:
+        arr = jnp.asarray(block, dtype=dtype)
+        if device is not None:
+            arr = jax.device_put(arr, device)
+        trace = raw2strain(arr, meta.scale_factor)
+
+    return assemble_block(trace, meta, sel, t_us)
+
+
+def assemble_block(trace, metadata, sel: ChannelSelection, t0_us: int) -> StrainBlock:
+    """Build a :class:`StrainBlock` (time/distance axes + UTC start) from a
+    conditioned ``[channel x time]`` array. Shared by the single-file loader
+    above and the multi-file streaming path (io/stream.py) so the axis
+    conventions (data_handle.py:220-228) live in exactly one place."""
+    meta = as_metadata(metadata)
     nnx, nns = trace.shape
     tx = np.arange(nns) / meta.fs
     dist = (np.arange(nnx) * sel.step + sel.start) * meta.dx
-    t0 = datetime.fromtimestamp(t_us * 1e-6, tz=timezone.utc).replace(tzinfo=None)
+    t0 = datetime.fromtimestamp(t0_us * 1e-6, tz=timezone.utc).replace(tzinfo=None)
     return StrainBlock(trace=trace, tx=tx, dist=dist, t0_utc=t0, metadata=meta, selection=sel)
 
 

@@ -1,0 +1,256 @@
+"""ctypes binding for the native C++ ingest engine.
+
+The reference's bulk-I/O path is h5py's C core called row-by-row from
+Python (data_handle.py:213) with numpy conditioning on the Python thread
+(data_handle.py:157-177). Here the bulk path is first-party native code
+(``native/ingest.cpp``): h5py is consulted once per file for metadata and
+the contiguous dataset byte offset, then the C++ engine pread()s the
+strided channel selection in parallel and fuses int->float32 + demean +
+scale-to-strain into the same pass. An async submit/wait pipeline overlaps
+host reads of file k+1 with device compute on file k.
+
+The engine is optional: if the shared library is missing it is compiled
+on first use with g++ (baked into the image); if that fails, callers fall
+back to the pure-h5py path. Set ``DAS4WHALES_NO_NATIVE=1`` to disable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdasingest.so")
+
+#: dtype codes shared with ingest.cpp (enum DType).
+_DTYPE_CODES = {
+    np.dtype(np.int16): 0,
+    np.dtype(np.int32): 1,
+    np.dtype(np.float32): 2,
+    np.dtype(np.float64): 3,
+}
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "ingest.cpp")
+    if not os.path.exists(src):
+        return False
+    # build to a unique temp path and publish with an atomic rename, so
+    # concurrent first-use builds in separate processes can't load a
+    # partially written library
+    tmp = f"{_SO_PATH}.build.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread", "-shared",
+             "-o", tmp, src],
+            check=True, capture_output=True, timeout=300,
+        )
+        os.replace(tmp, _SO_PATH)
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None if unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None:
+        return _lib
+    if _lib_failed or os.environ.get("DAS4WHALES_NO_NATIVE"):
+        return None
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if not os.path.exists(_SO_PATH) and not _build():
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.dw_abi_version.restype = ctypes.c_int32
+        lib.dw_read_strided.restype = ctypes.c_int32
+        lib.dw_read_strided.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.dw_raw2strain_f32.restype = ctypes.c_int32
+        lib.dw_raw2strain_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_double, ctypes.c_int32,
+        ]
+        lib.dw_pipe_create.restype = ctypes.c_void_p
+        lib.dw_pipe_create.argtypes = [ctypes.c_int32, ctypes.c_int32]
+        lib.dw_pipe_destroy.argtypes = [ctypes.c_void_p]
+        lib.dw_pipe_submit.restype = ctypes.c_int64
+        lib.dw_pipe_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.dw_pipe_wait.restype = ctypes.c_int32
+        lib.dw_pipe_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        if lib.dw_abi_version() != 1:
+            _lib_failed = True
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def contiguous_layout(dataset):
+    """(byte_offset, numpy_dtype) of an h5py dataset if the native engine
+    can read it directly (contiguous, uncompressed, supported dtype);
+    None otherwise."""
+    try:
+        if dataset.chunks is not None or dataset.compression is not None:
+            return None
+        offset = dataset.id.get_offset()
+        if offset is None:
+            return None
+        dt = np.dtype(dataset.dtype)
+        if dt not in _DTYPE_CODES:
+            return None
+        return int(offset), dt
+    except Exception:
+        return None
+
+
+def _float_ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def read_strided(
+    path: str,
+    offset: int,
+    dtype: np.dtype,
+    nx: int,
+    ns: int,
+    start: int,
+    stop: int,
+    step: int,
+    *,
+    fuse: bool = True,
+    scale: float = 1.0,
+    nthreads: int | None = None,
+    out: np.ndarray | None = None,
+) -> np.ndarray:
+    """Strided channel read (+ fused demean/scale when ``fuse``) into a
+    float32 ``[n_sel x ns]`` array."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native ingest engine unavailable")
+    n_sel = len(range(start, stop, step))
+    if out is None:
+        out = np.empty((n_sel, ns), dtype=np.float32)
+    elif out.shape != (n_sel, ns) or out.dtype != np.float32 or not out.flags.c_contiguous:
+        # real checks, not asserts: the C++ side writes n_sel*ns floats
+        # through this pointer, so a wrong buffer is memory corruption
+        raise ValueError(
+            f"out must be C-contiguous float32 of shape {(n_sel, ns)}, "
+            f"got {out.dtype} {out.shape}"
+        )
+    rc = lib.dw_read_strided(
+        path.encode(), offset, _DTYPE_CODES[np.dtype(dtype)], nx, ns,
+        start, stop, step, int(fuse), float(scale),
+        nthreads or os.cpu_count() or 4, _float_ptr(out),
+    )
+    if rc != 0:
+        raise IOError(f"native read failed (code {rc}) for {path}")
+    return out
+
+
+def raw2strain_inplace(block: np.ndarray, scale: float, nthreads: int | None = None) -> np.ndarray:
+    """Threaded in-place demean+scale of a float32 [nx x ns] block."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native ingest engine unavailable")
+    if block.dtype != np.float32 or block.ndim != 2 or not block.flags.c_contiguous:
+        raise ValueError("block must be a C-contiguous 2-D float32 array")
+    rc = lib.dw_raw2strain_f32(_float_ptr(block), block.shape[0], block.shape[1],
+                               float(scale), nthreads or os.cpu_count() or 4)
+    if rc != 0:
+        raise IOError(f"native raw2strain failed (code {rc})")
+    return block
+
+
+class Prefetcher:
+    """Async submit/wait front-end over the native pipeline.
+
+    Workers write directly into the numpy buffer allocated at submit time
+    (zero internal copies); ``wait`` blocks until that buffer is complete.
+    Typical double-buffered use::
+
+        pf = Prefetcher()
+        t0 = pf.submit(spec0); t1 = pf.submit(spec1)
+        block0 = pf.wait(t0)          # compute on block0 while spec1 loads
+    """
+
+    def __init__(self, nworkers: int = 2, io_threads_per_job: int | None = None):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native ingest engine unavailable")
+        self._lib = lib
+        self._handle = lib.dw_pipe_create(
+            nworkers, io_threads_per_job or max(1, (os.cpu_count() or 4) // nworkers)
+        )
+        self._pending: dict[int, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, path, offset, dtype, nx, ns, start, stop, step,
+               *, fuse=True, scale=1.0) -> int:
+        n_sel = len(range(start, stop, step))
+        out = np.empty((n_sel, ns), dtype=np.float32)
+        ticket = self._lib.dw_pipe_submit(
+            self._handle, path.encode(), offset, _DTYPE_CODES[np.dtype(dtype)],
+            nx, ns, start, stop, step, int(fuse), float(scale), _float_ptr(out),
+        )
+        with self._lock:
+            self._pending[int(ticket)] = out
+        return int(ticket)
+
+    def wait(self, ticket: int) -> np.ndarray:
+        rc = self._lib.dw_pipe_wait(self._handle, ticket)
+        with self._lock:
+            out = self._pending.pop(ticket)
+        if rc != 0:
+            raise IOError(f"native prefetch failed (code {rc})")
+        return out
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.dw_pipe_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

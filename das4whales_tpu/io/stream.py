@@ -1,0 +1,201 @@
+"""Multi-file streaming: double-buffered host→HBM strain pipeline.
+
+The reference processes one 60 s file at a time, serially, reloading and
+reconditioning on the Python thread (scripts/main_mfdetect.py:8-42 per
+file; the dask path, dask_wrap.py:21-93, keeps the file handle open and
+defers the read). Here ingest of file k+1 overlaps device compute on file
+k: the native C++ engine (io/native.py) or an *ordered* thread pool reads
+and conditions ahead, and blocks are handed to JAX as device arrays —
+optionally placed with a NamedSharding so a [file x channel x time] batch
+lands pre-sharded for the multi-chip step (parallel/pipeline.py).
+
+Unlike the reference's ThreadPoolExecutor fan-out, which loses result
+ordering via ``as_completed`` (detect.py:244-245), both paths here yield
+files strictly in submission order. Metadata probing is also pipelined —
+only ``prefetch`` files are probed ahead, so first-block latency is O(1)
+in campaign length.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Iterator, Sequence
+
+import h5py
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import AcquisitionMetadata, ChannelSelection, as_metadata
+from . import native
+from .hdf5 import StrainBlock, assemble_block
+from .interrogators import get_acquisition_parameters
+
+
+@dataclass
+class _FileSpec:
+    path: str
+    meta: AcquisitionMetadata
+    t0_us: int
+    layout: tuple | None  # (offset, disk_dtype, nx, ns) when natively readable
+
+
+def _probe(path: str, interrogator: str, metadata) -> _FileSpec:
+    meta = as_metadata(metadata) if metadata is not None else get_acquisition_parameters(
+        path, interrogator=interrogator
+    )
+    layout = None
+    with h5py.File(path, "r") as fp:
+        raw = fp["Acquisition/Raw[0]/RawData"]
+        t0_us = int(fp["Acquisition/Raw[0]/RawDataTime"][0])
+        if native.available():
+            lay = native.contiguous_layout(raw)
+            if lay is not None:
+                layout = (lay[0], lay[1], raw.shape[0], raw.shape[1])
+    return _FileSpec(path=path, meta=meta, t0_us=t0_us, layout=layout)
+
+
+def _read_h5py_host(spec: _FileSpec, sel: ChannelSelection) -> np.ndarray:
+    with h5py.File(spec.path, "r") as fp:
+        block = fp["Acquisition/Raw[0]/RawData"][sel.start : sel.stop : sel.step, :]
+    x = block.astype(np.float32)
+    x -= x.mean(axis=1, keepdims=True)
+    x *= spec.meta.scale_factor
+    return x
+
+
+def stream_strain_blocks(
+    files: Sequence[str],
+    selected_channels,
+    metadata=None,
+    *,
+    interrogator: str = "optasense",
+    prefetch: int = 2,
+    engine: str = "auto",
+    device=None,
+    sharding=None,
+) -> Iterator[StrainBlock]:
+    """Yield conditioned :class:`StrainBlock`\\ s for ``files`` in order,
+    reading ahead ``prefetch`` files while the caller computes.
+
+    ``metadata`` may be None (probed per file), one metadata for all files,
+    or a sequence aligned with ``files``. ``sharding``/``device`` place each
+    block on arrival (e.g. a per-file NamedSharding over the channel axis).
+
+    ``engine="auto"`` picks the native path iff the *first* file is natively
+    readable; a later file that breaks that assumption raises — pass
+    ``engine="h5py"`` for heterogeneous campaigns.
+    """
+    if prefetch < 1:
+        raise ValueError("prefetch must be >= 1")
+    files = list(files)
+    if not files:
+        return
+    sel = ChannelSelection.from_list(selected_channels)
+    metas = (
+        [None] * len(files)
+        if metadata is None
+        else ([metadata] * len(files) if not isinstance(metadata, (list, tuple)) else list(metadata))
+    )
+    if len(metas) != len(files):
+        raise ValueError(f"got {len(metas)} metadata entries for {len(files)} files")
+
+    def finish(spec: _FileSpec, host: np.ndarray) -> StrainBlock:
+        arr = jnp.asarray(host)
+        if sharding is not None:
+            arr = jax.device_put(arr, sharding)
+        elif device is not None:
+            arr = jax.device_put(arr, device)
+        return assemble_block(arr, spec.meta, sel, spec.t0_us)
+
+    first = _probe(files[0], interrogator, metas[0])
+    use_native = engine in ("auto", "native") and first.layout is not None
+    if engine == "native" and not use_native:
+        raise ValueError(f"engine='native' but {files[0]} is not natively readable")
+
+    def native_submit(pf, spec: _FileSpec):
+        if spec.layout is None:
+            raise ValueError(
+                f"{spec.path} is not natively readable but the stream started "
+                "on the native engine; pass engine='h5py' for mixed file sets"
+            )
+        offset, dt, nx, ns = spec.layout
+        return pf.submit(spec.path, offset, dt, nx, ns,
+                         sel.start, min(sel.stop, nx), sel.step,
+                         fuse=True, scale=spec.meta.scale_factor)
+
+    # probe lazily: spec k is probed right before its read is submitted,
+    # keeping only `prefetch` probes + reads ahead of the consumer
+    specs: dict[int, _FileSpec] = {0: first}
+
+    def spec_for(i: int) -> _FileSpec:
+        if i not in specs:
+            specs[i] = _probe(files[i], interrogator, metas[i])
+        return specs[i]
+
+    if use_native:
+        with native.Prefetcher(nworkers=prefetch) as pf:
+            tickets = {i: native_submit(pf, spec_for(i)) for i in range(min(prefetch, len(files)))}
+            for i in range(len(files)):
+                host = pf.wait(tickets.pop(i))
+                nxt = i + prefetch
+                if nxt < len(files):
+                    tickets[nxt] = native_submit(pf, spec_for(nxt))
+                yield finish(specs.pop(i), host)
+    else:
+        with ThreadPoolExecutor(max_workers=prefetch) as ex:
+            futs = {
+                i: ex.submit(_read_h5py_host, spec_for(i), sel)
+                for i in range(min(prefetch, len(files)))
+            }
+            for i in range(len(files)):
+                host = futs.pop(i).result()  # strict submission order
+                nxt = i + prefetch
+                if nxt < len(files):
+                    futs[nxt] = ex.submit(_read_h5py_host, spec_for(nxt), sel)
+                yield finish(specs.pop(i), host)
+
+
+def stream_file_batches(
+    files: Sequence[str],
+    selected_channels,
+    metadata=None,
+    *,
+    batch: int,
+    mesh=None,
+    interrogator: str = "optasense",
+    prefetch: int = 2,
+    engine: str = "auto",
+) -> Iterator[tuple]:
+    """Stack consecutive files into ``[file x channel x time]`` batches for
+    the sharded multi-chip detection step (parallel/pipeline.py).
+
+    Yields ``(batch_array, blocks)``; when ``mesh`` is given the stack is
+    placed with the pipeline's input sharding (file x channel). Trailing
+    files that do not fill a batch are dropped with a warning — pad the file
+    list if every file must be processed.
+    """
+    from ..parallel.pipeline import input_sharding
+
+    if batch < 1:
+        raise ValueError("batch must be >= 1")
+    n_full = (len(files) // batch) * batch
+    if n_full != len(files):
+        import warnings
+
+        warnings.warn(f"dropping {len(files) - n_full} trailing file(s) not filling a batch of {batch}")
+    sharding = input_sharding(mesh) if mesh is not None else None
+
+    pending: list[StrainBlock] = []
+    for blk in stream_strain_blocks(
+        files[:n_full], selected_channels, metadata,
+        interrogator=interrogator, prefetch=prefetch, engine=engine,
+    ):
+        pending.append(blk)
+        if len(pending) == batch:
+            stack = jnp.stack([b.trace for b in pending])
+            if sharding is not None:
+                stack = jax.device_put(stack, sharding)
+            yield stack, tuple(pending)
+            pending = []

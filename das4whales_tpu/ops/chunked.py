@@ -55,10 +55,15 @@ def welch_psd(
     density scaling). Replaces the reference's per-chunk
     ``signal.welch`` (tools.py:228-237) with one batched rFFT.
     """
+    n = x.shape[-1]
+    if nperseg > n:
+        # scipy parity: reduce nperseg to the signal length rather than
+        # letting the gather below clamp out-of-bounds indices silently
+        nperseg = n
+        noverlap = None
     if noverlap is None:
         noverlap = nperseg // 2
     step = nperseg - noverlap
-    n = x.shape[-1]
     n_seg = max((n - noverlap) // step, 1)
 
     idx = jnp.arange(n_seg)[:, None] * step + jnp.arange(nperseg)[None, :]

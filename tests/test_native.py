@@ -1,0 +1,123 @@
+"""Native C++ ingest engine tests: parity with the h5py path, fused
+conditioning correctness, the async prefetch pipeline, and graceful
+fallback when disabled."""
+
+import numpy as np
+import pytest
+
+from das4whales_tpu import io as dio
+from das4whales_tpu.io import native
+from das4whales_tpu.io.interrogators import get_acquisition_parameters
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="native engine unavailable")
+
+
+@pytest.fixture
+def h5file(tmp_path, rng):
+    raw = rng.integers(-30000, 30000, size=(64, 500)).astype(np.int32)
+    path = dio.write_optasense(str(tmp_path / "native.h5"), raw, fs=200.0, dx=2.042)
+    return path, raw
+
+
+def _layout(path):
+    import h5py
+
+    with h5py.File(path, "r") as fp:
+        ds = fp["Acquisition/Raw[0]/RawData"]
+        layout = native.contiguous_layout(ds)
+        assert layout is not None, "fixture file should be contiguous"
+        return layout[0], layout[1], ds.shape
+
+
+def test_read_strided_raw_parity(h5file):
+    path, raw = h5file
+    offset, dtype, (nx, ns) = _layout(path)
+    got = native.read_strided(path, offset, dtype, nx, ns, 4, 60, 2, fuse=False)
+    np.testing.assert_array_equal(got, raw[4:60:2].astype(np.float32))
+
+
+def test_read_strided_fused_strain(h5file):
+    path, raw = h5file
+    offset, dtype, (nx, ns) = _layout(path)
+    scale = 1.7e-9
+    got = native.read_strided(path, offset, dtype, nx, ns, 0, 64, 1, fuse=True, scale=scale)
+    want = raw.astype(np.float64)
+    want = (want - want.mean(axis=1, keepdims=True)) * scale
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-5, atol=1e-30)
+
+
+def test_load_das_data_native_matches_h5py(h5file):
+    import jax.numpy as jnp
+
+    path, _ = h5file
+    meta = get_acquisition_parameters(path, "optasense")
+    nat = dio.load_das_data(path, [4, 60, 2], meta, dtype=jnp.float32, engine="native")
+    ref = dio.load_das_data(path, [4, 60, 2], meta, dtype=jnp.float32, engine="h5py")
+    # native demeans with a float64 accumulator, the device path in f32 —
+    # tolerate one-ulp-of-f32 differences on ~1e-9 strain values
+    np.testing.assert_allclose(
+        np.asarray(nat.trace), np.asarray(ref.trace), rtol=1e-4, atol=1e-16
+    )
+    np.testing.assert_array_equal(nat.dist, ref.dist)
+
+
+def test_raw2strain_inplace(rng):
+    block = rng.standard_normal((16, 200)).astype(np.float32)
+    want = (block.astype(np.float64) - block.astype(np.float64).mean(axis=1, keepdims=True)) * 2.5e-9
+    got = native.raw2strain_inplace(block.copy(), 2.5e-9)
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-5, atol=1e-30)
+
+
+def test_prefetcher_overlap_and_order(tmp_path, rng):
+    """Submit several files up front; results arrive per-ticket regardless
+    of completion order (the reference's thread pool loses this ordering,
+    detect.py:244-245 — ours must not)."""
+    files = []
+    for k in range(4):
+        raw = rng.integers(-1000, 1000, size=(32, 250)).astype(np.int16 if k % 2 else np.int32)
+        path = dio.write_optasense(str(tmp_path / f"f{k}.h5"), raw.astype(np.int32), fs=200.0, dx=2.0)
+        files.append((path, raw.astype(np.int32)))
+
+    with native.Prefetcher(nworkers=3) as pf:
+        tickets = []
+        for path, _ in files:
+            offset, dtype, (nx, ns) = _layout(path)
+            tickets.append(pf.submit(path, offset, dtype, nx, ns, 0, 32, 1, fuse=False))
+        # wait out of submission order on purpose
+        for idx in (2, 0, 3, 1):
+            got = pf.wait(tickets[idx])
+            np.testing.assert_array_equal(got, files[idx][1].astype(np.float32))
+
+
+def test_native_errors():
+    with pytest.raises(IOError):
+        native.read_strided("/nonexistent/file.bin", 0, np.int32, 8, 8, 0, 8, 1)
+
+
+def test_native_engine_rejects_f64(h5file):
+    import jax.numpy as jnp
+
+    path, _ = h5file
+    meta = get_acquisition_parameters(path, "optasense")
+    with pytest.raises(ValueError, match="float32"):
+        dio.load_das_data(path, [0, 64, 1], meta, dtype=jnp.float64, engine="native")
+
+
+def test_native_rejects_bad_out_buffer(h5file):
+    path, _ = h5file
+    offset, dtype, (nx, ns) = _layout(path)
+    with pytest.raises(ValueError, match="C-contiguous"):
+        native.read_strided(path, offset, dtype, nx, ns, 0, 64, 1,
+                            out=np.empty((64, ns - 1), np.float32))
+
+
+def test_disable_env(monkeypatch, h5file):
+    """DAS4WHALES_NO_NATIVE forces the h5py path (engine='auto' still works)."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("DAS4WHALES_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "_lib", None)
+    path, _ = h5file
+    meta = get_acquisition_parameters(path, "optasense")
+    block = dio.load_das_data(path, [0, 64, 1], meta, dtype=jnp.float32, engine="auto")
+    assert np.asarray(block.trace).shape == (64, 500)
