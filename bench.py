@@ -16,10 +16,35 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+
+def _probe_device(timeout_s: float) -> bool:
+    """True iff the default JAX backend initializes and runs one op within
+    ``timeout_s``, probed in a subprocess so a wedged accelerator tunnel
+    can't hang the benchmark itself."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; jax.devices();"
+             "jnp.ones((8, 8)).sum().block_until_ready()"],
+            timeout=timeout_s, capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _force_cpu():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def _make_block(nx, ns, fs, dx, seed=0):
@@ -106,10 +131,24 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (CI smoke)")
     ap.add_argument("--no-cpu", action="store_true", help="skip CPU baseline; report cached ratio")
+    ap.add_argument(
+        "--device-timeout", type=float,
+        default=float(os.environ.get("DAS_BENCH_DEVICE_TIMEOUT", 180.0)),
+        help="seconds to wait for the accelerator before falling back to CPU",
+    )
     args = ap.parse_args()
 
+    fallback = False
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        # probe the backend (explicit platform or auto-detected TPU) before
+        # importing jax here: a wedged accelerator must degrade to a
+        # slow-but-honest CPU line, not hang the driver
+        if not _probe_device(args.device_timeout):
+            _force_cpu()
+            fallback = True
+
     fs, dx = 200.0, 2.042
-    if args.quick:
+    if args.quick or fallback:
         nx, ns, cpu_nx = 1024, 3000, 256
         peak_block = 512
     else:
@@ -119,6 +158,8 @@ def main():
         peak_block = 2048
 
     wall, n_picks, device = bench_tpu(nx, ns, fs, dx, peak_block=peak_block)
+    if fallback:
+        device = f"cpu-fallback (accelerator unreachable within {args.device_timeout:.0f}s): {device}"
     value = nx * ns / wall
 
     if args.no_cpu:

@@ -109,6 +109,8 @@ def load_das_data(
     meta = as_metadata(metadata)
     sel = ChannelSelection.from_list(selected_channels)
 
+    if engine not in ("auto", "native", "h5py"):
+        raise ValueError(f"unknown engine {engine!r}; expected 'auto', 'native', or 'h5py'")
     if engine == "native" and dtype != jnp.float32:
         raise ValueError("engine='native' produces float32; pass dtype=jnp.float32")
     native_spec = None

@@ -220,6 +220,8 @@ class Prefetcher:
 
     def submit(self, path, offset, dtype, nx, ns, start, stop, step,
                *, fuse=True, scale=1.0) -> int:
+        if self._handle is None:
+            raise RuntimeError("Prefetcher is closed")
         n_sel = len(range(start, stop, step))
         out = np.empty((n_sel, ns), dtype=np.float32)
         ticket = self._lib.dw_pipe_submit(
@@ -231,6 +233,13 @@ class Prefetcher:
         return int(ticket)
 
     def wait(self, ticket: int) -> np.ndarray:
+        if self._handle is None:
+            raise RuntimeError("Prefetcher is closed")
+        with self._lock:
+            if ticket not in self._pending:
+                # guard before the C++ wait: an unknown/already-consumed
+                # ticket would block on the completion cv forever
+                raise KeyError(f"unknown or already-waited ticket {ticket}")
         rc = self._lib.dw_pipe_wait(self._handle, ticket)
         with self._lock:
             out = self._pending.pop(ticket)

@@ -75,6 +75,7 @@ def stream_strain_blocks(
     engine: str = "auto",
     device=None,
     sharding=None,
+    as_numpy: bool = False,
 ) -> Iterator[StrainBlock]:
     """Yield conditioned :class:`StrainBlock`\\ s for ``files`` in order,
     reading ahead ``prefetch`` files while the caller computes.
@@ -82,6 +83,8 @@ def stream_strain_blocks(
     ``metadata`` may be None (probed per file), one metadata for all files,
     or a sequence aligned with ``files``. ``sharding``/``device`` place each
     block on arrival (e.g. a per-file NamedSharding over the channel axis).
+    ``as_numpy`` keeps traces on the host (for callers that batch several
+    files before one placed transfer, e.g. :func:`stream_file_batches`).
 
     ``engine="auto"`` picks the native path iff the *first* file is natively
     readable; a later file that breaks that assumption raises — pass
@@ -89,6 +92,8 @@ def stream_strain_blocks(
     """
     if prefetch < 1:
         raise ValueError("prefetch must be >= 1")
+    if engine not in ("auto", "native", "h5py"):
+        raise ValueError(f"unknown engine {engine!r}; expected 'auto', 'native', or 'h5py'")
     files = list(files)
     if not files:
         return
@@ -102,11 +107,14 @@ def stream_strain_blocks(
         raise ValueError(f"got {len(metas)} metadata entries for {len(files)} files")
 
     def finish(spec: _FileSpec, host: np.ndarray) -> StrainBlock:
-        arr = jnp.asarray(host)
-        if sharding is not None:
-            arr = jax.device_put(arr, sharding)
+        if as_numpy:
+            arr = host
+        elif sharding is not None:
+            arr = jax.device_put(host, sharding)
         elif device is not None:
-            arr = jax.device_put(arr, device)
+            arr = jax.device_put(host, device)
+        else:
+            arr = jnp.asarray(host)
         return assemble_block(arr, spec.meta, sel, spec.t0_us)
 
     first = _probe(files[0], interrogator, metas[0])
@@ -187,15 +195,21 @@ def stream_file_batches(
         warnings.warn(f"dropping {len(files) - n_full} trailing file(s) not filling a batch of {batch}")
     sharding = input_sharding(mesh) if mesh is not None else None
 
+    # traces stay host-side numpy until the whole batch is assembled, so
+    # the [file x channel x time] stack crosses to HBM exactly once and
+    # lands pre-sharded — never materialized whole on a single chip
     pending: list[StrainBlock] = []
     for blk in stream_strain_blocks(
         files[:n_full], selected_channels, metadata,
         interrogator=interrogator, prefetch=prefetch, engine=engine,
+        as_numpy=True,
     ):
         pending.append(blk)
         if len(pending) == batch:
-            stack = jnp.stack([b.trace for b in pending])
+            stack = np.stack([b.trace for b in pending])
             if sharding is not None:
                 stack = jax.device_put(stack, sharding)
+            else:
+                stack = jnp.asarray(stack)
             yield stack, tuple(pending)
             pending = []

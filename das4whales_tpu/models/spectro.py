@@ -32,9 +32,10 @@ def sliced_spectrogram(
 
     Parity: reference ``detect.get_sliced_nspectrogram`` (detect.py:334-408)
     — librosa-convention STFT, per-signal global-max normalization, then a
-    frequency slice. Returns ``(p, ff, tt)``.
+    frequency slice. Returns ``(p, ff, tt)``. On TPU the magnitudes come
+    from the Pallas MXU-DFT kernel (ops/pallas_stft.py).
     """
-    mag = jnp.abs(spectral.stft(trace, nperseg, nhop))
+    mag = spectral.stft_magnitude(trace, nperseg, nhop)
     nf, nt = mag.shape[-2], mag.shape[-1]
     tt = np.linspace(0, trace.shape[-1] / fs, num=nt)
     ff = np.linspace(0, fs / 2, num=nf)

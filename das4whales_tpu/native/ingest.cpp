@@ -111,7 +111,8 @@ inline int64_t n_selected(const ReadJob& j) {
 // Returns 0 on success, negative errno-style codes on failure.
 int run_job(const ReadJob& job, int nthreads) {
   const int64_t nsel = n_selected(job);
-  if (nsel <= 0 || job.ns <= 0) return -22;  // EINVAL
+  if (nsel <= 0 || job.ns <= 0 || job.start < 0 || job.offset < 0)
+    return -22;  // EINVAL: a negative start would pread file-header bytes
   const int64_t isz = itemsize(job.dtype);
   if (isz == 0) return -22;
   if (job.start + (nsel - 1) * job.step >= job.nx) return -34;  // ERANGE

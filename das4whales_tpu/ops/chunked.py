@@ -58,11 +58,13 @@ def welch_psd(
     n = x.shape[-1]
     if nperseg > n:
         # scipy parity: reduce nperseg to the signal length rather than
-        # letting the gather below clamp out-of-bounds indices silently
+        # letting the gather below clamp out-of-bounds indices silently;
+        # an explicit caller noverlap is kept (scipy keeps it too)
         nperseg = n
-        noverlap = None
     if noverlap is None:
         noverlap = nperseg // 2
+    elif noverlap >= nperseg:
+        raise ValueError(f"noverlap ({noverlap}) must be < nperseg ({nperseg})")
     step = nperseg - noverlap
     n_seg = max((n - noverlap) // step, 1)
 

@@ -362,6 +362,32 @@ def hough_lines(
     return lines
 
 
+def detect_long_lines(
+    img,
+    canny_low: float = 50.0,
+    canny_high: float = 150.0,
+    threshold: int = 100,
+    min_line_length: int = 50,
+    max_line_gap: int = 10,
+    bilateral_diameter: int = 9,
+    sigma_color: float = 75.0,
+    sigma_space: float = 75.0,
+):
+    """Long-line extraction: bilateral smoothing -> Canny -> Hough segment
+    walk. The reference composes cv2.bilateralFilter + cv2.Canny +
+    cv2.HoughLinesP (improcess.py:269-316); here the smoothing and edge map
+    run as jitted device kernels and only the per-line segment walk is host
+    numpy. Returns ``(lines, edges)`` with lines as (x1, y1, x2, y2)."""
+    img = jnp.asarray(img, dtype=jnp.float32)
+    smooth = bilateral_filter(img, bilateral_diameter, sigma_color, sigma_space)
+    edges = canny_edges(smooth, canny_low, canny_high)
+    lines = hough_lines(
+        edges, threshold=threshold,
+        min_line_length=min_line_length, max_line_gap=max_line_gap,
+    )
+    return lines, edges
+
+
 # ---------------------------------------------------------------------------
 # Radon transform (improcess.py:347-367)
 # ---------------------------------------------------------------------------
@@ -392,6 +418,12 @@ def radon_transform(image: jnp.ndarray, theta: np.ndarray | None = None) -> jnp.
 
     out = jax.lax.map(one_angle, jnp.asarray(theta, dtype=img_p.dtype))
     return out.T  # [projection position, angle] like skimage
+
+
+def compute_radon_transform(image, theta=None):
+    """Reference-named alias of :func:`radon_transform`
+    (improcess.py:347-367)."""
+    return radon_transform(image, theta)
 
 
 # ---------------------------------------------------------------------------

@@ -271,6 +271,7 @@ def find_peaks_sparse(
     ``saturated`` is False.
     """
     C, N = x.shape
+    max_peaks = min(max_peaks, N)  # top_k cannot exceed the time axis
     thr = jnp.asarray(threshold)
     thr_bc = jnp.broadcast_to(thr, (C,)) if thr.ndim <= 1 else thr
 

@@ -122,6 +122,35 @@ def stft(
     return jnp.swapaxes(spec, -1, -2)  # [..., freq, frame]
 
 
+def stft_magnitude(
+    x: jnp.ndarray, nfft: int, hop: int, *, engine: str = "auto"
+) -> jnp.ndarray:
+    """``|STFT|`` with an engine switch: the Pallas MXU-DFT kernel
+    (ops/pallas_stft.py) on TPU — framing stays in VMEM instead of a
+    ``nfft/hop``-fold HBM materialization — or the batched-rFFT path
+    elsewhere. Shapes/conventions identical to ``abs(stft(...))``.
+
+    ``engine``: ``"auto"`` (env ``DAS4WHALES_STFT_ENGINE`` overrides, then
+    TPU→pallas, else rfft), ``"pallas"``, or ``"rfft"``.
+    """
+    import os
+
+    if engine == "auto":
+        engine = os.environ.get("DAS4WHALES_STFT_ENGINE", "auto")
+    if engine == "auto":
+        engine = "pallas" if jax.default_backend() == "tpu" else "rfft"
+    if engine == "rfft":
+        return jnp.abs(stft(x, nfft, hop))
+    if engine != "pallas":
+        raise ValueError(f"unknown stft engine {engine!r}")
+
+    from .pallas_stft import stft_power
+
+    lead = x.shape[:-1]
+    power = stft_power(x.reshape(-1, x.shape[-1]), nfft, hop)
+    return jnp.sqrt(power).reshape(lead + power.shape[1:])
+
+
 @functools.partial(jax.jit, static_argnames=("nfft", "hop"))
 def _spectrogram_db(waveform: jnp.ndarray, nfft: int, hop: int) -> jnp.ndarray:
     mag = jnp.abs(stft(waveform, nfft, hop))

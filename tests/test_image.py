@@ -179,3 +179,29 @@ def test_apply_smooth_mask_uniform_mask_no_nan(rng):
     ones = np.asarray(im.apply_smooth_mask(x, np.ones((20, 30))))
     assert np.all(np.isfinite(ones))
     np.testing.assert_allclose(ones, x, atol=1e-8)
+
+
+def test_detect_long_lines_composition():
+    """bilateral -> canny -> hough finds a bright diagonal stripe
+    (reference improcess.py:269-316)."""
+    img = np.zeros((64, 64), np.float32)
+    for i in range(8, 56):
+        img[i, i - 2 : i + 3] = 200.0
+    lines, edges = im.detect_long_lines(
+        img, canny_low=20.0, canny_high=60.0, threshold=25,
+        min_line_length=20, max_line_gap=5,
+    )
+    assert np.asarray(edges).any()
+    assert lines, "expected at least one long line"
+    # the dominant segment runs diagonally (slope ~ 1)
+    x1, y1, x2, y2 = max(lines, key=lambda l: abs(l[2] - l[0]))
+    slope = (y2 - y1) / max(abs(x2 - x1), 1)
+    assert 0.6 < abs(slope) < 1.6
+
+
+def test_compute_radon_transform_alias():
+    img = np.zeros((16, 16), np.float32)
+    img[8, 8] = 1.0
+    a = np.asarray(im.compute_radon_transform(img, np.arange(0.0, 180.0, 45.0)))
+    b = np.asarray(im.radon_transform(img, np.arange(0.0, 180.0, 45.0)))
+    np.testing.assert_allclose(a, b)

@@ -121,3 +121,39 @@ def test_disable_env(monkeypatch, h5file):
     meta = get_acquisition_parameters(path, "optasense")
     block = dio.load_das_data(path, [0, 64, 1], meta, dtype=jnp.float32, engine="auto")
     assert np.asarray(block.trace).shape == (64, 500)
+
+
+def test_native_rejects_negative_start(h5file):
+    path, _ = h5file
+    offset, dtype, (nx, ns) = _layout(path)
+    with pytest.raises(IOError):
+        native.read_strided(path, offset, dtype, nx, ns, -10, 32, 1)
+
+
+def test_prefetcher_misuse_raises(h5file):
+    path, _ = h5file
+    offset, dtype, (nx, ns) = _layout(path)
+    pf = native.Prefetcher(nworkers=1)
+    t = pf.submit(path, offset, dtype, nx, ns, 0, 8, 1)
+    pf.wait(t)
+    with pytest.raises(KeyError):
+        pf.wait(t)          # already consumed
+    with pytest.raises(KeyError):
+        pf.wait(999999)     # never issued
+    pf.close()
+    with pytest.raises(RuntimeError):
+        pf.submit(path, offset, dtype, nx, ns, 0, 8, 1)
+    with pytest.raises(RuntimeError):
+        pf.wait(0)
+
+
+def test_unknown_engine_raises(h5file):
+    import jax.numpy as jnp
+    from das4whales_tpu.io.stream import stream_strain_blocks
+
+    path, _ = h5file
+    meta = get_acquisition_parameters(path, "optasense")
+    with pytest.raises(ValueError, match="unknown engine"):
+        dio.load_das_data(path, [0, 8, 1], meta, dtype=jnp.float32, engine="natve")
+    with pytest.raises(ValueError, match="unknown engine"):
+        list(stream_strain_blocks([path], [0, 8, 1], meta, engine="natve"))
