@@ -237,12 +237,12 @@ class Prefetcher:
             raise RuntimeError("Prefetcher is closed")
         with self._lock:
             if ticket not in self._pending:
-                # guard before the C++ wait: an unknown/already-consumed
-                # ticket would block on the completion cv forever
+                # an unknown/already-consumed ticket would block on the
+                # completion cv forever; claiming the buffer inside the
+                # lock also makes concurrent double-waits race-free
                 raise KeyError(f"unknown or already-waited ticket {ticket}")
-        rc = self._lib.dw_pipe_wait(self._handle, ticket)
-        with self._lock:
             out = self._pending.pop(ticket)
+        rc = self._lib.dw_pipe_wait(self._handle, ticket)
         if rc != 0:
             raise IOError(f"native prefetch failed (code {rc})")
         return out

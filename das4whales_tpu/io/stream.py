@@ -94,6 +94,8 @@ def stream_strain_blocks(
         raise ValueError("prefetch must be >= 1")
     if engine not in ("auto", "native", "h5py"):
         raise ValueError(f"unknown engine {engine!r}; expected 'auto', 'native', or 'h5py'")
+    if as_numpy and (sharding is not None or device is not None):
+        raise ValueError("as_numpy=True returns host arrays; drop sharding/device")
     files = list(files)
     if not files:
         return

@@ -53,6 +53,9 @@ class MatchedFilterDesign:
     templates: np.ndarray        # [n_templates x time]
     template_names: tuple
     trace_shape: tuple
+    fs: float = 200.0            # sampling rate the design was built for
+    bp_band: tuple = (14.0, 30.0)  # bandpass the gain was designed from
+    bp_order: int = 8
 
     def sparsity_report(self, verbose: bool = False):
         return fk_ops.compression_report(self.fk_mask, verbose=verbose)
@@ -104,6 +107,8 @@ def design_matched_filter(
         templates=tstack.astype(np.float32),
         template_names=tuple(templates.keys()),
         trace_shape=tuple(trace_shape),
+        fs=float(meta.fs),
+        bp_band=(float(bp_band[0]), float(bp_band[1])),
     )
 
 

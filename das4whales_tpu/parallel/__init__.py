@@ -1,6 +1,12 @@
 """Multi-chip scale-out: meshes, distributed FFT, sharded pipelines."""
 
-from . import fft, mesh, pipeline  # noqa: F401
+from . import fft, mesh, pipeline, timeshard  # noqa: F401
 from .mesh import make_mesh, shard_block  # noqa: F401
 from .fft import sharded_fk_apply  # noqa: F401
 from .pipeline import make_sharded_mf_step  # noqa: F401
+from .timeshard import (  # noqa: F401
+    make_sharded_mf_step_time,
+    sharded_bp_filt_time,
+    sharded_fk_apply_time,
+    time_sharding,
+)

@@ -37,16 +37,23 @@ def _axis_size(axis_name: str) -> int:
     return jax.lax.axis_size(axis_name)
 
 
-def prepare_mask_half(mask: np.ndarray, nns: int, pad_f: int = 0) -> np.ndarray:
-    """Hermitian-symmetrize an fftshifted ``[k x f]`` mask and keep the
-    rfft half ``[k x nns//2+1]`` (fft order along k), optionally zero-padded
-    along f to a multiple of the mesh axis size."""
+def symmetrize_mask_fftorder(mask: np.ndarray) -> np.ndarray:
+    """fftshifted ``[k x f]`` design mask -> point-reflect-symmetrized full
+    mask in fft order on both axes (guarantees a real filter output; the
+    device-side analogue is ``ops.fk._point_reflect``). Single source of
+    truth for the sharded f-k paths' mask convention."""
     mu = np.fft.ifftshift(np.asarray(mask))
     pr = mu
     for ax in (0, 1):
         pr = np.roll(np.flip(pr, axis=ax), 1, axis=ax)
-    msym = 0.5 * (mu + pr)
-    half = msym[:, : nns // 2 + 1]
+    return 0.5 * (mu + pr)
+
+
+def prepare_mask_half(mask: np.ndarray, nns: int, pad_f: int = 0) -> np.ndarray:
+    """Hermitian-symmetrize an fftshifted ``[k x f]`` mask and keep the
+    rfft half ``[k x nns//2+1]`` (fft order along k), optionally zero-padded
+    along f to a multiple of the mesh axis size."""
+    half = symmetrize_mask_fftorder(mask)[:, : nns // 2 + 1]
     if pad_f:
         half = np.pad(half, ((0, 0), (0, pad_f)))
     return half
