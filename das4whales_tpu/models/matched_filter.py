@@ -88,10 +88,11 @@ def design_matched_filter(
         fmin=fk_config.fmin, fmax=fk_config.fmax,
     )
 
+    from ..ops.filters import butter_zero_phase_gain
+
     sos = sp.butter(8, [bp_band[0] / (meta.fs / 2), bp_band[1] / (meta.fs / 2)], "bp", output="sos")
     padlen = 3 * (2 * len(sos) + 1)
-    nfft = trace_shape[1] + 2 * padlen
-    bp_gain = zero_phase_gain(np.fft.rfftfreq(nfft), sos)
+    bp_gain = butter_zero_phase_gain(trace_shape[1] + 2 * padlen, meta.fs, bp_band)
 
     time = np.arange(trace_shape[1]) / meta.fs
     tstack = np.stack(
@@ -129,7 +130,7 @@ def mf_filter_and_correlate(
 
     tr_bp = _fft_zero_phase_jit(trace, bp_gain, bp_padlen)
     trf_fk = fk_ops.fk_filter_apply_rfft(tr_bp, fk_mask)
-    corr = jax.vmap(lambda t: xcorr.compute_cross_correlogram(trf_fk, t))(templates)
+    corr = xcorr.compute_cross_correlograms_multi(trf_fk, templates)
     return trf_fk, corr
 
 

@@ -54,6 +54,18 @@ def butter_bandpass_ba(order: int, fmin: float, fmax: float, fs: float) -> Tuple
     return sp.butter(order, [fmin / (fs / 2), fmax / (fs / 2)], "bp")
 
 
+def butter_zero_phase_gain(
+    nfft: int, fs: float, band: Tuple[float, float], order: int = 8
+) -> np.ndarray:
+    """Zero-phase ``|H(f)|^2`` rFFT gain of a Butterworth bandpass for an
+    ``nfft``-sample window — the ONE construction shared by the filter
+    design (models/matched_filter.py) and every sharded rebuild of it at a
+    different window length (parallel/timeshard.py), so the convention
+    cannot silently diverge."""
+    sos = sp.butter(order, [band[0] / (fs / 2), band[1] / (fs / 2)], "bp", output="sos")
+    return zero_phase_gain(np.fft.rfftfreq(nfft), sos).astype(np.float32)
+
+
 def zero_phase_gain(freqs: np.ndarray, sos: np.ndarray) -> np.ndarray:
     """``|H(f)|^2`` of an SOS filter evaluated at ``freqs`` (cycles/sample
     units handled by the caller). Computed per-section for stability."""

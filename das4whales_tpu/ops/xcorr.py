@@ -15,12 +15,37 @@ import jax
 import jax.numpy as jnp
 
 
+def next_fast_len(n: int) -> int:
+    """Smallest 5-smooth (2^a 3^b 5^c) integer >= n.
+
+    Mixed-radix FFTs degrade badly on large prime factors; padding the
+    linear-correlation length to a 5-smooth size keeps every rFFT in the
+    fast path on both TPU and CPU (same contract as
+    ``scipy.fft.next_fast_len``).
+    """
+    if n <= 6:
+        return max(n, 1)
+    best = 1 << (n - 1).bit_length()  # upper bound: next power of two
+    p5 = 1
+    while p5 < best:
+        p35 = p5
+        while p35 < best:
+            # smallest power of two lifting p35 over n
+            q = -(-n // p35)  # ceil
+            p2 = 1 << max(q - 1, 0).bit_length()
+            cand = p2 * p35
+            if cand == n:
+                return n
+            if cand < best:
+                best = cand
+            p35 *= 3
+        p5 *= 5
+    return best
+
+
 def _xcorr_full_len(n: int, m: int) -> int:
     """FFT length for a linear (non-circular) correlation of n and m."""
-    need = n + m - 1
-    # round up to the next even size; FFT sizes here are products of small
-    # primes for typical DAS shapes (e.g. 24000 = 2^5*3*5^3)
-    return need + (need % 2)
+    return next_fast_len(n + m - 1)
 
 
 @jax.jit
@@ -68,6 +93,32 @@ def compute_cross_correlogram(data: jnp.ndarray, template: jnp.ndarray) -> jnp.n
     X = jnp.fft.rfft(norm_data, nfft, axis=-1)
     Y = jnp.fft.rfft(t, nfft)
     corr = jnp.fft.irfft(X * jnp.conj(Y), nfft, axis=-1)
+    return corr[..., :n].astype(data.dtype)
+
+
+@jax.jit
+def compute_cross_correlograms_multi(data: jnp.ndarray, templates: jnp.ndarray) -> jnp.ndarray:
+    """Matched-filter correlograms for SEVERAL templates with ONE forward
+    FFT of the data.
+
+    ``vmap(compute_cross_correlogram)`` over templates recomputes
+    ``rfft(norm_data)`` — the most expensive transform in the detection
+    step — once per template; here the normalized data spectrum is shared
+    and only the (tiny) template spectra and the inverse transforms repeat.
+    Returns ``[n_templates, channel, time]``, identical numerics.
+    """
+    norm_data = data - jnp.mean(data, axis=-1, keepdims=True)
+    norm_data = norm_data / jnp.max(jnp.abs(data), axis=-1, keepdims=True)
+    t = templates - jnp.mean(templates, axis=-1, keepdims=True)
+    t = t / jnp.max(jnp.abs(templates), axis=-1, keepdims=True)
+
+    n, m = data.shape[-1], t.shape[-1]
+    nfft = _xcorr_full_len(n, m)
+    X = jnp.fft.rfft(norm_data, nfft, axis=-1)          # once, shared
+    Y = jnp.fft.rfft(t, nfft, axis=-1)                  # [nT, F]
+    # align [nT, F] against X's arbitrary leading (batch/channel) axes
+    Yb = jnp.conj(Y).reshape((Y.shape[0],) + (1,) * (X.ndim - 1) + (Y.shape[-1],))
+    corr = jnp.fft.irfft(X[None, ...] * Yb, nfft, axis=-1)
     return corr[..., :n].astype(data.dtype)
 
 

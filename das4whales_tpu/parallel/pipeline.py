@@ -42,7 +42,7 @@ def _mf_body(
     tr_bp = _bp_local(trace, bp_gain, bp_padlen)
     trf_fk = fk_apply_local(tr_bp, mask_half, channel_axis)
 
-    corr = jax.vmap(lambda t: xcorr.compute_cross_correlogram(trf_fk, t))(templates)
+    corr = xcorr.compute_cross_correlograms_multi(trf_fk, templates)
     env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
 
     # per-file threshold: global max over templates/channels/time of the file

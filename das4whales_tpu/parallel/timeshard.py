@@ -213,7 +213,7 @@ def make_sharded_mf_step_time(
         trf = fk_apply_time_local(bp, mask_r, time_axis)           # [C, T/P]
         # relabel: one transpose into channel-sharded layout [C/P, T]
         y = jax.lax.all_to_all(trf, time_axis, split_axis=0, concat_axis=1, tiled=True)
-        corr = jax.vmap(lambda t: xcorr.compute_cross_correlogram(y, t))(tmpl)
+        corr = xcorr.compute_cross_correlograms_multi(y, tmpl)
         env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
         file_max = jax.lax.pmax(jnp.max(corr), time_axis)
         thres = relative_threshold * file_max

@@ -1,0 +1,62 @@
+"""Precision regression: float32 must remain decision-identical to
+float64 on the flagship pipeline (docs/PRECISION.md records the study;
+this test keeps it true)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import das4whales_tpu.io as dio
+from das4whales_tpu.io import synth
+from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+FS, DX, NX, NS = 200.0, 4.0, 48, 6000
+
+
+@pytest.fixture
+def scene_file(tmp_path):
+    scene = synth.SyntheticScene(
+        nx=NX, ns=NS, noise_rms=0.02, seed=11,
+        calls=[
+            synth.SyntheticCall(t0=4.0 + 8 * k, x0_m=40.0 + 50 * k, fmin=17.8,
+                                fmax=28.8, duration=0.68, amplitude=0.4 + 0.3 * k)
+            for k in range(3)
+        ],
+    )
+    return synth.write_synthetic_file(str(tmp_path / "prec.h5"), scene)
+
+
+def _run(path, meta, dtype):
+    blk = dio.load_das_data(path, [0, NX, 1], meta, dtype=dtype, engine="h5py")
+    det = MatchedFilterDetector(meta, [0, NX, 1], (NX, NS))
+    det._mask_dev = jnp.asarray(det.design.fk_mask, dtype=dtype)
+    det._gain_dev = jnp.asarray(det.design.bp_gain, dtype=dtype)
+    det._templates_dev = jnp.asarray(det.design.templates, dtype=dtype)
+    return det(jnp.asarray(blk.trace, dtype=dtype))
+
+
+def test_f32_decision_identical_to_f64(scene_file):
+    meta = dio.get_acquisition_parameters(scene_file, "optasense")
+    r64 = _run(scene_file, meta, jnp.float64)
+    r32 = _run(scene_file, meta, jnp.float32)
+
+    c64 = np.asarray(r64.correlograms["HF"], dtype=np.float64)
+    c32 = np.asarray(r32.correlograms["HF"], dtype=np.float64)
+    rel = np.abs(c32 - c64).max() / np.abs(c64).max()
+    assert rel < 5e-6, rel
+
+    th_rel = abs(r32.thresholds["HF"] - r64.thresholds["HF"]) / abs(r64.thresholds["HF"])
+    assert th_rel < 1e-5, th_rel
+
+    p64 = np.asarray(r64.picks["HF"])
+    p32 = np.asarray(r32.picks["HF"])
+    assert p64.shape[1] > 0
+    # every f64 pick has an f32 pick on the same channel within 2 samples
+    matched = 0
+    for ch, t in p64.T:
+        sel = p32[1][p32[0] == ch]
+        if len(sel) and np.min(np.abs(sel - t)) <= 2:
+            matched += 1
+    assert matched == p64.shape[1], (matched, p64.shape[1])
+    # and pick counts agree to within 2%
+    assert abs(p32.shape[1] - p64.shape[1]) <= max(2, 0.02 * p64.shape[1])

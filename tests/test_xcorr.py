@@ -1,5 +1,6 @@
 """Parity tests for ops.xcorr against scipy and the reference semantics."""
 
+import jax.numpy as jnp
 import numpy as np
 import scipy.signal as sp
 
@@ -75,3 +76,57 @@ def test_fftconvolve2d_same_matches_scipy(rng):
     got = np.asarray(xcorr.fftconvolve2d_same(x, k))
     want = sp.fftconvolve(x, k, mode="same")
     np.testing.assert_allclose(got, want, atol=1e-9)
+
+
+def test_next_fast_len():
+    from das4whales_tpu.ops.xcorr import next_fast_len
+
+    for n in (1, 5, 7, 97, 1000, 20191, 23999, 100003):
+        m = next_fast_len(n)
+        assert m >= n
+        r = m
+        for p in (2, 3, 5):
+            while r % p == 0:
+                r //= p
+        assert r == 1, f"{m} is not 5-smooth"
+    assert next_fast_len(24000) == 24000  # already smooth
+    # minimality by brute force on small sizes
+    def smooth(k):
+        for p in (2, 3, 5):
+            while k % p == 0:
+                k //= p
+        return k == 1
+    for n in range(1, 400):
+        want = next(k for k in range(max(n, 1), 4 * n + 8) if smooth(k))
+        assert next_fast_len(n) == want, (n, next_fast_len(n), want)
+
+
+def test_multi_template_matches_single(rng):
+    from das4whales_tpu.ops.xcorr import (
+        compute_cross_correlogram,
+        compute_cross_correlograms_multi,
+    )
+
+    data = jnp.asarray(rng.standard_normal((6, 500)).astype(np.float32))
+    tmpl = np.zeros((2, 500), np.float32)
+    tmpl[0, :91] = np.sin(np.linspace(0, 20, 91)) * np.hanning(91)
+    tmpl[1, :131] = np.cos(np.linspace(0, 16, 131)) * np.hanning(131)
+    tmpl = jnp.asarray(tmpl)
+    multi = np.asarray(compute_cross_correlograms_multi(data, tmpl))
+    for i in range(2):
+        single = np.asarray(compute_cross_correlogram(data, tmpl[i]))
+        np.testing.assert_allclose(multi[i], single, atol=1e-5)
+
+
+def test_multi_template_batched_leading_axes(rng):
+    from das4whales_tpu.ops.xcorr import (
+        compute_cross_correlogram,
+        compute_cross_correlograms_multi,
+    )
+
+    data = jnp.asarray(rng.standard_normal((3, 4, 200)).astype(np.float32))  # [B, C, T]
+    tmpl = jnp.asarray(rng.standard_normal((2, 200)).astype(np.float32))
+    multi = np.asarray(compute_cross_correlograms_multi(data, tmpl))
+    assert multi.shape == (2, 3, 4, 200)
+    single = np.asarray(compute_cross_correlogram(data, tmpl[1]))
+    np.testing.assert_allclose(multi[1], single, atol=1e-5)
