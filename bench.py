@@ -17,7 +17,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -26,18 +25,33 @@ import numpy as np
 
 def _probe_device(timeout_s: float) -> bool:
     """True iff the default JAX backend initializes and runs one op within
-    ``timeout_s``, probed in a subprocess so a wedged accelerator tunnel
-    can't hang the benchmark itself."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp; jax.devices();"
-             "jnp.ones((8, 8)).sum().block_until_ready()"],
-            timeout=timeout_s, capture_output=True,
-        )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    ``timeout_s`` (shared subprocess probe, das4whales_tpu.utils.device)."""
+    from das4whales_tpu.utils.device import probe_backend
+
+    return probe_backend(timeout_s) > 0
+
+
+def _probe_device_with_backoff(total_budget_s: float) -> bool:
+    """Keep probing the accelerator until it answers or the budget runs out.
+
+    A wedged tunnel sometimes recovers; one long probe can also die early on
+    a transient RPC error, so retry with growing per-attempt timeouts
+    (30/60/90 s...) and short pauses until ``total_budget_s`` is spent.
+    """
+    spent, attempt = 0.0, 0
+    while spent < total_budget_s:
+        per_try = min(30.0 * (attempt + 1), max(10.0, total_budget_s - spent))
+        t0 = time.perf_counter()
+        if _probe_device(per_try):
+            return True
+        spent += time.perf_counter() - t0
+        attempt += 1
+        pause = min(15.0, max(0.0, total_budget_s - spent))
+        if pause <= 0:
+            break
+        time.sleep(pause)
+        spent += pause
+    return False
 
 
 def _force_cpu():
@@ -45,6 +59,11 @@ def _force_cpu():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+# bench.py runs from the repo root; make the package importable for the
+# shared device-probe helpers without an install step
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def _make_block(nx, ns, fs, dx, seed=0):
@@ -87,7 +106,55 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048):
         res = run()
         times.append(time.perf_counter() - t0)
     n_picks = sum(int(v.shape[1]) for v in res.picks.values())
-    return min(times), n_picks, str(jax.devices()[0])
+    stages = bench_stages(det, x, repeats=repeats)
+    return min(times), n_picks, str(jax.devices()[0]), stages
+
+
+def bench_stages(det, x, repeats=3):
+    """Per-stage wall times (s) of the flagship pipeline: bp / fk /
+    correlate / envelope / peaks. Each stage is timed as its own jitted
+    program with a device sync, so the sum slightly exceeds the fused
+    end-to-end wall time (which XLA overlaps/fuses across stages)."""
+    import jax
+    import jax.numpy as jnp
+
+    from das4whales_tpu.ops import fk as fk_ops
+    from das4whales_tpu.ops import peaks as peak_ops
+    from das4whales_tpu.ops import spectral, xcorr
+    from das4whales_tpu.ops.filters import _fft_zero_phase_jit
+
+    gain, mask = det._gain_dev, det._mask_dev
+    templates = det._templates_dev
+    padlen = det.design.bp_padlen
+
+    bp_fn = lambda a: _fft_zero_phase_jit(a, gain, padlen)
+    fk_fn = jax.jit(lambda a: fk_ops.fk_filter_apply_rfft(a, mask))
+    corr_fn = jax.jit(lambda a: xcorr.compute_cross_correlograms_multi(a, templates))
+    env_fn = jax.jit(lambda a: jnp.abs(spectral.analytic_signal(a, axis=-1)))
+
+    def peaks_fn(env, thr):
+        return [
+            peak_ops.find_peaks_sparse(env[i], thr[i], max_peaks=det.max_peaks)
+            for i in range(env.shape[0])
+        ]
+
+    def timed(fn, *args):
+        out = jax.block_until_ready(fn(*args))  # compile + warm
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    stages = {}
+    stages["bp"], bp = timed(bp_fn, x)
+    stages["fk"], trf = timed(fk_fn, bp)
+    stages["correlate"], corr = timed(corr_fn, trf)
+    stages["envelope"], env = timed(env_fn, corr)
+    thr = jnp.full((env.shape[0],), 0.5 * float(jnp.max(corr)))
+    stages["peaks"], _ = timed(peaks_fn, env, thr)
+    return {k: round(v, 4) for k, v in stages.items()}
 
 
 def bench_cpu_reference(nx, ns, fs, dx):
@@ -139,11 +206,17 @@ def main():
     args = ap.parse_args()
 
     fallback = False
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # honor an explicit CPU request — but through the live config too:
+        # under this image's sitecustomize the env var alone does not keep
+        # jax off the (possibly wedged) accelerator (see tests/conftest.py)
+        _force_cpu()
+    else:
         # probe the backend (explicit platform or auto-detected TPU) before
         # importing jax here: a wedged accelerator must degrade to a
-        # slow-but-honest CPU line, not hang the driver
-        if not _probe_device(args.device_timeout):
+        # slow-but-honest CPU line, not hang the driver. Retry with backoff
+        # inside the budget — wedged tunnels sometimes recover.
+        if not _probe_device_with_backoff(args.device_timeout):
             _force_cpu()
             fallback = True
 
@@ -157,7 +230,7 @@ def main():
         nx, ns, cpu_nx = 22050, 12000, 1050
         peak_block = 2048
 
-    wall, n_picks, device = bench_tpu(nx, ns, fs, dx, peak_block=peak_block)
+    wall, n_picks, device, stages = bench_tpu(nx, ns, fs, dx, peak_block=peak_block)
     if fallback:
         device = f"cpu-fallback (accelerator unreachable within {args.device_timeout:.0f}s): {device}"
     value = nx * ns / wall
@@ -182,6 +255,7 @@ def main():
                 "n_picks": n_picks,
                 "device": device,
                 "cpu_ref_rate": round(cpu_rate, 1) if cpu_rate else None,
+                "stage_wall_s": stages,
             }
         )
     )

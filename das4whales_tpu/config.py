@@ -99,6 +99,12 @@ class ChannelSelection:
     def from_meters(cls, start_m: float, stop_m: float, step_m: float, dx: float) -> "ChannelSelection":
         """Convert a selection expressed in meters along the cable into
         channel indices (reference caller-side idiom, main_mfdetect.py:30-34)."""
+        if step_m < dx:
+            raise ValueError(
+                f"step_m={step_m} is below the spatial sampling dx={dx}; the "
+                f"integer-divide convention would yield a zero stride. Use "
+                f"step_m >= dx (every channel = dx)."
+            )
         return cls(int(start_m // dx), int(stop_m // dx), int(step_m // dx))
 
     @classmethod

@@ -13,6 +13,7 @@ unknown HDF5 layouts.
 from __future__ import annotations
 
 import os
+import re
 
 import numpy as np
 
@@ -51,13 +52,27 @@ def get_metadata_silixa(filepath: str) -> AcquisitionMetadata:
     )
 
 
+def _natural_key(name: str):
+    """Sort key ordering embedded integers numerically ("ch2" < "ch10",
+    regardless of zero padding), with lexicographic tie-breaking on the
+    non-digit runs. This is the channel order a fiber layout means by its
+    names; plain string sort would interleave ch1/ch10/ch2."""
+    # tag each run so int/str never compare directly (TypeError otherwise
+    # for names with different digit/text structure)
+    return tuple(
+        (0, int(part), "") if part.isdigit() else (1, 0, part)
+        for part in re.split(r"(\d+)", name)
+        if part != ""
+    )
+
+
 def load_silixa_data(filepath: str) -> np.ndarray:
     """Load the full ``[channel x time]`` raw block from a Silixa TDMS file
     (the reference materializes this inside get_metadata_silixa,
-    data_handle.py:140)."""
+    data_handle.py:140), channels in natural (numeric-aware) name order."""
     f = TdmsFile.read(filepath)
     channels = f["Measurement"]
-    return np.stack([channels[c] for c in sorted(channels, key=lambda s: (len(s), s))])
+    return np.stack([channels[c] for c in sorted(channels, key=_natural_key)])
 
 
 def get_metadata_mars(filepath: str) -> AcquisitionMetadata:

@@ -171,6 +171,10 @@ def read_strided(
             f"out must be C-contiguous float32 of shape {(n_sel, ns)}, "
             f"got {out.dtype} {out.shape}"
         )
+    if n_sel == 0:
+        # valid-but-empty selection: the C engine rejects it with -22, but a
+        # user slicing an empty range deserves the h5py-style empty block
+        return out
     rc = lib.dw_read_strided(
         path.encode(), offset, _DTYPE_CODES[np.dtype(dtype)], nx, ns,
         start, stop, step, int(fuse), float(scale),

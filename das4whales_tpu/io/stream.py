@@ -177,41 +177,62 @@ def stream_file_batches(
     interrogator: str = "optasense",
     prefetch: int = 2,
     engine: str = "auto",
+    tail: str = "pad",
 ) -> Iterator[tuple]:
     """Stack consecutive files into ``[file x channel x time]`` batches for
     the sharded multi-chip detection step (parallel/pipeline.py).
 
     Yields ``(batch_array, blocks)``; when ``mesh`` is given the stack is
-    placed with the pipeline's input sharding (file x channel). Trailing
-    files that do not fill a batch are dropped with a warning — pad the file
-    list if every file must be processed.
+    placed with the pipeline's input sharding (file x channel).
+
+    ``tail`` controls trailing files that do not fill a batch:
+    ``"pad"`` (default) zero-pads the final stack to the batch size and
+    yields it with only the real blocks in ``blocks`` (check
+    ``len(blocks)`` — padded file slots produce no correlogram energy, so
+    detection outputs there are empty); ``"drop"`` discards them with a
+    warning; ``"error"`` raises up front.
     """
     from ..parallel.pipeline import input_sharding
 
     if batch < 1:
         raise ValueError("batch must be >= 1")
+    if tail not in ("pad", "drop", "error"):
+        raise ValueError(f"tail must be 'pad', 'drop' or 'error', got {tail!r}")
     n_full = (len(files) // batch) * batch
     if n_full != len(files):
-        import warnings
+        if tail == "error":
+            raise ValueError(
+                f"{len(files) - n_full} trailing file(s) do not fill a batch "
+                f"of {batch} (tail='error')"
+            )
+        if tail == "drop":
+            import warnings
 
-        warnings.warn(f"dropping {len(files) - n_full} trailing file(s) not filling a batch of {batch}")
+            warnings.warn(
+                f"dropping {len(files) - n_full} trailing file(s) not filling a batch of {batch}"
+            )
+            files = files[:n_full]
     sharding = input_sharding(mesh) if mesh is not None else None
+
+    def place(stack):
+        if sharding is not None:
+            return jax.device_put(stack, sharding)
+        return jnp.asarray(stack)
 
     # traces stay host-side numpy until the whole batch is assembled, so
     # the [file x channel x time] stack crosses to HBM exactly once and
     # lands pre-sharded — never materialized whole on a single chip
     pending: list[StrainBlock] = []
     for blk in stream_strain_blocks(
-        files[:n_full], selected_channels, metadata,
+        files, selected_channels, metadata,
         interrogator=interrogator, prefetch=prefetch, engine=engine,
         as_numpy=True,
     ):
         pending.append(blk)
         if len(pending) == batch:
-            stack = np.stack([b.trace for b in pending])
-            if sharding is not None:
-                stack = jax.device_put(stack, sharding)
-            else:
-                stack = jnp.asarray(stack)
-            yield stack, tuple(pending)
+            yield place(np.stack([b.trace for b in pending])), tuple(pending)
             pending = []
+    if pending:  # tail == "pad"
+        stack = np.stack([b.trace for b in pending])
+        fill = np.zeros((batch - len(pending),) + stack.shape[1:], stack.dtype)
+        yield place(np.concatenate([stack, fill])), tuple(pending)

@@ -151,6 +151,12 @@ def stft_power(
         raise ValueError(f"expected [channel x time], got shape {x.shape}")
     if hop < 1 or hop > nfft:
         raise ValueError(f"need 1 <= hop <= nfft, got hop={hop}, nfft={nfft}")
+    if not center and x.shape[-1] < nfft:
+        # matches the rfft path (ops/spectral.py): without centering there is
+        # no full frame to take, and silently returning zero frames hides it
+        raise ValueError(
+            f"center=False needs at least nfft={nfft} samples, got {x.shape[-1]}"
+        )
     if window == "hann":
         # periodic Hann, librosa/stft parity
         win = 0.5 * (1.0 - np.cos(2.0 * np.pi * np.arange(nfft) / nfft))

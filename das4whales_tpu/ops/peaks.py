@@ -26,11 +26,27 @@ list-of-index-arrays and (channel, time) tuple formats.
 from __future__ import annotations
 
 import functools
-from typing import List
+from typing import List, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+class SparsePicks(NamedTuple):
+    """Fixed-capacity peak-pick result (one row per channel/correlogram).
+
+    ``positions`` [..., K] sample indices ascending per row (invalid = N),
+    ``selected`` the validity mask, ``saturated`` [...] per-row flag set
+    when more than K local maxima passed the height prefilter (only then
+    can picks be missed).
+    """
+
+    positions: jnp.ndarray
+    heights: jnp.ndarray
+    prominences: jnp.ndarray
+    selected: jnp.ndarray
+    saturated: jnp.ndarray
 
 
 def _carry_last_flagged(values: jnp.ndarray, flags: jnp.ndarray, init: jnp.ndarray):
@@ -297,7 +313,29 @@ def find_peaks_sparse(
     pos_sorted_key = jnp.where(selected, pos, N)
     order = jnp.argsort(pos_sorted_key, axis=-1)
     take = lambda a: jnp.take_along_axis(a, order, axis=-1)
-    return take(pos_sorted_key), take(heights), take(prom), take(selected), saturated
+    return SparsePicks(
+        take(pos_sorted_key), take(heights), take(prom), take(selected), saturated
+    )
+
+
+def find_peaks_sparse_batched(
+    x: jnp.ndarray,
+    threshold,
+    max_peaks: int = 256,
+    nb: int = 128,
+) -> SparsePicks:
+    """``find_peaks_sparse`` over arbitrary leading axes.
+
+    ``x`` is ``[..., T]``; ``threshold`` must broadcast to ``x.shape[:-1]``
+    (e.g. per-template/per-file thresholds in the sharded detection steps).
+    Leading axes are flattened into the channel axis for the kernel and
+    restored on output.
+    """
+    lead = x.shape[:-1]
+    rows = int(np.prod(lead)) if lead else 1
+    thr = jnp.broadcast_to(jnp.asarray(threshold), lead).reshape(rows)
+    res = find_peaks_sparse(x.reshape(rows, x.shape[-1]), thr, max_peaks=max_peaks, nb=nb)
+    return SparsePicks(*(a.reshape(lead + a.shape[1:]) for a in res))
 
 
 def sparse_to_pick_times(positions, selected) -> np.ndarray:

@@ -112,6 +112,10 @@ def stft(
         raise ValueError(f"unknown window {window!r}")
 
     n = x.shape[-1]
+    if not center and n < n_fft:
+        raise ValueError(
+            f"center=False needs at least n_fft={n_fft} samples, got {n}"
+        )
     if center:
         pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
         x = jnp.pad(x, pad)

@@ -35,7 +35,7 @@ def _bp_local(trace: jnp.ndarray, gain: jnp.ndarray, padlen: int) -> jnp.ndarray
 
 def _mf_body(
     trace, mask_half, bp_gain, templates, *, bp_padlen: int, channel_axis: str,
-    relative_threshold: float, hf_factor: float,
+    relative_threshold: float, hf_factor: float, pick_mode: str, max_peaks: int,
 ):
     """shard_map body. Local shapes: trace [B/Pf, C/Pc, T], mask_half
     [K, Fpad/Pc], bp_gain [Fext], templates [nT, T]."""
@@ -52,10 +52,19 @@ def _mf_body(
     factors = jnp.ones(templates.shape[0]).at[0].set(hf_factor)    # HF first
     thr = thres[None, :, None, None] * factors[:, None, None, None]
 
-    peak_mask = peak_ops.local_maxima(env) & (
-        peak_ops.peak_prominences_dense(env) >= thr
-    )
-    return trf_fk, corr, env, peak_mask, thres
+    if pick_mode == "sparse":
+        # TPU production route (ops/peaks.py): envelope peaks are
+        # nonnegative, so the height prefilter is exact; time is unsharded
+        # here, so positions are global sample indices already
+        picks = peak_ops.find_peaks_sparse_batched(
+            env, thr[..., 0], max_peaks=max_peaks
+        )
+    else:
+        # dense debug route: exact per-sample prominences, gather-heavy
+        picks = peak_ops.local_maxima(env) & (
+            peak_ops.peak_prominences_dense(env) >= thr
+        )
+    return trf_fk, corr, env, picks, thres
 
 
 def make_sharded_mf_step(
@@ -65,16 +74,27 @@ def make_sharded_mf_step(
     channel_axis: str = "channel",
     relative_threshold: float = 0.5,
     hf_factor: float = 0.9,
+    pick_mode: str = "sparse",
+    max_peaks: int = 256,
 ):
     """Build the jitted multi-chip detection step for a
     ``[file x channel x time]`` batch.
 
     ``design`` is a ``models.matched_filter.MatchedFilterDesign``. The
     returned callable maps a sharded batch to
-    ``(trf_fk, correlograms, envelopes, peak_masks, thresholds)`` with
-    matching shardings — ready for ``jax.jit`` ahead-of-time compilation on
-    any mesh shape, including the single-chip degenerate mesh.
+    ``(trf_fk, correlograms, envelopes, picks, thresholds)`` with matching
+    shardings — ready for ``jax.jit`` ahead-of-time compilation on any mesh
+    shape, including the single-chip degenerate mesh.
+
+    ``pick_mode="sparse"`` (production, matching the single-chip
+    ``MatchedFilterDetector`` default) yields ``picks`` as an
+    ``ops.peaks.SparsePicks`` of ``[n_templates, file, channel, K]`` arrays
+    (positions/heights/prominences/selected) plus a per-row ``saturated``
+    flag. ``pick_mode="dense"`` (debug) yields the full boolean peak mask —
+    exact everywhere but gather-heavy on TPU (ops/peaks.py:170-186).
     """
+    if pick_mode not in ("sparse", "dense"):
+        raise ValueError(f"pick_mode must be 'sparse' or 'dense', got {pick_mode!r}")
     nnx, nns = design.trace_shape
     pc = mesh.shape[channel_axis]
     if nnx % pc:
@@ -91,7 +111,17 @@ def make_sharded_mf_step(
         channel_axis=channel_axis,
         relative_threshold=relative_threshold,
         hf_factor=hf_factor,
+        pick_mode=pick_mode,
+        max_peaks=max_peaks,
     )
+    tfc = P(None, file_axis, channel_axis, None)  # [template, file, channel, *]
+    if pick_mode == "sparse":
+        picks_spec = peak_ops.SparsePicks(
+            positions=tfc, heights=tfc, prominences=tfc, selected=tfc,
+            saturated=P(None, file_axis, channel_axis),
+        )
+    else:
+        picks_spec = tfc
     fn = shard_map(
         body,
         mesh=mesh,
@@ -103,9 +133,9 @@ def make_sharded_mf_step(
         ),
         out_specs=(
             P(file_axis, channel_axis, None),         # trf_fk
-            P(None, file_axis, channel_axis, None),   # corr
-            P(None, file_axis, channel_axis, None),   # env
-            P(None, file_axis, channel_axis, None),   # peak mask
+            tfc,                                      # corr
+            tfc,                                      # env
+            picks_spec,
             P(file_axis),                             # thresholds
         ),
         check_vma=False,

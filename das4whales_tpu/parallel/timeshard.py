@@ -172,16 +172,26 @@ def make_sharded_mf_step_time(
     halo: int = 512,
     relative_threshold: float = 0.5,
     hf_factor: float = 0.9,
+    pick_mode: str = "sparse",
+    max_peaks: int = 256,
 ):
     """Full flagship detection step for a TIME-sharded ``[C, T]`` block.
 
     Stages: halo-exchanged zero-phase bandpass -> two-collective pencil
     f-k filter -> one ``all_to_all`` transpose into the channel-sharded
     layout -> per-channel matched-filter correlograms, envelopes and peak
-    masks (embarrassingly parallel there), with one ``pmax`` for the global
-    threshold. Returns ``(trf_fk, corr, env, peak_mask, thres)`` where
+    picking (embarrassingly parallel there), with one ``pmax`` for the
+    global threshold. Returns ``(trf_fk, corr, env, picks, thres)`` where
     ``trf_fk`` stays time-sharded and the detection outputs are
     channel-sharded (same mesh axis, relabeled layout).
+
+    ``pick_mode="sparse"`` (production, matching the single-chip
+    ``MatchedFilterDetector`` default) yields ``picks`` as an
+    ``ops.peaks.SparsePicks`` of ``[n_templates, channel, K]`` arrays plus
+    per-row saturation flags; positions are global time indices (the time
+    axis is whole within each channel shard after the relabel transpose).
+    ``pick_mode="dense"`` (debug) yields the full boolean peak mask —
+    exact everywhere but gather-heavy on TPU (ops/peaks.py:170-186).
 
     Numerics: interior samples — including every shard boundary — match
     the single-device pipeline to float32 roundoff. The first/last
@@ -192,6 +202,8 @@ def make_sharded_mf_step_time(
 
     ``design`` is a ``models.matched_filter.MatchedFilterDesign``.
     """
+    if pick_mode not in ("sparse", "dense"):
+        raise ValueError(f"pick_mode must be 'sparse' or 'dense', got {pick_mode!r}")
     nnx, nns = design.trace_shape
     p = mesh.shape[time_axis]
     if nnx % p or nns % p:
@@ -219,11 +231,26 @@ def make_sharded_mf_step_time(
         thres = relative_threshold * file_max
         factors = jnp.ones(tmpl.shape[0]).at[0].set(hf_factor)
         thr = thres * factors[:, None, None]
-        peak_mask = peak_ops.local_maxima(env) & (
-            peak_ops.peak_prominences_dense(env) >= thr
-        )
-        return trf, corr, env, peak_mask, thres
+        if pick_mode == "sparse":
+            # TPU production route: time is whole within each channel
+            # shard here, so positions are global sample indices
+            picks = peak_ops.find_peaks_sparse_batched(
+                env, thr[..., 0], max_peaks=max_peaks
+            )
+        else:
+            picks = peak_ops.local_maxima(env) & (
+                peak_ops.peak_prominences_dense(env) >= thr
+            )
+        return trf, corr, env, picks, thres
 
+    ct = P(None, time_axis, None)  # [template, channel(relabeled), *]
+    if pick_mode == "sparse":
+        picks_spec = peak_ops.SparsePicks(
+            positions=ct, heights=ct, prominences=ct, selected=ct,
+            saturated=P(None, time_axis),
+        )
+    else:
+        picks_spec = ct
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(
@@ -234,9 +261,9 @@ def make_sharded_mf_step_time(
         ),
         out_specs=(
             P(None, time_axis),         # trf_fk stays time-sharded
-            P(None, time_axis, None),   # corr: channel-sharded (relabeled axis)
-            P(None, time_axis, None),   # env
-            P(None, time_axis, None),   # peak mask
+            ct,                         # corr: channel-sharded (relabeled axis)
+            ct,                         # env
+            picks_spec,
             P(),                        # threshold (replicated scalar)
         ),
         check_vma=False,

@@ -1,0 +1,80 @@
+"""Accelerator probing and safe CPU-mesh fallback.
+
+This image's ``sitecustomize`` registers an experimental TPU platform at
+interpreter start that can hang ``jax.devices()`` indefinitely when the
+tunnel is wedged. Every entry point that must not hang (the benchmark,
+the driver's multi-chip dry run) probes the backend in a subprocess with
+a timeout first, and falls back to a virtual CPU host mesh — forcing the
+platform through the live config, because the ``JAX_PLATFORMS`` env var
+alone is applied too late under that sitecustomize.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "jnp.ones((8, 8)).sum().block_until_ready();"
+    "print(len(jax.devices()))"
+)
+
+
+def probe_backend(timeout_s: float) -> int:
+    """Number of devices the default JAX backend exposes, or 0 if it fails
+    to initialize and run one op within ``timeout_s``. Probed in a
+    subprocess so a wedged accelerator cannot hang the caller."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+        return int(proc.stdout.strip()) if proc.returncode == 0 else 0
+    except (subprocess.TimeoutExpired, ValueError):
+        return 0
+
+
+def force_cpu_host_devices(n_devices: int) -> None:
+    """Point this process at an ``n_devices``-device virtual CPU mesh.
+
+    Must run before the first JAX backend use. Overwrites any existing
+    ``--xla_force_host_platform_device_count`` flag (a stale smaller value
+    would silently cap the mesh below ``n_devices``).
+    """
+    import jax
+
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_devices(n_devices: int, probe_timeout_s: float | None = None) -> None:
+    """Guarantee ``jax.devices()`` will return >= n_devices working devices,
+    falling back to a virtual CPU host mesh whenever the default backend is
+    unreachable or exposes fewer than ``n_devices`` real chips."""
+    import jax
+
+    if probe_timeout_s is None:
+        probe_timeout_s = float(os.environ.get("DAS_PROBE_TIMEOUT", 30.0))
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        need_cpu = True  # explicit CPU request still needs enough host devices
+    else:
+        need_cpu = probe_backend(probe_timeout_s) < n_devices
+
+    if need_cpu:
+        force_cpu_host_devices(n_devices)
+
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, have {len(jax.devices())} "
+            f"on platform {jax.devices()[0].platform}"
+        )

@@ -104,19 +104,29 @@ def detect_long_record(
     step = make_sharded_mf_step_time(
         design, mesh, time_axis=time_axis, halo=halo,
         relative_threshold=relative_threshold, hf_factor=hf_factor,
+        pick_mode="sparse", max_peaks=max_peaks_per_channel,
     )
     xd = jax.device_put(jnp.asarray(record), time_sharding(mesh, time_axis))
-    trf, corr, env, peak_mask, thres = jax.block_until_ready(step(xd))
+    trf, corr, env, sp_picks, thres = jax.block_until_ready(step(xd))
 
     picks, times_s, thr_out = {}, {}, {}
     factors = {name: (hf_factor if i == 0 else 1.0)
                for i, name in enumerate(design.template_names)}
+    positions = np.asarray(sp_picks.positions)
+    selected = np.asarray(sp_picks.selected)
+    saturated = np.asarray(sp_picks.saturated)
     for i, name in enumerate(design.template_names):
-        mask_np = np.array(peak_mask[i])  # np.asarray of a jax array is read-only
-        mask_np[:, n_samples:] = False  # drop the divisibility padding
-        pk = peak_ops.convert_pick_times(mask_np)
-        if pk.shape[1] > max_peaks_per_channel * nnx:
-            log.warning("clipping %d picks for %s", pk.shape[1], name)
+        if saturated[i].any():
+            log.warning(
+                "%s: peak capacity saturated on %d/%d channels; picks beyond "
+                "the %d tallest per channel were dropped — raise "
+                "max_peaks_per_channel to keep them",
+                name, int(saturated[i].sum()), nnx, max_peaks_per_channel,
+            )
+        # drop picks inside the divisibility padding (padded zeros cannot
+        # raise the pmax threshold, but the envelope can ring there)
+        sel = selected[i] & (positions[i] < n_samples)
+        pk = peak_ops.sparse_to_pick_times(positions[i], sel)
         picks[name] = pk
         times_s[name] = pk[1] / meta.fs
         thr_out[name] = float(thres) * factors[name]

@@ -95,6 +95,23 @@ def test_tdms_roundtrip(tmp_path, rng):
     assert data.shape == (8, 300)
 
 
+def test_silixa_channel_order_natural(tmp_path, rng):
+    """Channels with non-padded numeric names load in numeric order, not
+    string order (ch1/ch10/ch2 interleaving)."""
+    from das4whales_tpu.io.interrogators import _natural_key
+
+    n = 12  # names 0..11: string sort would put "10", "11" before "2"
+    chans = {f"ch{i}": np.full(16, i, dtype=np.int16) for i in range(n)}
+    # insertion order scrambled too, so the test can't pass by accident
+    scrambled = dict(sorted(chans.items(), key=lambda kv: str(kv[0])))
+    path = tdms.write_tdms(str(tmp_path / "order.tdms"), {}, "Measurement", scrambled)
+    data = load_silixa_data(path)
+    np.testing.assert_array_equal(data[:, 0], np.arange(n))
+
+    # mixed structures must not raise (int-vs-str tuple comparison)
+    assert sorted(["b2", "2b", "a", "10"], key=_natural_key) == ["2b", "10", "a", "b2"]
+
+
 def test_tdms_multisegment(tmp_path, rng):
     """Segments appended with 'same as previous' raw index concatenate."""
     import struct

@@ -36,6 +36,15 @@ def test_read_strided_raw_parity(h5file):
     np.testing.assert_array_equal(got, raw[4:60:2].astype(np.float32))
 
 
+def test_read_strided_empty_selection(h5file):
+    """A valid-but-empty channel range yields an empty block (h5py slicing
+    semantics), not the C engine's -22 error."""
+    path, _ = h5file
+    offset, dtype, (nx, ns) = _layout(path)
+    got = native.read_strided(path, offset, dtype, nx, ns, 10, 10, 1)
+    assert got.shape == (0, ns) and got.dtype == np.float32
+
+
 def test_read_strided_fused_strain(h5file):
     path, raw = h5file
     offset, dtype, (nx, ns) = _layout(path)

@@ -60,6 +60,10 @@ def test_stft_power_validates_args(rng):
         stft_power(x, 32, 0)             # bad hop
     with pytest.raises(ValueError):
         stft_power(x, 32, 8, window="nuttall")
+    with pytest.raises(ValueError, match="center=False"):
+        stft_power(x, 128, 8, center=False)  # n < nfft: no full frame
+    with pytest.raises(ValueError, match="center=False"):
+        spectral.stft(x, 128, 8, center=False)
 
 
 def test_stft_magnitude_engines_agree(rng):

@@ -62,11 +62,14 @@ def test_sharded_mf_step_matches_unsharded(mesh2x4, rng):
     from das4whales_tpu.parallel.pipeline import input_sharding
 
     xb = jax.device_put(jnp.asarray(batch), input_sharding(mesh2x4))
-    trf_fk, corr, env, peak_mask, thres = step(xb)
+    trf_fk, corr, env, picks, thres = step(xb)
 
     assert trf_fk.shape == (2, NX, NS)
     assert corr.shape == (2, 2, NX, NS)  # [n_templates, file, channel, time]
-    assert peak_mask.dtype == bool
+    # sparse production picks: [n_templates, file, channel, K] slots
+    assert picks.positions.shape[:3] == (2, 2, NX)
+    assert picks.selected.dtype == bool
+    assert picks.saturated.shape == (2, 2, NX)
 
     for b in range(2):
         want_fk, want_corr = mf_filter_and_correlate(
@@ -85,11 +88,37 @@ def test_sharded_mf_step_matches_unsharded(mesh2x4, rng):
 
 
 def test_sharded_step_picks_match_detector(mesh2x4, rng):
-    """Peak masks from the sharded step equal the single-device detector's."""
+    """Sparse picks from the sharded step equal the single-device detector's
+    (both run the production find_peaks_sparse route)."""
+    from das4whales_tpu.ops import peaks as peak_ops
+
     design = design_matched_filter((NX, NS), SEL, META)
     step = make_sharded_mf_step(design, mesh2x4)
     batch = rng.standard_normal((2, NX, NS)).astype(np.float32)
+    _, _, _, picks, _ = step(jnp.asarray(batch))
+
+    det = MatchedFilterDetector(META, SEL, (NX, NS), pick_mode="sparse")
+    pos = np.asarray(picks.positions)
+    sel = np.asarray(picks.selected)
+    assert not np.asarray(picks.saturated).any()
+    for b in range(2):
+        res = det(batch[b])
+        for i, name in enumerate(det.design.template_names):
+            got = set(map(tuple, peak_ops.sparse_to_pick_times(pos[i, b], sel[i, b]).T))
+            want = set(map(tuple, res.picks[name].T))
+            # float32 threshold ties can flip individual marginal peaks;
+            # demand near-total agreement
+            assert len(got ^ want) <= max(2, 0.02 * max(len(want), 1))
+
+
+def test_sharded_step_dense_debug_route(mesh2x4, rng):
+    """pick_mode='dense' (debug) still yields the exact boolean peak mask."""
+    design = design_matched_filter((NX, NS), SEL, META)
+    step = make_sharded_mf_step(design, mesh2x4, pick_mode="dense")
+    batch = rng.standard_normal((2, NX, NS)).astype(np.float32)
     _, _, _, peak_mask, _ = step(jnp.asarray(batch))
+    assert peak_mask.shape == (2, 2, NX, NS)
+    assert peak_mask.dtype == bool
 
     det = MatchedFilterDetector(META, SEL, (NX, NS), peak_block=NX, pick_mode="dense")
     for b in range(2):
@@ -97,10 +126,11 @@ def test_sharded_step_picks_match_detector(mesh2x4, rng):
         for i, name in enumerate(det.design.template_names):
             got = np.asarray(peak_mask)[i, b]
             want = res.peak_masks[name]
-            # float32 threshold ties can flip individual marginal peaks;
-            # demand near-total agreement
             disagree = np.count_nonzero(got != want)
             assert disagree <= max(2, 0.01 * np.count_nonzero(want))
+
+    with pytest.raises(ValueError, match="pick_mode"):
+        make_sharded_mf_step(design, mesh2x4, pick_mode="nope")
 
 
 def test_mesh_helpers():

@@ -92,9 +92,9 @@ def test_stream_file_batches_sharded(file_set):
     paths, raws = file_set
     meta = get_acquisition_parameters(paths[0], "optasense")
     mesh = make_mesh(shape=(2, 4), axis_names=("file", "channel"))
-    with pytest.warns(UserWarning, match="dropping 1 trailing"):
-        batches = list(stream_file_batches(paths, [0, 32, 1], meta, batch=2, mesh=mesh))
-    assert len(batches) == 2
+    batches = list(stream_file_batches(paths, [0, 32, 1], meta, batch=2, mesh=mesh))
+    # default tail="pad": 5 files -> 2 full batches + 1 zero-padded
+    assert len(batches) == 3
     stack, blocks = batches[0]
     assert stack.shape == (2, 32, 400)
     assert len(blocks) == 2
@@ -104,3 +104,21 @@ def test_stream_file_batches_sharded(file_set):
         np.asarray(stack[1]), _expected(raws[1], [0, 32, 1], meta.scale_factor),
         rtol=1e-4, atol=1e-16,
     )
+    tail_stack, tail_blocks = batches[2]
+    assert tail_stack.shape == (2, 32, 400)
+    assert len(tail_blocks) == 1          # one real file in the final batch
+    assert not np.asarray(tail_stack[1]).any()  # padded slot is zeros
+
+
+def test_stream_file_batches_tail_policies(file_set):
+    paths, _ = file_set
+    meta = get_acquisition_parameters(paths[0], "optasense")
+    with pytest.warns(UserWarning, match="dropping 1 trailing"):
+        dropped = list(stream_file_batches(
+            paths, [0, 32, 1], meta, batch=2, tail="drop"
+        ))
+    assert len(dropped) == 2 and all(len(b) == 2 for _, b in dropped)
+    with pytest.raises(ValueError, match="tail='error'"):
+        list(stream_file_batches(paths, [0, 32, 1], meta, batch=2, tail="error"))
+    with pytest.raises(ValueError, match="tail must be"):
+        list(stream_file_batches(paths, [0, 32, 1], meta, batch=2, tail="wrap"))

@@ -96,7 +96,7 @@ def test_full_time_sharded_step_matches_single_device(tmesh, rng):
 
     step = make_sharded_mf_step_time(design, tmesh, halo=384)
     xd = jax.device_put(jnp.asarray(x), time_sharding(tmesh))
-    trf_t, corr_t, env_t, mask_t, thres_t = jax.block_until_ready(step(xd))
+    trf_t, corr_t, env_t, picks_t, thres_t = jax.block_until_ready(step(xd))
 
     trf_s, corr_s = mf_filter_and_correlate(
         jnp.asarray(x), jnp.asarray(design.fk_mask), jnp.asarray(design.bp_gain),
@@ -112,8 +112,39 @@ def test_full_time_sharded_step_matches_single_device(tmesh, rng):
     np.testing.assert_allclose(a[..., edge:-edge] / scale, b[..., edge:-edge] / scale, atol=5e-4)
     np.testing.assert_allclose(a / scale, b / scale, atol=5e-2)  # edges: loose
     assert float(thres_t) == pytest.approx(0.5 * float(np.max(b)), rel=2e-3)
-    # the injected call is picked in the sharded step
-    assert bool(np.asarray(mask_t)[0, 10].any())
+    # the injected call is picked in the sharded step (sparse production
+    # route: fixed-capacity [template, channel, K] slots)
+    assert picks_t.positions.shape[:2] == (2, nnx)
+    assert bool(np.asarray(picks_t.selected)[0, 10].any())
+    assert not np.asarray(picks_t.saturated).any()
+
+
+def test_time_sharded_step_dense_debug_route(tmesh, rng):
+    """pick_mode='dense' still yields the boolean mask, and picks agree with
+    the sparse route's positions."""
+    nnx, nns = 32, 4096
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nnx, ns=nns)
+    design = design_matched_filter((nnx, nns), [0, nnx, 1], meta)
+    x = rng.standard_normal((nnx, nns)).astype(np.float32) * 1e-9
+    tmpl = np.asarray(design.templates[0])
+    x[10, 500 : 500 + tmpl.shape[-1]] += 5e-9 * tmpl[: min(tmpl.shape[-1], nns - 500)]
+    xd = jax.device_put(jnp.asarray(x), time_sharding(tmesh))
+
+    dense_step = make_sharded_mf_step_time(design, tmesh, halo=384, pick_mode="dense")
+    *_, mask_t, _ = jax.block_until_ready(dense_step(xd))
+    assert mask_t.shape == (2, nnx, nns)
+    assert mask_t.dtype == bool
+
+    sparse_step = make_sharded_mf_step_time(design, tmesh, halo=384)
+    *_, picks_t, _ = jax.block_until_ready(sparse_step(xd))
+    for i in range(2):
+        want = {
+            (c, t) for c, t in zip(*np.nonzero(np.asarray(mask_t)[i]))
+        }
+        sel = np.asarray(picks_t.selected)[i]
+        pos = np.asarray(picks_t.positions)[i]
+        got = {(c, pos[c, k]) for c, k in zip(*np.nonzero(sel))}
+        assert len(got ^ want) <= max(2, 0.02 * max(len(want), 1))
 
 
 def test_design_carries_fs():
