@@ -23,12 +23,28 @@ import time
 import numpy as np
 
 
+def _device_utils():
+    """Load das4whales_tpu/utils/device.py by file path, NOT via the
+    package: the fallback decision must happen in a process that has made
+    no jax backend use yet, and importing the package pulls in every
+    submodule. Loading the single file keeps the pre-probe footprint to
+    os/re/subprocess."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "das4whales_tpu", "utils", "device.py",
+    )
+    spec = importlib.util.spec_from_file_location("_dw_device_probe", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _probe_device(timeout_s: float) -> bool:
     """True iff the default JAX backend initializes and runs one op within
-    ``timeout_s`` (shared subprocess probe, das4whales_tpu.utils.device)."""
-    from das4whales_tpu.utils.device import probe_backend
-
-    return probe_backend(timeout_s) > 0
+    ``timeout_s`` (shared subprocess probe, das4whales_tpu/utils/device.py)."""
+    return _device_utils().probe_backend(timeout_s) > 0
 
 
 def _probe_device_with_backoff(total_budget_s: float) -> bool:
@@ -55,14 +71,13 @@ def _probe_device_with_backoff(total_budget_s: float) -> bool:
 
 
 def _force_cpu():
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+    """Single-device CPU fallback via the shared helper (env var + live
+    config; the env var alone is too late under this image's sitecustomize)."""
+    _device_utils().force_cpu_host_devices(1)
 
-    jax.config.update("jax_platforms", "cpu")
 
-
-# bench.py runs from the repo root; make the package importable for the
-# shared device-probe helpers without an install step
+# bench.py runs from the repo root; make the package importable without an
+# install step (heavy imports happen only after the fallback decision)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -82,7 +97,7 @@ def _make_block(nx, ns, fs, dx, seed=0):
     return block
 
 
-def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048):
+def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True):
     import jax
     import jax.numpy as jnp
 
@@ -106,7 +121,7 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048):
         res = run()
         times.append(time.perf_counter() - t0)
     n_picks = sum(int(v.shape[1]) for v in res.picks.values())
-    stages = bench_stages(det, x, repeats=repeats)
+    stages = bench_stages(det, x, repeats=repeats) if with_stages else None
     return min(times), n_picks, str(jax.devices()[0]), stages
 
 
@@ -198,6 +213,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (CI smoke)")
     ap.add_argument("--no-cpu", action="store_true", help="skip CPU baseline; report cached ratio")
+    ap.add_argument("--no-stages", action="store_true",
+                    help="skip the per-stage breakdown (headline number only)")
     ap.add_argument(
         "--device-timeout", type=float,
         default=float(os.environ.get("DAS_BENCH_DEVICE_TIMEOUT", 180.0)),
@@ -230,7 +247,9 @@ def main():
         nx, ns, cpu_nx = 22050, 12000, 1050
         peak_block = 2048
 
-    wall, n_picks, device, stages = bench_tpu(nx, ns, fs, dx, peak_block=peak_block)
+    wall, n_picks, device, stages = bench_tpu(
+        nx, ns, fs, dx, peak_block=peak_block, with_stages=not args.no_stages
+    )
     if fallback:
         device = f"cpu-fallback (accelerator unreachable within {args.device_timeout:.0f}s): {device}"
     value = nx * ns / wall

@@ -190,10 +190,9 @@ def stream_file_batches(
     yields it with only the real blocks in ``blocks`` (check
     ``len(blocks)`` — padded file slots produce no correlogram energy, so
     detection outputs there are empty); ``"drop"`` discards them with a
-    warning; ``"error"`` raises up front.
+    warning; ``"error"`` raises up front (at call time, not first
+    ``next()`` — validation happens before the generator is created).
     """
-    from ..parallel.pipeline import input_sharding
-
     if batch < 1:
         raise ValueError("batch must be >= 1")
     if tail not in ("pad", "drop", "error"):
@@ -212,6 +211,18 @@ def stream_file_batches(
                 f"dropping {len(files) - n_full} trailing file(s) not filling a batch of {batch}"
             )
             files = files[:n_full]
+    return _file_batches_gen(
+        list(files), selected_channels, metadata, batch=batch, mesh=mesh,
+        interrogator=interrogator, prefetch=prefetch, engine=engine,
+    )
+
+
+def _file_batches_gen(
+    files, selected_channels, metadata, *, batch, mesh, interrogator,
+    prefetch, engine,
+) -> Iterator[tuple]:
+    from ..parallel.pipeline import input_sharding
+
     sharding = input_sharding(mesh) if mesh is not None else None
 
     def place(stack):

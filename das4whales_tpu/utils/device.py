@@ -32,7 +32,13 @@ def probe_backend(timeout_s: float) -> int:
             [sys.executable, "-c", _PROBE_SRC],
             timeout=timeout_s, capture_output=True, text=True,
         )
-        return int(proc.stdout.strip()) if proc.returncode == 0 else 0
+        if proc.returncode != 0:
+            return 0
+        # parse the last stdout token: runtimes/plugins may print banners
+        # before our count, and a healthy backend must not be mistaken for
+        # a dead one over stray output
+        tokens = proc.stdout.split()
+        return int(tokens[-1]) if tokens else 0
     except (subprocess.TimeoutExpired, ValueError):
         return 0
 
