@@ -168,7 +168,7 @@ class MatchedFilterDetector:
         bp_band=(14.0, 30.0),
         templates: Dict[str, CallTemplateConfig] | None = None,
         peak_block: int = 1024,
-        pick_mode: str = "sparse",
+        pick_mode: str = "auto",
         max_peaks: int = 256,
     ):
         self.metadata = as_metadata(metadata)
@@ -176,6 +176,14 @@ class MatchedFilterDetector:
             trace_shape, selected_channels, self.metadata, fk_config, bp_band, templates
         )
         self.peak_block = peak_block
+        if pick_mode == "auto":
+            # engine per backend: the fixed-capacity block-table kernels on
+            # accelerators; scipy's sequential walk when the envelope lands
+            # on a CPU host anyway (order-of-magnitude faster there,
+            # docs/PERF.md)
+            pick_mode = "sparse" if jax.default_backend() != "cpu" else "scipy"
+        if pick_mode not in ("sparse", "scipy", "dense"):
+            raise ValueError(f"unknown pick_mode {pick_mode!r}")
         self.pick_mode = pick_mode
         self.max_peaks = max_peaks
         self._mask_dev = jnp.asarray(self.design.fk_mask)
@@ -216,6 +224,9 @@ class MatchedFilterDetector:
                         f"peak capacity saturated for template {name}; "
                         f"raise max_peaks (now {self.max_peaks})"
                     )
+            elif self.pick_mode == "scipy":
+                # CPU host route: exact sequential walk, no capacity limit
+                picks[name] = peak_ops.find_peaks_scipy_host(env[i], thresholds[i])
             else:
                 mask = peak_ops.find_peaks_prominence_blocked(
                     env[i], thresholds[i], self.peak_block

@@ -365,6 +365,30 @@ def find_peaks_prominence_blocked(x: jnp.ndarray, threshold, block_size: int = 1
     return out.reshape(nblocks * block_size, n)[:c]
 
 
+def find_peaks_scipy_host(env, threshold) -> np.ndarray:
+    """Host-side exact picking: per-channel ``scipy.signal.find_peaks``.
+
+    Returns the stacked ``(2, n)`` [channel_idx, time_idx] pick array. Same
+    semantics as ``find_peaks_sparse`` (without the capacity limit) and as
+    the reference's per-channel loop (detect.py:169-274). This is the right
+    engine when the arrays live on a CPU host anyway: scipy's sequential
+    walk beats the TPU-shaped block-table kernels on a scalar core by an
+    order of magnitude (see docs/PERF.md), while on accelerator backends it
+    would force a device->host round trip per block — use ``sparse`` there.
+    """
+    import scipy.signal as sp
+
+    env = np.asarray(env)
+    thr = np.broadcast_to(np.asarray(threshold), (env.shape[0],))
+    chan: list = []
+    time: list = []
+    for i in range(env.shape[0]):
+        pk = sp.find_peaks(env[i], prominence=thr[i])[0]
+        chan.extend([i] * len(pk))
+        time.extend(pk.tolist())
+    return np.asarray([chan, time], dtype=np.int64).reshape(2, -1)
+
+
 # ---------------------------------------------------------------------------
 # Reference-shaped outputs (host side)
 # ---------------------------------------------------------------------------

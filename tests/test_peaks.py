@@ -92,6 +92,47 @@ def test_find_peaks_sparse_saturation_flag(rng):
     assert bool(np.asarray(saturated)[0])
 
 
+def test_scipy_host_route_matches_sparse(rng):
+    """The CPU host engine and the TPU sparse engine agree pick-for-pick."""
+    x = np.abs(rng.standard_normal((6, 500))) + 0.01
+    thr = 0.9
+    host = peaks.find_peaks_scipy_host(x, thr)
+    pos, _, _, sel, sat = peaks.find_peaks_sparse(x, thr, max_peaks=256, nb=32)
+    assert not np.asarray(sat).any()
+    np.testing.assert_array_equal(host, peaks.sparse_to_pick_times(pos, sel))
+    # per-channel thresholds broadcast too
+    thr_v = np.linspace(0.7, 1.2, 6)
+    host_v = peaks.find_peaks_scipy_host(x, thr_v)
+    pos, _, _, sel, _ = peaks.find_peaks_sparse(x, thr_v, max_peaks=256, nb=32)
+    np.testing.assert_array_equal(host_v, peaks.sparse_to_pick_times(pos, sel))
+
+
+def test_detector_pick_mode_auto_and_scipy(rng):
+    """pick_mode='auto' resolves to the scipy host engine on CPU and yields
+    the same picks as the sparse engine."""
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    import pytest
+
+    nx, ns = 32, 1024
+    meta = AcquisitionMetadata(fs=200.0, dx=4.0, nx=nx, ns=ns)
+    det_auto = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns))
+    assert det_auto.pick_mode == "scipy"  # CPU backend in tests
+    det_sparse = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns), pick_mode="sparse")
+
+    x = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
+    tmpl = np.asarray(det_auto.design.templates[0])
+    x[7, 300 : 300 + tmpl.shape[-1]] += 5e-9 * tmpl[: min(tmpl.shape[-1], ns - 300)]
+    res_a = det_auto(x)
+    res_s = det_sparse(x)
+    for name in det_auto.design.template_names:
+        np.testing.assert_array_equal(res_a.picks[name], res_s.picks[name])
+
+    with pytest.raises(ValueError, match="pick_mode"):
+        MatchedFilterDetector(meta, [0, nx, 1], (nx, ns), pick_mode="bogus")
+
+
 def test_pick_list_helpers(rng):
     x = rng.standard_normal((3, 200))
     mask = np.asarray(peaks.find_peaks_prominence(x, 0.5))
