@@ -10,8 +10,9 @@ definition (walk away from each peak until a higher sample), which SURVEY.md
 This module computes *exact* scipy ``find_peaks`` + prominence results for
 every sample of every channel simultaneously:
 
-* plateau-aware local maxima via an associative "carry last differing
-  value" scan (``lax.associative_scan``) — O(N log N) depth-parallel;
+* plateau-aware local maxima via a packed-key native ``lax.cummax`` (run
+  start index and entry-rise flag in one int32) — O(N), elementwise + one
+  cumulative max, no generic scan (TPU-compiler friendly next to sorts);
 * prominences via binary-lifting over precomputed sliding window max/min
   tables (sparse tables): for each sample, a greedy high-to-low descent
   skips power-of-two blocks whose max does not exceed the peak, folding in
@@ -49,16 +50,29 @@ class SparsePicks(NamedTuple):
     saturated: jnp.ndarray
 
 
-def _carry_last_flagged(values: jnp.ndarray, flags: jnp.ndarray, init: jnp.ndarray):
-    """For each i, the most recent ``values[j]`` (j <= i) where ``flags[j]``,
-    else ``init``. Associative scan along the last axis."""
-    def combine(a, b):
-        av, af = a
-        bv, bf = b
-        return jnp.where(bf, bv, av), af | bf
+def _run_info(x: jnp.ndarray):
+    """(run_start, rising) per sample: the start index of the sample's
+    equal-value run and whether the run was entered by a strict rise
+    (``x[start-1] < x[start]``; False for the run touching the left edge).
 
-    v, f = jax.lax.associative_scan(combine, (values, flags), axis=-1)
-    return jnp.where(f, v, init)
+    Implemented with ONE native ``lax.cummax`` over a packed int32 key
+    ``2*start + rising`` — the index part is monotone, so cummax carries the
+    latest run start forward and the LSB smuggles the boolean along with no
+    gather and no generic ``associative_scan``. (The earlier tuple
+    associative-scan formulation wedged the TPU compiler for minutes when it
+    shared an XLA module with ``top_k``/``sort`` — measured on v5e during
+    round 3 — and was slower everywhere.)
+    """
+    n = x.shape[-1]
+    chg = x[..., 1:] != x[..., :-1]
+    rising = x[..., 1:] > x[..., :-1]
+    idx1 = jnp.arange(1, n, dtype=jnp.int32)
+    # i=0 starts a run with rising=False (left-edge run: never a peak)
+    key_tail = jnp.where(chg, 2 * idx1 + rising.astype(jnp.int32), -1)
+    zeros = jnp.zeros(x.shape[:-1] + (1,), jnp.int32)
+    key = jnp.concatenate([zeros, key_tail], axis=-1)
+    carried = jax.lax.cummax(key, axis=x.ndim - 1)
+    return carried >> 1, (carried & 1).astype(bool)
 
 
 def local_maxima(x: jnp.ndarray) -> jnp.ndarray:
@@ -72,34 +86,12 @@ def local_maxima(x: jnp.ndarray) -> jnp.ndarray:
     n = x.shape[-1]
     idx = jnp.arange(n)
 
-    xl = jnp.concatenate([x[..., :1], x[..., :-1]], axis=-1)  # x[i-1]
-    diff_l = jnp.concatenate(
-        [jnp.zeros(x.shape[:-1] + (1,), bool), x[..., 1:] != x[..., :-1]], axis=-1
-    )
-    # previous differing value; +inf sentinel at the leading edge so
-    # edge-touching runs never qualify
-    inf = jnp.asarray(jnp.inf, x.dtype)
-    prev_diff = _carry_last_flagged(xl, diff_l, inf)
-    # run start index
-    # run start index (leading run starts at 0; it has prev_diff = +inf so it
-    # is excluded from peaks regardless)
-    run_start = _carry_last_flagged(
-        jnp.broadcast_to(idx, x.shape), diff_l, jnp.asarray(0)
-    )
+    run_start, rising = _run_info(x)
+    run_start_r, falling_r = _run_info(jnp.flip(x, axis=-1))
+    run_end = (n - 1) - jnp.flip(run_start_r, axis=-1)
+    falling = jnp.flip(falling_r, axis=-1)  # run exited by a strict fall
 
-    # mirror for the right side
-    xr = jnp.flip(x, axis=-1)
-    diff_r = jnp.concatenate(
-        [jnp.zeros(x.shape[:-1] + (1,), bool), xr[..., 1:] != xr[..., :-1]], axis=-1
-    )
-    xrl = jnp.concatenate([xr[..., :1], xr[..., :-1]], axis=-1)
-    next_diff = jnp.flip(_carry_last_flagged(xrl, diff_r, inf), axis=-1)
-    run_end = (n - 1) - jnp.flip(
-        _carry_last_flagged(jnp.broadcast_to(idx, x.shape), diff_r, jnp.asarray(0)),
-        axis=-1,
-    )
-
-    is_peak_run = (prev_diff < x) & (next_diff < x)
+    is_peak_run = rising & falling
     mid = (run_start + run_end) // 2
     return is_peak_run & (idx == mid)
 

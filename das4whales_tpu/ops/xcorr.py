@@ -13,6 +13,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def next_fast_len(n: int) -> int:
@@ -120,6 +121,72 @@ def compute_cross_correlograms_multi(data: jnp.ndarray, templates: jnp.ndarray) 
     Yb = jnp.conj(Y).reshape((Y.shape[0],) + (1,) * (X.ndim - 1) + (Y.shape[-1],))
     corr = jnp.fft.irfft(X[None, ...] * Yb, nfft, axis=-1)
     return corr[..., :n].astype(data.dtype)
+
+
+def padded_template_stats(templates_padded):
+    """Decompose a trace-length zero-padded template stack into the
+    true-length form used by the memory-lean correlate route.
+
+    The reference pads templates to the full trace length before
+    correlating (detect.py:68-93 + detect.py:140-166), which forces
+    ``nfft = next_fast_len(2n-1)`` — double the FFT length (and, at the
+    canonical 22050x12000 OOI shape, >12 GB of one-program temps; the
+    round-2 HBM OOM). But the padded-template correlogram is exactly
+    recoverable from a true-length correlation: with ``mu`` the mean of the
+    padded template and ``s`` its peak magnitude, the reference's
+    demeaned/normalized template is ``(y_pad - mu)/s``, so
+
+        corr[k] = (sum_j x[k+j] y_true[j] - mu * suffix_sum(x)[k]) / s
+
+    where ``suffix_sum(x)[k] = sum_{i>=k} x[i]`` (the zero tail of the
+    padded template contributes ``-mu`` against every remaining sample).
+    Verified exact to machine precision against the padded route.
+
+    Returns ``(templates_true [nT, m], mu [nT], scale [nT])`` as host
+    numpy; ``scale`` is each template's OWN peak magnitude, matching the
+    reference's template-by-template normalization (detect.py:140-166).
+    """
+    t = np.asarray(templates_padded)
+    t = np.atleast_2d(t)
+    nz = np.abs(t) > 0
+    m = 1
+    for row in nz:
+        idx = np.nonzero(row)[0]
+        if idx.size:
+            m = max(m, int(idx[-1]) + 1)
+    mu = t.mean(axis=-1)
+    scale = np.max(np.abs(t), axis=-1)
+    return t[:, :m].copy(), mu.astype(t.dtype), scale.astype(t.dtype)
+
+
+@jax.jit
+def compute_cross_correlograms_corrected(
+    data: jnp.ndarray, templates_true: jnp.ndarray, mu: jnp.ndarray, scale: jnp.ndarray
+) -> jnp.ndarray:
+    """Numerics of ``compute_cross_correlograms_multi(data, padded)`` with
+    TRUE-length template FFTs: ``nfft = next_fast_len(n + m - 1)`` instead
+    of ``next_fast_len(2n - 1)`` — half the FFT length and half the
+    correlate-stage temps at the canonical shape (see
+    ``padded_template_stats`` for the exact algebra).
+
+    ``data`` is ``[..., n]`` with arbitrary leading (batch/channel) axes;
+    returns ``[nT, ..., n]``.
+    """
+    n, m = data.shape[-1], templates_true.shape[-1]
+    nfft = _xcorr_full_len(n, m)
+    mean = jnp.mean(data, axis=-1, keepdims=True)
+    mx = jnp.max(jnp.abs(data), axis=-1, keepdims=True)
+    # tiny guard: all-zero (padding) rows yield corr == 0 instead of NaN
+    tiny = jnp.asarray(jnp.finfo(data.dtype).tiny, data.dtype)
+    xn = (data - mean) / jnp.maximum(mx, tiny)
+    suffix = jnp.flip(jnp.cumsum(jnp.flip(xn, -1), axis=-1), -1)
+    X = jnp.fft.rfft(xn, nfft, axis=-1)
+    Y = jnp.fft.rfft(templates_true, nfft, axis=-1)
+    Yb = jnp.conj(Y).reshape((Y.shape[0],) + (1,) * (xn.ndim - 1) + (Y.shape[-1],))
+    raw = jnp.fft.irfft(X[None, ...] * Yb, nfft, axis=-1)[..., :n]
+    mu_b = mu.reshape((mu.shape[0],) + (1,) * xn.ndim)
+    scale_b = jnp.asarray(scale).reshape((Y.shape[0],) + (1,) * xn.ndim)
+    return ((raw - mu_b * suffix[None, ...]) / scale_b).astype(data.dtype)
 
 
 @jax.jit

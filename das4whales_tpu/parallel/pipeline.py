@@ -34,22 +34,27 @@ def _bp_local(trace: jnp.ndarray, gain: jnp.ndarray, padlen: int) -> jnp.ndarray
 
 
 def _mf_body(
-    trace, mask_half, bp_gain, templates, *, bp_padlen: int, channel_axis: str,
+    trace, mask_half, bp_gain, templates_true, template_mu, template_scale, *,
+    bp_padlen: int, channel_axis: str,
     relative_threshold: float, hf_factor: float, pick_mode: str, max_peaks: int,
 ):
     """shard_map body. Local shapes: trace [B/Pf, C/Pc, T], mask_half
-    [K, Fpad/Pc], bp_gain [Fext], templates [nT, T]."""
+    [K, Fpad/Pc], bp_gain [Fext], templates_true [nT, m] (TRUE length —
+    the memory-lean correlate route, ops/xcorr.py:padded_template_stats,
+    halves the per-shard FFT temps vs the padded form)."""
     tr_bp = _bp_local(trace, bp_gain, bp_padlen)
     trf_fk = fk_apply_local(tr_bp, mask_half, channel_axis)
 
-    corr = xcorr.compute_cross_correlograms_multi(trf_fk, templates)
+    corr = xcorr.compute_cross_correlograms_corrected(
+        trf_fk, templates_true, template_mu, template_scale
+    )
     env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
 
     # per-file threshold: global max over templates/channels/time of the file
     local_max = jnp.max(corr, axis=(0, 2, 3))                     # [B/Pf]
     file_max = jax.lax.pmax(local_max, channel_axis)
     thres = relative_threshold * file_max                          # [B/Pf]
-    factors = jnp.ones(templates.shape[0]).at[0].set(hf_factor)    # HF first
+    factors = jnp.ones(templates_true.shape[0]).at[0].set(hf_factor)  # HF first
     thr = thres[None, :, None, None] * factors[:, None, None, None]
 
     if pick_mode == "sparse":
@@ -103,7 +108,10 @@ def make_sharded_mf_step(
     pad_f = (-nf) % pc
     mask_half = jnp.asarray(prepare_mask_half(design.fk_mask, nns, pad_f), dtype=jnp.float32)
     bp_gain = jnp.asarray(design.bp_gain)
-    templates = jnp.asarray(design.templates)
+    t_true, t_mu, t_scale = xcorr.padded_template_stats(design.templates)
+    templates_true = jnp.asarray(t_true)
+    template_mu = jnp.asarray(t_mu)
+    template_scale = jnp.asarray(t_scale)
 
     body = functools.partial(
         _mf_body,
@@ -129,7 +137,9 @@ def make_sharded_mf_step(
             P(file_axis, channel_axis, None),   # trace batch
             P(None, channel_axis),              # mask (f-sharded)
             P(None),                            # bp gain (replicated)
-            P(None, None),                      # templates (replicated)
+            P(None, None),                      # true-length templates (replicated)
+            P(None),                            # template means (replicated)
+            P(None),                            # template scales (replicated)
         ),
         out_specs=(
             P(file_axis, channel_axis, None),         # trf_fk
@@ -143,7 +153,7 @@ def make_sharded_mf_step(
 
     @jax.jit
     def step(trace_batch):
-        return fn(trace_batch, mask_half, bp_gain, templates)
+        return fn(trace_batch, mask_half, bp_gain, templates_true, template_mu, template_scale)
 
     return step
 

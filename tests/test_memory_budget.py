@@ -1,0 +1,117 @@
+"""Compile-time HBM budget guard for the flagship pipeline (VERDICT r2 #3).
+
+AOT-compiles the canonical-shape (22050 x 12000) detection programs and
+asserts their static memory footprint fits a v5e-class budget. This is the
+regression test that would have caught the round-2 bench OOM before the
+driver did: the monolithic correlate program's temps blow past the budget,
+the tiled route's stay far under it.
+
+CAVEAT (ADVICE r2): these numbers come from CPU-backend buffer assignment.
+TPU tiling/padding/fusion differ, so treat them as a *lower-bound
+heuristic*, not a reproduction of the TPU footprint — which is why the
+budget asserted here (10 GB) is well under the 16 GB v5e HBM and under the
+detector's 8 GB routing default + resident arrays. The real-chip
+certificate is the green TPU bench (BENCH_r03).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from das4whales_tpu.ops import xcorr
+
+C, N = 22050, 12000
+NT = 2
+M_TRUE = 156            # LF fin note: 0.78 s * 200 Hz
+TILE = 512
+BUDGET = 10 * 2**30
+
+
+def _stats(fn, *avals):
+    compiled = jax.jit(fn).lower(*avals).compile()
+    return compiled.memory_analysis()
+
+
+def _f32(*shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def template_avals():
+    return _f32(NT, M_TRUE), _f32(NT), _f32(NT)
+
+
+def test_monolithic_correlate_blows_budget():
+    """The legacy padded-template program at canonical shape exceeds the
+    budget even under CPU layouts — the round-2 OOM, caught at compile
+    time."""
+    stats = _stats(
+        xcorr.compute_cross_correlograms_multi, _f32(C, N), _f32(NT, N)
+    )
+    peak = stats.temp_size_in_bytes + stats.output_size_in_bytes
+    # 8 GiB is the detector's routing budget; CPU layouts are a lower bound
+    # on the TPU footprint, so exceeding it here means certain OOM there
+    # once trace/trf_fk/envelope buffers are added on top
+    assert peak > 8 * 2**30, f"expected blow-up, got {peak/2**30:.1f} GiB"
+
+
+def test_tiled_correlate_fits_budget(template_avals):
+    from das4whales_tpu.models.matched_filter import mf_correlate_tiled
+
+    t_aval, mu_aval, s_aval = template_avals
+    stats = _stats(
+        lambda trf, t, mu, sc: mf_correlate_tiled(trf, t, mu, sc, TILE),
+        _f32(C, N), t_aval, mu_aval, s_aval,
+    )
+    # output (the [n_tiles, nT, tile, N] correlograms) + temps must fit
+    total = stats.temp_size_in_bytes + stats.output_size_in_bytes
+    assert total < BUDGET, f"{total/2**30:.1f} GiB"
+    # and the per-tile working set (temps alone) must be small
+    assert stats.temp_size_in_bytes < 2 * 2**30
+
+
+def test_tiled_pick_fits_budget(template_avals):
+    from das4whales_tpu.models.matched_filter import mf_pick_tiled
+
+    n_tiles = -(-C // TILE)
+    stats = _stats(
+        lambda ct, thr: mf_pick_tiled(ct, thr, 256),
+        _f32(n_tiles, NT, TILE, N), _f32(NT),
+    )
+    # corr_tiles is an *argument* (donated by the pipeline); picks output is
+    # tiny; the envelope temps are per-tile only
+    assert stats.temp_size_in_bytes + stats.output_size_in_bytes < 4 * 2**30
+
+
+def test_whole_tiled_route_resident_estimate(template_avals):
+    """Sum the resident arrays of the full tiled route at its worst moment —
+    the user-facing ``corr_full`` transpose at the end of ``_call_tiled``,
+    when trace, trf_fk, corr_tiles AND the [nT, C, N] copy are all alive —
+    plus the correlate program's temps: must clear the budget with
+    headroom."""
+    from das4whales_tpu.models.matched_filter import mf_correlate_tiled
+
+    t_aval, mu_aval, s_aval = template_avals
+    stats = _stats(
+        lambda trf, t, mu, sc: mf_correlate_tiled(trf, t, mu, sc, TILE),
+        _f32(C, N), t_aval, mu_aval, s_aval,
+    )
+    n_tiles = -(-C // TILE)
+    trace = 4 * C * N
+    trf_fk = 4 * C * N
+    corr_tiles = 4 * n_tiles * NT * TILE * N
+    corr_full = 4 * NT * C * N          # the swapaxes+reshape copy
+    resident = trace + trf_fk + corr_tiles + corr_full + stats.temp_size_in_bytes
+    assert resident < BUDGET, f"{resident/2**30:.1f} GiB"
+
+
+def test_detector_auto_route_would_tile_at_canonical_shape():
+    """The routing estimate itself (no compile needed) must send the
+    canonical shape down the tiled route under the default 8 GB budget."""
+    nfft = xcorr._xcorr_full_len(N, N)
+    est = 4 * C * (nfft * (1 + 2 * NT) + 6 * N * NT)
+    assert est > 8 * 2**30
+    # and the true-length nfft is roughly half the padded one
+    assert xcorr._xcorr_full_len(N, M_TRUE) < 0.55 * nfft

@@ -1,0 +1,203 @@
+"""Memory-lean (channel-tiled) matched-filter route: exactness vs the
+monolithic path.
+
+The round-2 bench OOM'd on the real TPU because the monolithic
+correlate+envelope program materializes >12 GB of temps at the canonical
+22050x12000 shape (VERDICT r2). The fix is two-fold — true-length template
+FFTs (``ops.xcorr.padded_template_stats`` /
+``compute_cross_correlograms_corrected``) and channel tiling
+(``models.matched_filter.mf_correlate_tiled`` et al.) — and must be
+*numerically invisible*: these tests pin the tiled route to the monolithic
+one pick-for-pick and sample-for-sample.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from das4whales_tpu.config import AcquisitionMetadata
+from das4whales_tpu.models.matched_filter import (
+    MatchedFilterDetector,
+    mf_correlate_tiled,
+)
+from das4whales_tpu.models.templates import gen_template_fincall
+from das4whales_tpu.ops import xcorr
+
+FS, DX = 200.0, 4.0
+
+
+def _padded_templates(ns, fs=FS):
+    time = np.arange(ns) / fs
+    hf = gen_template_fincall(time, fs, 17.8, 28.8, 0.68)
+    lf = gen_template_fincall(time, fs, 14.7, 21.8, 0.78)
+    return jnp.stack([hf, lf]).astype(jnp.float32)
+
+
+def _block(nx, ns, fs=FS, seed=0):
+    rng = np.random.default_rng(seed)
+    block = rng.standard_normal((nx, ns)).astype(np.float32)
+    t = np.arange(0, 0.68, 1 / fs)
+    f0, f1 = 28.8, 17.8
+    sing = -f1 * 0.68 / (f0 - f1)
+    chirp = (
+        np.cos(2 * np.pi * (-sing * f0) * np.log(np.abs(1 - t / sing)))
+        * np.hanning(len(t))
+    ).astype(np.float32)
+    for k in range(4):
+        ch = (k + 1) * nx // 5
+        onset = int((1 + 1.5 * k) * fs)
+        if onset + len(chirp) < ns:
+            block[ch, onset : onset + len(chirp)] += 8.0 * chirp
+    return block
+
+
+def test_padded_template_stats_roundtrip():
+    tstack = _padded_templates(1500)
+    t_true, mu, scale = xcorr.padded_template_stats(tstack)
+    assert t_true.shape[-1] < tstack.shape[-1] // 4  # genuinely shorter
+    # true part matches, tail of the padded stack is zero
+    np.testing.assert_array_equal(np.asarray(tstack)[:, : t_true.shape[-1]], t_true)
+    assert np.all(np.asarray(tstack)[:, t_true.shape[-1] :] == 0)
+    np.testing.assert_allclose(mu, np.asarray(tstack).mean(-1), rtol=1e-6)
+    # per-template peak magnitudes (reference normalizes template-by-template)
+    np.testing.assert_allclose(scale, np.abs(np.asarray(tstack)).max(-1), rtol=1e-6)
+
+
+def _golden_correlograms_f64(data, tstack):
+    """Float64 numpy golden of the reference's padded-template semantics
+    (detect.py:140-166): the arbiter both float32 routes are judged by."""
+    x = np.asarray(data, np.float64)
+    xn = (x - x.mean(-1, keepdims=True)) / np.abs(x).max(-1, keepdims=True)
+    t = np.asarray(tstack, np.float64)
+    ns = x.shape[-1]
+    out = []
+    for i in range(t.shape[0]):
+        td = (t[i] - t[i].mean()) / np.abs(t[i]).max()
+        out.append(np.stack([np.correlate(r, td, "full")[ns - 1 :] for r in xn]))
+    return np.stack(out)
+
+
+def test_corrected_matches_padded_multi():
+    """True-length-FFT correlograms reproduce the padded-template
+    semantics: both float32 routes must sit at their roundoff floor
+    against the float64 golden, and agree with each other."""
+    ns = 1500
+    tstack = _padded_templates(ns)
+    data = jnp.asarray(_block(8, ns))
+    golden = _golden_correlograms_f64(data, tstack)
+    gscale = float(np.abs(golden).max())
+
+    legacy = np.asarray(xcorr.compute_cross_correlograms_multi(data, tstack))
+    t_true, mu, scale = xcorr.padded_template_stats(tstack)
+    got = np.asarray(
+        xcorr.compute_cross_correlograms_corrected(
+            data, jnp.asarray(t_true), jnp.asarray(mu), scale
+        )
+    )
+    assert got.shape == golden.shape
+    err_new = np.abs(got - golden).max()
+    err_legacy = np.abs(legacy - golden).max()
+    # both float32 routes sit at their roundoff floor against the float64
+    # golden (measured ~2-5e-6 relative); the short-FFT route must stay there
+    assert err_new < 1e-5 * gscale
+    assert err_legacy < 1e-5 * gscale
+    np.testing.assert_allclose(got, legacy, atol=2e-5 * gscale)
+
+
+def test_corrected_zero_rows_finite():
+    """All-zero (padding) channels must yield corr == 0, not NaN."""
+    ns = 800
+    tstack = _padded_templates(ns)
+    data = jnp.zeros((3, ns), jnp.float32)
+    t_true, mu, scale = xcorr.padded_template_stats(tstack)
+    got = xcorr.compute_cross_correlograms_corrected(
+        data, jnp.asarray(t_true), jnp.asarray(mu), scale
+    )
+    assert np.all(np.isfinite(np.asarray(got)))
+    np.testing.assert_array_equal(np.asarray(got), 0.0)
+
+
+def test_correlate_tiled_matches_monolithic_and_masks_padding():
+    ns, nx, tile = 1200, 100, 32  # 100 % 32 != 0 -> padding rows exercised
+    tstack = _padded_templates(ns)
+    data = jnp.asarray(_block(nx, ns))
+    golden = _golden_correlograms_f64(data, tstack)
+    t_true, mu, scale = xcorr.padded_template_stats(tstack)
+    corr_tiles, gmax = mf_correlate_tiled(
+        data, jnp.asarray(t_true), jnp.asarray(mu), scale, tile
+    )
+    nT = tstack.shape[0]
+    got = np.asarray(jnp.swapaxes(corr_tiles, 0, 1).reshape(nT, -1, ns)[:, :nx])
+    np.testing.assert_allclose(got, golden, atol=1e-5 * float(np.abs(golden).max()))
+    # tiling is invisible: tiled == untiled corrected route bit-for-bit
+    untiled = np.asarray(
+        xcorr.compute_cross_correlograms_corrected(
+            data, jnp.asarray(t_true), jnp.asarray(mu), scale
+        )
+    )
+    np.testing.assert_allclose(got, untiled, atol=1e-6 * float(np.abs(golden).max()))
+    # gmax excludes the padded rows and matches the golden max
+    assert float(gmax) == pytest.approx(float(golden.max()), rel=1e-5)
+
+
+@pytest.mark.parametrize("pick_mode", ["sparse", "scipy"])
+def test_tiled_detector_matches_monolithic(pick_mode):
+    nx, ns = 100, 1200
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    block = _block(nx, ns)
+    det_mono = MatchedFilterDetector(
+        meta, [0, nx, 1], (nx, ns), channel_tile=None, pick_mode=pick_mode
+    )
+    det_tiled = MatchedFilterDetector(
+        meta, [0, nx, 1], (nx, ns), channel_tile=32, pick_mode=pick_mode
+    )
+    r_mono = det_mono(block)
+    r_tiled = det_tiled(block)
+    np.testing.assert_allclose(
+        np.asarray(r_tiled.trf_fk), np.asarray(r_mono.trf_fk), atol=1e-6
+    )
+    for name in det_mono.design.template_names:
+        # the two routes agree to float32 roundoff
+        # (test_corrected_matches_padded_multi)
+        assert r_mono.thresholds[name] == pytest.approx(
+            r_tiled.thresholds[name], rel=1e-4
+        )
+        scale = float(jnp.abs(r_mono.correlograms[name]).max())
+        np.testing.assert_allclose(
+            np.asarray(r_tiled.correlograms[name]),
+            np.asarray(r_mono.correlograms[name]),
+            atol=1e-4 * scale,
+        )
+        np.testing.assert_array_equal(r_tiled.picks[name], r_mono.picks[name])
+        assert r_tiled.picks[name].shape[1] > 0  # injections were found
+
+
+def test_tiled_detector_threshold_override():
+    nx, ns = 64, 1000
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    block = _block(nx, ns)
+    det = MatchedFilterDetector(
+        meta, [0, nx, 1], (nx, ns), channel_tile=32, pick_mode="sparse"
+    )
+    res = det(block, threshold=1e9)
+    for name in det.design.template_names:
+        assert res.picks[name].shape[1] == 0
+        assert res.thresholds[name] == pytest.approx(1e9)
+
+
+def test_auto_route_decision():
+    nx, ns = 64, 600
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns))
+    # tiny shape under any sane budget -> monolithic
+    assert det._route() == "mono"
+    det_small_budget = MatchedFilterDetector(
+        meta, [0, nx, 1], (nx, ns), hbm_budget_bytes=1024
+    )
+    assert det_small_budget._route() == "tiled"
+    # the canonical OOI shape must estimate over the default 8 GB budget
+    C, n, nT = 22050, 12000, 2
+    nfft = xcorr._xcorr_full_len(n, n)
+    est = 4 * C * (nfft * (1 + 2 * nT) + 6 * n * nT)
+    assert est > 8 * 2**30
