@@ -237,6 +237,9 @@ def main():
     ap.add_argument("--no-cpu", action="store_true", help="skip CPU baseline; report cached ratio")
     ap.add_argument("--no-stages", action="store_true",
                     help="skip the per-stage breakdown (headline number only)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero when no measurement succeeded (CI gate); "
+                         "without it the JSON line is the contract and rc is 0")
     ap.add_argument(
         "--device-timeout", type=float,
         default=float(os.environ.get("DAS_BENCH_DEVICE_TIMEOUT", 180.0)),
@@ -305,7 +308,7 @@ def main():
             "vs_baseline": 0.0,
             "error": "; ".join(errors),
         }))
-        return 0
+        return 1 if args.strict else 0
 
     nx, ns, cpu_nx = shape_used
     if fallback:

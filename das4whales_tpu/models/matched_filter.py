@@ -418,9 +418,10 @@ class MatchedFilterDetector:
                 self._warn_saturated(name, sat[:, i].reshape(-1)[:C])
         else:
             env_tiles = mf_envelope_tiled(corr_tiles)
+            # untile once on device; only the scipy engine needs a host copy
+            env_full = jnp.swapaxes(env_tiles, 0, 1).reshape(nT, -1, n)[:, :C]
             for i, name in enumerate(names):
-                # untile on device; only the scipy engine needs a host copy
-                env_i = jnp.swapaxes(env_tiles, 0, 1)[i].reshape(-1, n)[:C]
+                env_i = env_full[i]
                 if self.pick_mode == "scipy":
                     picks[name] = peak_ops.find_peaks_scipy_host(
                         np.asarray(env_i), thr_np[i]

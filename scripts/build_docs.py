@@ -1,0 +1,281 @@
+"""Build the HTML documentation site — stdlib only.
+
+The reference ships a Sphinx site driven by nox (reference noxfile.py:34-49,
+.readthedocs.yaml): rendered guide pages + an autodoc API reference. This
+image has no sphinx/nox and installs are off-limits, so this generator
+reproduces the same arrangement with the standard library:
+
+* every ``docs/*.md`` guide (TUTORIAL, API, PERF, PRECISION) is rendered to
+  an HTML page through a small CommonMark-subset converter (headings,
+  fenced code, inline code, emphasis, links, lists, tables, quotes);
+* an API reference is generated from the LIVE package docstrings via
+  ``inspect`` — one page per module, every public class/function with its
+  signature and docstring (the docstrings carry the reference file:line
+  parity citations, so the rendered API doubles as the parity map);
+* an index page links everything.
+
+Usage:  python scripts/build_docs.py [--out docs/_build/html]
+(one command -> a browsable static site; wired into CI and exercised by
+tests/test_docs_build.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import importlib
+import inspect
+import os
+import pkgutil
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PACKAGE = "das4whales_tpu"
+
+CSS = """
+body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif; max-width: 60rem;
+       margin: 2rem auto; padding: 0 1rem; line-height: 1.55; color: #1a2330; }
+code, pre { font-family: ui-monospace, 'SF Mono', Menlo, Consolas, monospace;
+            background: #f4f6f8; border-radius: 4px; }
+code { padding: .1em .3em; font-size: .92em; }
+pre { padding: .8em 1em; overflow-x: auto; border: 1px solid #e2e6ea; }
+pre code { background: none; padding: 0; }
+h1, h2, h3 { line-height: 1.25; }
+h1 { border-bottom: 2px solid #e2e6ea; padding-bottom: .3em; }
+h2 { border-bottom: 1px solid #eef1f4; padding-bottom: .2em; margin-top: 2em; }
+table { border-collapse: collapse; margin: 1em 0; }
+th, td { border: 1px solid #d7dce2; padding: .35em .7em; text-align: left; }
+th { background: #f4f6f8; }
+a { color: #0b63c5; text-decoration: none; } a:hover { text-decoration: underline; }
+.sig { background: #f4f6f8; border-left: 3px solid #0b63c5; padding: .5em .8em;
+       margin: 1.2em 0 .4em; font-family: ui-monospace, Menlo, monospace;
+       font-size: .92em; white-space: pre-wrap; }
+.docstring { margin-left: .2em; white-space: pre-wrap; font-size: .95em; }
+.crumbs { color: #66707c; font-size: .9em; }
+blockquote { border-left: 3px solid #d7dce2; margin-left: 0; padding-left: 1em;
+             color: #4a5563; }
+"""
+
+
+# ---------------------------------------------------------------------------
+# Minimal markdown -> HTML (the subset our docs actually use)
+# ---------------------------------------------------------------------------
+
+def _inline(text: str) -> str:
+    text = html.escape(text, quote=False)
+    # code spans first so emphasis markers inside them survive
+    text = re.sub(r"``([^`]+)``", r"<code>\1</code>", text)
+    text = re.sub(r"`([^`]+)`", r"<code>\1</code>", text)
+    text = re.sub(r"\*\*([^*]+)\*\*", r"<strong>\1</strong>", text)
+    text = re.sub(r"(?<!\*)\*([^*\s][^*]*)\*(?!\*)", r"<em>\1</em>", text)
+    text = re.sub(r"\[([^\]]+)\]\(([^)]+)\)", r'<a href="\2">\1</a>', text)
+    return text
+
+
+def md_to_html(md: str) -> str:
+    out: list = []
+    lines = md.splitlines()
+    i = 0
+    in_list = None          # "ul" | "ol"
+    while i < len(lines):
+        line = lines[i]
+        if line.startswith("```"):
+            if in_list:
+                out.append(f"</{in_list}>"); in_list = None
+            block = []
+            i += 1
+            while i < len(lines) and not lines[i].startswith("```"):
+                block.append(lines[i]); i += 1
+            out.append("<pre><code>" + html.escape("\n".join(block)) + "</code></pre>")
+            i += 1
+            continue
+        if line.startswith("|") and i + 1 < len(lines) and re.match(r"^\|[\s:|-]+\|?$", lines[i + 1]):
+            if in_list:
+                out.append(f"</{in_list}>"); in_list = None
+            header = [c.strip() for c in line.strip().strip("|").split("|")]
+            out.append("<table><tr>" + "".join(f"<th>{_inline(c)}</th>" for c in header) + "</tr>")
+            i += 2
+            while i < len(lines) and lines[i].startswith("|"):
+                cells = [c.strip() for c in lines[i].strip().strip("|").split("|")]
+                out.append("<tr>" + "".join(f"<td>{_inline(c)}</td>" for c in cells) + "</tr>")
+                i += 1
+            out.append("</table>")
+            continue
+        m = re.match(r"^(#{1,6})\s+(.*)$", line)
+        if m:
+            if in_list:
+                out.append(f"</{in_list}>"); in_list = None
+            level = len(m.group(1))
+            out.append(f"<h{level}>{_inline(m.group(2))}</h{level}>")
+            i += 1
+            continue
+        m = re.match(r"^\s*[-*]\s+(.*)$", line)
+        if m:
+            if in_list != "ul":
+                if in_list:
+                    out.append(f"</{in_list}>")
+                out.append("<ul>"); in_list = "ul"
+            out.append(f"<li>{_inline(m.group(1))}</li>")
+            i += 1
+            continue
+        m = re.match(r"^\s*\d+[.)]\s+(.*)$", line)
+        if m:
+            if in_list != "ol":
+                if in_list:
+                    out.append(f"</{in_list}>")
+                out.append("<ol>"); in_list = "ol"
+            out.append(f"<li>{_inline(m.group(1))}</li>")
+            i += 1
+            continue
+        if line.startswith(">"):
+            out.append(f"<blockquote>{_inline(line.lstrip('> '))}</blockquote>")
+            i += 1
+            continue
+        if not line.strip():
+            if in_list:
+                out.append(f"</{in_list}>"); in_list = None
+            i += 1
+            continue
+        # paragraph: merge consecutive text lines
+        para = [line]
+        while i + 1 < len(lines) and lines[i + 1].strip() and not re.match(
+            r"^(#{1,6}\s|```|\||\s*[-*]\s|\s*\d+[.)]\s|>)", lines[i + 1]
+        ):
+            i += 1
+            para.append(lines[i])
+        out.append(f"<p>{_inline(' '.join(para))}</p>")
+        i += 1
+    if in_list:
+        out.append(f"</{in_list}>")
+    return "\n".join(out)
+
+
+def page(title: str, body: str, crumbs: str = "") -> str:
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{html.escape(title)}</title><style>{CSS}</style></head><body>"
+        f"<p class='crumbs'>{crumbs}</p>{body}</body></html>"
+    )
+
+
+# ---------------------------------------------------------------------------
+# API reference from live docstrings
+# ---------------------------------------------------------------------------
+
+def iter_modules():
+    pkg = importlib.import_module(PACKAGE)
+    yield PACKAGE, pkg
+    for info in pkgutil.walk_packages(pkg.__path__, prefix=PACKAGE + "."):
+        try:
+            yield info.name, importlib.import_module(info.name)
+        except Exception as e:  # noqa: BLE001 — a module that fails to import
+            print(f"  ! skipping {info.name}: {type(e).__name__}: {e}")
+
+
+def _doc(obj) -> str:
+    d = inspect.getdoc(obj) or ""
+    return f"<div class='docstring'>{html.escape(d)}</div>" if d else ""
+
+
+def _sig(name, obj) -> str:
+    try:
+        return f"{name}{inspect.signature(obj)}"
+    except (ValueError, TypeError):
+        return name
+
+
+def module_page(name: str, mod) -> str:
+    parts = [f"<h1><code>{name}</code></h1>", _doc(mod)]
+    members = inspect.getmembers(mod)
+    own = [
+        (n, o) for n, o in members
+        if not n.startswith("_") and getattr(o, "__module__", None) == name
+    ]
+    classes = [(n, o) for n, o in own if inspect.isclass(o)]
+    funcs = [(n, o) for n, o in own if inspect.isfunction(o)]
+    # jitted callables (jax wrappers) lose isfunction; show them too
+    wrapped = [
+        (n, o) for n, o in members
+        if not n.startswith("_") and (n, o) not in own
+        and callable(o) and not inspect.isclass(o) and not inspect.ismodule(o)
+        and getattr(getattr(o, "__wrapped__", None), "__module__", None) == name
+    ]
+    if classes:
+        parts.append("<h2>Classes</h2>")
+        for n, o in classes:
+            parts.append(f"<div class='sig' id='{n}'>class {_sig(n, o)}</div>{_doc(o)}")
+            for mn, mo in inspect.getmembers(o, inspect.isfunction):
+                if mn.startswith("_") or mo.__qualname__.split(".")[0] != n:
+                    continue
+                parts.append(
+                    f"<div class='sig' style='margin-left:2em'>{_sig(mn, mo)}</div>"
+                    f"<div style='margin-left:2em'>{_doc(mo)}</div>"
+                )
+    if funcs or wrapped:
+        parts.append("<h2>Functions</h2>")
+        for n, o in funcs + wrapped:
+            target = getattr(o, "__wrapped__", o)
+            parts.append(f"<div class='sig' id='{n}'>{_sig(n, target)}</div>{_doc(target)}")
+    return "\n".join(parts)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="docs/_build/html")
+    args = ap.parse_args()
+
+    # honor JAX_PLATFORMS through the live config too (this image's
+    # sitecustomize registers an accelerator backend the env var alone
+    # cannot keep jax off — see tests/conftest.py); docs builds must never
+    # touch, or hang on, the accelerator
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = os.path.join(root, args.out) if not os.path.isabs(args.out) else args.out
+    os.makedirs(os.path.join(out, "api"), exist_ok=True)
+
+    # guide pages
+    docs_dir = os.path.join(root, "docs")
+    guides = []
+    for fname in sorted(os.listdir(docs_dir)):
+        if not fname.endswith(".md"):
+            continue
+        name = fname[:-3]
+        with open(os.path.join(docs_dir, fname)) as fh:
+            body = md_to_html(fh.read())
+        with open(os.path.join(out, f"{name}.html"), "w") as fh:
+            fh.write(page(name, body, crumbs="<a href='index.html'>index</a>"))
+        guides.append(name)
+        print(f"  guide {name}.html")
+
+    # API pages
+    api_entries = []
+    for name, mod in iter_modules():
+        fname = name.replace(".", "_") + ".html"
+        with open(os.path.join(out, "api", fname), "w") as fh:
+            fh.write(page(name, module_page(name, mod),
+                          crumbs="<a href='../index.html'>index</a>"))
+        api_entries.append((name, "api/" + fname))
+        print(f"  api   {name}")
+
+    # index
+    body = ["<h1>das4whales_tpu documentation</h1>",
+            "<p>TPU-native DAS bioacoustics framework — guides and API reference "
+            "(generated from live docstrings; citations point at the reference "
+            "implementation for parity checking).</p>", "<h2>Guides</h2>", "<ul>"]
+    body += [f"<li><a href='{g}.html'>{g}</a></li>" for g in guides]
+    body += ["</ul>", "<h2>API reference</h2>", "<ul>"]
+    body += [f"<li><a href='{href}'><code>{n}</code></a></li>" for n, href in api_entries]
+    body += ["</ul>"]
+    with open(os.path.join(out, "index.html"), "w") as fh:
+        fh.write(page("das4whales_tpu docs", "\n".join(body)))
+    print(f"built {len(guides)} guides + {len(api_entries)} API pages -> {out}")
+
+
+if __name__ == "__main__":
+    main()

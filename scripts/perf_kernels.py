@@ -99,19 +99,45 @@ def bench_peaks(repeats=3, full=False):
     return rows
 
 
+def _device_utils():
+    """Load utils/device.py standalone (pre-jax-import probe, same defense
+    as bench.py — a wedged accelerator tunnel must degrade to an annotated
+    CPU run, not hang the harness)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "das4whales_tpu", "utils", "device.py",
+    )
+    spec = importlib.util.spec_from_file_location("_dw_device_probe", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true", help="include 22k-channel peak shape")
     ap.add_argument("--markdown", default=None, help="append a section to this file")
+    ap.add_argument(
+        "--device-timeout", type=float,
+        default=float(os.environ.get("DAS_BENCH_DEVICE_TIMEOUT", 120.0)),
+        help="seconds to wait for the accelerator before falling back to CPU",
+    )
     args = ap.parse_args()
 
+    dev = _device_utils()
+    fallback = False
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        dev.force_cpu_host_devices(1)
+    elif dev.probe_backend(args.device_timeout) <= 0:
+        dev.force_cpu_host_devices(1)
+        fallback = True
     import jax
 
     device = str(jax.devices()[0])
+    if fallback:
+        device = f"cpu-fallback (accelerator unreachable): {device}"
     stft_rows = bench_stft()
     peak_rows = bench_peaks(full=args.full)
     doc = {"device": device, "stft": stft_rows, "peaks": peak_rows}
