@@ -99,22 +99,6 @@ def bench_peaks(repeats=3, full=False):
     return rows
 
 
-def _device_utils():
-    """Load utils/device.py standalone (pre-jax-import probe, same defense
-    as bench.py — a wedged accelerator tunnel must degrade to an annotated
-    CPU run, not hang the harness)."""
-    import importlib.util
-
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "das4whales_tpu", "utils", "device.py",
-    )
-    spec = importlib.util.spec_from_file_location("_dw_device_probe", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true", help="include 22k-channel peak shape")
@@ -126,12 +110,15 @@ def main():
     )
     args = ap.parse_args()
 
-    dev = _device_utils()
+    # share bench.py's probe/fallback defense (single implementation: the
+    # standalone device.py loader + retry-with-backoff probing)
+    from bench import _device_utils, _probe_device_with_backoff
+
     fallback = False
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        dev.force_cpu_host_devices(1)
-    elif dev.probe_backend(args.device_timeout) <= 0:
-        dev.force_cpu_host_devices(1)
+        _device_utils().force_cpu_host_devices(1)
+    elif not _probe_device_with_backoff(args.device_timeout):
+        _device_utils().force_cpu_host_devices(1)
         fallback = True
     import jax
 
