@@ -297,10 +297,9 @@ class MatchedFilterDetector:
         self._mask_dev = jnp.asarray(self.design.fk_mask)
         self._gain_dev = jnp.asarray(self.design.bp_gain)
         self._templates_dev = jnp.asarray(self.design.templates)
-        t_true, t_mu, t_scale = xcorr.padded_template_stats(self.design.templates)
-        self._templates_true = jnp.asarray(t_true)
-        self._template_mu = jnp.asarray(t_mu)
-        self._template_scale = jnp.asarray(t_scale)
+        (self._templates_true, self._template_mu, self._template_scale) = (
+            xcorr.padded_template_stats_device(self.design.templates)
+        )
 
     def monolithic_temp_estimate(self) -> int:
         """Rough byte estimate of the one-program correlate+envelope route's

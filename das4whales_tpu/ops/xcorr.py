@@ -159,6 +159,15 @@ def padded_template_stats(templates_padded):
     return t[:, :m].copy(), mu.astype(t.dtype), scale.astype(t.dtype)
 
 
+def padded_template_stats_device(templates_padded):
+    """``padded_template_stats`` with the triple already on device — the
+    form every consumer (single-chip detector, batch-sharded and
+    time-sharded steps) wants, kept in one place so their template
+    numerics cannot drift apart."""
+    t_true, mu, scale = padded_template_stats(templates_padded)
+    return jnp.asarray(t_true), jnp.asarray(mu), jnp.asarray(scale)
+
+
 @jax.jit
 def compute_cross_correlograms_corrected(
     data: jnp.ndarray, templates_true: jnp.ndarray, mu: jnp.ndarray, scale: jnp.ndarray

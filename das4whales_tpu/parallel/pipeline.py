@@ -37,6 +37,7 @@ def _mf_body(
     trace, mask_half, bp_gain, templates_true, template_mu, template_scale, *,
     bp_padlen: int, channel_axis: str,
     relative_threshold: float, hf_factor: float, pick_mode: str, max_peaks: int,
+    outputs: str = "full",
 ):
     """shard_map body. Local shapes: trace [B/Pf, C/Pc, T], mask_half
     [K, Fpad/Pc], bp_gain [Fext], templates_true [nT, m] (TRUE length —
@@ -69,6 +70,11 @@ def _mf_body(
         picks = peak_ops.local_maxima(env) & (
             peak_ops.peak_prominences_dense(env) >= thr
         )
+    if outputs == "picks":
+        # campaign mode: only the (tiny) picks + thresholds leave the
+        # program, so XLA never has to keep the [nT, B, C/Pc, T] correlogram
+        # and envelope blocks alive as outputs — ~3x less HBM per shard
+        return picks, thres
     return trf_fk, corr, env, picks, thres
 
 
@@ -81,15 +87,23 @@ def make_sharded_mf_step(
     hf_factor: float = 0.9,
     pick_mode: str = "sparse",
     max_peaks: int = 256,
+    outputs: str = "full",
 ):
     """Build the jitted multi-chip detection step for a
     ``[file x channel x time]`` batch.
 
-    ``design`` is a ``models.matched_filter.MatchedFilterDesign``. The
-    returned callable maps a sharded batch to
+    ``outputs="full"`` returns ``(trf_fk, corr, env, picks, thresholds)``;
+    ``outputs="picks"`` returns only ``(picks, thresholds)`` — the campaign
+    mode: the filtered block, correlograms and envelopes never become
+    program outputs, so per-shard HBM drops ~3x and multi-file batches can
+    be correspondingly larger.
+
+    ``design`` is a ``models.matched_filter.MatchedFilterDesign``. With
+    ``outputs="full"`` the returned callable maps a sharded batch to
     ``(trf_fk, correlograms, envelopes, picks, thresholds)`` with matching
-    shardings — ready for ``jax.jit`` ahead-of-time compilation on any mesh
-    shape, including the single-chip degenerate mesh.
+    shardings (``outputs="picks"`` returns the 2-tuple above) — ready for
+    ``jax.jit`` ahead-of-time compilation on any mesh shape, including the
+    single-chip degenerate mesh.
 
     ``pick_mode="sparse"`` (production, matching the single-chip
     ``MatchedFilterDetector`` default) yields ``picks`` as an
@@ -100,6 +114,8 @@ def make_sharded_mf_step(
     """
     if pick_mode not in ("sparse", "dense"):
         raise ValueError(f"pick_mode must be 'sparse' or 'dense', got {pick_mode!r}")
+    if outputs not in ("full", "picks"):
+        raise ValueError(f"outputs must be 'full' or 'picks', got {outputs!r}")
     nnx, nns = design.trace_shape
     pc = mesh.shape[channel_axis]
     if nnx % pc:
@@ -108,10 +124,9 @@ def make_sharded_mf_step(
     pad_f = (-nf) % pc
     mask_half = jnp.asarray(prepare_mask_half(design.fk_mask, nns, pad_f), dtype=jnp.float32)
     bp_gain = jnp.asarray(design.bp_gain)
-    t_true, t_mu, t_scale = xcorr.padded_template_stats(design.templates)
-    templates_true = jnp.asarray(t_true)
-    template_mu = jnp.asarray(t_mu)
-    template_scale = jnp.asarray(t_scale)
+    templates_true, template_mu, template_scale = (
+        xcorr.padded_template_stats_device(design.templates)
+    )
 
     body = functools.partial(
         _mf_body,
@@ -121,6 +136,7 @@ def make_sharded_mf_step(
         hf_factor=hf_factor,
         pick_mode=pick_mode,
         max_peaks=max_peaks,
+        outputs=outputs,
     )
     tfc = P(None, file_axis, channel_axis, None)  # [template, file, channel, *]
     if pick_mode == "sparse":
@@ -142,11 +158,15 @@ def make_sharded_mf_step(
             P(None),                            # template scales (replicated)
         ),
         out_specs=(
-            P(file_axis, channel_axis, None),         # trf_fk
-            tfc,                                      # corr
-            tfc,                                      # env
-            picks_spec,
-            P(file_axis),                             # thresholds
+            (picks_spec, P(file_axis))                # picks, thresholds
+            if outputs == "picks"
+            else (
+                P(file_axis, channel_axis, None),     # trf_fk
+                tfc,                                  # corr
+                tfc,                                  # env
+                picks_spec,
+                P(file_axis),                         # thresholds
+            )
         ),
         check_vma=False,
     )

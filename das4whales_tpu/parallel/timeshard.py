@@ -174,6 +174,7 @@ def make_sharded_mf_step_time(
     hf_factor: float = 0.9,
     pick_mode: str = "sparse",
     max_peaks: int = 256,
+    outputs: str = "full",
 ):
     """Full flagship detection step for a TIME-sharded ``[C, T]`` block.
 
@@ -181,9 +182,12 @@ def make_sharded_mf_step_time(
     f-k filter -> one ``all_to_all`` transpose into the channel-sharded
     layout -> per-channel matched-filter correlograms, envelopes and peak
     picking (embarrassingly parallel there), with one ``pmax`` for the
-    global threshold. Returns ``(trf_fk, corr, env, picks, thres)`` where
-    ``trf_fk`` stays time-sharded and the detection outputs are
-    channel-sharded (same mesh axis, relabeled layout).
+    global threshold. With ``outputs="full"`` returns
+    ``(trf_fk, corr, env, picks, thres)`` where ``trf_fk`` stays
+    time-sharded and the detection outputs are channel-sharded (same mesh
+    axis, relabeled layout); ``outputs="picks"`` (campaign mode) returns
+    only ``(picks, thres)`` so the heavy per-shard arrays never become
+    program outputs.
 
     ``pick_mode="sparse"`` (production, matching the single-chip
     ``MatchedFilterDetector`` default) yields ``picks`` as an
@@ -204,6 +208,8 @@ def make_sharded_mf_step_time(
     """
     if pick_mode not in ("sparse", "dense"):
         raise ValueError(f"pick_mode must be 'sparse' or 'dense', got {pick_mode!r}")
+    if outputs not in ("full", "picks"):
+        raise ValueError(f"outputs must be 'full' or 'picks', got {outputs!r}")
     nnx, nns = design.trace_shape
     p = mesh.shape[time_axis]
     if nnx % p or nns % p:
@@ -218,18 +224,23 @@ def make_sharded_mf_step_time(
     sos = sp.butter(order, [band[0] / (fs / 2), band[1] / (fs / 2)], "bp", output="sos")
     gain = jnp.asarray(zero_phase_gain(np.fft.rfftfreq(local + 2 * halo), sos).astype(np.float32))
     mask_rows = jnp.asarray(prepare_mask_full(design.fk_mask))
-    templates = jnp.asarray(design.templates)
+    templates_true, template_mu, template_scale = (
+        xcorr.padded_template_stats_device(design.templates)
+    )
+    n_templates = design.templates.shape[0]
 
-    def body(x, gain_w, mask_r, tmpl):
+    def body(x, gain_w, mask_r, tmpl, tmu, tsc):
         bp = _bp_time_local(x, gain_w, halo=halo, axis_name=time_axis)
         trf = fk_apply_time_local(bp, mask_r, time_axis)           # [C, T/P]
         # relabel: one transpose into channel-sharded layout [C/P, T]
         y = jax.lax.all_to_all(trf, time_axis, split_axis=0, concat_axis=1, tiled=True)
-        corr = xcorr.compute_cross_correlograms_multi(y, tmpl)
+        # true-length-template correlate (ops/xcorr.py:padded_template_stats)
+        # — half the per-shard FFT length of the padded form
+        corr = xcorr.compute_cross_correlograms_corrected(y, tmpl, tmu, tsc)
         env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
         file_max = jax.lax.pmax(jnp.max(corr), time_axis)
         thres = relative_threshold * file_max
-        factors = jnp.ones(tmpl.shape[0]).at[0].set(hf_factor)
+        factors = jnp.ones(n_templates).at[0].set(hf_factor)
         thr = thres * factors[:, None, None]
         if pick_mode == "sparse":
             # TPU production route: time is whole within each channel
@@ -241,6 +252,9 @@ def make_sharded_mf_step_time(
             picks = peak_ops.local_maxima(env) & (
                 peak_ops.peak_prominences_dense(env) >= thr
             )
+        if outputs == "picks":
+            # campaign mode: only picks + threshold leave the program
+            return picks, thres
         return trf, corr, env, picks, thres
 
     ct = P(None, time_axis, None)  # [template, channel(relabeled), *]
@@ -257,21 +271,27 @@ def make_sharded_mf_step_time(
             P(None, time_axis),   # trace (time-sharded)
             P(None),              # bp gain (replicated)
             P(time_axis, None),   # fk mask rows
-            P(None, None),        # templates (replicated)
+            P(None, None),        # true-length templates (replicated)
+            P(None),              # template means (replicated)
+            P(None),              # template scales (replicated)
         ),
         out_specs=(
-            P(None, time_axis),         # trf_fk stays time-sharded
-            ct,                         # corr: channel-sharded (relabeled axis)
-            ct,                         # env
-            picks_spec,
-            P(),                        # threshold (replicated scalar)
+            (picks_spec, P())           # picks, threshold
+            if outputs == "picks"
+            else (
+                P(None, time_axis),     # trf_fk stays time-sharded
+                ct,                     # corr: channel-sharded (relabeled axis)
+                ct,                     # env
+                picks_spec,
+                P(),                    # threshold (replicated scalar)
+            )
         ),
         check_vma=False,
     )
 
     @jax.jit
     def step(trace):
-        return fn(trace, gain, mask_rows, templates)
+        return fn(trace, gain, mask_rows, templates_true, template_mu, template_scale)
 
     return step
 

@@ -243,7 +243,10 @@ def main():
         })
         print(f"  {name}: {json.dumps(rows[-1])}", flush=True)
 
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if args.json:
+        if not os.path.isabs(args.json):
+            args.json = os.path.join(repo_root, args.json)
         with open(args.json, "w") as fh:
             json.dump({"shape": [args.nx, args.ns], "rows": rows,
                        "prod_timings": p_t, "golden_timings": g_t}, fh, indent=1)
@@ -253,9 +256,7 @@ def main():
         if not os.path.isabs(out):
             # anchor to the repo root so the documented "regenerates
             # VALIDATION.md" holds from any invocation directory
-            out = os.path.join(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__))), out
-            )
+            out = os.path.join(repo_root, out)
         write_report(out, args.nx, args.ns, rows, p_t, g_t, len(truth))
         print("wrote", out)
 

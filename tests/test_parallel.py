@@ -152,3 +152,28 @@ def test_mesh_helpers():
     assert m.shape["file"] == 2 and m.shape["channel"] == 4
     with pytest.raises(ValueError):
         make_mesh(shape=(3, 3), axis_names=("file", "channel"))
+
+
+def test_sharded_step_picks_only_mode(mesh2x4, rng):
+    """outputs='picks' (campaign mode) returns only (picks, thresholds),
+    identical to the full mode's picks — the heavy per-shard arrays never
+    become program outputs."""
+    from das4whales_tpu.parallel.pipeline import input_sharding
+
+    design = design_matched_filter((NX, NS), SEL, META)
+    step_full = make_sharded_mf_step(design, mesh2x4)
+    step_picks = make_sharded_mf_step(design, mesh2x4, outputs="picks")
+
+    batch = rng.standard_normal((2, NX, NS)).astype(np.float32)
+    xb = jax.device_put(jnp.asarray(batch), input_sharding(mesh2x4))
+    _, _, _, picks_full, thres_full = step_full(xb)
+    picks, thres = step_picks(xb)
+
+    np.testing.assert_array_equal(np.asarray(picks.positions),
+                                  np.asarray(picks_full.positions))
+    np.testing.assert_array_equal(np.asarray(picks.selected),
+                                  np.asarray(picks_full.selected))
+    np.testing.assert_allclose(np.asarray(thres), np.asarray(thres_full))
+
+    with pytest.raises(ValueError, match="outputs"):
+        make_sharded_mf_step(design, mesh2x4, outputs="nope")

@@ -189,3 +189,33 @@ def test_stream_as_numpy_conflicts():
     with pytest.raises(ValueError, match="as_numpy"):
         list(stream_strain_blocks(["x.h5"], [0, 8, 1], None, as_numpy=True,
                                   device=jax.devices()[0]))
+
+
+def test_timeshard_picks_only_mode(tmesh, rng):
+    """outputs='picks' returns only (picks, threshold), matching full mode."""
+    from das4whales_tpu.models.matched_filter import design_matched_filter
+    from das4whales_tpu.parallel.timeshard import (
+        make_sharded_mf_step_time,
+        time_sharding,
+    )
+
+    nx, ns, halo = 32, 1024, 32
+    meta = AcquisitionMetadata(fs=200.0, dx=8.0, nx=nx, ns=ns)
+    design = design_matched_filter((nx, ns), [0, nx, 1], meta)
+    step_full = make_sharded_mf_step_time(design, tmesh, halo=halo)
+    step_picks = make_sharded_mf_step_time(design, tmesh, halo=halo, outputs="picks")
+
+    x = jax.device_put(
+        jnp.asarray(rng.standard_normal((nx, ns)).astype(np.float32)),
+        time_sharding(tmesh),
+    )
+    _, _, _, picks_full, thres_full = step_full(x)
+    picks, thres = step_picks(x)
+    np.testing.assert_array_equal(np.asarray(picks.positions),
+                                  np.asarray(picks_full.positions))
+    np.testing.assert_array_equal(np.asarray(picks.selected),
+                                  np.asarray(picks_full.selected))
+    assert float(thres) == pytest.approx(float(thres_full))
+
+    with pytest.raises(ValueError, match="outputs"):
+        make_sharded_mf_step_time(design, tmesh, halo=halo, outputs="nope")
