@@ -55,20 +55,43 @@ def analytic_signal(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
     Equivalent to ``scipy.signal.hilbert``: zero out negative frequencies,
     double positive ones. One batched FFT replaces the reference's
     per-channel scipy calls (detect.py:192, dsp.py:974).
+
+    For real input the forward transform is an rFFT and the one-sided
+    spectrum (interior bins doubled, Nyquist/DC kept) is zero-extended to
+    the full length before the complex inverse — the negative-frequency
+    half of ``H * FFT(x)`` is zero anyway, so this is exact while halving
+    the forward transform (the envelope stage is FFT-bound at detection
+    shapes).
     """
     n = x.shape[axis]
-    X = jnp.fft.fft(x, axis=axis)
-    h = np.zeros(n)
-    if n % 2 == 0:
-        h[0] = h[n // 2] = 1.0
-        h[1 : n // 2] = 2.0
-    else:
-        h[0] = 1.0
-        h[1 : (n + 1) // 2] = 2.0
+    if jnp.iscomplexobj(x):
+        X = jnp.fft.fft(x, axis=axis)
+        h = np.zeros(n)
+        if n % 2 == 0:
+            h[0] = h[n // 2] = 1.0
+            h[1 : n // 2] = 2.0
+        else:
+            h[0] = 1.0
+            h[1 : (n + 1) // 2] = 2.0
+        shape = [1] * x.ndim
+        shape[axis] = n
+        H = jnp.asarray(h, dtype=X.real.dtype).reshape(shape)
+        return jnp.fft.ifft(X * H, axis=axis)
+
+    spec = jnp.fft.rfft(x, axis=axis)
+    nf = spec.shape[axis]
+    h = np.ones(nf)
+    # double strictly-interior positive bins; DC and (even-n) Nyquist stay
+    h[1 : (n + 1) // 2] = 2.0
     shape = [1] * x.ndim
-    shape[axis] = n
-    H = jnp.asarray(h, dtype=X.real.dtype).reshape(shape)
-    return jnp.fft.ifft(X * H, axis=axis)
+    shape[axis] = nf
+    spec = spec * jnp.asarray(h, dtype=spec.real.dtype).reshape(shape)
+    pad_shape = list(x.shape)
+    pad_shape[axis] = n - nf
+    full = jnp.concatenate(
+        [spec, jnp.zeros(pad_shape, dtype=spec.dtype)], axis=axis
+    )
+    return jnp.fft.ifft(full, axis=axis)
 
 
 def envelope(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:

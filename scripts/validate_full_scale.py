@@ -246,10 +246,13 @@ def main():
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if args.json:
         if not os.path.isabs(args.json):
+            # anchored beside --out at the repo root (printed below so the
+            # resolved location is never a surprise)
             args.json = os.path.join(repo_root, args.json)
         with open(args.json, "w") as fh:
             json.dump({"shape": [args.nx, args.ns], "rows": rows,
                        "prod_timings": p_t, "golden_timings": g_t}, fh, indent=1)
+        print("wrote", args.json)
 
     if args.out:
         out = args.out

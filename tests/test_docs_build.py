@@ -26,7 +26,7 @@ def test_docs_build(tmp_path):
     assert "TUTORIAL.html" in index and "api/" in index
 
     # every guide rendered
-    for name in ("TUTORIAL", "API", "PERF", "PRECISION"):
+    for name in ("TUTORIAL", "API", "PERF", "PRECISION", "DESIGN"):
         page = (out / f"{name}.html").read_text()
         assert "<h1>" in page or "<h2>" in page, name
 
