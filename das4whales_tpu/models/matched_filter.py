@@ -270,6 +270,7 @@ class MatchedFilterDetector:
         max_peaks: int = 256,
         channel_tile: int | str | None = "auto",
         hbm_budget_bytes: int | None = None,
+        keep_correlograms: bool = True,
     ):
         self.metadata = as_metadata(metadata)
         self.design = design_matched_filter(
@@ -291,6 +292,10 @@ class MatchedFilterDetector:
         # round-2 bench OOM, VERDICT r2 §weak-1); an int forces that tile
         # size; None forces the monolithic route.
         self.channel_tile = channel_tile
+        # campaign mode (parity with the sharded steps' outputs="picks"):
+        # skip materializing the user-facing [C, n] correlograms — on the
+        # tiled route that's a whole extra [nT, C, n] device copy
+        self.keep_correlograms = keep_correlograms
         if hbm_budget_bytes is None:
             hbm_budget_bytes = int(float(os.environ.get("DAS_HBM_BUDGET_GB", 8.0)) * 2**30)
         self.hbm_budget_bytes = hbm_budget_bytes
@@ -354,7 +359,8 @@ class MatchedFilterDetector:
         names = self.design.template_names
         correlograms, peak_masks, picks, thr_out, snr = {}, {}, {}, {}, {}
         for i, name in enumerate(names):
-            correlograms[name] = corr[i]
+            if self.keep_correlograms:
+                correlograms[name] = corr[i]
             thr_out[name] = float(thresholds[i])
             if self.pick_mode == "sparse":
                 # TPU production route: envelope peaks are nonnegative, so
@@ -434,11 +440,18 @@ class MatchedFilterDetector:
                     picks[name] = peak_ops.convert_pick_times(mask_np)
 
         # user-facing [C, n] correlograms (the reference keeps them for
-        # plotting, main_mfdetect.py:84-92); one transposed reshape
-        corr_full = jnp.swapaxes(corr_tiles, 0, 1).reshape(nT, -1, n)[:, :C]
+        # plotting, main_mfdetect.py:84-92); one transposed reshape.
+        # Skipped in campaign mode (keep_correlograms=False) unless SNR
+        # matrices were requested.
+        corr_full = (
+            jnp.swapaxes(corr_tiles, 0, 1).reshape(nT, -1, n)[:, :C]
+            if (self.keep_correlograms or with_snr)
+            else None
+        )
         for i, name in enumerate(names):
-            correlograms[name] = corr_full[i]
             thr_out[name] = float(thr_np[i])
+            if self.keep_correlograms:
+                correlograms[name] = corr_full[i]
             if with_snr:
                 snr[name] = spectral.snr_tr_array(corr_full[i], env=True)
         return MatchedFilterResult(

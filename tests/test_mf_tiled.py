@@ -201,3 +201,28 @@ def test_auto_route_decision():
     nfft = xcorr._xcorr_full_len(n, n)
     est = 4 * C * (nfft * (1 + 2 * nT) + 6 * n * nT)
     assert est > 8 * 2**30
+
+
+def test_keep_correlograms_false_campaign_mode():
+    """keep_correlograms=False returns the same picks with an empty
+    correlogram dict on both routes (single-chip campaign mode)."""
+    nx, ns = 64, 1000
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    block = _block(nx, ns)
+    for tile in (None, 32):
+        det_full = MatchedFilterDetector(
+            meta, [0, nx, 1], (nx, ns), channel_tile=tile, pick_mode="sparse"
+        )
+        det_lean = MatchedFilterDetector(
+            meta, [0, nx, 1], (nx, ns), channel_tile=tile, pick_mode="sparse",
+            keep_correlograms=False,
+        )
+        r_full, r_lean = det_full(block), det_lean(block)
+        assert r_lean.correlograms == {}
+        for name in det_full.design.template_names:
+            np.testing.assert_array_equal(r_lean.picks[name], r_full.picks[name])
+            assert r_lean.thresholds[name] == pytest.approx(r_full.thresholds[name])
+        # SNR request still works without kept correlograms
+        r_snr = det_lean(block, with_snr=True)
+        assert set(r_snr.snr) == set(det_full.design.template_names)
+        assert r_snr.correlograms == {}

@@ -1,0 +1,62 @@
+"""Seeded fuzz: the packed-cummax local-maxima kernel and the sparse
+candidate route vs scipy, emphasizing plateaus.
+
+The round-3 rewrite of ``ops.peaks.local_maxima`` (tuple associative-scan
+-> packed-key native cummax) must keep exact scipy plateau semantics; this
+fuzz bombards it with quantized signals (heavy plateau density), edge
+runs, and constant segments. Deterministic seeds — failures reproduce.
+"""
+
+import numpy as np
+import pytest
+import scipy.signal as sp
+
+import jax.numpy as jnp
+
+from das4whales_tpu.ops import peaks as peak_ops
+
+
+def _signals():
+    rng = np.random.default_rng(2024)
+    lengths = (16, 64, 128, 384)   # fixed shapes -> 4 jit compiles total
+    for k in range(60):
+        n = lengths[int(rng.integers(0, len(lengths)))]
+        kind = k % 5
+        if kind == 0:          # heavy quantization -> many plateaus
+            x = np.round(rng.standard_normal(n) * 2) / 2
+        elif kind == 1:        # staircase with flat tops
+            x = np.repeat(rng.standard_normal(max(1, n // 4)), 4)[:n]
+        elif kind == 2:        # smooth + quantized mix
+            x = np.round(np.sin(np.linspace(0, rng.uniform(2, 30), n)) * 4) / 4
+        elif kind == 3:        # constant with isolated bumps
+            x = np.zeros(n)
+            for _ in range(int(rng.integers(1, 6))):
+                i = int(rng.integers(0, n))
+                x[i : i + int(rng.integers(1, 5))] = rng.uniform(0.5, 2.0)
+        else:                  # plain noise
+            x = rng.standard_normal(n)
+        yield k, x.astype(np.float32)
+
+
+def test_local_maxima_exact_scipy_parity_fuzz():
+    for k, x in _signals():
+        # public API: find_peaks with no conditions returns exactly the
+        # plateau-midpoint local maxima
+        want = sp.find_peaks(x.astype(np.float64))[0]
+        got = np.nonzero(np.asarray(peak_ops.local_maxima(jnp.asarray(x))))[0]
+        np.testing.assert_array_equal(got, want, err_msg=f"signal {k}")
+
+
+def test_find_peaks_sparse_matches_scipy_fuzz():
+    """On nonnegative signals, the sparse route equals
+    scipy.find_peaks(prominence=thr) whenever capacity suffices."""
+    for k, x in _signals():
+        env = np.abs(x)
+        thr = float(np.quantile(env, 0.7)) + 1e-3
+        want = sp.find_peaks(env, prominence=thr)[0]
+        res = peak_ops.find_peaks_sparse(
+            jnp.asarray(env)[None], thr, max_peaks=env.shape[0]
+        )
+        assert not bool(np.asarray(res.saturated).any())
+        got = res.positions[0][np.asarray(res.selected[0])]
+        np.testing.assert_array_equal(np.asarray(got), want, err_msg=f"signal {k}")
