@@ -1,0 +1,71 @@
+"""Unified command line: ``python -m das4whales_tpu <workflow> [options]``.
+
+The reference ships its pipelines as separate scripts
+(``scripts/main_mfdetect.py``, ``main_spectrodetect.py``, ...); here the
+same six workflows hang off one discoverable entry point. Every workflow
+runs fully offline on a synthetic OOI-like scene when no URL/file is
+given, or on a real OptaSense/Silixa file when one is.
+
+Examples::
+
+    python -m das4whales_tpu mfdetect --outdir out            # offline demo
+    python -m das4whales_tpu mfdetect https://.../file.h5
+    python -m das4whales_tpu mfdetect --no-snr
+    python -m das4whales_tpu list
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+WORKFLOWS = {
+    "mfdetect": "matched-filter detection (flagship: bandpass -> f-k -> "
+                "HF/LF correlograms -> envelope peak picks)",
+    "spectrodetect": "spectrogram-correlation detection (hat kernels)",
+    "gabordetect": "Gabor / image-processing detection",
+    "fkcomp": "f-k filter design comparison figures",
+    "plots": "exploratory t-x / f-x / spectrogram plots",
+    "bathynoise": "bathymetry-referenced noise maps",
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="das4whales_tpu",
+        description=__doc__.split("\n\n")[0],
+    )
+    sub = ap.add_subparsers(dest="workflow", required=True)
+    sub.add_parser("list", help="list available workflows")
+    for name, help_text in WORKFLOWS.items():
+        p = sub.add_parser(name, help=help_text)
+        p.add_argument("url", nargs="?", default=None,
+                       help="HDF5/TDMS file path or URL (omit: offline synthetic scene)")
+        p.add_argument("--outdir", default=f"out_{name}",
+                       help="directory for figures/artifacts (default: out_<workflow>)")
+        p.add_argument("--show", action="store_true", help="show figures interactively")
+        if name in ("mfdetect",):
+            p.add_argument("--no-snr", action="store_true", help="skip SNR matrices")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.workflow == "list":
+        for name, help_text in WORKFLOWS.items():
+            print(f"{name:15s} {help_text}")
+        return 0
+    mod = importlib.import_module(f"das4whales_tpu.workflows.{args.workflow}")
+    kwargs = dict(url=args.url, outdir=args.outdir, show=args.show)
+    if getattr(args, "no_snr", False):
+        kwargs["with_snr"] = False
+    result = mod.main(**kwargs)
+    if isinstance(result, dict) and "picks" in result:
+        for name, pk in result["picks"].items():
+            print(f"{args.workflow}: template {name}: {pk.shape[1]} picks")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""The unified CLI: python -m das4whales_tpu <workflow>."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MPLBACKEND="Agg",
+               PYTHONPATH=ROOT)
+    return subprocess.run(
+        [sys.executable, "-m", "das4whales_tpu", *args],
+        capture_output=True, text=True, env=env, timeout=timeout, cwd=ROOT,
+    )
+
+
+def test_cli_list_and_help():
+    res = _run(["list"])
+    assert res.returncode == 0
+    for name in ("mfdetect", "spectrodetect", "gabordetect",
+                 "fkcomp", "plots", "bathynoise"):
+        assert name in res.stdout
+    res = _run(["--help"])
+    assert res.returncode == 0 and "workflow" in res.stdout
+
+
+def test_cli_mfdetect_offline(tmp_path):
+    res = _run(["mfdetect", "--outdir", str(tmp_path), "--no-snr"])
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "picks" in res.stdout
+    # figures were written
+    assert any(p.suffix == ".png" for p in tmp_path.iterdir())
+
+
+def test_cli_unknown_workflow():
+    res = _run(["definitely-not-a-workflow"])
+    assert res.returncode != 0
