@@ -56,6 +56,16 @@ def main(argv=None) -> int:
         for name, help_text in WORKFLOWS.items():
             print(f"{name:15s} {help_text}")
         return 0
+    # honor JAX_PLATFORMS through the live config too: some environments
+    # register an accelerator plugin from sitecustomize that the env var
+    # alone cannot keep jax off (see tests/conftest.py) — a CLI run pinned
+    # to CPU must never hang on an unreachable accelerator
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     mod = importlib.import_module(f"das4whales_tpu.workflows.{args.workflow}")
     kwargs = dict(url=args.url, outdir=args.outdir, show=args.show)
     if getattr(args, "no_snr", False):

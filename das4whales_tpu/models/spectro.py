@@ -23,6 +23,12 @@ from ..ops import peaks as peak_ops
 from ..ops import spectral, xcorr
 from .templates import gen_hyperbolic_chirp
 
+# engine-aware channel-chunk defaults for the spectrogram sweep: the Pallas
+# kernel frames in VMEM; the rFFT fallback materializes the 95%-overlap
+# frame tensor (~1.8 MB/channel of temps, AOT-measured) in HBM
+PALLAS_DEFAULT_BATCH = 4096
+RFFT_DEFAULT_BATCH = 1024
+
 
 def sliced_spectrogram(
     trace: jnp.ndarray, fs: float, fmin: float, fmax: float, nperseg: int, nhop: int
@@ -147,7 +153,7 @@ def compute_cross_correlogram_spectrocorr(
     kernel: Dict,
     win_size: float,
     overlap_pct: float,
-    batch_channels: int = 4096,
+    batch_channels: int | None = None,
 ) -> jnp.ndarray:
     """Spectrogram-correlation correlogram for all channels.
 
@@ -155,7 +161,19 @@ def compute_cross_correlogram_spectrocorr(
     (detect.py:650-708): per-channel demean + peak normalization, sliced
     spectrogram, hat-kernel correlation. The reference's channel loop is one
     (optionally channel-chunked) batched computation.
+
+    ``batch_channels`` defaults by STFT engine: 4096 under the Pallas
+    kernel (framing stays in VMEM), 1024 under the rFFT fallback — whose
+    overlapped frame tensor costs ~1.8 MB/channel of temps at the
+    detector's 95% overlap (7.4 GB at 4096; AOT-measured — the same HBM
+    class as the round-2 matched-filter OOM).
     """
+    if batch_channels is None:
+        batch_channels = (
+            PALLAS_DEFAULT_BATCH
+            if spectral.resolve_stft_engine() == "pallas"
+            else RFFT_DEFAULT_BATCH
+        )
     nperseg = int(win_size * fs)
     nhop = int(np.floor(nperseg * (1 - overlap_pct)))
     fmin, fmax = effective_band(flims, kernel)

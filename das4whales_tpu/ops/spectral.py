@@ -149,6 +149,23 @@ def stft(
     return jnp.swapaxes(spec, -1, -2)  # [..., freq, frame]
 
 
+def resolve_stft_engine(engine: str = "auto") -> str:
+    """Resolve the STFT engine exactly as ``stft_magnitude`` will:
+    explicit arg > ``DAS4WHALES_STFT_ENGINE`` env > backend default
+    (TPU→pallas, else rfft). Exposed so batch-size heuristics upstream
+    (e.g. the spectro detector's channel chunking) can agree with the
+    engine that actually runs."""
+    import os
+
+    if engine == "auto":
+        engine = os.environ.get("DAS4WHALES_STFT_ENGINE", "auto")
+    if engine == "auto":
+        engine = "pallas" if jax.default_backend() == "tpu" else "rfft"
+    if engine not in ("pallas", "rfft"):
+        raise ValueError(f"unknown stft engine {engine!r}")
+    return engine
+
+
 def stft_magnitude(
     x: jnp.ndarray, nfft: int, hop: int, *, engine: str = "auto"
 ) -> jnp.ndarray:
@@ -160,16 +177,9 @@ def stft_magnitude(
     ``engine``: ``"auto"`` (env ``DAS4WHALES_STFT_ENGINE`` overrides, then
     TPU→pallas, else rfft), ``"pallas"``, or ``"rfft"``.
     """
-    import os
-
-    if engine == "auto":
-        engine = os.environ.get("DAS4WHALES_STFT_ENGINE", "auto")
-    if engine == "auto":
-        engine = "pallas" if jax.default_backend() == "tpu" else "rfft"
+    engine = resolve_stft_engine(engine)
     if engine == "rfft":
         return jnp.abs(stft(x, nfft, hop))
-    if engine != "pallas":
-        raise ValueError(f"unknown stft engine {engine!r}")
 
     from .pallas_stft import stft_power
 

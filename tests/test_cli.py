@@ -8,8 +8,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(args, timeout=600):
+    pythonpath = ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
     env = dict(os.environ, JAX_PLATFORMS="cpu", MPLBACKEND="Agg",
-               PYTHONPATH=ROOT)
+               PYTHONPATH=pythonpath.rstrip(os.pathsep))
     return subprocess.run(
         [sys.executable, "-m", "das4whales_tpu", *args],
         capture_output=True, text=True, env=env, timeout=timeout, cwd=ROOT,

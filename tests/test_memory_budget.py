@@ -122,3 +122,22 @@ def test_detector_auto_route_would_tile_at_canonical_shape():
     assert est > 8 * 2**30
     # and the true-length nfft is roughly half the padded one
     assert xcorr._xcorr_full_len(N, M_TRUE) < 0.55 * nfft
+
+
+def test_spectro_chunk_rfft_footprint(monkeypatch):
+    """The spectro detector's per-chunk program under the rFFT engine must
+    stay under ~2.5 GiB of temps at the shipped rFFT default batch — the
+    95%-overlap frame tensor was the same HBM class as the round-2
+    matched-filter OOM at the old 4096 default (7.4 GiB, AOT-measured)."""
+    from das4whales_tpu.models.spectro import RFFT_DEFAULT_BATCH, sliced_spectrogram
+    from das4whales_tpu.ops.spectral import resolve_stft_engine
+
+    monkeypatch.setenv("DAS4WHALES_STFT_ENGINE", "rfft")
+    assert resolve_stft_engine() == "rfft"
+
+    fs, ns, nperseg, nhop = 200.0, 12000, 160, 8
+    stats = _stats(
+        lambda x: sliced_spectrogram(x, fs, 14.6, 28.2, nperseg, nhop)[0],
+        _f32(RFFT_DEFAULT_BATCH, ns),
+    )
+    assert stats.temp_size_in_bytes < int(2.5 * 2**30)
