@@ -66,6 +66,12 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # multi-host launches: form the multi-process runtime when the
+    # JAX_COORDINATOR/JAX_NUM_PROCESSES/JAX_PROCESS_ID env is present
+    # (no-op on a single host)
+    from das4whales_tpu.parallel.distributed import initialize_from_env
+
+    initialize_from_env()
     mod = importlib.import_module(f"das4whales_tpu.workflows.{args.workflow}")
     kwargs = dict(url=args.url, outdir=args.outdir, show=args.show)
     if getattr(args, "no_snr", False):

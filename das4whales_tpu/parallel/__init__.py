@@ -1,7 +1,8 @@
 """Multi-chip scale-out: meshes, distributed FFT, sharded pipelines."""
 
-from . import fft, mesh, pipeline, timeshard  # noqa: F401
+from . import distributed, fft, mesh, pipeline, timeshard  # noqa: F401
 from .mesh import make_mesh, shard_block  # noqa: F401
+from .distributed import global_mesh, initialize_from_env  # noqa: F401
 from .fft import sharded_fk_apply  # noqa: F401
 from .pipeline import make_sharded_mf_step  # noqa: F401
 from .timeshard import (  # noqa: F401

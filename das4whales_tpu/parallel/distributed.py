@@ -1,0 +1,111 @@
+"""Multi-host (multi-process) initialization and global meshes.
+
+The reference scales across machines only implicitly (a human runs the
+per-file scripts on several nodes); an actual multi-host DAS campaign
+needs one program spanning hosts. JAX's runtime already provides the
+communication backend — XLA collectives ride ICI within a slice and DCN
+across hosts once ``jax.distributed.initialize`` has formed the global
+runtime — so this module is deliberately thin: process bootstrap from the
+environment, plus mesh builders that lay axes out so the *inner*
+(channel/time) collectives stay on ICI and only the file/data axis
+crosses DCN.
+
+Single-process calls are no-ops returning local meshes, so every code
+path here is exercised by the regular CPU test suite; on a real pod the
+same calls span hosts. Typical launch (one process per host)::
+
+    JAX_COORDINATOR=host0:8476 JAX_NUM_PROCESSES=4 JAX_PROCESS_ID=$RANK \
+        python -m das4whales_tpu mfdetect ...
+
+with ``initialize_from_env()`` called first (the CLI workflows tolerate
+its absence — single host is the default).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from .mesh import make_mesh
+
+
+def initialize_from_env(timeout_s: int = 300) -> bool:
+    """Form the multi-process JAX runtime from env vars, if configured.
+
+    Reads ``JAX_COORDINATOR`` (``host:port``), ``JAX_NUM_PROCESSES`` and
+    ``JAX_PROCESS_ID``. Returns True when a multi-process runtime was
+    initialized, False when the env is absent/single-process (no-op) or
+    when jax was already initialized (idempotent re-entry).
+    """
+    coord = os.environ.get("JAX_COORDINATOR")
+    nproc = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if not coord or nproc <= 1:
+        return False
+    pid_env = os.environ.get("JAX_PROCESS_ID")
+    if pid_env is None:
+        # a worker defaulting to rank 0 would collide with the real rank 0
+        # and deadlock the whole launch until timeout — fail fast instead
+        raise ValueError(
+            "JAX_NUM_PROCESSES > 1 but JAX_PROCESS_ID is not set; "
+            "export a distinct rank (0..N-1) on every process"
+        )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=nproc,
+            process_id=int(pid_env),
+            initialization_timeout=timeout_s,
+        )
+    except RuntimeError as e:  # already initialized — idempotent re-entry
+        msg = str(e).lower()
+        if "already" in msg or "only be called once" in msg:
+            return False
+        raise
+    return True
+
+
+def global_mesh(
+    axis_names: Sequence[str] = ("file", "channel"),
+    files_per_host: int | None = None,
+) -> Mesh:
+    """Mesh over ALL devices of all processes, laid out DCN-friendly.
+
+    The FIRST axis (``file`` — data parallelism) is the slowest-varying
+    and spans hosts, so only per-file scalars (the ``pmax`` threshold)
+    ever cross DCN; the LAST axis (``channel``/``time`` — the
+    ``all_to_all`` pencil-FFT axis) stays within a host's devices, i.e. on
+    ICI. With ``files_per_host=None`` the file axis gets exactly one shard
+    per process (the natural layout: each host ingests its own files —
+    ``io.stream`` reads locally, no cross-host data motion).
+
+    Single-process: degenerates to ``make_mesh`` over local devices with
+    ``file=1`` — identical semantics, fully testable on the CPU mesh.
+    """
+    devices = jax.devices()                       # global, process-major
+    n_proc = jax.process_count()
+    n_files = n_proc if files_per_host is None else n_proc * files_per_host
+    if len(devices) % n_files:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_files} file shards"
+        )
+    shape = (n_files, len(devices) // n_files)
+    return make_mesh(shape, axis_names, devices=devices)
+
+
+def local_device_batch(n_files_global: int) -> slice:
+    """This process's slice of a ``[file, ...]`` global batch: which file
+    indices the local host should ingest (matches ``global_mesh``'s
+    process-major file-axis layout)."""
+    n_proc = jax.process_count()
+    if n_files_global % n_proc:
+        # a silent remainder would mean files no host ever ingests
+        raise ValueError(
+            f"{n_files_global} files not divisible over {n_proc} processes; "
+            "pad the batch (io.stream tail policies) or adjust files_per_host"
+        )
+    per = n_files_global // n_proc
+    start = jax.process_index() * per
+    return slice(start, start + per)

@@ -1,0 +1,59 @@
+"""Multi-host helpers in their single-process degenerate mode (the same
+code paths a pod launch takes; jax.process_count()==1 here)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from das4whales_tpu.parallel import distributed, make_sharded_mf_step
+from das4whales_tpu.parallel.pipeline import input_sharding
+
+
+def test_initialize_from_env_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR", raising=False)
+    assert distributed.initialize_from_env() is False
+    monkeypatch.setenv("JAX_COORDINATOR", "host:1")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    assert distributed.initialize_from_env() is False  # single process
+
+
+def test_global_mesh_single_process_runs_sharded_step(rng):
+    """global_mesh degenerates to a local (1, n_devices) mesh that drives
+    the real sharded detection step."""
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import design_matched_filter
+
+    mesh = distributed.global_mesh()
+    assert mesh.shape["file"] == jax.process_count() == 1
+    assert mesh.shape["channel"] == len(jax.devices())
+
+    nx, ns = 8 * mesh.shape["channel"], 256
+    meta = AcquisitionMetadata(fs=200.0, dx=8.0, nx=nx, ns=ns)
+    design = design_matched_filter((nx, ns), [0, nx, 1], meta)
+    step = make_sharded_mf_step(design, mesh, outputs="picks")
+    x = jax.device_put(
+        jnp.asarray(rng.standard_normal((1, nx, ns)).astype(np.float32)),
+        input_sharding(mesh),
+    )
+    picks, thres = step(x)
+    assert picks.positions.shape[1] == 1 and thres.shape == (1,)
+
+
+def test_global_mesh_divisibility_error():
+    with pytest.raises(ValueError, match="divisible"):
+        distributed.global_mesh(files_per_host=3)  # 8 devices % 3 != 0
+
+
+def test_local_device_batch_single_process():
+    # single process: every global batch is local, and any count divides 1
+    assert distributed.local_device_batch(4) == slice(0, 4)
+    assert distributed.local_device_batch(5) == slice(0, 5)
+
+
+def test_initialize_requires_process_id(monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR", "host:1")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="JAX_PROCESS_ID"):
+        distributed.initialize_from_env()
