@@ -148,7 +148,7 @@ def bench_stages(det, x, repeats=3):
     from das4whales_tpu.ops import peaks as peak_ops
     from das4whales_tpu.ops import spectral, xcorr
 
-    gain, mask = det._gain_dev, det._mask_dev
+    gain = det._gain_dev
     padlen = det.design.bp_padlen
     nT = det.design.templates.shape[0]
 
@@ -162,7 +162,9 @@ def bench_stages(det, x, repeats=3):
         return best, out
 
     stages = {}
-    filter_fn = lambda a: mf_filter_only(a, mask, gain, padlen)
+    filter_fn = lambda a: mf_filter_only(
+        a, det._mask_band_dev, gain, det._band_lo, det._band_hi, padlen
+    )
     stages["filter"], trf = timed(filter_fn, x)
 
     if det._route() == "tiled":

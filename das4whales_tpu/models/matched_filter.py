@@ -135,18 +135,25 @@ def mf_filter_and_correlate(
     return trf_fk, corr
 
 
-@functools.partial(jax.jit, static_argnames=("bp_padlen",))
+@functools.partial(jax.jit, static_argnames=("band_lo", "band_hi", "bp_padlen"))
 def mf_filter_only(
-    trace: jnp.ndarray, fk_mask: jnp.ndarray, bp_gain: jnp.ndarray, bp_padlen: int
+    trace: jnp.ndarray,
+    fk_mask_band: jnp.ndarray,
+    bp_gain: jnp.ndarray,
+    band_lo: int,
+    band_hi: int,
+    bp_padlen: int,
 ) -> jnp.ndarray:
-    """Bandpass + f-k filter WITHOUT the correlate stage — the first program
-    of the memory-lean (tiled) detection route. Kept separate from
+    """Bandpass + band-limited f-k filter WITHOUT the correlate stage — the
+    first program of both detection routes. Kept separate from
     ``mf_filter_and_correlate`` so the correlate temps never share a live
-    range with the 2-D f-k spectrum."""
+    range with the 2-D f-k spectrum; uses the banded applier
+    (``ops.fk.banded_mask_half``) so the channel-axis FFT pair runs only on
+    the mask's in-band frequency columns."""
     from ..ops.filters import _fft_zero_phase_jit
 
     tr_bp = _fft_zero_phase_jit(trace, bp_gain, bp_padlen)
-    return fk_ops.fk_filter_apply_rfft(tr_bp, fk_mask)
+    return fk_ops.fk_filter_apply_rfft_banded(tr_bp, fk_mask_band, band_lo, band_hi)
 
 
 @functools.partial(jax.jit, static_argnames=("tile",))
@@ -299,7 +306,13 @@ class MatchedFilterDetector:
         if hbm_budget_bytes is None:
             hbm_budget_bytes = int(float(os.environ.get("DAS_HBM_BUDGET_GB", 8.0)) * 2**30)
         self.hbm_budget_bytes = hbm_budget_bytes
-        self._mask_dev = jnp.asarray(self.design.fk_mask)
+        # NOTE: the full dense mask stays host-side (design.fk_mask) — only
+        # the banded half-spectrum crop goes to HBM (~3x smaller; at the
+        # canonical shape the full mask would pin ~1 GB doing nothing)
+        mask_band, self._band_lo, self._band_hi = fk_ops.banded_mask_half(
+            self.design.fk_mask
+        )
+        self._mask_band_dev = jnp.asarray(mask_band)
         self._gain_dev = jnp.asarray(self.design.bp_gain)
         self._templates_dev = jnp.asarray(self.design.templates)
         (self._templates_true, self._template_mu, self._template_scale) = (
@@ -342,16 +355,18 @@ class MatchedFilterDetector:
         # into the compiled module — at canonical shape that stage alone is
         # the round-2 OOM
         return mf_filter_only(
-            trace, self._mask_dev, self._gain_dev, self.design.bp_padlen
+            trace, self._mask_band_dev, self._gain_dev,
+            self._band_lo, self._band_hi, self.design.bp_padlen,
         )
 
     def __call__(self, trace: jnp.ndarray, threshold: float | None = None, with_snr: bool = False) -> MatchedFilterResult:
-        trace = jnp.asarray(trace, dtype=self._mask_dev.dtype)
+        trace = jnp.asarray(trace, dtype=self._mask_band_dev.dtype)
         if self._route() == "tiled":
             return self._call_tiled(trace, threshold=threshold, with_snr=with_snr)
-        trf_fk, corr = mf_filter_and_correlate(
-            trace, self._mask_dev, self._gain_dev, self._templates_dev, self.design.bp_padlen
-        )
+        # both routes share the banded filter program, so their trf_fk (and
+        # everything downstream of it) is bit-identical
+        trf_fk = self.filter_block(trace)
+        corr = xcorr.compute_cross_correlograms_multi(trf_fk, self._templates_dev)
         env, thresholds = mf_envelope_and_threshold(corr)
         if threshold is not None:
             thresholds = jnp.full_like(thresholds, threshold)
@@ -398,9 +413,7 @@ class MatchedFilterDetector:
         nT = self.design.templates.shape[0]
         names = self.design.template_names
 
-        trf_fk = mf_filter_only(
-            trace, self._mask_dev, self._gain_dev, self.design.bp_padlen
-        )
+        trf_fk = self.filter_block(trace)
         corr_tiles, gmax = mf_correlate_tiled(
             trf_fk, self._templates_true, self._template_mu, self._template_scale, tile
         )

@@ -37,16 +37,9 @@ def _axis_size(axis_name: str) -> int:
     return jax.lax.axis_size(axis_name)
 
 
-def symmetrize_mask_fftorder(mask: np.ndarray) -> np.ndarray:
-    """fftshifted ``[k x f]`` design mask -> point-reflect-symmetrized full
-    mask in fft order on both axes (guarantees a real filter output; the
-    device-side analogue is ``ops.fk._point_reflect``). Single source of
-    truth for the sharded f-k paths' mask convention."""
-    mu = np.fft.ifftshift(np.asarray(mask))
-    pr = mu
-    for ax in (0, 1):
-        pr = np.roll(np.flip(pr, axis=ax), 1, axis=ax)
-    return 0.5 * (mu + pr)
+# single source of truth lives beside the appliers; re-exported here for
+# the sharded paths' existing import surface
+from ..ops.fk import symmetrize_mask_fftorder  # noqa: F401,E402
 
 
 def prepare_mask_half(mask: np.ndarray, nns: int, pad_f: int = 0) -> np.ndarray:

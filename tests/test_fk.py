@@ -184,3 +184,33 @@ def test_compression_report(capsys):
     assert rep["ratio"] > 1.0
     out = capsys.readouterr().out
     assert "compression ratio" in out
+
+
+def test_banded_applier_matches_full():
+    """Band-limited f-k apply == full half-spectrum apply (to the taper
+    tail's documented tolerance) at a fraction of the channel-FFT bins."""
+    import numpy as np
+
+    nx, ns, fs, dx = 120, 1600, 200.0, 4.0
+    mask = fk.hybrid_ninf_filter_design(
+        (nx, ns), [0, nx, 1], dx, fs, 1350, 1450, 3300, 3450, 14, 30
+    )
+    mask_band, lo, hi = fk.banded_mask_half(mask)
+    nf = ns // 2 + 1
+    assert hi - lo < 0.5 * nf            # genuinely band-limited
+    rng = np.random.default_rng(0)
+    import jax.numpy as jnp
+    x = jnp.asarray(rng.standard_normal((nx, ns)).astype(np.float32))
+    full = np.asarray(fk.fk_filter_apply_rfft(x, jnp.asarray(mask)))
+    band = np.asarray(
+        fk.fk_filter_apply_rfft_banded(x, jnp.asarray(mask_band), lo, hi)
+    )
+    scale = max(1e-30, float(np.abs(full).max()))
+    assert np.abs(full - band).max() < 1e-5 * scale
+
+    # tol=0 keeps strictly-nonzero support and is exact to roundoff
+    mb0, lo0, hi0 = fk.banded_mask_half(mask, tol=0.0)
+    band0 = np.asarray(
+        fk.fk_filter_apply_rfft_banded(x, jnp.asarray(mb0), lo0, hi0)
+    )
+    assert np.abs(full - band0).max() < 1e-6 * scale

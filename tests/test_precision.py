@@ -29,9 +29,12 @@ def scene_file(tmp_path):
 def _run(path, meta, dtype):
     blk = dio.load_das_data(path, [0, NX, 1], meta, dtype=dtype, engine="h5py")
     det = MatchedFilterDetector(meta, [0, NX, 1], (NX, NS))
-    det._mask_dev = jnp.asarray(det.design.fk_mask, dtype=dtype)
+    det._mask_band_dev = jnp.asarray(det._mask_band_dev, dtype=dtype)
     det._gain_dev = jnp.asarray(det.design.bp_gain, dtype=dtype)
     det._templates_dev = jnp.asarray(det.design.templates, dtype=dtype)
+    det._templates_true = jnp.asarray(det._templates_true, dtype=dtype)
+    det._template_mu = jnp.asarray(det._template_mu, dtype=dtype)
+    det._template_scale = jnp.asarray(det._template_scale, dtype=dtype)
     return det(jnp.asarray(blk.trace, dtype=dtype))
 
 
