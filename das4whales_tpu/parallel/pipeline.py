@@ -22,7 +22,7 @@ from jax import shard_map
 from ..ops import peaks as peak_ops
 from ..ops import spectral, xcorr
 from ..ops.filters import _odd_ext
-from .fft import fk_apply_local, prepare_mask_half
+from .fft import fk_apply_local_banded, prepare_mask_band
 
 
 def _bp_local(trace: jnp.ndarray, gain: jnp.ndarray, padlen: int) -> jnp.ndarray:
@@ -34,17 +34,19 @@ def _bp_local(trace: jnp.ndarray, gain: jnp.ndarray, padlen: int) -> jnp.ndarray
 
 
 def _mf_body(
-    trace, mask_half, bp_gain, templates_true, template_mu, template_scale, *,
-    bp_padlen: int, channel_axis: str,
+    trace, mask_band, bp_gain, templates_true, template_mu, template_scale, *,
+    band_lo: int, band_hi: int, bp_padlen: int, channel_axis: str,
     relative_threshold: float, hf_factor: float, pick_mode: str, max_peaks: int,
     outputs: str = "full",
 ):
-    """shard_map body. Local shapes: trace [B/Pf, C/Pc, T], mask_half
-    [K, Fpad/Pc], bp_gain [Fext], templates_true [nT, m] (TRUE length —
-    the memory-lean correlate route, ops/xcorr.py:padded_template_stats,
-    halves the per-shard FFT temps vs the padded form)."""
+    """shard_map body. Local shapes: trace [B/Pf, C/Pc, T], mask_band
+    [K, Bpad/Pc] (band-limited half-spectrum — the all_to_alls and
+    channel FFTs carry only in-band columns, parallel/fft.py), bp_gain
+    [Fext], templates_true [nT, m] (TRUE length — the memory-lean
+    correlate route, ops/xcorr.py:padded_template_stats, halves the
+    per-shard FFT temps vs the padded form)."""
     tr_bp = _bp_local(trace, bp_gain, bp_padlen)
-    trf_fk = fk_apply_local(tr_bp, mask_half, channel_axis)
+    trf_fk = fk_apply_local_banded(tr_bp, mask_band, band_lo, band_hi, channel_axis)
 
     corr = xcorr.compute_cross_correlograms_corrected(
         trf_fk, templates_true, template_mu, template_scale
@@ -120,9 +122,8 @@ def make_sharded_mf_step(
     pc = mesh.shape[channel_axis]
     if nnx % pc:
         raise ValueError(f"channels {nnx} not divisible by {channel_axis}={pc}")
-    nf = nns // 2 + 1
-    pad_f = (-nf) % pc
-    mask_half = jnp.asarray(prepare_mask_half(design.fk_mask, nns, pad_f), dtype=jnp.float32)
+    mask_band_np, band_lo, band_hi = prepare_mask_band(design.fk_mask, pc)
+    mask_band = jnp.asarray(mask_band_np, dtype=jnp.float32)
     bp_gain = jnp.asarray(design.bp_gain)
     templates_true, template_mu, template_scale = (
         xcorr.padded_template_stats_device(design.templates)
@@ -130,6 +131,8 @@ def make_sharded_mf_step(
 
     body = functools.partial(
         _mf_body,
+        band_lo=band_lo,
+        band_hi=band_hi,
         bp_padlen=design.bp_padlen,
         channel_axis=channel_axis,
         relative_threshold=relative_threshold,
@@ -173,7 +176,7 @@ def make_sharded_mf_step(
 
     @jax.jit
     def step(trace_batch):
-        return fn(trace_batch, mask_half, bp_gain, templates_true, template_mu, template_scale)
+        return fn(trace_batch, mask_band, bp_gain, templates_true, template_mu, template_scale)
 
     return step
 
