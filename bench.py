@@ -111,7 +111,16 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
         meta, [0, nx, 1], (nx, ns), peak_block=peak_block, channel_tile=channel_tile
     )
     block = _make_block(nx, ns, fs, dx)
-    x = jax.device_put(jnp.asarray(block))
+    # stage the host->device transfer in channel slabs: one ~1 GB RPC is a
+    # suspected trigger of the tunnel wedge (TESTLOG.md), and slab puts cost
+    # nothing on a healthy device
+    slab = 4096
+    if nx > slab:
+        x = jnp.concatenate(
+            [jax.device_put(block[i : i + slab]) for i in range(0, nx, slab)], axis=0
+        )
+    else:
+        x = jax.device_put(block)
 
     def run():
         res = det(x)
@@ -354,30 +363,37 @@ def main():
 
     # Attempt ladder: a runtime failure (the round-2 HBM OOM) must degrade
     # to the next rung and ANNOTATE, never exit without the JSON line
-    # (VERDICT r2 weak-2). Each rung is (label, shape, bench kwargs).
+    # (VERDICT r2 weak-2). Each rung is (label, shape, kwargs, final);
+    # non-final rungs secure a provisional number and keep climbing —
+    # observed failure mode on this image (TESTLOG.md second wedge): the
+    # canonical-shape rung can wedge the tunnel outright, so a quick-shape
+    # accelerator number is banked FIRST and the payload keeps the largest
+    # successful shape.
     if args.quick or fallback or explicit_cpu:
         ladder = [
-            ("quick", quick_shape, {"channel_tile": "auto"}),
-            ("quick-tiled-512", quick_shape, {"channel_tile": 512, "with_stages": False}),
+            ("quick", quick_shape, {"channel_tile": "auto"}, True),
+            ("quick-tiled-512", quick_shape, {"channel_tile": 512, "with_stages": False}, True),
         ]
     else:
         ladder = [
-            ("full", full_shape, {"channel_tile": "auto"}),
-            ("full-tile-1024", full_shape, {"channel_tile": 1024, "with_stages": False}),
-            ("degraded-quick-shape", quick_shape, {"channel_tile": "auto"}),
+            ("secure-quick", quick_shape,
+             {"channel_tile": "auto", "with_stages": False}, False),
+            ("full", full_shape, {"channel_tile": "auto"}, True),
+            ("full-tile-1024", full_shape, {"channel_tile": 1024, "with_stages": False}, True),
         ]
 
     errors = []
-    result = None
-    shape_used = None
+    successes = []  # (nx*ns, label, (nx, ns, cpu_nx), result)
     on_cpu = fallback or explicit_cpu
-    for label, (nx, ns, cpu_nx, peak_block), kw in ladder:
+    for label, (nx, ns, cpu_nx, peak_block), kw, final in ladder:
         if on_cpu and nx > 4096:
             # a full-shape rung on the CPU fallback would burn the whole
             # rung timeout for nothing (the CPU reference is ~20x smaller
             # and already takes minutes) — jump to the quick-shape rung
             errors.append(f"{label}: skipped at full shape on CPU fallback")
             continue
+        if successes and on_cpu:
+            break  # an accelerator number is banked; no point in CPU rungs
         kw.setdefault("with_stages", not args.no_stages)
         spec = {"nx": nx, "ns": ns, "fs": fs, "dx": dx,
                 "peak_block": peak_block, "kw": kw}
@@ -387,10 +403,10 @@ def main():
         )
         result, err = _spawn_rung(spec, timeout, cpu=on_cpu)
         if result is not None:
-            shape_used = (nx, ns, cpu_nx)
-            if label != ladder[0][0]:
-                errors.append(f"degraded to rung '{label}'")
-            break
+            successes.append((nx * ns, label, (nx, ns, cpu_nx), result))
+            if final:
+                break
+            continue
         errors.append(f"{label}: {err}")
         if err.startswith("timeout:") and not on_cpu:
             # a killed mid-compile child usually means the tunnel is wedged;
@@ -400,7 +416,24 @@ def main():
                               "degrading remaining rungs to CPU")
                 on_cpu = True
 
-    if result is None:
+    if not successes and not (args.quick or fallback or explicit_cpu):
+        # nothing succeeded on the accelerator ladder — one last CPU rung
+        # so the JSON line still carries a real measurement
+        spec = {"nx": quick_shape[0], "ns": quick_shape[1], "fs": fs, "dx": dx,
+                "peak_block": quick_shape[3],
+                "kw": {"channel_tile": "auto", "with_stages": False}}
+        result, err = _spawn_rung(spec, args.rung_timeout, cpu=True)
+        if result is not None:
+            on_cpu = True
+            successes.append(
+                (quick_shape[0] * quick_shape[1], "degraded-quick-cpu",
+                 (quick_shape[0], quick_shape[1], quick_shape[2]), result)
+            )
+            errors.append("degraded to rung 'degraded-quick-cpu'")
+        else:
+            errors.append(f"degraded-quick-cpu: {err}")
+
+    if not successes:
         # every rung failed — emit an honest dead-bench line rather than rc!=0
         print(json.dumps({
             "metric": "OOI-RCA 60s chunk: fk_filter+mf_detect wall-clock; ch*samples/s/chip",
@@ -411,12 +444,14 @@ def main():
         }))
         return 1 if args.strict else 0
 
-    nx, ns, cpu_nx = shape_used
+    _, best_label, (nx, ns, cpu_nx), result = max(successes)
+    if not (args.quick or fallback or explicit_cpu) and not best_label.startswith("full"):
+        errors.append(f"headline from rung '{best_label}' (canonical shape did not complete)")
     wall, n_picks = result["wall"], result["n_picks"]
     device, stages, route = result["device"], result["stages"], result["route"]
     if fallback:
         device = f"cpu-fallback (accelerator unreachable within {args.device_timeout:.0f}s): {device}"
-    elif on_cpu and not explicit_cpu:
+    elif on_cpu and not explicit_cpu and best_label == "degraded-quick-cpu":
         device = f"cpu-fallback (accelerator wedged mid-rung): {device}"
     value = nx * ns / wall
 

@@ -1,0 +1,136 @@
+"""Control-flow tests for the bench's deadline-guarded attempt ladder.
+
+The bench's contract is ONE JSON line on every exit path (VERDICT r2
+weak-2), and — after the second tunnel wedge (TESTLOG.md) — that a
+wedged-mid-compile canonical rung costs the round a canonical number but
+never the banked quick-shape accelerator number. These tests script the
+rung outcomes (no jax, no subprocesses) and assert the parent's ladder
+decisions; the subprocess plumbing itself is exercised by the CI bench
+smoke (`python bench.py --quick --no-cpu --no-stages --strict`).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+import bench  # noqa: E402
+
+
+TPU_OK = {"wall": 0.5, "n_picks": 12, "device": "TPU v5 lite0",
+          "stages": None, "route": "mono"}
+WEDGE = "timeout: rung exceeded 900s (wedged tunnel or runaway compile)"
+
+
+def run_scenario(monkeypatch, spawn, probe_ok=True, probe_after=False, argv=None):
+    monkeypatch.setattr(bench, "_spawn_rung", spawn)
+    monkeypatch.setattr(bench, "_probe_device_with_backoff", lambda b: probe_ok)
+    monkeypatch.setattr(bench, "_probe_device", lambda t: probe_after)
+    monkeypatch.setattr(sys, "argv", argv or ["bench.py"])
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    buf = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", buf)
+    rc = bench.main()
+    return rc, json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_secure_quick_banked_when_full_rung_wedges(monkeypatch):
+    attempts = []
+
+    def spawn(spec, timeout_s, cpu=False):
+        attempts.append((spec.get("nx"), cpu))
+        if spec.get("cpu_baseline"):
+            return {"cpu_wall": 10.0, "n_picks": 4}, None
+        if spec["nx"] == 1024 and not cpu:
+            return dict(TPU_OK), None
+        return None, WEDGE
+
+    rc, p = run_scenario(monkeypatch, spawn)
+    assert rc == 0
+    assert p["shape"] == [1024, 3000]
+    assert p["device"] == "TPU v5 lite0"          # NOT a cpu-fallback line
+    assert "headline from rung 'secure-quick'" in p["error"]
+    assert "full: timeout" in p["error"]
+    # after the wedge + dead re-probe, no full-shape rung may run on CPU
+    assert not any(nx and nx > 4096 and cpu for nx, cpu in attempts)
+
+
+def test_full_shape_headline_when_everything_succeeds(monkeypatch):
+    def spawn(spec, timeout_s, cpu=False):
+        if spec.get("cpu_baseline"):
+            return {"cpu_wall": 100.0, "n_picks": 4}, None
+        wall = 2.0 if spec["nx"] > 4096 else 0.5
+        return dict(TPU_OK, wall=wall, route="tiled(tile=512)"), None
+
+    rc, p = run_scenario(monkeypatch, spawn)
+    assert p["shape"] == [22050, 12000]
+    assert "error" not in p
+    expect_vs = (22050 * 12000 / 2.0) / (1050 * 12000 / 100.0)
+    assert p["vs_baseline"] == pytest.approx(expect_vs, rel=0.01)
+
+
+def test_oom_error_degrades_to_tiled_rung_on_accelerator(monkeypatch):
+    def spawn(spec, timeout_s, cpu=False):
+        if spec.get("cpu_baseline"):
+            return {"cpu_wall": 100.0, "n_picks": 4}, None
+        if spec["nx"] == 1024:
+            return dict(TPU_OK), None
+        if spec["kw"].get("channel_tile") == "auto":
+            return None, "RESOURCE_EXHAUSTED: out of memory"  # round-2 mode
+        return dict(TPU_OK, wall=3.0, route="tiled(tile=1024)"), None
+
+    rc, p = run_scenario(monkeypatch, spawn)
+    assert p["shape"] == [22050, 12000]
+    assert "full: RESOURCE_EXHAUSTED" in p["error"]
+    assert "headline" not in p["error"]           # canonical shape completed
+
+
+def test_total_accelerator_failure_degrades_to_cpu_quick(monkeypatch):
+    def spawn(spec, timeout_s, cpu=False):
+        if spec.get("cpu_baseline"):
+            return {"cpu_wall": 10.0, "n_picks": 4}, None
+        if cpu:
+            return {"wall": 1.0, "n_picks": 12, "device": "TFRT_CPU_0",
+                    "stages": None, "route": "mono"}, None
+        return None, WEDGE
+
+    rc, p = run_scenario(monkeypatch, spawn)
+    assert rc == 0
+    assert p["shape"] == [1024, 3000]
+    assert p["device"].startswith("cpu-fallback (accelerator wedged mid-rung)")
+
+
+def test_every_rung_dead_still_emits_json_line(monkeypatch):
+    def spawn(spec, timeout_s, cpu=False):
+        return None, WEDGE
+
+    rc, p = run_scenario(monkeypatch, spawn)
+    assert rc == 0                                # non-strict: JSON is the contract
+    assert p["value"] == 0.0 and p["vs_baseline"] == 0.0
+    assert "degraded-quick-cpu" in p["error"]
+
+    rc, p = run_scenario(monkeypatch, spawn, argv=["bench.py", "--strict"])
+    assert rc == 1                                # strict: CI gate
+
+
+def test_truncated_rung_result_line_is_a_rung_failure():
+    # SIGKILL mid-write must not crash the parent (json decode guard)
+    import subprocess as sp
+
+    class FakeProc:
+        returncode = 0
+        stdout = 'RUNG_RESULT:{"wall": 1.2, "n_pick'
+        stderr = ""
+
+    orig = sp.run
+    try:
+        sp.run = lambda *a, **k: FakeProc()
+        res, err = bench._spawn_rung({"nx": 8, "ns": 8, "fs": 1.0, "dx": 1.0,
+                                      "peak_block": 8, "kw": {}}, 5.0)
+    finally:
+        sp.run = orig
+    assert res is None and err
