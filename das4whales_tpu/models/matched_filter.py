@@ -57,6 +57,13 @@ class MatchedFilterDesign:
     fs: float = 200.0            # sampling rate the design was built for
     bp_band: tuple = (14.0, 30.0)  # bandpass the gain was designed from
     bp_order: int = 8
+    # padded channel count the f-k mask was designed for (== trace_shape[0]
+    # when no padding); see design_matched_filter(channel_pad=...)
+    fk_channels: int = 0
+
+    def __post_init__(self):
+        if not self.fk_channels:
+            self.fk_channels = self.fk_mask.shape[0]
 
     def sparsity_report(self, verbose: bool = False):
         return fk_ops.compression_report(self.fk_mask, verbose=verbose)
@@ -69,6 +76,7 @@ def design_matched_filter(
     fk_config: FkFilterConfig = SCRIPT_FK,
     bp_band=(14.0, 30.0),
     templates: Dict[str, CallTemplateConfig] | None = None,
+    channel_pad: int | str | None = None,
 ) -> MatchedFilterDesign:
     """Design the full pipeline for a given block shape.
 
@@ -76,14 +84,37 @@ def design_matched_filter(
     script fan (main_mfdetect.py:46-47), 14-30 Hz Butterworth-8 bandpass
     (main_mfdetect.py:53), and the HF/LF fin-call note templates
     (main_mfdetect.py:72-73).
+
+    ``channel_pad`` pads the CHANNEL axis of the f-k transform:
+    ``"auto"`` rounds the channel count up to the next 5-smooth FFT length
+    (e.g. the canonical 22050 = 2*3^2*5^2*7^2, whose radix-7 factors
+    mixed-radix FFTs handle worst, becomes 22500 = 2^2*3^2*5^4); an int
+    forces that padded length; ``None`` (default) keeps the exact count.
+    The mask is DESIGNED on the padded wavenumber grid — the speed fan is
+    a continuous function of (f, k), merely sampled finer — and the block
+    is zero-padded with virtual silent channels before the channel FFT and
+    cropped after, so padding changes only the circular-wraparound edge
+    behavior (zeros buffer the wrap; deviation from the reference's
+    circular-in-C transform, documented in docs/PRECISION.md).
     """
     meta = as_metadata(metadata)
     sel = ChannelSelection.from_list(selected_channels)
     if templates is None:
         templates = {"HF": FIN_HF_NOTE, "LF": FIN_LF_NOTE}
 
+    if channel_pad == "auto":
+        fk_channels = xcorr.next_fast_len(trace_shape[0])
+    elif channel_pad:
+        if int(channel_pad) < trace_shape[0]:
+            raise ValueError(
+                f"channel_pad={channel_pad} < channel count {trace_shape[0]}"
+            )
+        fk_channels = int(channel_pad)
+    else:
+        fk_channels = trace_shape[0]
+
     mask = fk_ops.hybrid_ninf_filter_design(
-        tuple(trace_shape), sel.to_list(), meta.dx, meta.fs,
+        (fk_channels, trace_shape[1]), sel.to_list(), meta.dx, meta.fs,
         cs_min=fk_config.cs_min, cp_min=fk_config.cp_min,
         cp_max=fk_config.cp_max, cs_max=fk_config.cs_max,
         fmin=fk_config.fmin, fmax=fk_config.fmax,
@@ -111,6 +142,7 @@ def design_matched_filter(
         trace_shape=tuple(trace_shape),
         fs=float(meta.fs),
         bp_band=(float(bp_band[0]), float(bp_band[1])),
+        fk_channels=fk_channels,
     )
 
 
@@ -129,13 +161,22 @@ def mf_filter_and_correlate(
     """
     from ..ops.filters import _fft_zero_phase_jit
 
+    if fk_mask.shape[0] != trace.shape[0]:
+        raise ValueError(
+            f"fk_mask has {fk_mask.shape[0]} channel rows but trace has "
+            f"{trace.shape[0]}; channel-padded designs "
+            f"(design_matched_filter(channel_pad=...)) are not supported by "
+            f"this legacy entry point — use MatchedFilterDetector"
+        )
     tr_bp = _fft_zero_phase_jit(trace, bp_gain, bp_padlen)
     trf_fk = fk_ops.fk_filter_apply_rfft(tr_bp, fk_mask)
     corr = xcorr.compute_cross_correlograms_multi(trf_fk, templates)
     return trf_fk, corr
 
 
-@functools.partial(jax.jit, static_argnames=("band_lo", "band_hi", "bp_padlen"))
+@functools.partial(
+    jax.jit, static_argnames=("band_lo", "band_hi", "bp_padlen", "pad_rows")
+)
 def mf_filter_only(
     trace: jnp.ndarray,
     fk_mask_band: jnp.ndarray,
@@ -143,17 +184,26 @@ def mf_filter_only(
     band_lo: int,
     band_hi: int,
     bp_padlen: int,
+    pad_rows: int = 0,
 ) -> jnp.ndarray:
     """Bandpass + band-limited f-k filter WITHOUT the correlate stage — the
     first program of both detection routes. Kept separate from
     ``mf_filter_and_correlate`` so the correlate temps never share a live
     range with the 2-D f-k spectrum; uses the banded applier
     (``ops.fk.banded_mask_half``) so the channel-axis FFT pair runs only on
-    the mask's in-band frequency columns."""
+    the mask's in-band frequency columns.
+
+    ``pad_rows`` appends that many virtual silent channels before the f-k
+    transform (mask must be designed at the padded count — see
+    ``design_matched_filter(channel_pad=...)``); output is cropped back to
+    the real channels."""
     from ..ops.filters import _fft_zero_phase_jit
 
     tr_bp = _fft_zero_phase_jit(trace, bp_gain, bp_padlen)
-    return fk_ops.fk_filter_apply_rfft_banded(tr_bp, fk_mask_band, band_lo, band_hi)
+    if pad_rows:
+        tr_bp = jnp.pad(tr_bp, ((0, pad_rows), (0, 0)))
+    out = fk_ops.fk_filter_apply_rfft_banded(tr_bp, fk_mask_band, band_lo, band_hi)
+    return out[: trace.shape[0]] if pad_rows else out
 
 
 @functools.partial(jax.jit, static_argnames=("tile",))
@@ -278,10 +328,12 @@ class MatchedFilterDetector:
         channel_tile: int | str | None = "auto",
         hbm_budget_bytes: int | None = None,
         keep_correlograms: bool = True,
+        channel_pad: int | str | None = None,
     ):
         self.metadata = as_metadata(metadata)
         self.design = design_matched_filter(
-            trace_shape, selected_channels, self.metadata, fk_config, bp_band, templates
+            trace_shape, selected_channels, self.metadata, fk_config, bp_band,
+            templates, channel_pad=channel_pad,
         )
         self.peak_block = peak_block
         if pick_mode == "auto":
@@ -350,6 +402,10 @@ class MatchedFilterDetector:
                 f"raise max_peaks (now {self.max_peaks})"
             )
 
+    @property
+    def fk_pad_rows(self) -> int:
+        return self.design.fk_channels - self.design.trace_shape[0]
+
     def filter_block(self, trace: jnp.ndarray) -> jnp.ndarray:
         # filter-only program: never drags the (discarded) correlate stage
         # into the compiled module — at canonical shape that stage alone is
@@ -357,6 +413,7 @@ class MatchedFilterDetector:
         return mf_filter_only(
             trace, self._mask_band_dev, self._gain_dev,
             self._band_lo, self._band_hi, self.design.bp_padlen,
+            pad_rows=self.fk_pad_rows,
         )
 
     def __call__(self, trace: jnp.ndarray, threshold: float | None = None, with_snr: bool = False) -> MatchedFilterResult:

@@ -119,6 +119,11 @@ def make_sharded_mf_step(
     if outputs not in ("full", "picks"):
         raise ValueError(f"outputs must be 'full' or 'picks', got {outputs!r}")
     nnx, nns = design.trace_shape
+    if design.fk_channels != nnx:
+        raise ValueError(
+            "channel-padded designs (design_matched_filter(channel_pad=...)) "
+            "are single-chip only; design without padding for the sharded step"
+        )
     pc = mesh.shape[channel_axis]
     if nnx % pc:
         raise ValueError(f"channels {nnx} not divisible by {channel_axis}={pc}")

@@ -211,6 +211,11 @@ def make_sharded_mf_step_time(
     if outputs not in ("full", "picks"):
         raise ValueError(f"outputs must be 'full' or 'picks', got {outputs!r}")
     nnx, nns = design.trace_shape
+    if design.fk_channels != nnx:
+        raise ValueError(
+            "channel-padded designs (design_matched_filter(channel_pad=...)) "
+            "are single-chip only; design without padding for the sharded step"
+        )
     p = mesh.shape[time_axis]
     if nnx % p or nns % p:
         raise ValueError(f"trace shape {design.trace_shape} must divide mesh axis {p}")

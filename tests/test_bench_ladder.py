@@ -104,6 +104,36 @@ def test_total_accelerator_failure_degrades_to_cpu_quick(monkeypatch):
     assert p["device"].startswith("cpu-fallback (accelerator wedged mid-rung)")
 
 
+def test_quick_mode_midladder_wedge_annotates_cpu_fallback(monkeypatch):
+    def spawn(spec, timeout_s, cpu=False):
+        if spec.get("cpu_baseline"):
+            return {"cpu_wall": 10.0, "n_picks": 4}, None
+        if cpu:
+            return {"wall": 1.0, "n_picks": 12, "device": "TFRT_CPU_0",
+                    "stages": None, "route": "mono"}, None
+        return None, WEDGE
+
+    rc, p = run_scenario(monkeypatch, spawn, argv=["bench.py", "--quick"])
+    assert p["device"].startswith("cpu-fallback (accelerator wedged mid-rung)")
+
+
+def test_banked_tpu_number_never_labeled_cpu_fallback(monkeypatch):
+    # secure-quick succeeds on the accelerator, full wedges, degrade flips
+    # on_cpu — the banked TPU headline must keep its clean device string
+    def spawn(spec, timeout_s, cpu=False):
+        if spec.get("cpu_baseline"):
+            return {"cpu_wall": 10.0, "n_picks": 4}, None
+        if spec["nx"] == 1024 and not cpu:
+            return dict(TPU_OK), None
+        return None, WEDGE
+
+    rc, p = run_scenario(monkeypatch, spawn)
+    assert p["device"] == "TPU v5 lite0"
+    # and the misleading 'skipped at full shape' note must not appear when
+    # the skip reason is a banked accelerator number
+    assert "skipped at full shape" not in p.get("error", "")
+
+
 def test_every_rung_dead_still_emits_json_line(monkeypatch):
     def spawn(spec, timeout_s, cpu=False):
         return None, WEDGE
