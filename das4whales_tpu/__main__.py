@@ -38,6 +38,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = ap.add_subparsers(dest="workflow", required=True)
     sub.add_parser("list", help="list available workflows")
+    pe = sub.add_parser(
+        "evaluate",
+        help="detection-quality sweep: injection recall/precision vs SNR "
+             "on the production matched-filter detector (das4whales_tpu.eval)",
+    )
+    pe.add_argument("--amplitudes", default="0.02,0.05,0.15,0.5,1.0",
+                    help="comma-separated call amplitudes (noise RMS 0.05)")
+    pe.add_argument("--seeds", default="0", help="comma-separated noise seeds")
+    pe.add_argument("--nx", type=int, default=256)
+    pe.add_argument("--ns", type=int, default=6000)
     for name, help_text in WORKFLOWS.items():
         p = sub.add_parser(name, help=help_text)
         p.add_argument("url", nargs="?", default=None,
@@ -72,6 +82,23 @@ def main(argv=None) -> int:
     from das4whales_tpu.parallel.distributed import initialize_from_env
 
     initialize_from_env()
+    if args.workflow == "evaluate":
+        import json
+
+        from das4whales_tpu.eval import amplitude_sweep, default_eval_scene
+        from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+        scene = default_eval_scene(nx=args.nx, ns=args.ns)
+        det = MatchedFilterDetector(
+            scene.metadata, [0, scene.nx, 1], (scene.nx, scene.ns)
+        )
+        rows = amplitude_sweep(
+            det, scene,
+            [float(a) for a in args.amplitudes.split(",")],
+            seeds=[int(s) for s in args.seeds.split(",")],
+        )
+        print(json.dumps(rows, indent=1))
+        return 0
     mod = importlib.import_module(f"das4whales_tpu.workflows.{args.workflow}")
     kwargs = dict(url=args.url, outdir=args.outdir, show=args.show)
     if getattr(args, "no_snr", False):

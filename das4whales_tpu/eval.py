@@ -1,0 +1,205 @@
+"""Detection-quality evaluation: injection recall, precision, SNR sweeps.
+
+The reference has no quantitative detection-quality harness at all — its
+integration story is eyeballing waterfall plots of one live OOI file
+(SURVEY.md §4, `scripts/main_mfdetect.py:106`). A user tuning thresholds,
+speed fans, or templates has no way to ask "what fraction of calls does
+this configuration actually recover, at what false-alarm rate?". This
+module answers that with synthetic ground truth:
+
+* `io.synth.SyntheticScene` renders propagating calls with known
+  (channel, arrival-time) footprints;
+* `match_picks` scores a detector's (channel, time) picks against those
+  footprints — per-(call, channel) hits, misses, and unmatched picks;
+* `evaluate_detector` / `amplitude_sweep` turn that into recall,
+  precision, and false alarms per channel-minute across an
+  amplitude (SNR) grid — the detection-performance curve.
+
+Everything here is host-side numpy orchestration around the jitted
+detector: the device work is exactly the production detection path, so
+the sweep doubles as an end-to-end regression harness.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, Sequence
+
+import numpy as np
+
+from .config import as_metadata
+from .io.synth import SyntheticCall, SyntheticScene, synthesize_scene
+
+
+def arrival_times(call: SyntheticCall, scene: SyntheticScene) -> np.ndarray:
+    """Per-channel arrival time [s] of ``call`` in ``scene``'s geometry —
+    the ground-truth footprint (mirrors ``io.synth.synthesize_scene``'s
+    injection delays, which mirror ``loc.calc_arrival_times``)."""
+    x = np.arange(scene.nx) * scene.dx
+    return call.t0 + np.abs(x - call.x0_m) / call.speed
+
+
+@dataclass
+class PickMatch:
+    """Result of scoring one template's picks against scene ground truth."""
+
+    hits: np.ndarray          # [n_calls, n_channels] bool — call footprint picked
+    covered: np.ndarray       # [n_calls, n_channels] bool — footprint inside record
+    n_false: int              # picks matching no call footprint
+    n_picks: int
+
+    @property
+    def recall(self) -> float:
+        n_cov = int(self.covered.sum())
+        return float(self.hits.sum() / n_cov) if n_cov else float("nan")
+
+    @property
+    def precision(self) -> float:
+        return float((self.n_picks - self.n_false) / self.n_picks) if self.n_picks else float("nan")
+
+
+def match_picks(
+    picks: np.ndarray,
+    scene: SyntheticScene,
+    time_tol_s: float = 0.3,
+    call_indices: Sequence[int] | None = None,
+) -> PickMatch:
+    """Score ``picks`` (a ``(2, n)`` [channel_idx, time_idx] array, the
+    detector output convention of detect.py:277-303) against call
+    footprints in ``scene``.
+
+    A (call, channel) cell counts as hit when any pick on that channel
+    falls within ``time_tol_s`` of the call's arrival there (the arrival
+    is the template *onset*; correlator peaks land within the template
+    support, so the default tolerance is about half the 0.68 s call).
+
+    ``call_indices`` restricts the recall accounting (hits/covered) to
+    those calls — used when a template should only be credited for its
+    own note type. False-pick accounting always runs against EVERY call:
+    a pick on another template's call is a cross-template response, not a
+    false alarm.
+    """
+    picks = np.asarray(picks)
+    n_calls = len(scene.calls)
+    sel = set(range(n_calls)) if call_indices is None else set(call_indices)
+    hits = np.zeros((len(sel), scene.nx), dtype=bool)
+    covered = np.zeros((len(sel), scene.nx), dtype=bool)
+    tol = time_tol_s * scene.fs
+
+    pick_t = [picks[1][picks[0] == ch] for ch in range(scene.nx)]
+    matched_any = [np.zeros(t.shape, dtype=bool) for t in pick_t]
+    row = 0
+    for ci, call in enumerate(scene.calls):
+        onsets = arrival_times(call, scene) * scene.fs
+        L = call.duration * scene.fs
+        cov = (onsets >= 0) & (onsets + L <= scene.ns)
+        scored = ci in sel
+        if scored:
+            covered[row] = cov
+        for ch in range(scene.nx):
+            if not cov[ch] or pick_t[ch].size == 0:
+                continue
+            near = np.abs(pick_t[ch] - onsets[ch]) <= tol
+            if near.any():
+                matched_any[ch] |= near
+                if scored:
+                    hits[row, ch] = True
+        if scored:
+            row += 1
+    n_picks = int(picks.shape[1])
+    n_false = int(n_picks - sum(int(m.sum()) for m in matched_any))
+    return PickMatch(hits=hits, covered=covered, n_false=n_false, n_picks=n_picks)
+
+
+def _calls_for_template(cfg, scene: SyntheticScene) -> list:
+    """Indices of scene calls whose chirp parameters match a
+    ``CallTemplateConfig`` (within 0.5 Hz / 50 ms) — the auto-association
+    behind per-template recall. Empty when no call matches."""
+    out = []
+    for ci, call in enumerate(scene.calls):
+        if (abs(call.fmin - cfg.fmin) < 0.5 and abs(call.fmax - cfg.fmax) < 0.5
+                and abs(call.duration - cfg.duration) < 0.05):
+            out.append(ci)
+    return out
+
+
+def evaluate_detector(
+    detector, scene: SyntheticScene, time_tol_s: float = 0.3,
+) -> Dict[str, dict]:
+    """Run ``detector`` (a ``models.matched_filter.MatchedFilterDetector``
+    or any callable returning ``.picks``) on the rendered scene and score
+    every template's picks. Returns per-template metric dicts."""
+    import jax.numpy as jnp
+
+    block = synthesize_scene(scene)
+    result = detector(jnp.asarray(block, dtype=jnp.float32))
+    out = {}
+    minutes = scene.ns / scene.fs / 60.0
+    cfgs = getattr(detector, "template_configs", None) or {}
+    for name, picks in result.picks.items():
+        indices = _calls_for_template(cfgs[name], scene) if name in cfgs else []
+        m = match_picks(picks, scene, time_tol_s,
+                        call_indices=indices or None)
+        out[name] = {
+            "recall": m.recall,
+            "precision": m.precision,
+            "n_picks": m.n_picks,
+            "n_false": m.n_false,
+            "false_per_channel_minute": m.n_false / (scene.nx * minutes),
+        }
+    return out
+
+
+def amplitude_sweep(
+    detector,
+    base_scene: SyntheticScene,
+    amplitudes: Sequence[float],
+    seeds: Sequence[int] = (0,),
+    time_tol_s: float = 0.3,
+) -> list:
+    """Detection-performance curve: re-render ``base_scene`` at each call
+    amplitude (noise RMS fixed, so amplitude IS the SNR knob) x seed, run
+    the detector, and average recall/precision per amplitude.
+
+    Returns rows ``{"amplitude", "snr_db", <template>: {recall, ...}}``
+    sorted by amplitude. The detector is reused across the whole sweep —
+    one compile, many scenes (the design-once/apply-many pattern,
+    tutorial.md:93).
+    """
+    rows = []
+    for amp in amplitudes:
+        per_template: Dict[str, list] = {}
+        for seed in seeds:
+            scene = replace(
+                base_scene,
+                seed=seed,
+                calls=[replace(c, amplitude=amp) for c in base_scene.calls],
+            )
+            for name, metrics in evaluate_detector(detector, scene, time_tol_s).items():
+                per_template.setdefault(name, []).append(metrics)
+        row = {
+            "amplitude": float(amp),
+            "snr_db": float(20 * np.log10(amp / base_scene.noise_rms)),
+        }
+        for name, ms in per_template.items():
+            row[name] = {
+                k: float(np.nanmean([m[k] for m in ms]))
+                for k in ("recall", "precision", "false_per_channel_minute")
+            }
+        rows.append(row)
+    return rows
+
+
+def default_eval_scene(nx: int = 256, ns: int = 6000) -> SyntheticScene:
+    """A standard evaluation scene: three fin-call pairs (HF + LF note
+    shapes) at staggered times/positions across the array, matching the
+    template defaults (config.FIN_HF_NOTE / FIN_LF_NOTE)."""
+    calls = []
+    dx = 2.042
+    for k, t0 in enumerate((4.0, 12.0, 21.0)):
+        x0 = (0.25 + 0.25 * k) * nx * dx
+        calls.append(SyntheticCall(t0=t0, x0_m=x0, fmin=17.8, fmax=28.8,
+                                   duration=0.68, amplitude=1.0))
+        calls.append(SyntheticCall(t0=t0 + 2.0, x0_m=x0, fmin=14.7, fmax=21.8,
+                                   duration=0.78, amplitude=1.0))
+    return SyntheticScene(nx=nx, ns=ns, dx=dx, noise_rms=0.05, calls=calls)

@@ -331,6 +331,11 @@ class MatchedFilterDetector:
         channel_pad: int | str | None = None,
     ):
         self.metadata = as_metadata(metadata)
+        if templates is None:
+            templates = {"HF": FIN_HF_NOTE, "LF": FIN_LF_NOTE}
+        # resolved name -> CallTemplateConfig mapping (consumed by eval.py's
+        # call-to-template auto-association)
+        self.template_configs = dict(templates)
         self.design = design_matched_filter(
             trace_shape, selected_channels, self.metadata, fk_config, bp_band,
             templates, channel_pad=channel_pad,

@@ -1,0 +1,109 @@
+"""Detection-quality evaluation harness (das4whales_tpu/eval.py).
+
+The reference has no detection-metrics capability to mirror (SURVEY.md
+§4: shape-contract tests only, integration by eyeballing live-URL
+plots); these tests pin the harness's own semantics: footprint
+matching, template auto-association, false-alarm accounting, and the
+SNR sweep's monotone behavior on the production detector.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from das4whales_tpu.config import FIN_HF_NOTE
+from das4whales_tpu.eval import (
+    PickMatch,
+    amplitude_sweep,
+    arrival_times,
+    default_eval_scene,
+    evaluate_detector,
+    match_picks,
+)
+from das4whales_tpu.io.synth import SyntheticCall, SyntheticScene
+
+
+def _scene_one_call(nx=64, ns=2000, amplitude=1.0):
+    call = SyntheticCall(t0=2.0, x0_m=nx / 2 * 2.042, amplitude=amplitude)
+    return SyntheticScene(nx=nx, ns=ns, noise_rms=0.05, calls=[call])
+
+
+def test_arrival_times_hyperbolic_moveout():
+    scene = _scene_one_call()
+    t = arrival_times(scene.calls[0], scene)
+    mid = scene.nx // 2
+    assert t[mid] == pytest.approx(2.0, abs=1 / scene.fs)
+    assert t[0] > t[mid] and t[-1] > t[mid]          # moveout away from x0
+    # symmetric footprint around the source channel
+    np.testing.assert_allclose(t[mid - 10], t[mid + 10], rtol=1e-12)
+
+
+def test_match_picks_perfect_and_false():
+    scene = _scene_one_call(nx=8, ns=2000)
+    onsets = np.round(arrival_times(scene.calls[0], scene) * scene.fs).astype(int)
+    # perfect picks on every channel + one far-away false pick on channel 0
+    chan = np.arange(8)
+    picks = np.asarray([np.append(chan, 0), np.append(onsets, 1900)])
+    m = match_picks(picks, scene)
+    assert m.recall == 1.0
+    assert m.n_false == 1 and m.n_picks == 9
+    assert m.precision == pytest.approx(8 / 9)
+
+
+def test_match_picks_empty():
+    scene = _scene_one_call(nx=8)
+    m = match_picks(np.zeros((2, 0), dtype=int), scene)
+    assert m.recall == 0.0 and m.n_picks == 0
+    assert np.isnan(m.precision)
+
+
+def test_call_indices_restrict_recall_but_not_false_accounting():
+    scene = _scene_one_call(nx=8, ns=2000)
+    scene.calls.append(SyntheticCall(t0=6.0, x0_m=8.0, fmin=14.7, fmax=21.8,
+                                     duration=0.78))
+    on1 = np.round(arrival_times(scene.calls[1], scene) * scene.fs).astype(int)
+    picks = np.asarray([[3], [on1[3]]])   # pick on the SECOND call only
+    m = match_picks(picks, scene, call_indices=[0])
+    assert m.hits.shape[0] == 1           # scored against call 0 only
+    assert m.recall == 0.0                # call 0 never picked
+    assert m.n_false == 0                 # ...but the pick is not "false"
+
+
+def test_evaluate_detector_separates_templates():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    scene = default_eval_scene(nx=128, ns=4000)
+    det = MatchedFilterDetector(scene.metadata, [0, scene.nx, 1],
+                                (scene.nx, scene.ns))
+    metrics = evaluate_detector(det, scene)
+    assert set(metrics) == {"HF", "LF"}
+    for name in ("HF", "LF"):
+        assert metrics[name]["recall"] > 0.8
+        assert metrics[name]["false_per_channel_minute"] < 0.5
+
+
+def test_amplitude_sweep_recall_collapses_below_noise():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    scene = default_eval_scene(nx=128, ns=4000)
+    det = MatchedFilterDetector(scene.metadata, [0, scene.nx, 1],
+                                (scene.nx, scene.ns))
+    rows = amplitude_sweep(det, scene, [0.001, 1.0])
+    assert rows[0]["snr_db"] < rows[1]["snr_db"]
+    # at -34 dB the calls are unrecoverable; at +26 dB nearly all are found
+    assert rows[0]["HF"]["recall"] < 0.3
+    assert rows[1]["HF"]["recall"] > 0.8
+
+
+def test_default_scene_templates_cover_both_notes():
+    scene = default_eval_scene()
+    hf = [c for c in scene.calls if abs(c.fmax - FIN_HF_NOTE.fmax) < 0.5]
+    lf = [c for c in scene.calls if abs(c.fmax - 21.8) < 0.5]
+    assert len(hf) == 3 and len(lf) == 3
