@@ -111,16 +111,41 @@ def match_picks(
     return PickMatch(hits=hits, covered=covered, n_false=n_false, n_picks=n_picks)
 
 
-def _calls_for_template(cfg, scene: SyntheticScene) -> list:
-    """Indices of scene calls whose chirp parameters match a
-    ``CallTemplateConfig`` (within 0.5 Hz / 50 ms) — the auto-association
-    behind per-template recall. Empty when no call matches."""
-    out = []
+def _call_groups(scene: SyntheticScene) -> Dict[tuple, list]:
+    """Scene calls grouped by (fmin, fmax, duration) — one group per
+    distinct note type."""
+    groups: Dict[tuple, list] = {}
     for ci, call in enumerate(scene.calls):
-        if (abs(call.fmin - cfg.fmin) < 0.5 and abs(call.fmax - cfg.fmax) < 0.5
-                and abs(call.duration - cfg.duration) < 0.05):
-            out.append(ci)
-    return out
+        groups.setdefault((call.fmin, call.fmax, call.duration), []).append(ci)
+    return groups
+
+
+def _calls_for_template(cfg, scene: SyntheticScene) -> list:
+    """Indices of the scene call group nearest a template's chirp
+    parameters — the auto-association behind per-template recall.
+
+    ``cfg`` is a ``CallTemplateConfig`` (fmin/fmax/duration) or a
+    spectro-kernel dict (f0/f1/dur, reference ``detect.buildkernel``
+    convention of swept-down contours, detect.py:411-492). Exact matches
+    win trivially; for kernels whose contour only approximates the call
+    band (e.g. the 27->17 Hz hat kernel vs the 28.8->17.8 Hz note) the
+    nearest distinct group is chosen, so every template/kernel is scored
+    against exactly one note type. Empty only when the scene has no calls.
+    """
+    if isinstance(cfg, dict):
+        fmin = min(cfg["f0"], cfg["f1"])
+        fmax = max(cfg["f0"], cfg["f1"])
+        dur = cfg["dur"]
+    else:
+        fmin, fmax, dur = cfg.fmin, cfg.fmax, cfg.duration
+    groups = _call_groups(scene)
+    if not groups:
+        return []
+    key = min(
+        groups,
+        key=lambda g: abs(g[0] - fmin) + abs(g[1] - fmax) + 10.0 * abs(g[2] - dur),
+    )
+    return groups[key]
 
 
 def evaluate_detector(
@@ -188,6 +213,43 @@ def amplitude_sweep(
             }
         rows.append(row)
     return rows
+
+
+@dataclass
+class _EvalResult:
+    picks: Dict[str, np.ndarray]
+
+
+class SpectroEvalAdapter:
+    """Adapts the spectrogram-correlation family to the
+    ``evaluate_detector`` protocol, enabling cross-family comparisons
+    (matched filter vs spectro correlation at the same SNR — a question
+    the reference cannot ask).
+
+    ``prefilter`` supplies the bandpass + f-k front end the spectro
+    workflow shares with the flagship (main_spectrodetect.py:7-55): a
+    ``MatchedFilterDetector`` (its ``filter_block``) or any callable
+    mapping a block to ``trf_fk``. Spectro pick times are converted from
+    spectrogram-hop units back to sample units (the inverse of the
+    workflow's ``spectro_fs`` rescale, main_spectrodetect.py:123).
+    """
+
+    def __init__(self, prefilter, spectro_detector):
+        self.prefilter = prefilter
+        self.det = spectro_detector
+        self.template_configs = dict(spectro_detector.kernels)
+
+    def __call__(self, block):
+        filt = getattr(self.prefilter, "filter_block", self.prefilter)
+        trf_fk = filt(block)
+        _, picks, spectro_fs = self.det(trf_fk)
+        fs = self.det.metadata.fs
+        out = {}
+        for name, pk in picks.items():
+            pk = np.asarray(pk)
+            t_samples = np.round(pk[1] * (fs / spectro_fs)).astype(int)
+            out[name] = np.asarray([pk[0], t_samples])
+        return _EvalResult(picks=out)
 
 
 def default_eval_scene(nx: int = 256, ns: int = 6000) -> SyntheticScene:

@@ -102,6 +102,39 @@ def test_amplitude_sweep_recall_collapses_below_noise():
     assert rows[1]["HF"]["recall"] > 0.8
 
 
+def test_spectro_adapter_cross_family_eval():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from das4whales_tpu.eval import SpectroEvalAdapter
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.models.spectro import SpectroCorrDetector
+
+    scene = default_eval_scene(nx=64, ns=4000)
+    mf = MatchedFilterDetector(scene.metadata, [0, scene.nx, 1],
+                               (scene.nx, scene.ns))
+    adapter = SpectroEvalAdapter(mf, SpectroCorrDetector(scene.metadata))
+    metrics = evaluate_detector(adapter, scene, time_tol_s=0.5)
+    assert set(metrics) == {"HF", "LF"}
+    # the HF hat kernel must recover the HF notes despite its 27->17 Hz
+    # contour only approximating the 28.8->17.8 Hz call (nearest-group
+    # auto-association)
+    assert metrics["HF"]["recall"] > 0.8
+
+
+def test_kernel_dict_auto_association():
+    from das4whales_tpu.config import SPECTRO_HF_KERNEL, SPECTRO_LF_KERNEL
+    from das4whales_tpu.eval import _calls_for_template
+
+    scene = default_eval_scene()
+    hf_idx = _calls_for_template(SPECTRO_HF_KERNEL, scene)
+    lf_idx = _calls_for_template(SPECTRO_LF_KERNEL, scene)
+    assert len(hf_idx) == 3 and len(lf_idx) == 3
+    assert not set(hf_idx) & set(lf_idx)
+    assert all(scene.calls[i].fmax > 25 for i in hf_idx)
+    assert all(scene.calls[i].fmax < 25 for i in lf_idx)
+
+
 def test_default_scene_templates_cover_both_notes():
     scene = default_eval_scene()
     hf = [c for c in scene.calls if abs(c.fmax - FIN_HF_NOTE.fmax) < 0.5]
