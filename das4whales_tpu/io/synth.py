@@ -20,8 +20,11 @@ from .hdf5 import optasense_scale_factor, write_optasense
 
 @dataclass
 class SyntheticCall:
-    """One injected call: onset at ``t0`` [s] at the channel nearest
-    ``x0_m`` [m], propagating across channels at ``speed`` [m/s]."""
+    """One injected call: source at ``(x0_m, y0_m, z0_m)`` in cable
+    coordinates (cable along x at y = z = 0), emitting at ``t0`` [s];
+    arrivals propagate to each channel at ``speed`` [m/s] over the 3-D
+    slant range (the forward model of ``loc.calc_arrival_times``).
+    ``y0_m = z0_m = 0`` degenerates to on-cable moveout."""
 
     t0: float
     x0_m: float
@@ -30,6 +33,8 @@ class SyntheticCall:
     duration: float = 0.68
     amplitude: float = 1.0
     speed: float = 1500.0
+    y0_m: float = 0.0
+    z0_m: float = 0.0
 
 
 @dataclass
@@ -70,7 +75,8 @@ def synthesize_scene(scene: SyntheticScene) -> np.ndarray:
     x = np.arange(scene.nx) * scene.dx
     for call in scene.calls:
         chirp = _hyperbolic_chirp(call.fmin, call.fmax, call.duration, scene.fs) * call.amplitude
-        delays = call.t0 + np.abs(x - call.x0_m) / call.speed
+        slant = np.sqrt((x - call.x0_m) ** 2 + call.y0_m ** 2 + call.z0_m ** 2)
+        delays = call.t0 + slant / call.speed
         onsets = np.round(delays * scene.fs).astype(int)
         L = len(chirp)
         for ch in range(scene.nx):
