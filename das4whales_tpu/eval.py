@@ -304,6 +304,30 @@ class SpectroEvalAdapter:
         return _EvalResult(picks=out)
 
 
+class GaborEvalAdapter:
+    """Adapts the Gabor/image-processing family to the
+    ``evaluate_detector`` protocol — third detector family on the same
+    metrics, completing the cross-family comparison matrix.
+
+    ``prefilter`` is the shared bandpass + f-k front end
+    (main_gabordetect.py:10-74); the Gabor detector's picks are already
+    in sample units, so only template association needs adapting (its
+    notes are (fmin, fmax, duration) tuples)."""
+
+    def __init__(self, prefilter, gabor_detector):
+        self.prefilter = prefilter
+        self.det = gabor_detector
+        self.template_configs = {
+            name: {"f0": fmax, "f1": fmin, "dur": dur}
+            for name, (fmin, fmax, dur) in gabor_detector.note_params.items()
+        }
+
+    def __call__(self, block):
+        filt = getattr(self.prefilter, "filter_block", self.prefilter)
+        out = self.det(filt(block))
+        return _EvalResult(picks={k: np.asarray(v) for k, v in out["picks"].items()})
+
+
 def default_eval_scene(nx: int = 256, ns: int = 6000) -> SyntheticScene:
     """A standard evaluation scene: three fin-call pairs (HF + LF note
     shapes) at staggered times/positions across the array, matching the

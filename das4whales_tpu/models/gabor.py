@@ -122,6 +122,9 @@ class GaborDetector:
         self.design = design_gabor(self.metadata, selected_channels, c0, bin_factor, threshold1, threshold2)
         if notes is None:
             notes = {"HF": (17.8, 28.8, 0.68), "LF": (14.7, 21.8, 0.78)}
+        # (fmin, fmax, duration) per note, kept for eval.py's
+        # call-to-template auto-association
+        self.note_params = dict(notes)
         fs = self.metadata.fs
         self.notes = {}
         for name, (fmin, fmax, dur) in notes.items():

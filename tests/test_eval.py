@@ -122,6 +122,23 @@ def test_spectro_adapter_cross_family_eval():
     assert metrics["HF"]["recall"] > 0.8
 
 
+def test_gabor_adapter_cross_family_eval():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from das4whales_tpu.eval import GaborEvalAdapter
+    from das4whales_tpu.models.gabor import GaborDetector
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    scene = default_eval_scene(nx=64, ns=4000)
+    mf = MatchedFilterDetector(scene.metadata, [0, scene.nx, 1],
+                               (scene.nx, scene.ns))
+    adapter = GaborEvalAdapter(mf, GaborDetector(scene.metadata, [0, scene.nx, 1]))
+    metrics = evaluate_detector(adapter, scene, time_tol_s=0.5)
+    assert set(metrics) == {"HF", "LF"}
+    assert metrics["HF"]["recall"] > 0.6
+
+
 def test_kernel_dict_auto_association():
     from das4whales_tpu.config import SPECTRO_HF_KERNEL, SPECTRO_LF_KERNEL
     from das4whales_tpu.eval import _calls_for_template
