@@ -1,0 +1,81 @@
+"""A/B the channel-padded f-k transform at canonical shape on the live chip.
+
+Times ``mf_filter_only`` (bandpass + banded f-k apply) at 22050x12000
+with channel_pad=None (exact 22050 = 2*3^2*5^2*7^2 transform) vs
+channel_pad="auto" (22500 = 2^2*3^2*5^4) vs 32768 (power of two) —
+the measurement behind flipping the detector's channel_pad default
+(docs/PRECISION.md). Prints one JSON line; safe on CPU (just slow).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    nx, ns = (22050, 12000) if "--quick" not in sys.argv else (1050, 3000)
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the env var through the live config too — under this
+        # image's sitecustomize the env var alone cannot keep jax off a
+        # wedged accelerator (tests/conftest.py)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
+    import jax.numpy as jnp
+
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import (
+        design_matched_filter,
+        mf_filter_only,
+    )
+    from das4whales_tpu.ops.fk import banded_mask_half
+
+    meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=nx, ns=ns)
+    rng = np.random.default_rng(0)
+    block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
+    slab = 4096
+    x = jnp.concatenate(
+        [jax.device_put(block[i : i + slab]) for i in range(0, nx, slab)], axis=0
+    )
+
+    rows = []
+    for label, pad in (("exact", None), ("5-smooth", "auto"),
+                       ("pow2", 1 << (nx - 1).bit_length())):
+        design = design_matched_filter((nx, ns), [0, nx, 1], meta, channel_pad=pad)
+        mask_band, lo, hi = banded_mask_half(design.fk_mask)
+        mb = jnp.asarray(mask_band)
+        gain = jnp.asarray(design.bp_gain)
+        pad_rows = design.fk_channels - nx
+
+        def run():
+            return jax.block_until_ready(
+                mf_filter_only(x, mb, gain, lo, hi, design.bp_padlen,
+                               pad_rows=pad_rows)
+            )
+
+        t0 = time.perf_counter()
+        run()
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+        rows.append({"label": label, "fk_channels": design.fk_channels,
+                     "wall_s": round(best, 4), "compile_s": round(compile_s, 1)})
+        print(json.dumps(rows[-1]), file=sys.stderr, flush=True)  # partial progress
+
+    print(json.dumps({"device": str(jax.devices()[0]), "shape": [nx, ns],
+                      "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Roofline cost model of the flagship pipeline at canonical shape.
+
+Computes per-stage FLOPs and HBM traffic for the 22050x12000 matched-
+filter detection pipeline and converts them to lower-bound stage walls on
+TPU v5e (one chip: 819 GB/s HBM, ~98 TFLOP/s f32) — the prediction the
+on-chip stage breakdown (bench.py --no-cpu, stage_wall_s) is judged
+against. FFT cost model: 5 N log2 N flops per complex length-N transform,
+2.5 N log2 N for rfft/irfft; every stage is assumed HBM-bound unless its
+arithmetic intensity clears the ridge (~120 flops/byte at f32).
+
+Prints a markdown table (used for the PERF.md "Roofline" section).
+"""
+
+from __future__ import annotations
+
+import math
+
+HBM_GBS = 819e9          # v5e HBM bandwidth
+F32_FLOPS = 98e12        # v5e f32 peak (MXU bf16 is 197e12)
+
+C, N = 22050, 12000
+NF_BP = 12150            # bandpass zero-phase rfft length (padded, 5-smooth)
+NF_XC = 12150            # true-length-template correlate rfft length
+F_HALF = N // 2 + 1      # rfft bins of the f-k spectrum
+BAND = 960               # in-band columns kept by the banded applier (14-30 Hz)
+NT = 2                   # templates
+B = 4                    # f32 bytes
+
+
+def rfft_flops(n):
+    return 2.5 * n * math.log2(n)
+
+
+def cfft_flops(n):
+    return 5.0 * n * math.log2(n)
+
+
+def stage(name, flops, bytes_moved):
+    t_flops = flops / F32_FLOPS
+    t_hbm = bytes_moved / HBM_GBS
+    bound = "HBM" if t_hbm >= t_flops else "FLOP"
+    return {
+        "stage": name,
+        "gflops": flops / 1e9,
+        "hbm_gb": bytes_moved / 1e9,
+        "intensity": flops / bytes_moved,
+        "pred_ms": max(t_hbm, t_flops) * 1e3,
+        "bound": bound,
+    }
+
+
+def model():
+    rows = []
+    # 1. bandpass: rfft -> gain mul -> irfft per channel (ops/filters.py)
+    fl = C * (2 * rfft_flops(NF_BP) + 6 * (NF_BP / 2 + 1))
+    by = B * C * (N + 2 * (NF_BP / 2 + 1) * 2 + N)      # in, spec rw (c64), out
+    rows.append(stage("bandpass |H|^2", fl, by))
+
+    # 2. banded f-k: rfft(time) + band fft/ifft(channel) + mask + irfft(time)
+    fl = C * (rfft_flops(N) + rfft_flops(N)) + BAND * 2 * cfft_flops(C) + 6 * C * BAND
+    by = B * (C * N                       # read
+              + 2 * C * F_HALF * 2        # half-spectrum write+read (c64)
+              + 4 * C * BAND * 2          # band slice rw twice (c64)
+              + C * N)                    # out
+    rows.append(stage("f-k apply (banded)", fl, by))
+
+    # 3. correlate (tiled): norm + rfft + NT (mul + irfft) + suffix cumsum
+    fl = C * (rfft_flops(NF_XC) + NT * (rfft_flops(NF_XC) + 6 * (NF_XC / 2 + 1)) + 4 * N)
+    by = B * (C * N * 2                   # read + normalized rw
+              + C * (NF_XC / 2 + 1) * 2   # spectrum (c64)
+              + NT * C * N)               # correlogram out
+    rows.append(stage(f"correlate x{NT} (tiled)", fl, by))
+
+    # 4. envelope: analytic signal = fft + ifft on [NT, C, N] + abs
+    fl = NT * C * (cfft_flops(N) + 2 * N)
+    by = B * (NT * C * N * 2 + NT * C * N * 2 * 2)  # corr rw + c64 spectrum rw
+    rows.append(stage("envelope (Hilbert)", fl, by))
+
+    # 5. sparse peaks: ~6 elementwise/scan passes over [NT, C, N] + top-k
+    fl = NT * C * N * 12
+    by = B * NT * C * N * 6
+    rows.append(stage("peaks (sparse)", fl, by))
+
+    return rows
+
+
+def main():
+    rows = model()
+    total = sum(r["pred_ms"] for r in rows)
+    print("| stage | GFLOPs | HBM GB | flops/byte | bound | predicted ms |")
+    print("|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['stage']} | {r['gflops']:.0f} | {r['hbm_gb']:.1f} "
+              f"| {r['intensity']:.0f} | {r['bound']} | {r['pred_ms']:.1f} |")
+    print(f"| **total** | | | | | **{total:.0f}** |")
+    rate = C * N / (total / 1e3)
+    print()
+    print(f"Predicted single-chip rate: {rate:.2e} ch*samples/s "
+          f"({total:.0f} ms per 60 s file)")
+
+
+if __name__ == "__main__":
+    main()
