@@ -48,6 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
     pe.add_argument("--seeds", default="0", help="comma-separated noise seeds")
     pe.add_argument("--nx", type=int, default=256)
     pe.add_argument("--ns", type=int, default=6000)
+    pe.add_argument("--family", default="mf",
+                    choices=("mf", "spectro", "gabor", "all"),
+                    help="detector family to score (all: cross-family table)")
+    pe.add_argument("--time-tol", type=float, default=0.5,
+                    help="pick-to-arrival match tolerance [s]")
     for name, help_text in WORKFLOWS.items():
         p = sub.add_parser(name, help=help_text)
         p.add_argument("url", nargs="?", default=None,
@@ -85,19 +90,42 @@ def main(argv=None) -> int:
     if args.workflow == "evaluate":
         import json
 
-        from das4whales_tpu.eval import amplitude_sweep, default_eval_scene
+        from das4whales_tpu.eval import (
+            GaborEvalAdapter,
+            SpectroEvalAdapter,
+            amplitude_sweep,
+            default_eval_scene,
+        )
         from das4whales_tpu.models.matched_filter import MatchedFilterDetector
 
         scene = default_eval_scene(nx=args.nx, ns=args.ns)
-        det = MatchedFilterDetector(
+        mf = MatchedFilterDetector(
             scene.metadata, [0, scene.nx, 1], (scene.nx, scene.ns)
         )
-        rows = amplitude_sweep(
-            det, scene,
-            [float(a) for a in args.amplitudes.split(",")],
-            seeds=[int(s) for s in args.seeds.split(",")],
-        )
-        print(json.dumps(rows, indent=1))
+        detectors = {"mf": mf}
+        if args.family in ("spectro", "all"):
+            from das4whales_tpu.models.spectro import SpectroCorrDetector
+
+            detectors["spectro"] = SpectroEvalAdapter(
+                mf, SpectroCorrDetector(scene.metadata)
+            )
+        if args.family in ("gabor", "all"):
+            from das4whales_tpu.models.gabor import GaborDetector
+
+            detectors["gabor"] = GaborEvalAdapter(
+                mf, GaborDetector(scene.metadata, [0, scene.nx, 1])
+            )
+        if args.family != "all":
+            detectors = {args.family: detectors[args.family]}
+        amps = [float(a) for a in args.amplitudes.split(",")]
+        seeds = [int(s) for s in args.seeds.split(",")]
+        out = {
+            fam: amplitude_sweep(det, scene, amps, seeds=seeds,
+                                 time_tol_s=args.time_tol)
+            for fam, det in detectors.items()
+        }
+        print(json.dumps(out if args.family == "all" else out[args.family],
+                         indent=1))
         return 0
     mod = importlib.import_module(f"das4whales_tpu.workflows.{args.workflow}")
     kwargs = dict(url=args.url, outdir=args.outdir, show=args.show)

@@ -304,6 +304,27 @@ class SpectroEvalAdapter:
         return _EvalResult(picks=out)
 
 
+def sharded_picks_to_dict(
+    sp_picks, template_names, file_index: int = 0, n_samples: int | None = None,
+) -> Dict[str, np.ndarray]:
+    """One file's picks from a sharded detection step's ``SparsePicks``
+    (``[n_templates, file, channel, K]`` arrays,
+    ``parallel.pipeline.make_sharded_mf_step``) -> the ``{name: (2, n)}``
+    dict the scoring functions consume. ``n_samples`` drops picks inside
+    any divisibility padding (same policy as ``workflows.longrecord``)."""
+    from .ops import peaks as peak_ops
+
+    pos = np.asarray(sp_picks.positions)
+    sel = np.asarray(sp_picks.selected)
+    out = {}
+    for i, name in enumerate(template_names):
+        s = sel[i, file_index]
+        if n_samples is not None:
+            s = s & (pos[i, file_index] < n_samples)
+        out[name] = peak_ops.sparse_to_pick_times(pos[i, file_index], s)
+    return out
+
+
 class GaborEvalAdapter:
     """Adapts the Gabor/image-processing family to the
     ``evaluate_detector`` protocol — third detector family on the same
