@@ -53,6 +53,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="detector family to score (all: cross-family table)")
     pe.add_argument("--time-tol", type=float, default=0.5,
                     help="pick-to-arrival match tolerance [s]")
+    pc = sub.add_parser(
+        "campaign",
+        help="fault-tolerant resumable detection over many files "
+             "(workflows.campaign: manifest + per-file picks artifacts)",
+    )
+    pc.add_argument("files", nargs="+", help="HDF5/TDMS file paths, in order")
+    pc.add_argument("--outdir", default="out_campaign")
+    pc.add_argument("--channels", default=None,
+                    help="start,stop,step channel selection (default: all of file 0)")
+    pc.add_argument("--max-failures", type=int, default=None)
+    pc.add_argument("--no-resume", action="store_true",
+                    help="reprocess files already recorded done in the manifest")
+    pc.add_argument("--interrogator", default="optasense")
     for name, help_text in WORKFLOWS.items():
         p = sub.add_parser(name, help=help_text)
         p.add_argument("url", nargs="?", default=None,
@@ -127,6 +140,23 @@ def main(argv=None) -> int:
         print(json.dumps(out if args.family == "all" else out[args.family],
                          indent=1))
         return 0
+    if args.workflow == "campaign":
+        from das4whales_tpu.io.interrogators import get_acquisition_parameters
+        from das4whales_tpu.workflows.campaign import run_campaign
+
+        if args.channels:
+            sel = [int(v) for v in args.channels.split(",")]
+        else:
+            meta0 = get_acquisition_parameters(args.files[0], args.interrogator)
+            sel = [0, meta0.nx, 1]
+        res = run_campaign(
+            args.files, sel, args.outdir,
+            resume=not args.no_resume, max_failures=args.max_failures,
+            interrogator=args.interrogator,
+        )
+        print(f"campaign: {res.n_done} done, {res.n_failed} failed, "
+              f"{res.n_skipped} skipped -> {res.outdir}")
+        return 0 if res.n_failed == 0 else 3
     mod = importlib.import_module(f"das4whales_tpu.workflows.{args.workflow}")
     kwargs = dict(url=args.url, outdir=args.outdir, show=args.show)
     if getattr(args, "no_snr", False):

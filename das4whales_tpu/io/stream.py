@@ -135,8 +135,12 @@ def stream_strain_blocks(
                          sel.start, min(sel.stop, nx), sel.step,
                          fuse=True, scale=spec.meta.scale_factor)
 
-    # probe lazily: spec k is probed right before its read is submitted,
-    # keeping only `prefetch` probes + reads ahead of the consumer
+    # probe lazily: spec k is probed right before (native) or inside (h5py)
+    # its read task, keeping only `prefetch` probes + reads ahead of the
+    # consumer. Errors are DEFERRED to the failing file's own position in
+    # the yield order — a bad file k must raise on the k-th next(), not
+    # while the consumer is still working on file k-prefetch (the campaign
+    # runner's per-file fault isolation relies on this attribution).
     specs: dict[int, _FileSpec] = {0: first}
 
     def spec_for(i: int) -> _FileSpec:
@@ -146,25 +150,39 @@ def stream_strain_blocks(
 
     if use_native:
         with native.Prefetcher(nworkers=prefetch) as pf:
-            tickets = {i: native_submit(pf, spec_for(i)) for i in range(min(prefetch, len(files)))}
+            def submit(i):
+                try:
+                    return native_submit(pf, spec_for(i))
+                except Exception as exc:  # noqa: BLE001 — re-raised in order
+                    return ("__probe_error__", exc)
+
+            tickets = {i: submit(i) for i in range(min(prefetch, len(files)))}
             for i in range(len(files)):
-                host = pf.wait(tickets.pop(i))
+                ticket = tickets.pop(i)
                 nxt = i + prefetch
                 if nxt < len(files):
-                    tickets[nxt] = native_submit(pf, spec_for(nxt))
+                    tickets[nxt] = submit(nxt)
+                if isinstance(ticket, tuple) and ticket[0] == "__probe_error__":
+                    raise ticket[1]
+                host = pf.wait(ticket)
                 yield finish(specs.pop(i), host)
     else:
+        def probe_and_read(i):
+            spec = spec_for(i) if i == 0 else _probe(files[i], interrogator, metas[i])
+            return spec, _read_h5py_host(spec, sel)
+
         with ThreadPoolExecutor(max_workers=prefetch) as ex:
             futs = {
-                i: ex.submit(_read_h5py_host, spec_for(i), sel)
+                i: ex.submit(probe_and_read, i)
                 for i in range(min(prefetch, len(files)))
             }
             for i in range(len(files)):
-                host = futs.pop(i).result()  # strict submission order
+                fut = futs.pop(i)
                 nxt = i + prefetch
                 if nxt < len(files):
-                    futs[nxt] = ex.submit(_read_h5py_host, spec_for(nxt), sel)
-                yield finish(specs.pop(i), host)
+                    futs[nxt] = ex.submit(probe_and_read, nxt)
+                spec, host = fut.result()  # strict submission order
+                yield finish(spec, host)
 
 
 def stream_file_batches(

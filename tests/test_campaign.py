@@ -1,0 +1,95 @@
+"""Fault-tolerant resumable campaign runner (workflows/campaign.py).
+
+The reference has no failure detection or checkpoint/resume at all
+(SURVEY.md §5.3-4); these tests pin the runner's contract: corrupt files
+are isolated and recorded, completed files are skipped on resume, picks
+artifacts round-trip, and max_failures bounds the tolerance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from das4whales_tpu.io.synth import SyntheticCall, SyntheticScene, write_synthetic_file
+from das4whales_tpu.workflows.campaign import (
+    CampaignAborted,
+    load_picks,
+    run_campaign,
+)
+
+NX, NS = 48, 1500
+SEL = [0, NX, 1]
+
+
+@pytest.fixture()
+def file_set(tmp_path):
+    """Three synthetic files, the middle one corrupted."""
+    paths = []
+    for k in range(3):
+        scene = SyntheticScene(
+            nx=NX, ns=NS, noise_rms=0.05, seed=k,
+            calls=[SyntheticCall(t0=2.0 + k, x0_m=NX / 2 * 2.042, amplitude=2.0)],
+        )
+        p = str(tmp_path / f"file{k}.h5")
+        write_synthetic_file(p, scene)
+        paths.append(p)
+    with open(paths[1], "wb") as fh:
+        fh.write(b"this is not an hdf5 file")
+    return paths
+
+
+def test_corrupt_file_is_isolated(file_set, tmp_path):
+    out = str(tmp_path / "camp")
+    res = run_campaign(file_set, SEL, out)
+    assert res.n_done == 2 and res.n_failed == 1 and res.n_skipped == 0
+    failed = [r for r in res.records if r.status == "failed"]
+    assert failed[0].path == file_set[1]
+    assert failed[0].error
+    # manifest records everything durably
+    with open(os.path.join(out, "manifest.jsonl")) as fh:
+        lines = [json.loads(x) for x in fh]
+    assert sum(r["status"] == "done" for r in lines) == 2
+    assert sum(r["status"] == "failed" for r in lines) == 1
+
+
+def test_picks_artifacts_roundtrip_and_find_the_call(file_set, tmp_path):
+    out = str(tmp_path / "camp")
+    res = run_campaign(file_set, SEL, out)
+    done = [r for r in res.records if r.status == "done"]
+    for rec in done:
+        picks = load_picks(rec.picks_file)
+        assert set(picks) == {"HF", "LF"}
+        assert rec.n_picks["HF"] == picks["HF"].shape[1]
+        # the injected call sits mid-array; its channel must be picked
+        assert NX // 2 in picks["HF"][0]
+
+
+def test_resume_skips_done_files(file_set, tmp_path):
+    out = str(tmp_path / "camp")
+    first = run_campaign(file_set, SEL, out)
+    assert first.n_done == 2
+    second = run_campaign(file_set, SEL, out)
+    assert second.n_skipped == 2            # done files not re-processed
+    assert second.n_done == 0
+    assert second.n_failed == 1             # corrupt file retried, fails again
+
+
+def test_max_failures_aborts(file_set, tmp_path):
+    with pytest.raises(CampaignAborted):
+        run_campaign(file_set, SEL, str(tmp_path / "camp"), max_failures=0)
+
+
+def test_failure_free_run(tmp_path):
+    scene = SyntheticScene(
+        nx=NX, ns=NS, noise_rms=0.05,
+        calls=[SyntheticCall(t0=2.0, x0_m=NX / 2 * 2.042, amplitude=2.0)],
+    )
+    p = str(tmp_path / "ok.h5")
+    write_synthetic_file(p, scene)
+    res = run_campaign([p], SEL, str(tmp_path / "camp"))
+    assert res.n_done == 1 and res.n_failed == 0
+    assert res.records[0].wall_s > 0
