@@ -142,18 +142,34 @@ def main(argv=None) -> int:
         return 0
     if args.workflow == "campaign":
         from das4whales_tpu.io.interrogators import get_acquisition_parameters
-        from das4whales_tpu.workflows.campaign import run_campaign
+        from das4whales_tpu.workflows.campaign import CampaignAborted, run_campaign
 
         if args.channels:
             sel = [int(v) for v in args.channels.split(",")]
         else:
-            meta0 = get_acquisition_parameters(args.files[0], args.interrogator)
-            sel = [0, meta0.nx, 1]
-        res = run_campaign(
-            args.files, sel, args.outdir,
-            resume=not args.no_resume, max_failures=args.max_failures,
-            interrogator=args.interrogator,
-        )
+            # derive the selection from the first PROBEABLE file — a corrupt
+            # head of the list must not crash the fault-tolerant runner
+            # before it starts
+            sel = None
+            for path in args.files:
+                try:
+                    meta0 = get_acquisition_parameters(path, args.interrogator)
+                    sel = [0, meta0.nx, 1]
+                    break
+                except Exception:  # noqa: BLE001 — run_campaign records it
+                    continue
+            if sel is None:
+                print("campaign: no file in the list is probeable; nothing to do")
+                return 3
+        try:
+            res = run_campaign(
+                args.files, sel, args.outdir,
+                resume=not args.no_resume, max_failures=args.max_failures,
+                interrogator=args.interrogator,
+            )
+        except CampaignAborted as exc:
+            print(f"campaign aborted: {exc} (progress kept in {args.outdir})")
+            return 4
         print(f"campaign: {res.n_done} done, {res.n_failed} failed, "
               f"{res.n_skipped} skipped -> {res.outdir}")
         return 0 if res.n_failed == 0 else 3

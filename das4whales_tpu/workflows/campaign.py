@@ -96,10 +96,15 @@ def _append_manifest(outdir: str, rec: FileRecord) -> None:
 
 
 def _save_picks(outdir: str, path: str, result) -> str:
+    import hashlib
+
     stem = os.path.splitext(os.path.basename(path))[0]
+    # disambiguate same-named files from different directories (a campaign
+    # over day1/seg.h5 + day2/seg.h5 must not overwrite artifacts)
+    digest = hashlib.sha1(os.path.abspath(path).encode()).hexdigest()[:8]
     pdir = os.path.join(outdir, "picks")
     os.makedirs(pdir, exist_ok=True)
-    out = os.path.join(pdir, f"{stem}.npz")
+    out = os.path.join(pdir, f"{stem}-{digest}.npz")
     arrays = {f"picks_{name}": np.asarray(pk) for name, pk in result.picks.items()}
     arrays["thresholds"] = np.asarray(
         [result.thresholds[name] for name in result.picks]
