@@ -1,0 +1,63 @@
+"""Capture a jax.profiler trace of the flagship detection pipeline.
+
+Produces a TensorBoard/Perfetto-loadable trace of one post-compile
+detection step (filter -> tiled correlate -> envelope -> picks) in
+``artifacts/profile/`` — the ground truth behind PERF.md's roofline
+predictions (which ops dominate, what overlaps, where HBM stalls).
+The reference's only progress surface is tqdm bars (SURVEY.md §5.1).
+
+Usage: ``python scripts/profile_flagship.py [--quick] [--logdir DIR]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="1024x3000 instead of canonical")
+    ap.add_argument("--logdir", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "profile"))
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
+    import jax.numpy as jnp
+
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.utils.profiling import device_trace
+
+    nx, ns = (1024, 3000) if args.quick else (22050, 12000)
+    meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=nx, ns=ns)
+    det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns))
+    rng = np.random.default_rng(0)
+    block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
+    slab = 4096
+    x = jnp.concatenate(
+        [jax.device_put(block[i : i + slab]) for i in range(0, nx, slab)], axis=0
+    )
+
+    res = det(x)                                   # compile + warm
+    jax.block_until_ready(res.trf_fk)
+    os.makedirs(args.logdir, exist_ok=True)
+    with device_trace(args.logdir):
+        res = det(x)
+        jax.block_until_ready(res.trf_fk)
+    print(f"trace written to {args.logdir} "
+          f"(device={jax.devices()[0]}, shape=[{nx}, {ns}], route={det._route()})")
+
+
+if __name__ == "__main__":
+    main()
