@@ -41,10 +41,20 @@ class _FileSpec:
     layout: tuple | None  # (offset, disk_dtype, nx, ns) when natively readable
 
 
+def _is_tdms(path: str) -> bool:
+    return path.lower().endswith(".tdms")
+
+
 def _probe(path: str, interrogator: str, metadata) -> _FileSpec:
+    if _is_tdms(path) and metadata is None and interrogator == "optasense":
+        interrogator = "silixa"  # extension beats the h5-centric default
     meta = as_metadata(metadata) if metadata is not None else get_acquisition_parameters(
         path, interrogator=interrogator
     )
+    if _is_tdms(path) or meta.interrogator == "silixa":
+        # TDMS: no native layout; t0 is extracted by the reader (one parse
+        # serves data + timestamp instead of a second full-file parse here)
+        return _FileSpec(path=path, meta=meta, t0_us=0, layout=None)
     layout = None
     with h5py.File(path, "r") as fp:
         raw = fp["Acquisition/Raw[0]/RawData"]
@@ -63,6 +73,32 @@ def _read_h5py_host(spec: _FileSpec, sel: ChannelSelection) -> np.ndarray:
     x -= x.mean(axis=1, keepdims=True)
     x *= spec.meta.scale_factor
     return x
+
+
+def _read_tdms_host(spec: _FileSpec, sel: ChannelSelection) -> np.ndarray:
+    """Read + condition a Silixa TDMS file, updating ``spec.t0_us`` from
+    its ``GPSTimeStamp`` property when present (the reference never loads
+    TDMS bulk data at all — its silixa path is metadata-only,
+    data_handle.py:113-154)."""
+    from .interrogators import _natural_key
+    from .tdms import TdmsFile
+
+    f = TdmsFile.read(spec.path)
+    channels = f["Measurement"]
+    names = sorted(channels, key=_natural_key)[sel.start : sel.stop : sel.step]
+    x = np.stack([channels[c] for c in names]).astype(np.float32)
+    x -= x.mean(axis=1, keepdims=True)
+    x *= spec.meta.scale_factor
+    t0 = f.properties.get("GPSTimeStamp")
+    if hasattr(t0, "timestamp"):
+        spec.t0_us = int(t0.timestamp() * 1e6)
+    return x
+
+
+def _read_host(spec: _FileSpec, sel: ChannelSelection) -> np.ndarray:
+    if _is_tdms(spec.path) or spec.meta.interrogator == "silixa":
+        return _read_tdms_host(spec, sel)
+    return _read_h5py_host(spec, sel)
 
 
 def stream_strain_blocks(
@@ -169,7 +205,7 @@ def stream_strain_blocks(
     else:
         def probe_and_read(i):
             spec = spec_for(i) if i == 0 else _probe(files[i], interrogator, metas[i])
-            return spec, _read_h5py_host(spec, sel)
+            return spec, _read_host(spec, sel)
 
         with ThreadPoolExecutor(max_workers=prefetch) as ex:
             futs = {

@@ -101,3 +101,28 @@ def write_synthetic_file(filepath: str, scene: SyntheticScene, counts_scale: flo
         filepath, raw, fs=scene.fs, dx=scene.dx,
         gauge_length=scene.gauge_length, n=scene.n,
     )
+
+
+def write_synthetic_tdms(filepath: str, scene: SyntheticScene, counts_scale: float = 1000.0) -> str:
+    """Render a scene through the Silixa-schema TDMS writer (int16 channel
+    data + the property set ``get_metadata_silixa`` reads, plus a
+    ``GPSTimeStamp``) — the offline fixture for the TDMS ingest/stream
+    path, which the reference cannot exercise at all (its silixa support
+    is metadata-only, data_handle.py:113-154)."""
+    from datetime import datetime
+
+    from .tdms import write_tdms
+
+    block = synthesize_scene(scene)
+    raw = np.round(block * counts_scale).astype(np.int16)
+    props = {
+        "SamplingFrequency[Hz]": float(scene.fs),
+        "SpatialResolution[m]": float(scene.dx),
+        "FibreIndex": float(scene.n),
+        "GaugeLength": float(scene.gauge_length),
+        "GPSTimeStamp": datetime(2021, 11, 4, 1, 59, 2),
+    }
+    # zero-padded names keep natural == lexicographic order; the loader's
+    # numeric-aware sort must not depend on that (io/interrogators.py:55-75)
+    chans = {f"ch{i:05d}": raw[i] for i in range(scene.nx)}
+    return write_tdms(filepath, props, "Measurement", chans)
