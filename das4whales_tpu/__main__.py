@@ -172,6 +172,21 @@ def main(argv=None) -> int:
             return 4
         print(f"campaign: {res.n_done} done, {res.n_failed} failed, "
               f"{res.n_skipped} skipped -> {res.outdir}")
+        if res.n_done:
+            import json as _json
+
+            from das4whales_tpu.workflows.campaign import (
+                plot_campaign_density,
+                summarize_campaign,
+            )
+
+            summary = summarize_campaign(args.outdir)
+            fig = plot_campaign_density(summary)
+            fig.savefig(os.path.join(args.outdir, "density.png"), dpi=120)
+            slim = {k: v for k, v in summary.items() if k != "density"}
+            with open(os.path.join(args.outdir, "summary.json"), "w") as fh:
+                _json.dump(slim, fh, indent=1)
+            print(f"campaign: report -> {args.outdir}/summary.json, density.png")
         return 0 if res.n_failed == 0 else 3
     mod = importlib.import_module(f"das4whales_tpu.workflows.{args.workflow}")
     kwargs = dict(url=args.url, outdir=args.outdir, show=args.show)

@@ -211,3 +211,79 @@ def run_campaign(
             i += 1
         del stream
     return CampaignResult(outdir=outdir, records=records)
+
+
+def summarize_campaign(outdir: str) -> dict:
+    """Aggregate a campaign's manifest + picks artifacts into a report
+    dict: per-file status/pick counts, totals per template, and a
+    ``[file x channel]`` detection-count matrix (the campaign-scale
+    analog of the reference's single-file detection scatter,
+    plot.py:373-415)."""
+    recs = []
+    with open(_manifest_path(outdir)) as fh:
+        for line in fh:
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    # keep only each path's LAST record (resume runs append fresh records)
+    latest = {r["path"]: r for r in recs}
+    done = [r for r in latest.values() if r["status"] == "done"]
+    failed = [r for r in latest.values() if r["status"] == "failed"]
+
+    totals: Dict[str, int] = {}
+    density = {}                  # name -> [n_files x nx] counts
+    nx = 0
+    for fi, rec in enumerate(done):
+        picks = load_picks(rec["picks_file"])
+        for name, pk in picks.items():
+            totals[name] = totals.get(name, 0) + pk.shape[1]
+            if pk.shape[1]:
+                nx = max(nx, int(pk[0].max()) + 1)
+    for name in totals:
+        density[name] = np.zeros((len(done), nx), dtype=np.int32)
+    for fi, rec in enumerate(done):
+        picks = load_picks(rec["picks_file"])
+        for name, pk in picks.items():
+            if pk.shape[1]:
+                np.add.at(density[name][fi], pk[0].astype(int), 1)
+    return {
+        "n_done": len(done),
+        "n_failed": len(failed),
+        "failed_paths": [r["path"] for r in failed],
+        "total_picks": totals,
+        "files": [{"path": r["path"], "n_picks": r["n_picks"],
+                   "wall_s": r["wall_s"]} for r in done],
+        "density": density,
+    }
+
+
+def plot_campaign_density(summary: dict, dx_km: float = 2.042e-3, show=None):
+    """Detection-density heatmaps (file index x cable distance) from a
+    :func:`summarize_campaign` dict — one panel per template. Returns the
+    matplotlib Figure (headless-safe, like ``viz.plot``)."""
+    import matplotlib
+
+    if not show:
+        matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    names = list(summary["density"])
+    fig, axes = plt.subplots(
+        1, max(len(names), 1), figsize=(7 * max(len(names), 1), 5),
+        squeeze=False,
+    )
+    for ax, name in zip(axes[0], names):
+        d = summary["density"][name]
+        im = ax.imshow(
+            d, aspect="auto", origin="lower", cmap="turbo",
+            extent=[0, d.shape[1] * dx_km, -0.5, d.shape[0] - 0.5],
+        )
+        ax.set_xlabel("Distance [km]")
+        ax.set_ylabel("File index")
+        ax.set_title(f"{name}: {summary['total_picks'][name]} picks")
+        fig.colorbar(im, ax=ax, label="picks per channel")
+    fig.tight_layout()
+    if show:
+        plt.show()
+    return fig

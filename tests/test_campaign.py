@@ -83,6 +83,30 @@ def test_max_failures_aborts(file_set, tmp_path):
         run_campaign(file_set, SEL, str(tmp_path / "camp"), max_failures=0)
 
 
+def test_summary_and_density_report(file_set, tmp_path):
+    from das4whales_tpu.workflows.campaign import (
+        plot_campaign_density,
+        summarize_campaign,
+    )
+
+    out = str(tmp_path / "camp")
+    run_campaign(file_set, SEL, out)
+    s = summarize_campaign(out)
+    assert s["n_done"] == 2 and s["n_failed"] == 1
+    assert s["failed_paths"] == [file_set[1]]
+    assert s["total_picks"]["HF"] > 0
+    d = s["density"]["HF"]
+    assert d.shape[0] == 2
+    # the injected mid-array call dominates the density map
+    assert d[:, NX // 2].sum() >= 2
+    fig = plot_campaign_density(s)
+    assert fig is not None
+    # resume appends fresh records; summary must keep only the latest per path
+    run_campaign(file_set, SEL, out)
+    s2 = summarize_campaign(out)
+    assert s2["n_done"] == 2 and s2["n_failed"] == 1
+
+
 def test_failure_free_run(tmp_path):
     scene = SyntheticScene(
         nx=NX, ns=NS, noise_rms=0.05,
