@@ -95,7 +95,8 @@ def _append_manifest(outdir: str, rec: FileRecord) -> None:
         fh.write(json.dumps(rec.__dict__) + "\n")
 
 
-def _save_picks(outdir: str, path: str, result) -> str:
+def _save_picks(outdir: str, path: str, picks: Dict[str, np.ndarray],
+                thresholds: Dict[str, float]) -> str:
     import hashlib
 
     stem = os.path.splitext(os.path.basename(path))[0]
@@ -105,11 +106,9 @@ def _save_picks(outdir: str, path: str, result) -> str:
     pdir = os.path.join(outdir, "picks")
     os.makedirs(pdir, exist_ok=True)
     out = os.path.join(pdir, f"{stem}-{digest}.npz")
-    arrays = {f"picks_{name}": np.asarray(pk) for name, pk in result.picks.items()}
-    arrays["thresholds"] = np.asarray(
-        [result.thresholds[name] for name in result.picks]
-    )
-    arrays["template_names"] = np.asarray(list(result.picks), dtype="U")
+    arrays = {f"picks_{name}": np.asarray(pk) for name, pk in picks.items()}
+    arrays["thresholds"] = np.asarray([thresholds[name] for name in picks])
+    arrays["template_names"] = np.asarray(list(picks), dtype="U")
     np.savez(out, **arrays)
     return out
 
@@ -202,7 +201,8 @@ def run_campaign(
                     path=path, status="done",
                     n_picks={k: int(v.shape[1]) for k, v in result.picks.items()},
                     wall_s=round(time.perf_counter() - t0, 3),
-                    picks_file=_save_picks(outdir, path, result),
+                    picks_file=_save_picks(outdir, path, result.picks,
+                                           result.thresholds),
                 )
                 records.append(rec)
                 _append_manifest(outdir, rec)
@@ -210,6 +210,122 @@ def run_campaign(
                 fail(path, exc)
             i += 1
         del stream
+    return CampaignResult(outdir=outdir, records=records)
+
+
+def run_campaign_sharded(
+    files: Sequence[str],
+    selected_channels,
+    outdir: str,
+    mesh,
+    metadata=None,
+    batch: int | None = None,
+    resume: bool = True,
+    max_failures: int | None = None,
+    interrogator: str = "optasense",
+    prefetch: int = 2,
+    engine: str = "h5py",
+    relative_threshold: float = 0.5,
+) -> CampaignResult:
+    """Multi-chip campaign: file batches land pre-sharded on the mesh and
+    the whole batch detects in ONE program (data-parallel over files,
+    channel-parallel within each — ``parallel.pipeline``), with the same
+    manifest/resume/picks-artifact contract as :func:`run_campaign`.
+
+    Fault isolation is at PROBE granularity: every pending file is probed
+    up front (cheap attribute read for HDF5; full parse for TDMS) and
+    unprobeable files are recorded failed before any batch forms — a
+    read error after a clean probe (rare: truncated-after-header file)
+    aborts the run, since a half-read batch cannot be attributed cleanly.
+    ``batch`` defaults to the mesh's file-axis size.
+    """
+    import jax
+
+    from ..io.stream import _probe, stream_file_batches
+    from ..parallel.pipeline import make_sharded_mf_step
+    from ..eval import sharded_picks_to_dict
+
+    os.makedirs(outdir, exist_ok=True)
+    done = _load_done(outdir) if resume else set()
+    records: List[FileRecord] = []
+    pending: List[str] = []
+    for path in files:
+        if path in done:
+            records.append(FileRecord(path=path, status="skipped"))
+        else:
+            pending.append(path)
+
+    n_failed = 0
+
+    def fail(path: str, exc: Exception) -> None:
+        nonlocal n_failed
+        n_failed += 1
+        rec = FileRecord(path=path, status="failed",
+                         error=f"{type(exc).__name__}: {exc}")
+        records.append(rec)
+        _append_manifest(outdir, rec)
+        log.warning("file failed (%d so far): %s — %s", n_failed, path, rec.error)
+        if max_failures is not None and n_failed > max_failures:
+            raise CampaignAborted(
+                f"{n_failed} failures exceed max_failures={max_failures}"
+            ) from exc
+
+    healthy: List[str] = []
+    spec0 = None
+    for path in pending:
+        try:
+            spec = _probe(path, interrogator, metadata)
+            if spec0 is None:
+                spec0 = spec
+            healthy.append(path)
+        except Exception as exc:  # noqa: BLE001 — per-file isolation
+            fail(path, exc)
+    if not healthy:
+        return CampaignResult(outdir=outdir, records=records)
+
+    from ..config import ChannelSelection
+    from ..models.matched_filter import design_matched_filter
+
+    sel = ChannelSelection.from_list(selected_channels)
+    nx_sel = len(range(sel.start, min(sel.stop, spec0.meta.nx), sel.step))
+    design = design_matched_filter(
+        (nx_sel, spec0.meta.ns), selected_channels, spec0.meta
+    )
+    if batch is None:
+        batch = mesh.shape.get("file", 1) if hasattr(mesh.shape, "get") else 1
+        batch = max(int(batch), 1)
+    step = jax.jit(make_sharded_mf_step(
+        design, mesh, outputs="picks", relative_threshold=relative_threshold,
+    ))
+
+    factors = {name: (0.9 if i == 0 else 1.0)
+               for i, name in enumerate(design.template_names)}
+    consumed = 0  # batches cover `healthy` strictly in order
+    for stack, blocks in stream_file_batches(
+        healthy, selected_channels, metadata, batch=batch, mesh=mesh,
+        interrogator=interrogator, prefetch=prefetch, engine=engine, tail="pad",
+    ):
+        t0 = time.perf_counter()
+        sp_picks, thres = jax.block_until_ready(step(stack))
+        wall = time.perf_counter() - t0
+        thres_np = np.asarray(thres)
+        for k, block in enumerate(blocks):
+            path = healthy[consumed + k]
+            picks = sharded_picks_to_dict(
+                sp_picks, design.template_names, file_index=k,
+                n_samples=spec0.meta.ns,
+            )
+            thresholds = {name: float(thres_np[k]) * factors[name]
+                          for name in design.template_names}
+            rec = FileRecord(
+                path=path, status="done",
+                n_picks={n: int(p.shape[1]) for n, p in picks.items()},
+                wall_s=round(wall / max(len(blocks), 1), 3),
+                picks_file=_save_picks(outdir, path, picks, thresholds),
+            )
+            records.append(rec)
+            _append_manifest(outdir, rec)
+        consumed += len(blocks)
     return CampaignResult(outdir=outdir, records=records)
 
 
