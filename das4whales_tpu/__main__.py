@@ -66,6 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("--no-resume", action="store_true",
                     help="reprocess files already recorded done in the manifest")
     pc.add_argument("--interrogator", default="optasense")
+    pc.add_argument("--sharded", action="store_true",
+                    help="detect batches on a (file x channel) device mesh "
+                         "(workflows.campaign.run_campaign_sharded)")
     for name, help_text in WORKFLOWS.items():
         p = sub.add_parser(name, help=help_text)
         p.add_argument("url", nargs="?", default=None,
@@ -162,11 +165,21 @@ def main(argv=None) -> int:
                 print("campaign: no file in the list is probeable; nothing to do")
                 return 3
         try:
-            res = run_campaign(
-                args.files, sel, args.outdir,
-                resume=not args.no_resume, max_failures=args.max_failures,
-                interrogator=args.interrogator,
-            )
+            if args.sharded:
+                from das4whales_tpu.parallel.mesh import make_mesh
+                from das4whales_tpu.workflows.campaign import run_campaign_sharded
+
+                res = run_campaign_sharded(
+                    args.files, sel, args.outdir, make_mesh(),
+                    resume=not args.no_resume, max_failures=args.max_failures,
+                    interrogator=args.interrogator,
+                )
+            else:
+                res = run_campaign(
+                    args.files, sel, args.outdir,
+                    resume=not args.no_resume, max_failures=args.max_failures,
+                    interrogator=args.interrogator,
+                )
         except CampaignAborted as exc:
             print(f"campaign aborted: {exc} (progress kept in {args.outdir})")
             return 4

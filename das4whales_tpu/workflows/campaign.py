@@ -119,6 +119,56 @@ def load_picks(picks_file: str) -> Dict[str, np.ndarray]:
         return {str(n): z[f"picks_{n}"] for n in z["template_names"]}
 
 
+def _normalize_metas(metadata, files):
+    """The stream's metadata convention (None / one-for-all / aligned
+    sequence) as an explicit per-file list."""
+    if metadata is None:
+        return [None] * len(files)
+    if isinstance(metadata, (list, tuple)):
+        if len(metadata) != len(files):
+            raise ValueError(
+                f"got {len(metadata)} metadata entries for {len(files)} files"
+            )
+        return list(metadata)
+    return [metadata] * len(files)
+
+
+def _split_resume(files, outdir: str, resume: bool, records: List[FileRecord]):
+    """Partition ``files`` into (pending, pending_indices), appending
+    'skipped' records for manifest-complete files."""
+    done = _load_done(outdir) if resume else set()
+    pending, idx = [], []
+    for j, path in enumerate(files):
+        if path in done:
+            records.append(FileRecord(path=path, status="skipped"))
+        else:
+            pending.append(path)
+            idx.append(j)
+    if records and resume:
+        log.info("resume: %d/%d files already done", len(records), len(files))
+    return pending, idx
+
+
+def _failure_recorder(outdir: str, records: List[FileRecord], max_failures):
+    """Shared per-file failure bookkeeping: manifest record + warning +
+    max_failures enforcement."""
+    state = {"n": 0}
+
+    def fail(path: str, exc: Exception) -> None:
+        state["n"] += 1
+        rec = FileRecord(path=path, status="failed",
+                         error=f"{type(exc).__name__}: {exc}")
+        records.append(rec)
+        _append_manifest(outdir, rec)
+        log.warning("file failed (%d so far): %s — %s", state["n"], path, rec.error)
+        if max_failures is not None and state["n"] > max_failures:
+            raise CampaignAborted(
+                f"{state['n']} failures exceed max_failures={max_failures}"
+            ) from exc
+
+    return fail
+
+
 def run_campaign(
     files: Sequence[str],
     selected_channels,
@@ -143,38 +193,18 @@ def run_campaign(
     import jax.numpy as jnp
 
     os.makedirs(outdir, exist_ok=True)
-    done = _load_done(outdir) if resume else set()
+    metas = _normalize_metas(metadata, list(files))
     records: List[FileRecord] = []
-    pending: List[str] = []
-    for path in files:
-        if path in done:
-            records.append(FileRecord(path=path, status="skipped"))
-        else:
-            pending.append(path)
-    if done and resume:
-        log.info("resume: %d/%d files already done", len(records), len(files))
-
-    n_failed = 0
-
-    def fail(path: str, exc: Exception) -> None:
-        nonlocal n_failed
-        n_failed += 1
-        rec = FileRecord(path=path, status="failed",
-                         error=f"{type(exc).__name__}: {exc}")
-        records.append(rec)
-        _append_manifest(outdir, rec)
-        log.warning("file failed (%d so far): %s — %s", n_failed, path, rec.error)
-        if max_failures is not None and n_failed > max_failures:
-            raise CampaignAborted(
-                f"{n_failed} failures exceed max_failures={max_failures}"
-            ) from exc
+    pending, pend_idx = _split_resume(list(files), outdir, resume, records)
+    pend_metas = [metas[j] for j in pend_idx]
+    fail = _failure_recorder(outdir, records, max_failures)
 
     i = 0
     while i < len(pending):
         # one stream per contiguous run of healthy files; a failure mid-
         # stream kills the generator, so restart it after the culprit
         stream = stream_strain_blocks(
-            pending[i:], selected_channels, metadata,
+            pending[i:], selected_channels, pend_metas[i:],
             interrogator=interrogator, prefetch=prefetch, engine=engine,
             as_numpy=True,
         )
@@ -226,6 +256,7 @@ def run_campaign_sharded(
     prefetch: int = 2,
     engine: str = "h5py",
     relative_threshold: float = 0.5,
+    hf_factor: float = 0.9,
 ) -> CampaignResult:
     """Multi-chip campaign: file batches land pre-sharded on the mesh and
     the whole batch detects in ONE program (data-parallel over files,
@@ -237,47 +268,36 @@ def run_campaign_sharded(
     unprobeable files are recorded failed before any batch forms — a
     read error after a clean probe (rare: truncated-after-header file)
     aborts the run, since a half-read batch cannot be attributed cleanly.
-    ``batch`` defaults to the mesh's file-axis size.
+    Probed metadata feeds the stream, so no file is probed twice.
+    ``batch`` defaults to the mesh's file-axis size; ``hf_factor`` is the
+    first template's threshold factor, threaded to both the picking step
+    and the recorded artifact thresholds (single source).
     """
+    import types
+
     import jax
 
+    from ..eval import sharded_picks_to_dict
     from ..io.stream import _probe, stream_file_batches
     from ..parallel.pipeline import make_sharded_mf_step
-    from ..eval import sharded_picks_to_dict
 
     os.makedirs(outdir, exist_ok=True)
-    done = _load_done(outdir) if resume else set()
+    metas = _normalize_metas(metadata, list(files))
     records: List[FileRecord] = []
-    pending: List[str] = []
-    for path in files:
-        if path in done:
-            records.append(FileRecord(path=path, status="skipped"))
-        else:
-            pending.append(path)
-
-    n_failed = 0
-
-    def fail(path: str, exc: Exception) -> None:
-        nonlocal n_failed
-        n_failed += 1
-        rec = FileRecord(path=path, status="failed",
-                         error=f"{type(exc).__name__}: {exc}")
-        records.append(rec)
-        _append_manifest(outdir, rec)
-        log.warning("file failed (%d so far): %s — %s", n_failed, path, rec.error)
-        if max_failures is not None and n_failed > max_failures:
-            raise CampaignAborted(
-                f"{n_failed} failures exceed max_failures={max_failures}"
-            ) from exc
+    pending, pend_idx = _split_resume(list(files), outdir, resume, records)
+    pend_metas = [metas[j] for j in pend_idx]
+    fail = _failure_recorder(outdir, records, max_failures)
 
     healthy: List[str] = []
+    healthy_metas: List = []
     spec0 = None
-    for path in pending:
+    for path, meta_j in zip(pending, pend_metas):
         try:
-            spec = _probe(path, interrogator, metadata)
+            spec = _probe(path, interrogator, meta_j)
             if spec0 is None:
                 spec0 = spec
             healthy.append(path)
+            healthy_metas.append(spec.meta)
         except Exception as exc:  # noqa: BLE001 — per-file isolation
             fail(path, exc)
     if not healthy:
@@ -287,32 +307,37 @@ def run_campaign_sharded(
     from ..models.matched_filter import design_matched_filter
 
     sel = ChannelSelection.from_list(selected_channels)
-    nx_sel = len(range(sel.start, min(sel.stop, spec0.meta.nx), sel.step))
     design = design_matched_filter(
-        (nx_sel, spec0.meta.ns), selected_channels, spec0.meta
+        (sel.n_channels(spec0.meta.nx), spec0.meta.ns), selected_channels,
+        spec0.meta,
     )
     if batch is None:
-        batch = mesh.shape.get("file", 1) if hasattr(mesh.shape, "get") else 1
-        batch = max(int(batch), 1)
+        batch = max(int(mesh.shape.get("file", 1)), 1)
     step = jax.jit(make_sharded_mf_step(
-        design, mesh, outputs="picks", relative_threshold=relative_threshold,
+        design, mesh, outputs="picks",
+        relative_threshold=relative_threshold, hf_factor=hf_factor,
     ))
 
-    factors = {name: (0.9 if i == 0 else 1.0)
+    factors = {name: (hf_factor if i == 0 else 1.0)
                for i, name in enumerate(design.template_names)}
     consumed = 0  # batches cover `healthy` strictly in order
     for stack, blocks in stream_file_batches(
-        healthy, selected_channels, metadata, batch=batch, mesh=mesh,
+        healthy, selected_channels, healthy_metas, batch=batch, mesh=mesh,
         interrogator=interrogator, prefetch=prefetch, engine=engine, tail="pad",
     ):
         t0 = time.perf_counter()
         sp_picks, thres = jax.block_until_ready(step(stack))
         wall = time.perf_counter() - t0
         thres_np = np.asarray(thres)
-        for k, block in enumerate(blocks):
+        # one device->host conversion per batch, not per file
+        host_picks = types.SimpleNamespace(
+            positions=np.asarray(sp_picks.positions),
+            selected=np.asarray(sp_picks.selected),
+        )
+        for k, _block in enumerate(blocks):
             path = healthy[consumed + k]
             picks = sharded_picks_to_dict(
-                sp_picks, design.template_names, file_index=k,
+                host_picks, design.template_names, file_index=k,
                 n_samples=spec0.meta.ns,
             )
             thresholds = {name: float(thres_np[k]) * factors[name]

@@ -128,6 +128,22 @@ def test_sharded_campaign_matches_contract(file_set, tmp_path):
     assert res2.n_skipped == 2 and res2.n_done == 0 and res2.n_failed == 1
 
 
+def test_metadata_sequence_form(file_set, tmp_path):
+    """The stream's per-file metadata-sequence convention must survive the
+    campaign's resume filtering (metas stay aligned with pending files)."""
+    from das4whales_tpu.io.interrogators import get_acquisition_parameters
+
+    metas = []
+    for p in file_set:
+        try:
+            metas.append(get_acquisition_parameters(p, "optasense"))
+        except Exception:
+            metas.append(metas[0] if metas else None)  # corrupt slot: any meta
+    out = str(tmp_path / "camp_meta")
+    res = run_campaign(file_set, SEL, out, metadata=metas)
+    assert res.n_done == 2 and res.n_failed == 1
+
+
 def test_failure_free_run(tmp_path):
     scene = SyntheticScene(
         nx=NX, ns=NS, noise_rms=0.05,
