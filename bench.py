@@ -108,7 +108,12 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
 
     meta = AcquisitionMetadata(fs=fs, dx=dx, nx=nx, ns=ns)
     det = MatchedFilterDetector(
-        meta, [0, nx, 1], (nx, ns), peak_block=peak_block, channel_tile=channel_tile
+        meta, [0, nx, 1], (nx, ns), peak_block=peak_block, channel_tile=channel_tile,
+        # opt-in A/B knobs (documented deviations; defaults preserve the
+        # golden-validated numerics): DAS_BENCH_FUSED=1 folds the bandpass
+        # into the f-k mask, DAS_BENCH_CHANNEL_PAD=auto pads the channel FFT
+        fused_bandpass=os.environ.get("DAS_BENCH_FUSED", "") == "1",
+        channel_pad=os.environ.get("DAS_BENCH_CHANNEL_PAD") or None,
     )
     block = _make_block(nx, ns, fs, dx)
     # stage the host->device transfer in channel slabs: one ~1 GB RPC is a
@@ -152,14 +157,11 @@ def bench_stages(det, x, repeats=3):
 
     from das4whales_tpu.models.matched_filter import (
         mf_correlate_tiled,
-        mf_filter_only,
         mf_pick_tiled,
     )
     from das4whales_tpu.ops import peaks as peak_ops
     from das4whales_tpu.ops import spectral, xcorr
 
-    gain = det._gain_dev
-    padlen = det.design.bp_padlen
     nT = det.design.templates.shape[0]
 
     def timed(fn, *args):
@@ -172,11 +174,9 @@ def bench_stages(det, x, repeats=3):
         return best, out
 
     stages = {}
-    filter_fn = lambda a: mf_filter_only(
-        a, det._mask_band_dev, gain, det._band_lo, det._band_hi, padlen,
-        pad_rows=det.fk_pad_rows,
-    )
-    stages["filter"], trf = timed(filter_fn, x)
+    # the detector's own filter program (covers the staged, fused-bandpass
+    # and channel-padded routes uniformly)
+    stages["filter"], trf = timed(det.filter_block, x)
 
     if det._route() == "tiled":
         tile = det.effective_channel_tile

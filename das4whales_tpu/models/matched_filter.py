@@ -174,6 +174,32 @@ def mf_filter_and_correlate(
     return trf_fk, corr
 
 
+@functools.partial(jax.jit, static_argnames=("band_lo", "band_hi", "pad_rows"))
+def mf_filter_fused(
+    trace: jnp.ndarray,
+    fused_mask_band: jnp.ndarray,
+    band_lo: int,
+    band_hi: int,
+    pad_rows: int = 0,
+) -> jnp.ndarray:
+    """Bandpass ∘ f-k filter as ONE banded spectral multiply.
+
+    Both stages are frequency-domain gains along time, so their product is
+    a single mask: ``mask'[k, f] = mask[k, f] * |H(f)|^2``. This removes
+    the bandpass's separate rfft+irfft round trip over the whole block —
+    at the canonical shape, two of the six full-array HBM passes of the
+    filter stage (docs/PERF.md roofline). Deviation vs the staged path:
+    the bandpass edge handling becomes circular (no odd-extension pad),
+    so the record edges differ by a transient that rings down with the
+    Butterworth-8 impulse response — <=1e-3 relative beyond ~1 s from
+    either edge, ~1e-4 beyond ~3 s (tests/test_fused_bandpass.py); picks
+    of interior calls are identical. The reference tapers file edges
+    anyway (dsp.py:705-722)."""
+    x = jnp.pad(trace, ((0, pad_rows), (0, 0))) if pad_rows else trace
+    out = fk_ops.fk_filter_apply_rfft_banded(x, fused_mask_band, band_lo, band_hi)
+    return out[: trace.shape[0]] if pad_rows else out
+
+
 @functools.partial(
     jax.jit, static_argnames=("band_lo", "band_hi", "bp_padlen", "pad_rows")
 )
@@ -329,6 +355,7 @@ class MatchedFilterDetector:
         hbm_budget_bytes: int | None = None,
         keep_correlograms: bool = True,
         channel_pad: int | str | None = None,
+        fused_bandpass: bool = False,
     ):
         self.metadata = as_metadata(metadata)
         if templates is None:
@@ -369,6 +396,18 @@ class MatchedFilterDetector:
         mask_band, self._band_lo, self._band_hi = fk_ops.banded_mask_half(
             self.design.fk_mask
         )
+        # fused route: fold |H(f)|^2 into the banded mask (one spectral
+        # multiply instead of bandpass rfft/irfft + f-k rfft/irfft) —
+        # see mf_filter_fused for the numerics contract
+        self.fused_bandpass = fused_bandpass
+        if fused_bandpass:
+            from ..ops.filters import butter_zero_phase_gain
+
+            gain_n = butter_zero_phase_gain(
+                self.design.trace_shape[1], self.design.fs, self.design.bp_band,
+                order=self.design.bp_order,
+            )
+            mask_band = mask_band * gain_n[self._band_lo : self._band_hi][None, :]
         self._mask_band_dev = jnp.asarray(mask_band)
         self._gain_dev = jnp.asarray(self.design.bp_gain)
         self._templates_dev = jnp.asarray(self.design.templates)
@@ -415,6 +454,11 @@ class MatchedFilterDetector:
         # filter-only program: never drags the (discarded) correlate stage
         # into the compiled module — at canonical shape that stage alone is
         # the round-2 OOM
+        if self.fused_bandpass:
+            return mf_filter_fused(
+                trace, self._mask_band_dev, self._band_lo, self._band_hi,
+                pad_rows=self.fk_pad_rows,
+            )
         return mf_filter_only(
             trace, self._mask_band_dev, self._gain_dev,
             self._band_lo, self._band_hi, self.design.bp_padlen,

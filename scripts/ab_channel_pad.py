@@ -38,6 +38,9 @@ def main():
     )
     from das4whales_tpu.ops.fk import banded_mask_half
 
+    from das4whales_tpu.models.matched_filter import mf_filter_fused
+    from das4whales_tpu.ops.filters import butter_zero_phase_gain
+
     meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=nx, ns=ns)
     rng = np.random.default_rng(0)
     block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
@@ -47,19 +50,27 @@ def main():
     )
 
     rows = []
-    for label, pad in (("exact", None), ("5-smooth", "auto"),
-                       ("pow2", 1 << (nx - 1).bit_length())):
+    variants = [("exact", None, False), ("5-smooth", "auto", False),
+                ("pow2", 1 << (nx - 1).bit_length(), False),
+                ("exact+fused", None, True), ("5-smooth+fused", "auto", True)]
+    for label, pad, fused in variants:
         design = design_matched_filter((nx, ns), [0, nx, 1], meta, channel_pad=pad)
         mask_band, lo, hi = banded_mask_half(design.fk_mask)
+        if fused:
+            gain_n = butter_zero_phase_gain(ns, meta.fs, design.bp_band,
+                                            order=design.bp_order)
+            mask_band = mask_band * gain_n[lo:hi][None, :]
         mb = jnp.asarray(mask_band)
         gain = jnp.asarray(design.bp_gain)
         pad_rows = design.fk_channels - nx
 
         def run():
-            return jax.block_until_ready(
-                mf_filter_only(x, mb, gain, lo, hi, design.bp_padlen,
-                               pad_rows=pad_rows)
-            )
+            if fused:
+                out = mf_filter_fused(x, mb, lo, hi, pad_rows=pad_rows)
+            else:
+                out = mf_filter_only(x, mb, gain, lo, hi, design.bp_padlen,
+                                     pad_rows=pad_rows)
+            return jax.block_until_ready(out)
 
         t0 = time.perf_counter()
         run()

@@ -1,0 +1,88 @@
+"""Fused bandpass ∘ f-k filtering (MatchedFilterDetector(fused_bandpass=True)).
+
+The staged path applies |H(f)|^2 with an odd-extension-padded rfft round
+trip, then the banded f-k transform; the fused path folds the gain into
+the banded mask — one spectral multiply, two fewer full-array HBM passes
+(docs/PERF.md roofline). These tests pin the numerics contract: interior
+samples match to <=1e-3 relative beyond ~1 s of the edges (the
+disagreement rings down with the Butterworth-8 impulse response, NOT
+within bp_padlen), and picks are identical for interior calls.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from das4whales_tpu.config import AcquisitionMetadata
+from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+NX, NS = 96, 2048
+META = AcquisitionMetadata(fs=200.0, dx=2.042, nx=NX, ns=NS)
+
+
+def _block(seed=5):
+    rng = np.random.default_rng(seed)
+    block = rng.standard_normal((NX, NS)).astype(np.float32) * 1e-9
+    t = np.arange(0, 0.68, 1 / 200.0)
+    sing = -17.8 * 0.68 / (28.8 - 17.8)
+    chirp = np.cos(2 * np.pi * (-sing * 28.8) * np.log(np.abs(1 - t / sing)))
+    block[NX // 2, 800 : 800 + len(t)] += 5e-9 * chirp * np.hanning(len(t))
+    return jnp.asarray(block)
+
+
+@pytest.fixture(scope="module")
+def detectors():
+    staged = MatchedFilterDetector(META, [0, NX, 1], (NX, NS), channel_tile=None)
+    fused = MatchedFilterDetector(
+        META, [0, NX, 1], (NX, NS), channel_tile=None, fused_bandpass=True
+    )
+    return staged, fused
+
+
+def test_interior_fields_match(detectors):
+    staged, fused = detectors
+    x = _block()
+    f_staged = np.asarray(staged.filter_block(x))
+    f_fused = np.asarray(fused.filter_block(x))
+    denom = np.abs(f_staged).max()
+    rel = np.abs(f_fused - f_staged).max(axis=0) / denom
+    one_s = int(META.fs)          # edge ring-down of the order-8 bandpass
+    assert rel[2 * one_s : NS - 2 * one_s].max() < 1e-3
+    assert rel[4 * one_s : NS - 4 * one_s].max() < 2e-4
+
+
+def test_edge_transient_bounded(detectors):
+    staged, fused = detectors
+    x = _block()
+    d = np.abs(np.asarray(staged.filter_block(x)) - np.asarray(fused.filter_block(x)))
+    # the disagreement must concentrate at (and decay from) the record edges
+    prof = d.max(axis=0)
+    assert prof.argmax() < 100 or prof.argmax() > NS - 100
+    assert prof[400:-400].max() < 0.01 * prof.max()
+
+
+def test_picks_identical_for_interior_calls(detectors):
+    staged, fused = detectors
+    x = _block()
+    r_staged, r_fused = staged(x), fused(x)
+    for name in ("HF", "LF"):
+        ps, pf = r_staged.picks[name], r_fused.picks[name]
+        hit_s = ps[1][ps[0] == NX // 2]
+        hit_f = pf[1][pf[0] == NX // 2]
+        assert hit_s.size and hit_f.size
+        assert np.min(np.abs(hit_f[:, None] - hit_s[None, :])) <= 1
+
+
+def test_fused_composes_with_channel_pad():
+    det = MatchedFilterDetector(
+        META, [0, NX, 1], (NX, NS), channel_tile=None,
+        fused_bandpass=True, channel_pad="auto",
+    )
+    x = _block()
+    out = det.filter_block(x)
+    assert out.shape == (NX, NS)
+    r = det(x)
+    assert NX // 2 in r.picks["HF"][0]
