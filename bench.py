@@ -143,6 +143,10 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
     route = det._route()
     if route == "tiled":
         route = f"tiled(tile={det.effective_channel_tile})"
+    if det.fused_bandpass:
+        route += "+fusedbp"
+    if det.fk_pad_rows:
+        route += f"+chpad{det.design.fk_channels}"
     return min(times), n_picks, str(jax.devices()[0]), stages, route
 
 
