@@ -227,12 +227,18 @@ def run_campaign(
                         **detector_kwargs,
                     )
                 result = detector(jnp.asarray(block.trace))
+                # any detector family works: the contract is a result with
+                # .picks {name: (2, n)}; thresholds are optional metadata
+                # (the eval adapters for spectro/gabor don't expose them)
+                thresholds = getattr(result, "thresholds", None) or {
+                    name: float("nan") for name in result.picks
+                }
                 rec = FileRecord(
                     path=path, status="done",
                     n_picks={k: int(v.shape[1]) for k, v in result.picks.items()},
                     wall_s=round(time.perf_counter() - t0, 3),
                     picks_file=_save_picks(outdir, path, result.picks,
-                                           result.thresholds),
+                                           thresholds),
                 )
                 records.append(rec)
                 _append_manifest(outdir, rec)

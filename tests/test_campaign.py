@@ -128,6 +128,29 @@ def test_sharded_campaign_matches_contract(file_set, tmp_path):
     assert res2.n_skipped == 2 and res2.n_done == 0 and res2.n_failed == 1
 
 
+def test_campaign_with_spectro_adapter(file_set, tmp_path):
+    """Any detector family runs under the campaign contract — here the
+    spectro-correlation adapter (no thresholds attribute)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.eval import SpectroEvalAdapter
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.models.spectro import SpectroCorrDetector
+
+    meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=NX, ns=NS)
+    mf = MatchedFilterDetector(meta, SEL, (NX, NS))
+    adapter = SpectroEvalAdapter(mf, SpectroCorrDetector(meta))
+    out = str(tmp_path / "camp_sp")
+    res = run_campaign(file_set, SEL, out, detector=adapter)
+    assert res.n_done == 2 and res.n_failed == 1
+    for rec in res.records:
+        if rec.status == "done":
+            picks = load_picks(rec.picks_file)
+            assert set(picks) == {"HF", "LF"}
+
+
 def test_metadata_sequence_form(file_set, tmp_path):
     """The stream's per-file metadata-sequence convention must survive the
     campaign's resume filtering (metas stay aligned with pending files)."""
