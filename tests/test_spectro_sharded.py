@@ -1,0 +1,72 @@
+"""Channel-sharded spectro-correlation step vs the single-chip detector.
+
+No collectives are involved (absolute threshold), so the sharded step
+must reproduce the single-chip correlograms and picks exactly up to
+float32 reduction order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from das4whales_tpu.config import AcquisitionMetadata
+from das4whales_tpu.models.spectro import SpectroCorrDetector
+from das4whales_tpu.parallel.mesh import make_mesh
+from das4whales_tpu.parallel.pipeline import input_sharding
+from das4whales_tpu.parallel.spectro import make_sharded_spectro_step
+
+NX, NS = 64, 2000
+META = AcquisitionMetadata(fs=200.0, dx=2.042, nx=NX, ns=NS)
+
+
+def _blocks():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((2, NX, NS)).astype(np.float32) * 1e-9
+    t = np.arange(0, 0.68, 1 / 200.0)
+    sing = -17.8 * 0.68 / (28.8 - 17.8)
+    chirp = np.cos(2 * np.pi * (-sing * 28.8) * np.log(np.abs(1 - t / sing)))
+    x[0, 32, 400 : 400 + len(t)] += 5e-9 * chirp * np.hanning(len(t))
+    x[1, 48, 900 : 900 + len(t)] += 5e-9 * chirp * np.hanning(len(t))
+    return x
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_sharded_spectro_matches_single_chip():
+    mesh = make_mesh()
+    step, names = make_sharded_spectro_step(META, mesh)
+    x = _blocks()
+    xd = jax.device_put(jnp.asarray(x), input_sharding(mesh))
+    corr, picks = jax.block_until_ready(step(xd))
+    assert corr.shape[:3] == (2, 2, NX)
+
+    det = SpectroCorrDetector(META)
+    for f in range(2):
+        single_corr, single_picks, _ = det(jnp.asarray(x[f]))
+        for ti, name in enumerate(names):
+            np.testing.assert_allclose(
+                np.asarray(corr[ti, f]), np.asarray(single_corr[name]),
+                rtol=0, atol=2e-4,
+            )
+            sel = np.asarray(picks.selected[ti, f])
+            pos = np.asarray(picks.positions[ti, f])
+            ch, slot = np.nonzero(sel)
+            got = set(zip(ch.tolist(), pos[ch, slot].tolist()))
+            want = set(zip(*np.asarray(single_picks[name]).tolist()))
+            assert got == want, (f, name)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_sharded_spectro_picks_only_mode():
+    mesh = make_mesh()
+    step, names = make_sharded_spectro_step(META, mesh, outputs="picks")
+    x = _blocks()
+    xd = jax.device_put(jnp.asarray(x), input_sharding(mesh))
+    picks = jax.block_until_ready(step(xd))
+    sel = np.asarray(picks.selected)
+    hf = names.index("HF")
+    assert sel[hf, 0, 32].any()           # file 0's injected call
+    assert sel[hf, 1, 48].any()           # file 1's injected call
