@@ -1,0 +1,107 @@
+"""File-sharded (data-parallel) Gabor/image detection.
+
+Unlike the other two families, the Gabor pipeline's 2-D image operators
+couple channels — the oriented Gabor pair spans ~100 binned pixels
+(~1000 raw channels) of the t-x image (models/gabor.py, reference
+improcess.py:98-140) — so channel sharding would need kilochannel halos.
+The natural scale-out axis is FILES: each mesh slot owns whole files and
+runs the full image pipeline locally; there are no collectives (the
+0.5·max detection threshold is per file, main_gabordetect.py-style
+script behavior, computed inside each file's program).
+
+Files stream through ``lax.map`` within a shard so only one file's
+image-pipeline temps are live at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..config import C0_WATER, as_metadata
+from ..models.gabor import design_gabor, gabor_mask, masked_matched_filter
+from ..models.templates import gen_hyperbolic_chirp
+from ..ops import peaks as peak_ops
+from ..ops import spectral
+
+
+def gabor_input_sharding(mesh, file_axis: str = "file"):
+    """Sharding for a ``[file x channel x time]`` batch: files split over
+    the mesh's file axis, channels/time replicated (whole within a slot)."""
+    return NamedSharding(mesh, P(file_axis, None, None))
+
+
+def make_sharded_gabor_step(
+    metadata,
+    selected_channels,
+    mesh,
+    c0: float = C0_WATER,
+    notes: Dict[str, Tuple[float, float, float]] | None = None,
+    max_peaks: int = 128,
+    relative_threshold: float = 0.5,
+    hf_factor: float = 0.9,
+    file_axis: str = "file",
+):
+    """Build a jittable file-sharded Gabor detection step.
+
+    The returned callable maps a ``[file x channel x time]`` batch placed
+    with :func:`gabor_input_sharding` to ``(correlograms, picks,
+    thresholds)``: correlograms ``[n_notes, file, channel, time]``, picks
+    an ``ops.peaks.SparsePicks`` over the same axes, thresholds
+    ``[file]`` (per-file 0.5·max policy). Also returns the note names.
+    """
+    meta = as_metadata(metadata)
+    design = design_gabor(meta, list(selected_channels), c0=c0)
+    if notes is None:
+        notes = {"HF": (17.8, 28.8, 0.68), "LF": (14.7, 21.8, 0.78)}
+    names = tuple(notes)
+    # keep each note at its TRUE length: masked_matched_filter's 'same'
+    # window is centered by the note length, so zero-padding to a common
+    # length would shift every pick by (pad/2) samples
+    notes_dev = []
+    for fmin, fmax, dur in notes.values():
+        chirp = np.asarray(gen_hyperbolic_chirp(fmin, fmax, dur, meta.fs))
+        notes_dev.append(jnp.asarray(chirp * np.hanning(len(chirp)), jnp.float32))
+    factors = jnp.asarray(
+        [hf_factor if i == 0 else 1.0 for i in range(len(names))], jnp.float32
+    )
+
+    def one_file(trf):                               # [C, T]
+        _, _, masked = gabor_mask(trf, design)
+        corr = jnp.stack([
+            masked_matched_filter(masked, nt.astype(trf.dtype))
+            for nt in notes_dev
+        ])                                           # [nT, C, T]
+        thres = relative_threshold * jnp.max(corr)
+        env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
+        picks = peak_ops.find_peaks_sparse_batched(
+            env, (thres * factors)[:, None], max_peaks=max_peaks
+        )
+        return corr, picks, thres
+
+    def _shard_body(x):                              # [B/P, C, T]
+        corr, picks, thres = jax.lax.map(one_file, x)
+        # local axes: corr [B/P, nT, C, T] -> [nT, B/P, C, T]
+        corr = jnp.moveaxis(corr, 0, 1)
+        picks = jax.tree_util.tree_map(lambda a: jnp.moveaxis(a, 0, 1), picks)
+        return corr, picks, thres
+
+    spec_in = P(file_axis, None, None)
+    spec_corr = P(None, file_axis, None, None)
+    spec_picks = jax.tree_util.tree_map(
+        lambda _: P(None, file_axis, None), peak_ops.SparsePicks(0, 0, 0, 0, 0)
+    )
+    step = jax.jit(
+        shard_map(
+            _shard_body, mesh=mesh, in_specs=(spec_in,),
+            out_specs=(spec_corr, spec_picks, P(file_axis)),
+            check_vma=False,
+        )
+    )
+    return step, names

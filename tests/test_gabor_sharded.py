@@ -1,0 +1,73 @@
+"""File-sharded Gabor detection step (parallel/gabor.py).
+
+The Gabor family shards over files (its 2-D image operators couple
+channels — kilochannel halos otherwise); each mesh slot runs the full
+image pipeline on whole files with no collectives. Sharded picks must
+match the single-chip GaborDetector per file.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from das4whales_tpu.config import AcquisitionMetadata
+from das4whales_tpu.models.gabor import GaborDetector
+from das4whales_tpu.parallel.gabor import gabor_input_sharding, make_sharded_gabor_step
+from das4whales_tpu.parallel.mesh import make_mesh
+
+NX, NS = 64, 2000
+META = AcquisitionMetadata(fs=200.0, dx=2.042, nx=NX, ns=NS)
+
+
+def _batch(n_files=8):
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((n_files, NX, NS)).astype(np.float32) * 1e-9
+    t = np.arange(0, 0.68, 1 / 200.0)
+    sing = -17.8 * 0.68 / (28.8 - 17.8)
+    chirp = (np.cos(2 * np.pi * (-sing * 28.8) * np.log(np.abs(1 - t / sing)))
+             * np.hanning(len(t))).astype(np.float32)
+    for f in range(n_files):
+        x[f, 16 + 4 * f, 400 : 400 + len(t)] += 5e-9 * chirp
+    return x
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_sharded_gabor_finds_every_files_call():
+    mesh = make_mesh(shape=(8,), axis_names=("file",))
+    step, names = make_sharded_gabor_step(META, [0, NX, 1], mesh)
+    x = _batch()
+    xd = jax.device_put(jnp.asarray(x), gabor_input_sharding(mesh))
+    corr, picks, thres = jax.block_until_ready(step(xd))
+    assert corr.shape == (2, 8, NX, NS)
+    assert np.asarray(thres).shape == (8,)
+    sel = np.asarray(picks.selected)
+    hf = names.index("HF")
+    for f in range(8):
+        assert sel[hf, f, 16 + 4 * f].any(), f
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_sharded_gabor_matches_single_chip_picks():
+    mesh = make_mesh(shape=(8,), axis_names=("file",))
+    step, names = make_sharded_gabor_step(META, [0, NX, 1], mesh)
+    x = _batch()
+    xd = jax.device_put(jnp.asarray(x), gabor_input_sharding(mesh))
+    _, picks, thres = jax.block_until_ready(step(xd))
+
+    det = GaborDetector(META, [0, NX, 1], max_peaks=128)
+    for f in (0, 3, 7):
+        # single-chip pipeline needs the same f-k-filtered input; the test
+        # batch is already conditioned, so call the detector directly
+        out = det(jnp.asarray(x[f]))
+        assert out["threshold"] == pytest.approx(float(np.asarray(thres)[f]), rel=1e-5)
+        for ti, name in enumerate(names):
+            sel = np.asarray(picks.selected[ti, f])
+            pos = np.asarray(picks.positions[ti, f])
+            ch, slot = np.nonzero(sel)
+            got = set(zip(ch.tolist(), pos[ch, slot].tolist()))
+            want = set(zip(*np.asarray(out["picks"][name]).tolist()))
+            assert got == want, (f, name)
