@@ -349,6 +349,38 @@ class GaborEvalAdapter:
         return _EvalResult(picks={k: np.asarray(v) for k, v in out["picks"].items()})
 
 
+def threshold_sweep(
+    detector,
+    scene: SyntheticScene,
+    thresholds: Sequence[float],
+    time_tol_s: float = 0.3,
+) -> list:
+    """Operating curve over the pick threshold: recall/precision/false
+    rate per template at each absolute threshold (the detector's
+    ``threshold`` override replaces the reference's fixed 0.5·max policy,
+    main_mfdetect.py:94). One rendered scene, one compiled detector, many
+    thresholds — the tuning loop a practitioner actually runs."""
+    import jax.numpy as jnp
+
+    block = jnp.asarray(synthesize_scene(scene), dtype=jnp.float32)
+    cfgs = getattr(detector, "template_configs", None) or {}
+    minutes = scene.ns / scene.fs / 60.0
+    rows = []
+    for thr in thresholds:
+        result = detector(block, threshold=float(thr))
+        row = {"threshold": float(thr)}
+        for name, picks in result.picks.items():
+            indices = _calls_for_template(cfgs[name], scene) if name in cfgs else []
+            m = match_picks(picks, scene, time_tol_s, call_indices=indices or None)
+            row[name] = {
+                "recall": m.recall,
+                "precision": m.precision,
+                "false_per_channel_minute": m.n_false / (scene.nx * minutes),
+            }
+        rows.append(row)
+    return rows
+
+
 def default_eval_scene(nx: int = 256, ns: int = 6000) -> SyntheticScene:
     """A standard evaluation scene: three fin-call pairs (HF + LF note
     shapes) at staggered times/positions across the array, matching the

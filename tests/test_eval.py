@@ -152,6 +152,22 @@ def test_kernel_dict_auto_association():
     assert all(scene.calls[i].fmax < 25 for i in lf_idx)
 
 
+def test_threshold_sweep_monotone_recall():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from das4whales_tpu.eval import threshold_sweep
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    scene = default_eval_scene(nx=64, ns=4000)
+    det = MatchedFilterDetector(scene.metadata, [0, scene.nx, 1],
+                                (scene.nx, scene.ns))
+    rows = threshold_sweep(det, scene, [2.0, 20.0, 80.0])
+    recalls = [r["HF"]["recall"] for r in rows]
+    assert recalls[0] >= recalls[1] >= recalls[2]
+    assert recalls[0] > 0.8 and recalls[2] < 0.2
+
+
 def test_default_scene_templates_cover_both_notes():
     scene = default_eval_scene()
     hf = [c for c in scene.calls if abs(c.fmax - FIN_HF_NOTE.fmax) < 0.5]
