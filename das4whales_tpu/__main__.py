@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("--sharded", action="store_true",
                     help="detect batches on a (file x channel) device mesh "
                          "(workflows.campaign.run_campaign_sharded)")
+    pc.add_argument("--family", default="mf",
+                    choices=("mf", "spectro", "gabor"),
+                    help="detector family (spectro/gabor run through the "
+                         "shared bandpass+f-k front end; single-chip only)")
     for name, help_text in WORKFLOWS.items():
         p = sub.add_parser(name, help=help_text)
         p.add_argument("url", nargs="?", default=None,
@@ -164,6 +168,39 @@ def main(argv=None) -> int:
             if sel is None:
                 print("campaign: no file in the list is probeable; nothing to do")
                 return 3
+        detector = None
+        if args.family != "mf":
+            if args.sharded:
+                print("campaign: --family spectro/gabor is single-chip only")
+                return 2
+            # adapters need the design shape up front: probe the first
+            # probeable file
+            meta0 = None
+            for path in args.files:
+                try:
+                    meta0 = get_acquisition_parameters(path, args.interrogator)
+                    break
+                except Exception:  # noqa: BLE001 — run_campaign records it
+                    continue
+            if meta0 is None:
+                print("campaign: no file in the list is probeable; nothing to do")
+                return 3
+            from das4whales_tpu.config import ChannelSelection
+            from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+            csel = ChannelSelection.from_list(sel)
+            shape = (csel.n_channels(meta0.nx), meta0.ns)
+            mf = MatchedFilterDetector(meta0, sel, shape)
+            if args.family == "spectro":
+                from das4whales_tpu.eval import SpectroEvalAdapter
+                from das4whales_tpu.models.spectro import SpectroCorrDetector
+
+                detector = SpectroEvalAdapter(mf, SpectroCorrDetector(meta0))
+            else:
+                from das4whales_tpu.eval import GaborEvalAdapter
+                from das4whales_tpu.models.gabor import GaborDetector
+
+                detector = GaborEvalAdapter(mf, GaborDetector(meta0, sel))
         try:
             if args.sharded:
                 from das4whales_tpu.parallel.mesh import make_mesh
@@ -176,7 +213,7 @@ def main(argv=None) -> int:
                 )
             else:
                 res = run_campaign(
-                    args.files, sel, args.outdir,
+                    args.files, sel, args.outdir, detector=detector,
                     resume=not args.no_resume, max_failures=args.max_failures,
                     interrogator=args.interrogator,
                 )
