@@ -151,37 +151,29 @@ def main(argv=None) -> int:
         from das4whales_tpu.io.interrogators import get_acquisition_parameters
         from das4whales_tpu.workflows.campaign import CampaignAborted, run_campaign
 
+        # ONE probe pass: the first probeable file supplies the default
+        # channel selection and (for --family adapters) the design shape —
+        # a corrupt head of the list must not crash the fault-tolerant
+        # runner before it starts
+        meta0 = None
+        for path in args.files:
+            try:
+                meta0 = get_acquisition_parameters(path, args.interrogator)
+                break
+            except Exception:  # noqa: BLE001 — run_campaign records it
+                continue
         if args.channels:
             sel = [int(v) for v in args.channels.split(",")]
+        elif meta0 is not None:
+            sel = [0, meta0.nx, 1]
         else:
-            # derive the selection from the first PROBEABLE file — a corrupt
-            # head of the list must not crash the fault-tolerant runner
-            # before it starts
-            sel = None
-            for path in args.files:
-                try:
-                    meta0 = get_acquisition_parameters(path, args.interrogator)
-                    sel = [0, meta0.nx, 1]
-                    break
-                except Exception:  # noqa: BLE001 — run_campaign records it
-                    continue
-            if sel is None:
-                print("campaign: no file in the list is probeable; nothing to do")
-                return 3
+            print("campaign: no file in the list is probeable; nothing to do")
+            return 3
         detector = None
         if args.family != "mf":
             if args.sharded:
                 print("campaign: --family spectro/gabor is single-chip only")
                 return 2
-            # adapters need the design shape up front: probe the first
-            # probeable file
-            meta0 = None
-            for path in args.files:
-                try:
-                    meta0 = get_acquisition_parameters(path, args.interrogator)
-                    break
-                except Exception:  # noqa: BLE001 — run_campaign records it
-                    continue
             if meta0 is None:
                 print("campaign: no file in the list is probeable; nothing to do")
                 return 3

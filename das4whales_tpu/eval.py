@@ -291,10 +291,20 @@ class SpectroEvalAdapter:
         self.det = spectro_detector
         self.template_configs = dict(spectro_detector.kernels)
 
-    def __call__(self, block):
+    def __call__(self, block, threshold: float | None = None):
         filt = getattr(self.prefilter, "filter_block", self.prefilter)
         trf_fk = filt(block)
-        _, picks, spectro_fs = self.det(trf_fk)
+        if threshold is None:
+            _, picks, spectro_fs = self.det(trf_fk)
+        else:
+            # sweep support: the spectro family's absolute threshold is
+            # exactly the knob eval.threshold_sweep varies
+            saved = self.det.threshold
+            try:
+                self.det.threshold = float(threshold)
+                _, picks, spectro_fs = self.det(trf_fk)
+            finally:
+                self.det.threshold = saved
         fs = self.det.metadata.fs
         out = {}
         for name, pk in picks.items():
@@ -343,9 +353,9 @@ class GaborEvalAdapter:
             for name, (fmin, fmax, dur) in gabor_detector.note_params.items()
         }
 
-    def __call__(self, block):
+    def __call__(self, block, threshold: float | None = None):
         filt = getattr(self.prefilter, "filter_block", self.prefilter)
-        out = self.det(filt(block))
+        out = self.det(filt(block), threshold=threshold)
         return _EvalResult(picks={k: np.asarray(v) for k, v in out["picks"].items()})
 
 

@@ -132,17 +132,25 @@ class GaborDetector:
             self.notes[name] = jnp.asarray(chirp * np.hanning(len(chirp)))
         self.max_peaks = max_peaks
 
-    def __call__(self, trf_fk: jnp.ndarray):
+    def __call__(self, trf_fk: jnp.ndarray, threshold: float | None = None):
+        """Detect on a filtered block. ``threshold`` overrides the
+        reference's relative 0.5·max policy with an absolute value (same
+        override contract as MatchedFilterDetector — used by
+        eval.threshold_sweep)."""
         score, mask_binned, masked_tr = gabor_mask(jnp.asarray(trf_fk), self.design)
         correlograms = {
             name: masked_matched_filter(masked_tr, note.astype(masked_tr.dtype))
             for name, note in self.notes.items()
         }
-        maxv = max(float(jnp.max(c)) for c in correlograms.values())
-        thres = 0.5 * maxv
+        if threshold is None:
+            maxv = max(float(jnp.max(c)) for c in correlograms.values())
+            thres = 0.5 * maxv
+        else:
+            thres = float(threshold)
         picks = {}
         for name, corr in correlograms.items():
-            thr = thres * (0.9 if name == "HF" else 1.0)  # HF picked at 0.9*thres
+            hf_discount = 0.9 if (name == "HF" and threshold is None) else 1.0
+            thr = thres * hf_discount  # HF picked at 0.9*thres (relative policy)
             env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
             pos, _, _, sel, _ = peak_ops.find_peaks_sparse(env, thr, max_peaks=self.max_peaks)
             picks[name] = peak_ops.sparse_to_pick_times(pos, sel)

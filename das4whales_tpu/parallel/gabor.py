@@ -43,7 +43,7 @@ def make_sharded_gabor_step(
     mesh,
     c0: float = C0_WATER,
     notes: Dict[str, Tuple[float, float, float]] | None = None,
-    max_peaks: int = 128,
+    max_peaks: int = 256,
     relative_threshold: float = 0.5,
     hf_factor: float = 0.9,
     file_axis: str = "file",
@@ -68,8 +68,10 @@ def make_sharded_gabor_step(
     for fmin, fmax, dur in notes.values():
         chirp = np.asarray(gen_hyperbolic_chirp(fmin, fmax, dur, meta.fs))
         notes_dev.append(jnp.asarray(chirp * np.hanning(len(chirp)), jnp.float32))
+    # keyed by NAME, matching GaborDetector's policy (models/gabor.py:
+    # "HF picked at 0.9*thres"), not by dict position
     factors = jnp.asarray(
-        [hf_factor if i == 0 else 1.0 for i in range(len(names))], jnp.float32
+        [hf_factor if name == "HF" else 1.0 for name in names], jnp.float32
     )
 
     def one_file(trf):                               # [C, T]

@@ -168,6 +168,24 @@ def test_threshold_sweep_monotone_recall():
     assert recalls[0] > 0.8 and recalls[2] < 0.2
 
 
+def test_threshold_sweep_supports_adapter_families():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from das4whales_tpu.eval import SpectroEvalAdapter, threshold_sweep
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.models.spectro import SpectroCorrDetector
+
+    scene = default_eval_scene(nx=48, ns=3000)
+    mf = MatchedFilterDetector(scene.metadata, [0, scene.nx, 1],
+                               (scene.nx, scene.ns))
+    sp = SpectroCorrDetector(scene.metadata)
+    rows = threshold_sweep(SpectroEvalAdapter(mf, sp), scene,
+                           [5.0, 1000.0], time_tol_s=0.5)
+    assert rows[0]["HF"]["recall"] > rows[1]["HF"]["recall"]
+    assert sp.threshold == 14.0            # override restored after the sweep
+
+
 def test_default_scene_templates_cover_both_notes():
     scene = default_eval_scene()
     hf = [c for c in scene.calls if abs(c.fmax - FIN_HF_NOTE.fmax) < 0.5]
