@@ -290,6 +290,13 @@ def _spawn_rung(spec: dict, timeout_s: float, cpu: bool = False):
     child's own failure (e.g. the round-2 style HBM OOM).
     """
     env = dict(os.environ)
+    # persistent compilation cache: a rung retried after a wedge (and the
+    # driver's next bench run) reuses the serialized executables instead of
+    # re-spending the canonical-shape compile inside its deadline
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
     if cpu:
         spec = dict(spec, cpu=True)
         env["JAX_PLATFORMS"] = "cpu"
