@@ -28,6 +28,7 @@ from ..config import SPECTRO_HF_KERNEL, SPECTRO_LF_KERNEL, as_metadata
 from ..models.spectro import buildkernel, effective_band, xcorr2d
 from ..ops import peaks as peak_ops
 from ..ops import spectral
+from .timeshard import halo_exchange
 
 
 def make_sharded_spectro_step(
@@ -122,5 +123,122 @@ def make_sharded_spectro_step(
         shard_map(
             _shard_body, mesh=mesh, in_specs=(spec_in,), out_specs=out_specs,
             check_vma=False,
+        )
+    ), names
+
+
+def make_sharded_spectro_step_time(
+    metadata,
+    mesh,
+    flims: Tuple[float, float] = (14.0, 30.0),
+    kernels: Dict[str, Dict] | None = None,
+    win_size: float = 0.8,
+    overlap_pct: float = 0.95,
+    threshold: float = 14.0,
+    max_peaks: int = 256,
+    outputs: str = "full",
+    time_axis: str = "time",
+):
+    """Sequence parallelism for the spectro family: detection on a
+    ``[channel x time]`` record whose TIME axis is sharded over ``mesh``
+    (records longer than one chip — same layout as
+    ``timeshard.make_sharded_mf_step_time``).
+
+    Collective inventory: one ``psum``/``pmax`` pair for the global
+    per-channel signal statistics, a ``halo_exchange`` of ``nperseg/2``
+    samples so every STFT frame is sample-exact across shard boundaries,
+    one ``pmax`` for the spectrogram's per-channel max normalization, and
+    ONE ``all_to_all`` relabel (frames gathered, channels scattered) after
+    which correlation/median/picking are channel-local and exactly the
+    single-chip computation.
+
+    Parity deviation: librosa's final centered frame (center == record
+    end, mostly zero padding) is dropped — the frame grid is
+    ``ns // nhop`` instead of ``1 + ns // nhop``. Consequences are
+    confined to the record's trailing edge: correlogram frames within
+    one kernel width of the end see the convolution's shortened tail
+    (and the per-channel median/max normalizers can shift ~1%); interior
+    frames match the single-chip detector to float32 noise
+    (tests/test_spectro_timeshard.py).
+
+    Returns ``(step, names)``; the step maps the sharded ``[C, T]`` block
+    to ``(correlograms [nT, C, n_frames], picks)`` with the CHANNEL axis
+    sharded over ``time_axis`` after the relabel (the timeshard
+    convention), or just picks with ``outputs="picks"``.
+    """
+    if outputs not in ("full", "picks"):
+        raise ValueError(f"outputs must be 'full' or 'picks', got {outputs!r}")
+    meta = as_metadata(metadata)
+    fs, ns = meta.fs, meta.ns
+    kernels = kernels or {"HF": SPECTRO_HF_KERNEL, "LF": SPECTRO_LF_KERNEL}
+    nperseg = int(win_size * fs)
+    nhop = int(np.floor(nperseg * (1 - overlap_pct)))
+    p = mesh.shape[time_axis]
+    if ns % p:
+        raise ValueError(f"time length {ns} not divisible by mesh axis {time_axis}={p}")
+    local = ns // p
+    if local % nhop:
+        raise ValueError(
+            f"local shard length {local} must divide the frame hop {nhop} "
+            f"(frame grid must align with shard boundaries)"
+        )
+    halo = nperseg // 2
+    if halo >= local:
+        raise ValueError(f"STFT halo {halo} must be < local shard length {local}")
+    nt_total = ns // nhop
+
+    # kernel design on the same grids as the channel-sharded step (the
+    # kernel depends only on the frame spacing nhop/fs and band rows)
+    nf = nperseg // 2 + 1
+    ff_full = np.linspace(0, fs / 2, num=nf)
+    tt = np.linspace(0, ns / fs, num=nt_total + 1)
+    designs = []
+    for name, ker in kernels.items():
+        fmin, fmax = effective_band(flims, ker)
+        sel_rows = np.where((ff_full >= fmin) & (ff_full <= fmax))[0]
+        lo, hi = int(sel_rows[0]), int(sel_rows[-1]) + 1
+        _, _, K = buildkernel(
+            ker["f0"], ker["f1"], ker["bdwidth"], ker["dur"],
+            ff_full[lo:hi], tt, fs, fmin, fmax,
+        )
+        designs.append((name, lo, hi, jnp.asarray(K, jnp.float32)))
+    names = tuple(d[0] for d in designs)
+
+    def _body(x):                                    # [C, local]
+        # global per-channel signal stats (reference normalization,
+        # detect.py:650-708) via collectives
+        mean = jax.lax.psum(jnp.sum(x, axis=-1, keepdims=True), time_axis) / ns
+        mx = jax.lax.pmax(jnp.max(jnp.abs(x), axis=-1, keepdims=True), time_axis)
+        norm = (x - mean) / mx
+        # halo so every frame is sample-exact; global edges zero-pad —
+        # exactly librosa's centered zero padding of the normalized signal
+        ext = halo_exchange(norm, halo, time_axis)    # [C, halo + local + halo]
+        frames = jnp.abs(
+            spectral.stft(ext, nperseg, nhop, center=False)
+        )[..., : local // nhop]                       # [C, nf, local/nhop]
+        smax = jax.lax.pmax(jnp.max(frames, axis=(-2, -1), keepdims=True), time_axis)
+        pnorm = frames / smax
+        # ONE relabel: frames gathered whole, channels scattered
+        pr = jax.lax.all_to_all(
+            pnorm, time_axis, split_axis=0, concat_axis=2, tiled=True
+        )                                             # [C/P, nf, nt_total]
+        corr = jnp.stack([
+            xcorr2d(pr[:, lo:hi, :], K) for _, lo, hi, K in designs
+        ])                                            # [nT, C/P, nt_total]
+        picks = peak_ops.find_peaks_sparse_batched(
+            corr, jnp.asarray(threshold, x.dtype), max_peaks=max_peaks
+        )
+        if outputs == "picks":
+            return picks
+        return corr, picks
+
+    spec_picks = jax.tree_util.tree_map(
+        lambda _: P(None, time_axis), peak_ops.SparsePicks(0, 0, 0, 0, 0)
+    )
+    out_specs = spec_picks if outputs == "picks" else (P(None, time_axis, None), spec_picks)
+    return jax.jit(
+        shard_map(
+            _body, mesh=mesh, in_specs=(P(None, time_axis),),
+            out_specs=out_specs, check_vma=False,
         )
     ), names
