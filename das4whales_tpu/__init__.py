@@ -22,8 +22,9 @@ from .config import AcquisitionMetadata, ChannelSelection  # noqa: F401
 
 def __getattr__(name):
     # viz needs matplotlib (an optional extra); load it on first use so a
-    # base install can run detection/localization headless.
-    if name in ("viz", "parallel", "workflows"):
+    # base install can run detection/localization headless. eval/parallel/
+    # workflows load lazily to keep plain-kernel imports light.
+    if name in ("viz", "parallel", "workflows", "eval"):
         import importlib
 
         module = importlib.import_module(f".{name}", __name__)
