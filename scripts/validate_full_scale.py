@@ -67,7 +67,7 @@ def make_scene(nx, ns, n_calls=24, seed=7):
     return block, truth
 
 
-def run_production(block):
+def run_production(block, fused_bandpass: bool = False):
     """das4whales_tpu float32 pipeline; returns picks dict + timings."""
     import jax
 
@@ -80,7 +80,8 @@ def run_production(block):
     nx, ns = block.shape
     meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
     t0 = time.perf_counter()
-    det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns), max_peaks=256)
+    det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns), max_peaks=256,
+                                fused_bandpass=fused_bandpass)
     t_design = time.perf_counter() - t0
 
     x = jnp.asarray(block)
@@ -210,6 +211,9 @@ def main():
         help="report path; relative paths are anchored to the repo root",
     )
     ap.add_argument("--json", default=None, help="also dump raw numbers")
+    ap.add_argument("--fused", action="store_true",
+                    help="validate the fused bandpass-into-f-k route (the "
+                         "bench default) instead of the staged default")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -218,7 +222,7 @@ def main():
     block, truth = make_scene(args.nx, args.ns)
 
     print("production float32 pipeline ...", flush=True)
-    p_picks, p_thr, p_t = run_production(block)
+    p_picks, p_thr, p_t = run_production(block, fused_bandpass=args.fused)
     print(f"  design {p_t['design_s']:.1f}s  first {p_t['first_call_s']:.1f}s "
           f"steady {p_t['steady_s']:.1f}s", flush=True)
 
