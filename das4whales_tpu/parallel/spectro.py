@@ -31,6 +31,28 @@ from ..ops import spectral
 from .timeshard import halo_exchange
 
 
+
+
+def _design_kernels(fs, ns, flims, kernels, nperseg, nhop, nt):
+    """Host-side per-kernel design shared by both sharded factories: band
+    rows as STATIC slices of the full-band spectrogram grid + the hat
+    kernel on those rows (one source so the factories cannot diverge)."""
+    nf = nperseg // 2 + 1
+    ff_full = np.linspace(0, fs / 2, num=nf)
+    tt = np.linspace(0, ns / fs, num=nt)
+    designs = []
+    for name, ker in kernels.items():
+        fmin, fmax = effective_band(flims, ker)
+        sel_rows = np.where((ff_full >= fmin) & (ff_full <= fmax))[0]
+        lo, hi = int(sel_rows[0]), int(sel_rows[-1]) + 1
+        _, _, K = buildkernel(
+            ker["f0"], ker["f1"], ker["bdwidth"], ker["dur"],
+            ff_full[lo:hi], tt, fs, fmin, fmax,
+        )
+        designs.append((name, lo, hi, jnp.asarray(K, jnp.float32)))
+    return designs, tuple(d[0] for d in designs)
+
+
 def make_sharded_spectro_step(
     metadata,
     mesh,
@@ -63,27 +85,13 @@ def make_sharded_spectro_step(
     nperseg = int(win_size * fs)
     nhop = int(np.floor(nperseg * (1 - overlap_pct)))
 
-    # per-kernel frequency band (as STATIC row slices of the full-band
-    # spectrogram) + hat kernel from the axis grids (host). The full-band
-    # magnitude is max-normalized BEFORE slicing (sliced_spectrogram
-    # semantics), so computing the STFT once per tile and slicing each
-    # kernel's band from it is bit-identical to per-kernel spectrograms —
-    # and halves the step's dominant cost (the 95%-overlap STFT).
+    # The full-band magnitude is max-normalized BEFORE slicing
+    # (sliced_spectrogram semantics), so computing the STFT once per tile
+    # and slicing each kernel's band from it is bit-identical to
+    # per-kernel spectrograms — and halves the step's dominant cost.
     probe_mag = spectral.stft_magnitude(jnp.zeros((1, ns), jnp.float32), nperseg, nhop)
-    nf, nt = probe_mag.shape[-2], probe_mag.shape[-1]
-    ff_full = np.linspace(0, fs / 2, num=nf)
-    tt = np.linspace(0, ns / fs, num=nt)
-    designs = []
-    for name, ker in kernels.items():
-        fmin, fmax = effective_band(flims, ker)
-        sel_rows = np.where((ff_full >= fmin) & (ff_full <= fmax))[0]
-        lo, hi = int(sel_rows[0]), int(sel_rows[-1]) + 1
-        _, _, K = buildkernel(
-            ker["f0"], ker["f1"], ker["bdwidth"], ker["dur"],
-            ff_full[lo:hi], tt, fs, fmin, fmax,
-        )
-        designs.append((name, lo, hi, jnp.asarray(K, jnp.float32)))
-    names = tuple(d[0] for d in designs)
+    nt = probe_mag.shape[-1]
+    designs, names = _design_kernels(fs, ns, flims, kernels, nperseg, nhop, nt)
 
     def _shard_body(x):                              # [B/Pf, C/Pc, ns]
         norm = x - jnp.mean(x, axis=-1, keepdims=True)
@@ -179,8 +187,8 @@ def make_sharded_spectro_step_time(
     local = ns // p
     if local % nhop:
         raise ValueError(
-            f"local shard length {local} must divide the frame hop {nhop} "
-            f"(frame grid must align with shard boundaries)"
+            f"local shard length {local} must be a MULTIPLE of the frame "
+            f"hop {nhop} (frame grid must align with shard boundaries)"
         )
     halo = nperseg // 2
     if halo >= local:
@@ -189,20 +197,9 @@ def make_sharded_spectro_step_time(
 
     # kernel design on the same grids as the channel-sharded step (the
     # kernel depends only on the frame spacing nhop/fs and band rows)
-    nf = nperseg // 2 + 1
-    ff_full = np.linspace(0, fs / 2, num=nf)
-    tt = np.linspace(0, ns / fs, num=nt_total + 1)
-    designs = []
-    for name, ker in kernels.items():
-        fmin, fmax = effective_band(flims, ker)
-        sel_rows = np.where((ff_full >= fmin) & (ff_full <= fmax))[0]
-        lo, hi = int(sel_rows[0]), int(sel_rows[-1]) + 1
-        _, _, K = buildkernel(
-            ker["f0"], ker["f1"], ker["bdwidth"], ker["dur"],
-            ff_full[lo:hi], tt, fs, fmin, fmax,
-        )
-        designs.append((name, lo, hi, jnp.asarray(K, jnp.float32)))
-    names = tuple(d[0] for d in designs)
+    designs, names = _design_kernels(
+        fs, ns, flims, kernels, nperseg, nhop, nt_total + 1
+    )
 
     def _body(x):                                    # [C, local]
         # global per-channel signal stats (reference normalization,
@@ -213,9 +210,23 @@ def make_sharded_spectro_step_time(
         # halo so every frame is sample-exact; global edges zero-pad —
         # exactly librosa's centered zero padding of the normalized signal
         ext = halo_exchange(norm, halo, time_axis)    # [C, halo + local + halo]
-        frames = jnp.abs(
-            spectral.stft(ext, nperseg, nhop, center=False)
-        )[..., : local // nhop]                       # [C, nf, local/nhop]
+        # channels stream through lax.map tiles: the 95%-overlap frame
+        # tensor is ~(nperseg/nhop)x the input bytes — untiled it is the
+        # round-2 OOM class (same policy as the channel-sharded step).
+        # center=False framing (the halo IS the centering), rfft engine.
+        C = ext.shape[0]
+        tile = min(256, C)
+        n_tiles = -(-C // tile)
+        extp = jnp.pad(ext, ((0, n_tiles * tile - C), (0, 0)))
+        extp = extp.reshape(n_tiles, tile, ext.shape[-1])
+
+        def per_tile(chunk):
+            return jnp.abs(
+                spectral.stft(chunk, nperseg, nhop, center=False)
+            )[..., : local // nhop]
+
+        frames = jax.lax.map(per_tile, extp)
+        frames = frames.reshape(n_tiles * tile, *frames.shape[2:])[:C]
         smax = jax.lax.pmax(jnp.max(frames, axis=(-2, -1), keepdims=True), time_axis)
         pnorm = frames / smax
         # ONE relabel: frames gathered whole, channels scattered

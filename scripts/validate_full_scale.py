@@ -99,7 +99,8 @@ def run_production(block, fused_bandpass: bool = False):
         "design_s": t_design, "first_call_s": t_first, "steady_s": t_steady,
         # which code paths actually executed — write_report must not claim
         # a route the run never took
-        "route": det._route(), "pick_engine": det.pick_mode,
+        "route": det._route() + ("+fusedbp" if fused_bandpass else ""),
+        "pick_engine": det.pick_mode,
     }
 
 
@@ -258,7 +259,14 @@ def main():
                        "prod_timings": p_t, "golden_timings": g_t}, fh, indent=1)
         print("wrote", args.json)
 
-    if args.out:
+    if args.out and args.fused and args.out == "VALIDATION.md":
+        # --fused must not regenerate the default-route certificate (it
+        # would mislabel the run and destroy the fused addendum section);
+        # results went to stdout/--json — update the addendum by hand or
+        # pass an explicit --out.
+        print("(--fused: skipping default VALIDATION.md regeneration; "
+              "use --json or an explicit --out)")
+    elif args.out:
         out = args.out
         if not os.path.isabs(out):
             # anchor to the repo root so the documented "regenerates
