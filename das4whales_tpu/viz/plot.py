@@ -324,3 +324,36 @@ def plot_cross_correlogram(corr_m, time, dist, maxv, minv=0,
     cbar = fig.colorbar(im, ax=ax, orientation="horizontal", aspect=50, pad=0.02)
     cbar.set_label("Cross-correlation envelope []")
     return _finish(fig, show)
+
+
+def plot_eval_curves(rows, x_key="snr_db", show=None):
+    """Detection-performance curves from ``eval.amplitude_sweep`` /
+    ``eval.threshold_sweep`` rows: recall (solid) and precision (dashed)
+    per template vs the sweep variable. No reference analog (the
+    reference has no detection-metrics capability at all); returns the
+    Figure (headless-safe)."""
+    import matplotlib
+
+    if not show:
+        matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    names = [k for k in rows[0] if isinstance(rows[0][k], dict)]
+    xs = [r[x_key] for r in rows]
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for name in names:
+        ax.plot(xs, [r[name]["recall"] for r in rows], "-o", label=f"{name} recall")
+        ax.plot(xs, [r[name]["precision"] for r in rows], "--s",
+                label=f"{name} precision", alpha=0.7)
+    label = {"snr_db": "SNR [dB]", "threshold": "absolute threshold",
+             "amplitude": "call amplitude"}.get(x_key, x_key)
+    ax.set_xlabel(label)
+    ax.set_ylabel("fraction")
+    ax.set_ylim(-0.05, 1.05)
+    ax.grid(alpha=0.3)
+    ax.legend()
+    ax.set_title("Detection performance")
+    fig.tight_layout()
+    if show:
+        plt.show()
+    return fig

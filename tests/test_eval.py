@@ -186,6 +186,23 @@ def test_threshold_sweep_supports_adapter_families():
     assert sp.threshold == 14.0            # override restored after the sweep
 
 
+def test_plot_eval_curves_headless():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from das4whales_tpu.eval import amplitude_sweep
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.viz.plot import plot_eval_curves
+
+    scene = default_eval_scene(nx=48, ns=3000)
+    det = MatchedFilterDetector(scene.metadata, [0, scene.nx, 1],
+                                (scene.nx, scene.ns))
+    rows = amplitude_sweep(det, scene, [0.5])
+    fig = plot_eval_curves(rows)
+    assert fig is not None
+    assert len(fig.axes[0].lines) == 4       # recall+precision x HF/LF
+
+
 def test_default_scene_templates_cover_both_notes():
     scene = default_eval_scene()
     hf = [c for c in scene.calls if abs(c.fmax - FIN_HF_NOTE.fmax) < 0.5]
