@@ -117,9 +117,10 @@ class GaborDetector:
         threshold2: float = 150.0,
         notes: Dict[str, Tuple[float, float, float]] | None = None,
         max_peaks: int = 256,
+        ksize: int = 100,
     ):
         self.metadata = as_metadata(metadata)
-        self.design = design_gabor(self.metadata, selected_channels, c0, bin_factor, threshold1, threshold2)
+        self.design = design_gabor(self.metadata, selected_channels, c0, bin_factor, threshold1, threshold2, ksize=ksize)
         if notes is None:
             notes = {"HF": (17.8, 28.8, 0.68), "LF": (14.7, 21.8, 0.78)}
         # (fmin, fmax, duration) per note, kept for eval.py's

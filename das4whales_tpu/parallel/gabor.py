@@ -1,16 +1,20 @@
-"""File-sharded (data-parallel) Gabor/image detection.
+"""Sharded Gabor/image detection: file-parallel batches and time-sharded
+long records.
 
-Unlike the other two families, the Gabor pipeline's 2-D image operators
-couple channels — the oriented Gabor pair spans ~100 binned pixels
-(~1000 raw channels) of the t-x image (models/gabor.py, reference
-improcess.py:98-140) — so channel sharding would need kilochannel halos.
-The natural scale-out axis is FILES: each mesh slot owns whole files and
-runs the full image pipeline locally; there are no collectives (the
-0.5·max detection threshold is per file, main_gabordetect.py-style
-script behavior, computed inside each file's program).
+The Gabor pipeline's 2-D image operators couple channels — the oriented
+Gabor pair spans ~100 binned pixels (~1000 raw channels) of the t-x
+image (models/gabor.py, reference improcess.py:98-140) — so channel
+sharding would need kilochannel halos. Two layouts avoid that:
 
-Files stream through ``lax.map`` within a shard so only one file's
-image-pipeline temps are live at a time.
+* ``make_sharded_gabor_step`` — data-parallel over FILES: each mesh slot
+  owns whole files, runs the full image pipeline locally, zero
+  collectives; files stream through ``lax.map`` so only one file's
+  image temps are live at a time.
+* ``make_sharded_gabor_step_time`` — one record longer than a chip,
+  TIME-sharded: an ``all_to_all`` relabel plus pmin/pmax collectives
+  reproduce the pipeline's global couplings, and the channel-row halo
+  (the two-stage Gabor receptive field) makes interior channels exactly
+  single-chip.
 """
 
 from __future__ import annotations
@@ -103,6 +107,141 @@ def make_sharded_gabor_step(
         shard_map(
             _shard_body, mesh=mesh, in_specs=(spec_in,),
             out_specs=(spec_corr, spec_picks, P(file_axis)),
+            check_vma=False,
+        )
+    )
+    return step, names
+
+
+def make_sharded_gabor_step_time(
+    metadata,
+    selected_channels,
+    mesh,
+    c0: float = C0_WATER,
+    bin_factor: float = 0.1,
+    threshold1: float = 9100.0,
+    threshold2: float = 150.0,
+    ksize: int = 100,
+    notes: Dict[str, Tuple[float, float, float]] | None = None,
+    max_peaks: int = 256,
+    relative_threshold: float = 0.5,
+    hf_factor: float = 0.9,
+    channel_halo: int | None = None,
+    time_axis: str = "time",
+):
+    """Sequence parallelism for the Gabor family: detection on a
+    ``[channel x time]`` record whose TIME axis is sharded over ``mesh``.
+
+    The image pipeline's global couplings become collectives: ONE
+    ``all_to_all`` relabel makes time whole per channel shard (the
+    per-channel Hilbert envelope needs it), the image min-max scaling and
+    the smoothed-mask renormalization use ``pmin``/``pmax`` pairs, the
+    Gabor convolutions see a CHANNEL-row halo exchange, and the
+    detection threshold is one more ``pmax``.
+
+    Parity: interior channels match the single-chip ``GaborDetector`` to
+    resize-antialias noise. The outermost ``channel_halo`` rows at the
+    two CABLE ENDS deviate (antialiased ``binning`` renormalizes its
+    kernel at a true image boundary but sees explicit zero halo rows
+    here) — the same class of edge transient as the time-sharded
+    filters' record edges, and the reference pipeline distrusts cable
+    ends anyway. Pinned in tests/test_gabor_timeshard.py.
+
+    ``channel_halo`` defaults to the two-stage Gabor receptive field,
+    ``(2*(ksize//2) + 4) / bin_factor`` rows rounded up to the binning
+    granularity — interior results then equal the single-chip
+    ``GaborDetector`` to resize-antialias noise. Requires
+    ``channels % mesh`` and ``time % mesh`` divisibility and
+    ``channel_halo < channels / mesh``.
+
+    Returns ``(step, names)``: the step maps the time-sharded ``[C, T]``
+    block to ``(correlograms [nT, C, T] (channel axis sharded over
+    ``time_axis`` after the relabel), picks, threshold [])``.
+    """
+    from ..models.gabor import design_gabor
+    from ..ops import image as img_ops
+    from .timeshard import halo_exchange
+
+    meta = as_metadata(metadata)
+    design = design_gabor(meta, list(selected_channels), c0=c0,
+                          bin_factor=bin_factor, threshold1=threshold1,
+                          threshold2=threshold2, ksize=ksize)
+    if notes is None:
+        notes = {"HF": (17.8, 28.8, 0.68), "LF": (14.7, 21.8, 0.78)}
+    names = tuple(notes)
+    notes_dev = []
+    for fmin, fmax, dur in notes.values():
+        chirp = np.asarray(gen_hyperbolic_chirp(fmin, fmax, dur, meta.fs))
+        notes_dev.append(jnp.asarray(chirp * np.hanning(len(chirp)), jnp.float32))
+    factors = jnp.asarray(
+        [hf_factor if name == "HF" else 1.0 for name in names], jnp.float32
+    )
+    grain = max(int(round(1.0 / bin_factor)), 1)
+    if channel_halo is None:
+        need = (2 * (ksize // 2) + 4) / bin_factor
+        channel_halo = int(-(-need // grain) * grain)
+    if channel_halo % grain:
+        raise ValueError(
+            f"channel_halo {channel_halo} must be a multiple of the binning "
+            f"granularity {grain}"
+        )
+    up = jnp.asarray(design.gabor_up, jnp.float32)
+    down = jnp.asarray(design.gabor_down, jnp.float32)
+
+    def _body(x):                                    # [C, T/P]
+        p = jax.lax.axis_size(time_axis)
+        # relabel: time gathered whole, channels scattered -> [C/P, T]
+        xr = jax.lax.all_to_all(x, time_axis, split_axis=0, concat_axis=1,
+                                tiled=True)
+        # t-x image with GLOBAL min-max scaling (trace2image semantics)
+        env = jnp.abs(spectral.analytic_signal(xr, axis=-1))
+        img = env / jnp.std(xr, axis=-1, keepdims=True)
+        lo = jax.lax.pmin(jnp.min(img), time_axis)
+        hi = jax.lax.pmax(jnp.max(img), time_axis)
+        image = (img - lo) / (hi - lo) * 255.0
+        # channel-row halo: zero rows at the global edges = the zero
+        # padding filter2d_same applies on one chip
+        ext = jnp.moveaxis(
+            halo_exchange(jnp.moveaxis(image, 0, -1), channel_halo, time_axis),
+            -1, 0,
+        )
+        imagebin = img_ops.binning(ext, bin_factor, bin_factor)
+        score = (img_ops.filter2d_same(imagebin, up)
+                 + img_ops.filter2d_same(imagebin, down))
+        binary = (score > threshold1).astype(ext.dtype)
+        mask_binned = (
+            img_ops.filter2d_same(binary, up) + img_ops.filter2d_same(binary, down)
+        ) > threshold2
+        mask_full = jax.image.resize(
+            mask_binned.astype(ext.dtype), ext.shape, method="linear",
+            antialias=False,
+        )
+        smoothed = img_ops.gaussian_filter2d(mask_full, 1.5)
+        smoothed = smoothed[channel_halo:-channel_halo]
+        slo = jax.lax.pmin(jnp.min(smoothed), time_axis)
+        shi = jax.lax.pmax(jnp.max(smoothed), time_axis)
+        span = shi - slo
+        smoothed = jnp.where(
+            span > 0, (smoothed - slo) / jnp.where(span > 0, span, 1.0), smoothed
+        )
+        masked = xr * smoothed
+        corr = jnp.stack([
+            masked_matched_filter(masked, nt.astype(xr.dtype)) for nt in notes_dev
+        ])                                           # [nT, C/P, T]
+        thres = relative_threshold * jax.lax.pmax(jnp.max(corr), time_axis)
+        env_c = jnp.abs(spectral.analytic_signal(corr, axis=-1))
+        picks = peak_ops.find_peaks_sparse_batched(
+            env_c, (thres * factors)[:, None], max_peaks=max_peaks
+        )
+        return corr, picks, thres
+
+    spec_picks = jax.tree_util.tree_map(
+        lambda _: P(None, time_axis), peak_ops.SparsePicks(0, 0, 0, 0, 0)
+    )
+    step = jax.jit(
+        shard_map(
+            _body, mesh=mesh, in_specs=(P(None, time_axis),),
+            out_specs=(P(None, time_axis, None), spec_picks, P()),
             check_vma=False,
         )
     )

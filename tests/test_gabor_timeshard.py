@@ -1,0 +1,84 @@
+"""Time-sharded Gabor detection vs the single-chip GaborDetector.
+
+The image pipeline's global couplings (per-channel Hilbert, min-max
+scalings, two-stage Gabor receptive field, global threshold) become one
+all_to_all + pmin/pmax pairs + a channel-row halo; interior channels
+must match the single-chip detector, with deviations confined to the
+halo-sized bands at the two cable ends (antialiased binning
+renormalizes at true image boundaries — documented in the module).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from das4whales_tpu.config import AcquisitionMetadata
+from das4whales_tpu.models.gabor import GaborDetector
+from das4whales_tpu.parallel.gabor import make_sharded_gabor_step_time
+from das4whales_tpu.parallel.mesh import make_mesh
+
+NX, NS = 256, 4096
+META = AcquisitionMetadata(fs=200.0, dx=2.042, nx=NX, ns=NS)
+KW = dict(bin_factor=0.5, ksize=6, threshold1=2000.0, threshold2=10.0)
+
+
+def _block():
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((NX, NS)).astype(np.float32) * 1e-9
+    t = np.arange(0, 0.68, 1 / 200.0)
+    sing = -17.8 * 0.68 / (28.8 - 17.8)
+    chirp = (np.cos(2 * np.pi * (-sing * 28.8) * np.log(np.abs(1 - t / sing)))
+             * np.hanning(len(t))).astype(np.float32)
+    # moveout across channels so the oriented Gabor pair has structure;
+    # one arrival straddles the shard-3/4 time boundary at sample 2048
+    for ch0, onset in ((40, 800), (128, 2000), (200, 3000)):
+        for dch in range(-12, 13):
+            s = onset + abs(dch) * 4
+            if 0 <= ch0 + dch < NX and s + len(chirp) < NS:
+                x[ch0 + dch, s : s + len(chirp)] += 4e-9 * chirp
+    return x
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_time_sharded_gabor_matches_single_chip():
+    mesh = make_mesh(shape=(8,), axis_names=("time",))
+    step, names = make_sharded_gabor_step_time(META, [0, NX, 1], mesh, **KW)
+    x = _block()
+    xd = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(None, "time")))
+    corr, picks, thres = jax.block_until_ready(step(xd))
+    assert corr.shape == (2, NX, NS)
+
+    det = GaborDetector(META, [0, NX, 1], **KW)
+    out = det(jnp.asarray(x))
+    assert float(thres) == pytest.approx(out["threshold"], rel=1e-4)
+    halo = 20                                  # (2*(6//2)+4)/0.5
+    interior = slice(halo, NX - halo)
+    for ti, name in enumerate(names):
+        sc = np.asarray(out["correlograms"][name])
+        cs = np.asarray(corr[ti])
+        denom = max(float(np.abs(sc).max()), 1e-12)
+        # interior channels: single-chip to antialias noise; cable-end
+        # bands carry the documented boundary deviation
+        assert np.abs(cs[interior] - sc[interior]).max() / denom < 5e-3, name
+        sel = np.asarray(picks.selected[ti])
+        pos = np.asarray(picks.positions[ti])
+        ch, slot = np.nonzero(sel)
+        keep = (ch >= halo) & (ch < NX - halo)
+        got = set(zip(ch[keep].tolist(), pos[ch[keep], slot[keep]].tolist()))
+        sp = np.asarray(out["picks"][name])
+        kw = (sp[0] >= halo) & (sp[0] < NX - halo)
+        want = set(zip(sp[0][kw].tolist(), sp[1][kw].tolist()))
+        assert got == want, (name, got ^ want)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+def test_halo_granularity_validation():
+    mesh = make_mesh(shape=(8,), axis_names=("time",))
+    with pytest.raises(ValueError, match="granularity"):
+        make_sharded_gabor_step_time(META, [0, NX, 1], mesh, channel_halo=21, **KW)
