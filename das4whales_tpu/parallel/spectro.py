@@ -39,12 +39,18 @@ def _design_kernels(fs, ns, flims, kernels, nperseg, nhop, nt):
     kernel on those rows (one source so the factories cannot diverge)."""
     nf = nperseg // 2 + 1
     ff_full = np.linspace(0, fs / 2, num=nf)
-    tt = np.linspace(0, ns / fs, num=nt)
+    dt = (ns / fs) / max(nt - 1, 1)              # frame spacing of the record grid
     designs = []
     for name, ker in kernels.items():
         fmin, fmax = effective_band(flims, ker)
         sel_rows = np.where((ff_full >= fmin) & (ff_full <= fmax))[0]
         lo, hi = int(sel_rows[0]), int(sel_rows[-1]) + 1
+        # buildkernel sizes its time axis by counting grid points in the
+        # (7*dur, 8*dur) window (reference detect.py:411-492) — only the
+        # SPACING matters, so hand it a grid guaranteed to span that
+        # window: identical kernels at real record lengths, and no empty
+        # kernel when the record is shorter than 8*dur (tiny CI shapes)
+        tt = np.arange(0, 8.2 * ker["dur"] + dt, dt)
         _, _, K = buildkernel(
             ker["f0"], ker["f1"], ker["bdwidth"], ker["dur"],
             ff_full[lo:hi], tt, fs, fmin, fmax,
