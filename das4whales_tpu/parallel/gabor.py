@@ -185,11 +185,31 @@ def make_sharded_gabor_step_time(
             f"channel_halo {channel_halo} must be a multiple of the binning "
             f"granularity {grain}"
         )
+    from ..config import ChannelSelection
+
+    C = ChannelSelection.from_list(list(selected_channels)).n_channels(meta.nx)
+    p_mesh = mesh.shape[time_axis]
+    if C % p_mesh:
+        raise ValueError(f"channels {C} not divisible by mesh axis {time_axis}={p_mesh}")
+    local_c = C // p_mesh
+    if not (0 < channel_halo < local_c):
+        raise ValueError(
+            f"channel_halo {channel_halo} must be in (0, C/P={local_c})"
+        )
+    # single-chip parity needs the per-shard resize scale to EQUAL the
+    # full-image scale: both the local channel count and the halo must
+    # bin to integers
+    for label, n in (("C/P", local_c), ("channel_halo", channel_halo)):
+        if abs(n * bin_factor - round(n * bin_factor)) > 1e-9:
+            raise ValueError(
+                f"{label}={n} times bin_factor={bin_factor} is not an "
+                f"integer: the per-shard binned grid would misalign with "
+                f"the single-chip grid"
+            )
     up = jnp.asarray(design.gabor_up, jnp.float32)
     down = jnp.asarray(design.gabor_down, jnp.float32)
 
     def _body(x):                                    # [C, T/P]
-        p = jax.lax.axis_size(time_axis)
         # relabel: time gathered whole, channels scattered -> [C/P, T]
         xr = jax.lax.all_to_all(x, time_axis, split_axis=0, concat_axis=1,
                                 tiled=True)

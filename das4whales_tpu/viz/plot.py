@@ -331,13 +331,7 @@ def plot_eval_curves(rows, x_key="snr_db", show=None):
     ``eval.threshold_sweep`` rows: recall (solid) and precision (dashed)
     per template vs the sweep variable. No reference analog (the
     reference has no detection-metrics capability at all); returns the
-    Figure (headless-safe)."""
-    import matplotlib
-
-    if not show:
-        matplotlib.use("Agg", force=False)
-    import matplotlib.pyplot as plt
-
+    Figure (headless-safe via the module's ``_finish`` convention)."""
     names = [k for k in rows[0] if isinstance(rows[0][k], dict)]
     xs = [r[x_key] for r in rows]
     fig, ax = plt.subplots(figsize=(7, 5))
@@ -354,6 +348,4 @@ def plot_eval_curves(rows, x_key="snr_db", show=None):
     ax.legend()
     ax.set_title("Detection performance")
     fig.tight_layout()
-    if show:
-        plt.show()
-    return fig
+    return _finish(fig, show)
