@@ -263,6 +263,7 @@ def run_campaign_sharded(
     engine: str = "h5py",
     relative_threshold: float = 0.5,
     hf_factor: float = 0.9,
+    fused_bandpass: bool = False,
 ) -> CampaignResult:
     """Multi-chip campaign: file batches land pre-sharded on the mesh and
     the whole batch detects in ONE program (data-parallel over files,
@@ -322,6 +323,7 @@ def run_campaign_sharded(
     step = jax.jit(make_sharded_mf_step(
         design, mesh, outputs="picks",
         relative_threshold=relative_threshold, hf_factor=hf_factor,
+        fused_bandpass=fused_bandpass,
     ))
 
     factors = {name: (hf_factor if i == 0 else 1.0)

@@ -217,3 +217,49 @@ def test_sharded_banded_fk_matches_full(mesh8, rng):
     band = np.asarray(jax.jit(band_fn)(x, jnp.asarray(mask_band)))
     scale = max(1e-30, float(np.abs(full).max()))
     assert np.abs(full - band).max() < 1e-5 * scale
+
+
+def test_sharded_fused_bandpass_matches_single_chip_fused():
+    """The sharded step's fused_bandpass folds |H|^2 into the mask at
+    design time — its picks must equal the single-chip fused detector's
+    (same edge contract, VALIDATION.md fused addendum)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.parallel.mesh import make_mesh
+    from das4whales_tpu.parallel.pipeline import input_sharding, make_sharded_mf_step
+
+    if len(jax.devices()) < 8:
+        import pytest
+
+        pytest.skip("needs 8-device mesh")
+    design = design_matched_filter((NX, NS), SEL, META)
+    mesh = make_mesh()
+    step = jax.jit(make_sharded_mf_step(design, mesh, fused_bandpass=True))
+    rng = np.random.default_rng(21)
+    x = rng.standard_normal((2, NX, NS)).astype(np.float32) * 1e-9
+    t = np.arange(0, 0.68, 1 / 200.0)
+    sing = -17.8 * 0.68 / (28.8 - 17.8)
+    chirp = (np.cos(2 * np.pi * (-sing * 28.8) * np.log(np.abs(1 - t / sing)))
+             * np.hanning(len(t))).astype(np.float32)
+    x[0, NX // 2, 100 : 100 + len(t)] += 5e-9 * chirp
+    x[1, NX // 3, 250 : 250 + len(t)] += 5e-9 * chirp
+    xd = jax.device_put(jnp.asarray(x), input_sharding(mesh))
+    trf, corr, env, picks, thres = jax.block_until_ready(step(xd))
+
+    det = MatchedFilterDetector(META, SEL, (NX, NS), fused_bandpass=True,
+                                channel_tile=None, pick_mode="sparse")
+    for f in range(2):
+        res = det(jnp.asarray(x[f]))
+        np.testing.assert_allclose(
+            np.asarray(trf[f]), np.asarray(res.trf_fk), rtol=0, atol=2e-6 * float(np.abs(np.asarray(res.trf_fk)).max())
+        )
+        for ti, name in enumerate(design.template_names):
+            sel = np.asarray(picks.selected[ti, f])
+            pos = np.asarray(picks.positions[ti, f])
+            ch, slot = np.nonzero(sel)
+            got = set(zip(ch.tolist(), pos[ch, slot].tolist()))
+            want = set(zip(*res.picks[name].tolist()))
+            assert got == want, (f, name, got ^ want)
