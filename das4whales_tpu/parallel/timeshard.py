@@ -175,8 +175,14 @@ def make_sharded_mf_step_time(
     pick_mode: str = "sparse",
     max_peaks: int = 256,
     outputs: str = "full",
+    fused_bandpass: bool = False,
 ):
     """Full flagship detection step for a TIME-sharded ``[C, T]`` block.
+
+    ``fused_bandpass=True`` folds |H(f)|² into the full f-k mask (the
+    time FFT of the pencil transform applies it), dropping the
+    halo-exchange bandpass stage entirely — the sequence-parallel analog
+    of the golden-certified single-chip fused route (VALIDATION.md).
 
     Stages: halo-exchanged zero-phase bandpass -> two-collective pencil
     f-k filter -> one ``all_to_all`` transpose into the channel-sharded
@@ -228,14 +234,22 @@ def make_sharded_mf_step_time(
     band, order, fs = design.bp_band, design.bp_order, design.fs
     sos = sp.butter(order, [band[0] / (fs / 2), band[1] / (fs / 2)], "bp", output="sos")
     gain = jnp.asarray(zero_phase_gain(np.fft.rfftfreq(local + 2 * halo), sos).astype(np.float32))
-    mask_rows = jnp.asarray(prepare_mask_full(design.fk_mask))
+    fk_mask = design.fk_mask
+    if fused_bandpass:
+        # |H|^2 on the fftshifted full-frequency grid; symmetric in f, so
+        # folding before the Hermitian symmetrization is exact (same
+        # construction as parallel/pipeline.py)
+        freqs_cps = np.abs(np.fft.fftshift(np.fft.fftfreq(nns)))
+        fk_mask = fk_mask * zero_phase_gain(freqs_cps, sos).astype(fk_mask.dtype)[None, :]
+    mask_rows = jnp.asarray(prepare_mask_full(fk_mask))
     templates_true, template_mu, template_scale = (
         xcorr.padded_template_stats_device(design.templates)
     )
     n_templates = design.templates.shape[0]
 
     def body(x, gain_w, mask_r, tmpl, tmu, tsc):
-        bp = _bp_time_local(x, gain_w, halo=halo, axis_name=time_axis)
+        bp = (x if fused_bandpass
+              else _bp_time_local(x, gain_w, halo=halo, axis_name=time_axis))
         trf = fk_apply_time_local(bp, mask_r, time_axis)           # [C, T/P]
         # relabel: one transpose into channel-sharded layout [C/P, T]
         y = jax.lax.all_to_all(trf, time_axis, split_axis=0, concat_axis=1, tiled=True)

@@ -219,3 +219,53 @@ def test_timeshard_picks_only_mode(tmesh, rng):
 
     with pytest.raises(ValueError, match="outputs"):
         make_sharded_mf_step_time(design, tmesh, halo=halo, outputs="nope")
+
+
+def test_time_sharded_fused_matches_single_chip_fused():
+    """fused_bandpass on the time-sharded step: |H|^2 folded into the
+    pencil mask must reproduce the single-chip fused detector
+    pick-for-pick (VALIDATION.md fused addendum contract)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8-device mesh")
+    import jax.numpy as jnp
+
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.parallel.mesh import make_mesh
+    from das4whales_tpu.parallel.timeshard import (
+        make_sharded_mf_step_time,
+        time_sharding,
+    )
+
+    nnx, nns = 64, 4096
+    meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=nnx, ns=nns)
+    design = design_matched_filter((nnx, nns), [0, nnx, 1], meta)
+    mesh = make_mesh(shape=(8,), axis_names=("time",))
+    step = make_sharded_mf_step_time(design, mesh, fused_bandpass=True, halo=128)
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((nnx, nns)).astype(np.float32) * 1e-9
+    t = np.arange(0, 0.68, 1 / 200.0)
+    sing = -17.8 * 0.68 / (28.8 - 17.8)
+    x[32, 1500 : 1500 + len(t)] += (
+        5e-9 * np.cos(2 * np.pi * (-sing * 28.8) * np.log(np.abs(1 - t / sing)))
+        * np.hanning(len(t))
+    )
+    xd = jax.device_put(jnp.asarray(x), time_sharding(mesh))
+    trf, corr, env, picks, thres = jax.block_until_ready(step(xd))
+
+    det = MatchedFilterDetector(
+        meta, [0, nnx, 1], (nnx, nns), fused_bandpass=True,
+        channel_tile=None, pick_mode="sparse",
+    )
+    res = det(jnp.asarray(x))
+    denom = float(np.abs(np.asarray(res.trf_fk)).max())
+    assert np.abs(np.asarray(trf) - np.asarray(res.trf_fk)).max() < 1e-5 * denom
+    sel = np.asarray(picks.selected)
+    pos = np.asarray(picks.positions)
+    for ti, name in enumerate(design.template_names):
+        ch, slot = np.nonzero(sel[ti])
+        got = set(zip(ch.tolist(), pos[ti][ch, slot].tolist()))
+        want = set(zip(*res.picks[name].tolist()))
+        assert got == want, name
