@@ -73,6 +73,9 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=("mf", "spectro", "gabor"),
                     help="detector family (spectro/gabor run through the "
                          "shared bandpass+f-k front end; single-chip only)")
+    pc.add_argument("--fused", action="store_true",
+                    help="fold the bandpass into the f-k mask (golden-"
+                         "certified fused route, VALIDATION.md; mf only)")
     for name, help_text in WORKFLOWS.items():
         p = sub.add_parser(name, help=help_text)
         p.add_argument("url", nargs="?", default=None,
@@ -202,12 +205,16 @@ def main(argv=None) -> int:
                     args.files, sel, args.outdir, make_mesh(),
                     resume=not args.no_resume, max_failures=args.max_failures,
                     interrogator=args.interrogator,
+                    fused_bandpass=args.fused,
                 )
             else:
+                kwargs = {} if detector is not None else {
+                    "fused_bandpass": args.fused
+                }
                 res = run_campaign(
                     args.files, sel, args.outdir, detector=detector,
                     resume=not args.no_resume, max_failures=args.max_failures,
-                    interrogator=args.interrogator,
+                    interrogator=args.interrogator, **kwargs,
                 )
         except CampaignAborted as exc:
             print(f"campaign aborted: {exc} (progress kept in {args.outdir})")

@@ -66,6 +66,20 @@ def butter_zero_phase_gain(
     return zero_phase_gain(np.fft.rfftfreq(nfft), sos).astype(np.float32)
 
 
+def butter_zero_phase_gain_full(
+    nns: int, fs: float, band, order: int = 8
+) -> np.ndarray:
+    """Zero-phase ``|H(f)|^2`` Butterworth gain on the FFTSHIFTED
+    full-frequency grid of an ``nns``-sample window (symmetric in f, so
+    folding it into an fftshifted f-k mask BEFORE the Hermitian
+    symmetrization is exact) — the one construction behind every
+    ``fused_bandpass`` route (models/matched_filter.py,
+    parallel/pipeline.py, parallel/timeshard.py)."""
+    sos = sp.butter(order, [band[0] / (fs / 2), band[1] / (fs / 2)], "bp", output="sos")
+    freqs_cps = np.abs(np.fft.fftshift(np.fft.fftfreq(nns)))
+    return zero_phase_gain(freqs_cps, sos).astype(np.float32)
+
+
 def zero_phase_gain(freqs: np.ndarray, sos: np.ndarray) -> np.ndarray:
     """``|H(f)|^2`` of an SOS filter evaluated at ``freqs`` (cycles/sample
     units handled by the caller). Computed per-section for stability."""

@@ -37,7 +37,7 @@ def _mf_body(
     trace, mask_band, bp_gain, templates_true, template_mu, template_scale, *,
     band_lo: int, band_hi: int, bp_padlen: int, channel_axis: str,
     relative_threshold: float, hf_factor: float, pick_mode: str, max_peaks: int,
-    outputs: str = "full",
+    outputs: str = "full", fused: bool = False,
 ):
     """shard_map body. Local shapes: trace [B/Pf, C/Pc, T], mask_band
     [K, Bpad/Pc] (band-limited half-spectrum — the all_to_alls and
@@ -45,11 +45,11 @@ def _mf_body(
     [Fext], templates_true [nT, m] (TRUE length — the memory-lean
     correlate route, ops/xcorr.py:padded_template_stats, halves the
     per-shard FFT temps vs the padded form)."""
-    # fused mode (bp_padlen < 0 sentinel): |H(f)|^2 is already folded
-    # into mask_band at design time — skip the separate bandpass program
-    # (same math and edge contract as the single-chip fused route,
+    # fused mode: |H(f)|^2 is already folded into mask_band at design
+    # time — skip the separate bandpass program (same math and edge
+    # contract as the single-chip fused route,
     # models/matched_filter.py:mf_filter_fused)
-    tr_bp = trace if bp_padlen < 0 else _bp_local(trace, bp_gain, bp_padlen)
+    tr_bp = trace if fused else _bp_local(trace, bp_gain, bp_padlen)
     trf_fk = fk_apply_local_banded(tr_bp, mask_band, band_lo, band_hi, channel_axis)
 
     corr = xcorr.compute_cross_correlograms_corrected(
@@ -139,21 +139,13 @@ def make_sharded_mf_step(
     if nnx % pc:
         raise ValueError(f"channels {nnx} not divisible by {channel_axis}={pc}")
     fk_mask = design.fk_mask
-    bp_padlen = design.bp_padlen
     if fused_bandpass:
-        from scipy import signal as _sp
+        from ..ops.filters import butter_zero_phase_gain_full
 
-        from ..ops.filters import zero_phase_gain
-
-        band, order = design.bp_band, design.bp_order
-        sos = _sp.butter(order, [band[0] / (design.fs / 2),
-                                 band[1] / (design.fs / 2)], "bp", output="sos")
-        # |H|^2 on the fftshifted full-frequency grid (symmetric in f, so
-        # multiplying before the Hermitian symmetrization is exact)
-        freqs_cps = np.abs(np.fft.fftshift(np.fft.fftfreq(nns)))
-        gain_full = zero_phase_gain(freqs_cps, sos).astype(fk_mask.dtype)
-        fk_mask = fk_mask * gain_full[None, :]
-        bp_padlen = -1                      # body sentinel: skip the bp stage
+        gain_full = butter_zero_phase_gain_full(
+            nns, design.fs, design.bp_band, design.bp_order
+        )
+        fk_mask = fk_mask * gain_full[None, :].astype(fk_mask.dtype)
     mask_band_np, band_lo, band_hi = prepare_mask_band(fk_mask, pc)
     mask_band = jnp.asarray(mask_band_np, dtype=jnp.float32)
     bp_gain = jnp.asarray(design.bp_gain)
@@ -165,7 +157,8 @@ def make_sharded_mf_step(
         _mf_body,
         band_lo=band_lo,
         band_hi=band_hi,
-        bp_padlen=bp_padlen,
+        bp_padlen=design.bp_padlen,
+        fused=fused_bandpass,
         channel_axis=channel_axis,
         relative_threshold=relative_threshold,
         hf_factor=hf_factor,

@@ -226,21 +226,25 @@ def make_sharded_mf_step_time(
     if nnx % p or nns % p:
         raise ValueError(f"trace shape {design.trace_shape} must divide mesh axis {p}")
     local = nns // p
-    if halo >= local:
-        raise ValueError(f"halo {halo} must be < local shard length {local}")
-
-    # rebuild the design's own bandpass at the shard-window length (the
-    # stored bp_gain is for the full-record window; same filter, new nfft)
-    band, order, fs = design.bp_band, design.bp_order, design.fs
-    sos = sp.butter(order, [band[0] / (fs / 2), band[1] / (fs / 2)], "bp", output="sos")
-    gain = jnp.asarray(zero_phase_gain(np.fft.rfftfreq(local + 2 * halo), sos).astype(np.float32))
     fk_mask = design.fk_mask
+    band, order, fs = design.bp_band, design.bp_order, design.fs
     if fused_bandpass:
-        # |H|^2 on the fftshifted full-frequency grid; symmetric in f, so
-        # folding before the Hermitian symmetrization is exact (same
-        # construction as parallel/pipeline.py)
-        freqs_cps = np.abs(np.fft.fftshift(np.fft.fftfreq(nns)))
-        fk_mask = fk_mask * zero_phase_gain(freqs_cps, sos).astype(fk_mask.dtype)[None, :]
+        # the halo-exchange bandpass stage never runs: no halo constraint,
+        # no shard-window gain to build — |H|^2 folds into the pencil mask
+        # via the shared single-source construction (ops/filters.py)
+        from ..ops.filters import butter_zero_phase_gain_full
+
+        gain = jnp.ones((1,), jnp.float32)   # unused by the fused body
+        fk_mask = fk_mask * butter_zero_phase_gain_full(
+            nns, fs, band, order
+        )[None, :].astype(fk_mask.dtype)
+    else:
+        if halo >= local:
+            raise ValueError(f"halo {halo} must be < local shard length {local}")
+        # rebuild the design's own bandpass at the shard-window length (the
+        # stored bp_gain is for the full-record window; same filter, new nfft)
+        sos = sp.butter(order, [band[0] / (fs / 2), band[1] / (fs / 2)], "bp", output="sos")
+        gain = jnp.asarray(zero_phase_gain(np.fft.rfftfreq(local + 2 * halo), sos).astype(np.float32))
     mask_rows = jnp.asarray(prepare_mask_full(fk_mask))
     templates_true, template_mu, template_scale = (
         xcorr.padded_template_stats_device(design.templates)
