@@ -53,6 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="detector family to score (all: cross-family table)")
     pe.add_argument("--time-tol", type=float, default=0.5,
                     help="pick-to-arrival match tolerance [s]")
+    pe.add_argument("--fused", action="store_true",
+                    help="evaluate the fused bandpass∘f-k route")
     pc = sub.add_parser(
         "campaign",
         help="fault-tolerant resumable detection over many files "
@@ -123,7 +125,8 @@ def main(argv=None) -> int:
 
         scene = default_eval_scene(nx=args.nx, ns=args.ns)
         mf = MatchedFilterDetector(
-            scene.metadata, [0, scene.nx, 1], (scene.nx, scene.ns)
+            scene.metadata, [0, scene.nx, 1], (scene.nx, scene.ns),
+            fused_bandpass=args.fused,
         )
         detectors = {"mf": mf}
         if args.family in ("spectro", "all"):
