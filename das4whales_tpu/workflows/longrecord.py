@@ -68,14 +68,30 @@ def detect_long_record(
     bp_band=(14.0, 30.0),
     fk_config=None,
     max_peaks_per_channel: int = 512,
+    family: str = "mf",
+    fused_bandpass: bool = False,
+    family_kwargs: dict | None = None,
 ) -> LongRecordResult:
     """Detect calls over a continuous multi-file record.
 
     ``files`` must be consecutive segments of one recording (their
     concatenation is treated as gapless, the acquisition's native layout).
     The time axis is sharded over ``mesh`` (defaults to all devices on a
-    1-D ``(time,)`` mesh); channels stay whole, so any channel count works.
+    1-D ``(time,)`` mesh); channels stay whole for the flagship family,
+    so any channel count works.
+
+    ``family`` selects the detector: ``"mf"`` (flagship matched filter),
+    ``"spectro"`` (spectrogram correlation — picks are reported at frame
+    resolution, converted to samples via the hop), or ``"gabor"`` (image
+    pipeline). The non-flagship families run the shared bandpass+f-k
+    front end first (their workflows' prologue), then their own
+    time-sharded step; both need the channel count divisible by the mesh
+    (their relabel scatters channels). ``family_kwargs`` passes through
+    to the family's step factory (e.g. ``threshold`` for spectro,
+    ``ksize``/``bin_factor``/``channel_halo`` for gabor).
     """
+    if family not in ("mf", "spectro", "gabor"):
+        raise ValueError(f"unknown family {family!r}")
     files = list(files)
     if not files:
         raise ValueError("need at least one file")
@@ -90,7 +106,15 @@ def detect_long_record(
     meta = as_metadata(blocks[0].metadata)
     record = np.concatenate([b.trace for b in blocks], axis=-1)
     n_samples = record.shape[-1]
-    record = _pad_to_multiple(record, p)
+    # spectro additionally needs each local shard to be a whole number of
+    # STFT hops (frame grid aligned with shard boundaries)
+    pad_mult = p
+    nhop = None
+    if family == "spectro":
+        nperseg = int(0.8 * meta.fs)
+        nhop = int(np.floor(nperseg * 0.05))
+        pad_mult = p * nhop
+    record = _pad_to_multiple(record, pad_mult)
     nnx, nns = record.shape
     log.info("continuous record: %d files -> [%d x %d] (%.1f s)",
              len(files), nnx, nns, n_samples / meta.fs)
@@ -101,21 +125,76 @@ def detect_long_record(
         (nnx, nns), blocks[0].selection.to_list(), meta,
         fk_config=fk_config or SCRIPT_FK, bp_band=bp_band, templates=templates,
     )
-    step = make_sharded_mf_step_time(
-        design, mesh, time_axis=time_axis, halo=halo,
-        relative_threshold=relative_threshold, hf_factor=hf_factor,
-        pick_mode="sparse", max_peaks=max_peaks_per_channel,
-    )
     xd = jax.device_put(jnp.asarray(record), time_sharding(mesh, time_axis))
-    trf, corr, env, sp_picks, thres = jax.block_until_ready(step(xd))
+
+    if family == "mf":
+        step = make_sharded_mf_step_time(
+            design, mesh, time_axis=time_axis, halo=halo,
+            relative_threshold=relative_threshold, hf_factor=hf_factor,
+            pick_mode="sparse", max_peaks=max_peaks_per_channel,
+            fused_bandpass=fused_bandpass,
+        )
+        trf, corr, env, sp_picks, thres = jax.block_until_ready(step(xd))
+        names = design.template_names
+        thr_map = {name: float(thres) * (hf_factor if i == 0 else 1.0)
+                   for i, name in enumerate(names)}
+        pos_scale = 1
+    else:
+        # shared front end (the spectro/gabor workflows' prologue):
+        # time-sharded zero-phase bandpass + pencil f-k
+        from dataclasses import replace as _dc_replace
+
+        from ..parallel.timeshard import (
+            sharded_bp_filt_time,
+            sharded_fk_apply_time,
+        )
+
+        if nnx % p:
+            raise ValueError(
+                f"family={family!r} relabels channels across the mesh: "
+                f"channel count {nnx} must be divisible by {p}"
+            )
+        trf_dev = sharded_fk_apply_time(
+            sharded_bp_filt_time(
+                xd, mesh, meta.fs, bp_band[0], bp_band[1],
+                halo=halo, time_axis=time_axis,
+            ),
+            design.fk_mask, mesh, time_axis=time_axis,
+        )
+        trf_dev = jax.device_put(trf_dev, time_sharding(mesh, time_axis))
+        meta_rec = _dc_replace(meta, nx=nnx, ns=nns)
+        fam_kw = dict(family_kwargs or {})
+        if family == "spectro":
+            from ..parallel.spectro import make_sharded_spectro_step_time
+
+            step, names = make_sharded_spectro_step_time(
+                meta_rec, mesh, outputs="picks",
+                max_peaks=max_peaks_per_channel, time_axis=time_axis,
+                **fam_kw,
+            )
+            sp_picks = jax.block_until_ready(step(trf_dev))
+            thr = float(fam_kw.get("threshold", 14.0))
+            thr_map = {name: thr for name in names}
+            pos_scale = nhop                   # frame index -> sample index
+        else:
+            from ..parallel.gabor import make_sharded_gabor_step_time
+
+            step, names = make_sharded_gabor_step_time(
+                meta_rec, blocks[0].selection.to_list(), mesh,
+                relative_threshold=relative_threshold, hf_factor=hf_factor,
+                max_peaks=max_peaks_per_channel, time_axis=time_axis,
+                **fam_kw,
+            )
+            corr_g, sp_picks, thres = jax.block_until_ready(step(trf_dev))
+            thr_map = {name: float(thres) * (hf_factor if name == "HF" else 1.0)
+                       for name in names}
+            pos_scale = 1
 
     picks, times_s, thr_out = {}, {}, {}
-    factors = {name: (hf_factor if i == 0 else 1.0)
-               for i, name in enumerate(design.template_names)}
-    positions = np.asarray(sp_picks.positions)
+    positions = np.asarray(sp_picks.positions) * pos_scale
     selected = np.asarray(sp_picks.selected)
     saturated = np.asarray(sp_picks.saturated)
-    for i, name in enumerate(design.template_names):
+    for i, name in enumerate(names):
         if saturated[i].any():
             log.warning(
                 "%s: peak capacity saturated on %d/%d channels; picks beyond "
@@ -129,7 +208,7 @@ def detect_long_record(
         pk = peak_ops.sparse_to_pick_times(positions[i], sel)
         picks[name] = pk
         times_s[name] = pk[1] / meta.fs
-        thr_out[name] = float(thres) * factors[name]
+        thr_out[name] = thr_map[name]
     return LongRecordResult(
         picks=picks, pick_times_s=times_s, thresholds=thr_out,
         t0_utc=blocks[0].t0_utc, n_samples=n_samples, n_files=len(files),

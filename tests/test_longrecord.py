@@ -126,3 +126,38 @@ def test_end_of_record_call_under_padding(tmp_path, rng):
     sel = res.picks["HF"][1][res.picks["HF"][0] == ch]
     near = sel[np.abs(sel - onset) < 120] if len(sel) else []
     assert len(near) > 0, f"end-of-record call at ch{ch}/{onset} missed: {sel[:10]}"
+
+
+def test_long_record_spectro_family(campaign):
+    """family='spectro': the boundary-straddling call must be picked by
+    the time-sharded spectrogram-correlation path (frame-resolution
+    picks converted to samples)."""
+    paths, onsets = campaign
+    # the f-k fan strips most of a SINGLE-channel call's energy (its k
+    # spectrum is flat; real propagating calls live inside the fan), so
+    # the absolute threshold is lowered for this synthetic fixture
+    res = detect_long_record(paths, [0, NX, 1], family="spectro",
+                             family_kwargs={"threshold": 4.0})
+    ch, onset = onsets["straddle"]
+    hf = res.picks["HF"]
+    hits = hf[1][hf[0] == ch]
+    assert hits.size, "straddling call not picked by spectro family"
+    # frame resolution: within ~the kernel duration of the onset
+    assert np.min(np.abs(hits - onset)) < 0.8 * FS
+    assert res.thresholds["HF"] == 4.0
+
+
+def test_long_record_gabor_family(campaign):
+    """family='gabor': the time-sharded image pipeline runs end-to-end on
+    a multi-file record (capability smoke; single-channel calls give the
+    oriented Gabor pair little moveout structure to lock onto)."""
+    paths, _ = campaign
+    res = detect_long_record(
+        paths, [0, NX, 1], family="gabor",
+        # tiny image-kernel config: C/P = 4 rows/shard, so a 2-row halo
+        # (multiple of 1/bin_factor = 2) with a matching small kernel
+        family_kwargs={"ksize": 4, "bin_factor": 0.5, "channel_halo": 2,
+                       "threshold1": 500.0, "threshold2": 2.0},
+    )
+    assert set(res.picks) == {"HF", "LF"}
+    assert res.n_files == 3
