@@ -92,6 +92,17 @@ def detect_long_record(
     """
     if family not in ("mf", "spectro", "gabor"):
         raise ValueError(f"unknown family {family!r}")
+    fam_kw = dict(family_kwargs or {})
+    if family == "mf" and fam_kw:
+        raise ValueError(
+            "family_kwargs only apply to family='spectro'/'gabor' — "
+            f"got {sorted(fam_kw)} with family='mf' (did you forget family=?)"
+        )
+    if family != "mf" and fused_bandpass:
+        raise ValueError(
+            "fused_bandpass applies to the flagship family only; the "
+            "spectro/gabor front end designs its own bandpass"
+        )
     files = list(files)
     if not files:
         raise ValueError("need at least one file")
@@ -107,12 +118,14 @@ def detect_long_record(
     record = np.concatenate([b.trace for b in blocks], axis=-1)
     n_samples = record.shape[-1]
     # spectro additionally needs each local shard to be a whole number of
-    # STFT hops (frame grid aligned with shard boundaries)
+    # STFT hops (frame grid aligned with shard boundaries) — derive the
+    # hop from the SAME knobs the step factory will use (family_kwargs
+    # may override win_size/overlap_pct)
     pad_mult = p
     nhop = None
     if family == "spectro":
-        nperseg = int(0.8 * meta.fs)
-        nhop = int(np.floor(nperseg * 0.05))
+        nperseg = int(float(fam_kw.get("win_size", 0.8)) * meta.fs)
+        nhop = int(np.floor(nperseg * (1 - float(fam_kw.get("overlap_pct", 0.95)))))
         pad_mult = p * nhop
     record = _pad_to_multiple(record, pad_mult)
     nnx, nns = record.shape
@@ -121,29 +134,35 @@ def detect_long_record(
 
     from ..config import SCRIPT_FK
 
-    design = design_matched_filter(
-        (nnx, nns), blocks[0].selection.to_list(), meta,
-        fk_config=fk_config or SCRIPT_FK, bp_band=bp_band, templates=templates,
-    )
+    fk_cfg = fk_config or SCRIPT_FK
     xd = jax.device_put(jnp.asarray(record), time_sharding(mesh, time_axis))
 
     if family == "mf":
+        design = design_matched_filter(
+            (nnx, nns), blocks[0].selection.to_list(), meta,
+            fk_config=fk_cfg, bp_band=bp_band, templates=templates,
+        )
+        # campaign-mode outputs: the full-record trf/corr/env arrays never
+        # become program outputs (this workflow only consumes picks)
         step = make_sharded_mf_step_time(
             design, mesh, time_axis=time_axis, halo=halo,
             relative_threshold=relative_threshold, hf_factor=hf_factor,
             pick_mode="sparse", max_peaks=max_peaks_per_channel,
-            fused_bandpass=fused_bandpass,
+            fused_bandpass=fused_bandpass, outputs="picks",
         )
-        trf, corr, env, sp_picks, thres = jax.block_until_ready(step(xd))
+        sp_picks, thres = jax.block_until_ready(step(xd))
         names = design.template_names
         thr_map = {name: float(thres) * (hf_factor if i == 0 else 1.0)
                    for i, name in enumerate(names)}
         pos_scale = 1
     else:
         # shared front end (the spectro/gabor workflows' prologue):
-        # time-sharded zero-phase bandpass + pencil f-k
+        # time-sharded zero-phase bandpass + pencil f-k. Only the mask is
+        # needed here — skip design_matched_filter's (unused) full-record
+        # templates and bandpass gain.
         from dataclasses import replace as _dc_replace
 
+        from ..ops import fk as fk_ops
         from ..parallel.timeshard import (
             sharded_bp_filt_time,
             sharded_fk_apply_time,
@@ -154,16 +173,20 @@ def detect_long_record(
                 f"family={family!r} relabels channels across the mesh: "
                 f"channel count {nnx} must be divisible by {p}"
             )
+        fk_mask = fk_ops.hybrid_ninf_filter_design(
+            (nnx, nns), blocks[0].selection.to_list(), meta.dx, meta.fs,
+            cs_min=fk_cfg.cs_min, cp_min=fk_cfg.cp_min,
+            cp_max=fk_cfg.cp_max, cs_max=fk_cfg.cs_max,
+            fmin=fk_cfg.fmin, fmax=fk_cfg.fmax,
+        ).astype(np.float32)
         trf_dev = sharded_fk_apply_time(
             sharded_bp_filt_time(
                 xd, mesh, meta.fs, bp_band[0], bp_band[1],
                 halo=halo, time_axis=time_axis,
             ),
-            design.fk_mask, mesh, time_axis=time_axis,
+            fk_mask, mesh, time_axis=time_axis,
         )
-        trf_dev = jax.device_put(trf_dev, time_sharding(mesh, time_axis))
         meta_rec = _dc_replace(meta, nx=nnx, ns=nns)
-        fam_kw = dict(family_kwargs or {})
         if family == "spectro":
             from ..parallel.spectro import make_sharded_spectro_step_time
 
@@ -173,7 +196,14 @@ def detect_long_record(
                 **fam_kw,
             )
             sp_picks = jax.block_until_ready(step(trf_dev))
-            thr = float(fam_kw.get("threshold", 14.0))
+            # echo the threshold the factory actually used (its own
+            # signature default is the single source)
+            import inspect
+
+            factory_default = inspect.signature(
+                make_sharded_spectro_step_time
+            ).parameters["threshold"].default
+            thr = float(fam_kw.get("threshold", factory_default))
             thr_map = {name: thr for name in names}
             pos_scale = nhop                   # frame index -> sample index
         else:
