@@ -100,12 +100,17 @@ def main() -> int:
             print(f"{name:22s} {state.get(name, {}).get('status', 'pending')}")
         return 0
 
-    if not probe(60.0):
+    # --skip-probe: the caller (scripts/tpu_watchdog.py) just probed green;
+    # re-probing here would burn up to a minute of a short live window
+    if "--skip-probe" in sys.argv:
+        log_event({"step": "probe", "skipped": True})
+    elif not probe(60.0):
         print("tunnel down; nothing to do (re-run when it answers)")
         log_event({"step": "probe", "ok": False})
         return 1
-    log_event({"step": "probe", "ok": True})
-    print("tunnel answers — running agenda")
+    else:
+        log_event({"step": "probe", "ok": True})
+    print("running agenda")
 
     for name, argv, timeout_s in AGENDA:
         if state.get(name, {}).get("status") == "done":
