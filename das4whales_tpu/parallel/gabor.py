@@ -128,6 +128,8 @@ def make_sharded_gabor_step_time(
     hf_factor: float = 0.9,
     channel_halo: int | None = None,
     time_axis: str = "time",
+    n_channels: int | None = None,
+    outputs: str = "full",
 ):
     """Sequence parallelism for the Gabor family: detection on a
     ``[channel x time]`` record whose TIME axis is sharded over ``mesh``.
@@ -154,9 +156,24 @@ def make_sharded_gabor_step_time(
     ``channels % mesh`` and ``time % mesh`` divisibility and
     ``channel_halo < channels / mesh``.
 
-    Returns ``(step, names)``: the step maps the time-sharded ``[C, T]``
-    block to ``(correlograms [nT, C, T] (channel axis sharded over
-    ``time_axis`` after the relabel), picks, threshold [])``.
+    ``n_channels`` is the ROW COUNT of the block the step will receive.
+    It defaults to applying ``selected_channels`` to ``metadata.nx`` —
+    correct when ``metadata`` is the acquisition metadata — but callers
+    holding an already-selected record (``metadata.nx`` is the
+    post-selection count while ``selected_channels`` still describes the
+    original load-time stride, e.g. workflows/longrecord.py) must pass
+    the record's row count explicitly: re-applying a non-trivial
+    selection to the reduced ``nx`` would validate against a wrong (often
+    zero) channel count. ``selected_channels`` itself only sets the Gabor
+    orientation (step·dx, reference improcess.py:66-95).
+
+    Returns ``(step, names)``. With ``outputs="full"`` the step maps the
+    time-sharded ``[C, T]`` block to ``(correlograms [nT, C, T] (channel
+    axis sharded over ``time_axis`` after the relabel), picks,
+    threshold [])``; ``outputs="picks"`` (campaign/long-record mode)
+    returns ``(picks, threshold)`` only, so the full-record correlograms
+    never become program outputs (the memory class behind the round-2
+    OOM, mirroring make_sharded_mf_step_time).
     """
     from ..models.gabor import design_gabor
     from ..ops import image as img_ops
@@ -185,9 +202,15 @@ def make_sharded_gabor_step_time(
             f"channel_halo {channel_halo} must be a multiple of the binning "
             f"granularity {grain}"
         )
-    from ..config import ChannelSelection
+    if outputs not in ("full", "picks"):
+        raise ValueError(f"outputs must be 'full' or 'picks', got {outputs!r}")
+    if n_channels is None:
+        from ..config import ChannelSelection
 
-    C = ChannelSelection.from_list(list(selected_channels)).n_channels(meta.nx)
+        n_channels = ChannelSelection.from_list(
+            list(selected_channels)
+        ).n_channels(meta.nx)
+    C = n_channels
     p_mesh = mesh.shape[time_axis]
     if C % p_mesh:
         raise ValueError(f"channels {C} not divisible by mesh axis {time_axis}={p_mesh}")
@@ -253,15 +276,22 @@ def make_sharded_gabor_step_time(
         picks = peak_ops.find_peaks_sparse_batched(
             env_c, (thres * factors)[:, None], max_peaks=max_peaks
         )
+        if outputs == "picks":
+            return picks, thres
         return corr, picks, thres
 
     spec_picks = jax.tree_util.tree_map(
         lambda _: P(None, time_axis), peak_ops.SparsePicks(0, 0, 0, 0, 0)
     )
+    out_specs = (
+        (spec_picks, P())
+        if outputs == "picks"
+        else (P(None, time_axis, None), spec_picks, P())
+    )
     step = jax.jit(
         shard_map(
             _body, mesh=mesh, in_specs=(P(None, time_axis),),
-            out_specs=(P(None, time_axis, None), spec_picks, P()),
+            out_specs=out_specs,
             check_vma=False,
         )
     )

@@ -209,13 +209,18 @@ def detect_long_record(
         else:
             from ..parallel.gabor import make_sharded_gabor_step_time
 
+            # the original selection sets the Gabor angle only; the record's
+            # actual row count (meta_rec.nx is already post-selection) drives
+            # the sharding validation. outputs='picks' keeps the full-record
+            # correlograms out of the program outputs (campaign mode).
             step, names = make_sharded_gabor_step_time(
                 meta_rec, blocks[0].selection.to_list(), mesh,
                 relative_threshold=relative_threshold, hf_factor=hf_factor,
                 max_peaks=max_peaks_per_channel, time_axis=time_axis,
+                n_channels=nnx, outputs="picks",
                 **fam_kw,
             )
-            corr_g, sp_picks, thres = jax.block_until_ready(step(trf_dev))
+            sp_picks, thres = jax.block_until_ready(step(trf_dev))
             thr_map = {name: float(thres) * (hf_factor if name == "HF" else 1.0)
                        for name in names}
             pos_scale = 1

@@ -147,6 +147,33 @@ def test_long_record_spectro_family(campaign):
     assert res.thresholds["HF"] == 4.0
 
 
+def test_long_record_gabor_strided_selection(campaign):
+    """A non-trivial load-time selection (offset + stride) must work for
+    family='gabor'. The step factory's channel validation uses the
+    record's ACTUAL row count — re-applying the original selection to the
+    already-post-selection ``nx`` (the pre-fix behavior, ADVICE r3) gives
+    C=0 here ([16, 32, 2] re-applied to the 8 loaded rows) and spuriously
+    raises. The selection itself still sets the Gabor angle."""
+    import jax
+
+    from das4whales_tpu.parallel.mesh import make_mesh
+
+    paths, _ = campaign
+    # 8 loaded rows / 2-device mesh -> C/P = 4 rows per shard
+    mesh = make_mesh(shape=(2,), axis_names=("time",),
+                     devices=jax.devices()[:2])
+    res = detect_long_record(
+        paths, [16, NX, 2], family="gabor", mesh=mesh,
+        family_kwargs={"ksize": 4, "bin_factor": 0.5, "channel_halo": 2,
+                       "threshold1": 500.0, "threshold2": 2.0},
+    )
+    assert set(res.picks) == {"HF", "LF"}
+    assert res.n_files == 3
+    # picks index ROWS of the selected record: never >= the 8 loaded rows
+    for pk in res.picks.values():
+        assert pk.shape[1] == 0 or pk[0].max() < 8
+
+
 def test_long_record_gabor_family(campaign):
     """family='gabor': the time-sharded image pipeline runs end-to-end on
     a multi-file record (capability smoke; single-channel calls give the
