@@ -150,20 +150,25 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
         route += "+fusedbp"
     if det.fk_pad_rows:
         route += f"+chpad{det.design.fk_channels}"
-    return min(times), n_picks, str(jax.devices()[0]), stages, route
+    return min(times), n_picks, str(jax.devices()[0]), stages, route, det.pick_mode
 
 
 def bench_stages(det, x, repeats=3):
     """Per-stage wall times (s) of the flagship pipeline, following the
-    detector's own route (monolithic or channel-tiled — timing the
-    monolithic correlate at canonical shape is exactly what OOM'd the
-    round-2 bench). Each stage is its own jitted program with a device
-    sync, so the sum slightly exceeds the fused end-to-end wall time."""
+    detector's own resolved route (monolithic or channel-tiled — timing
+    the monolithic correlate at canonical shape is exactly what OOM'd the
+    round-2 bench) AND its resolved pick engine (sparse on accelerators,
+    scipy host walk on the CPU backend — matched_filter.py pick_mode
+    resolution; a sparse-engine stage table next to a scipy-engine
+    headline is how the r03 artifact contradicted itself). Each stage is
+    its own program with a device sync, so the sum slightly exceeds the
+    fused end-to-end wall time."""
     import jax
     import jax.numpy as jnp
 
     from das4whales_tpu.models.matched_filter import (
         mf_correlate_tiled,
+        mf_envelope_tiled,
         mf_pick_tiled,
     )
     from das4whales_tpu.ops import peaks as peak_ops
@@ -180,6 +185,15 @@ def bench_stages(det, x, repeats=3):
             best = min(best, time.perf_counter() - t0)
         return best, out
 
+    def host_peaks_fn(env, thr):
+        """The scipy engine's timed unit: device->host envelope copy + the
+        exact sequential walk, the same work the detector does per call."""
+        env_np = np.asarray(env)
+        return [
+            peak_ops.find_peaks_scipy_host(env_np[i], float(thr[i]))
+            for i in range(env_np.shape[0])
+        ]
+
     stages = {}
     # the detector's own filter program (covers the staged, fused-bandpass
     # and channel-padded routes uniformly)
@@ -193,15 +207,30 @@ def bench_stages(det, x, repeats=3):
         stages["correlate"], (corr_tiles, gmax) = timed(corr_fn, trf)
         thres = 0.5 * float(gmax)
         thr = jnp.asarray([0.9 * thres] + [thres] * (nT - 1), x.dtype)
-        pick_fn = lambda ct, t: mf_pick_tiled(ct, t, det.max_peaks)
-        stages["envelope+peaks"], _ = timed(pick_fn, corr_tiles, thr)
+        if det.pick_mode == "sparse":
+            pick_fn = lambda ct, t: mf_pick_tiled(ct, t, det.max_peaks)
+            stages["envelope+peaks"], _ = timed(pick_fn, corr_tiles, thr)
+        else:  # scipy/dense engines untile the envelope (matched_filter._call_tiled)
+            C = trf.shape[0]
+
+            def env_untiled(ct):
+                # the untile transpose is per-call detector work
+                # (_call_tiled "untile once on device") — inside the stage
+                return jnp.swapaxes(mf_envelope_tiled(ct), 0, 1).reshape(
+                    nT, -1, trf.shape[1]
+                )[:, :C]
+
+            stages["envelope"], env_full = timed(env_untiled, corr_tiles)
+            peaks_fn = (host_peaks_fn if det.pick_mode == "scipy"
+                        else _dense_peaks_fn(det, peak_ops))
+            stages["peaks"], _ = timed(peaks_fn, env_full, np.asarray(thr))
     else:
         corr_fn = jax.jit(
             lambda a: xcorr.compute_cross_correlograms_multi(a, det._templates_dev)
         )
         env_fn = jax.jit(lambda a: jnp.abs(spectral.analytic_signal(a, axis=-1)))
 
-        def peaks_fn(env, thr):
+        def sparse_peaks_fn(env, thr):
             return [
                 peak_ops.find_peaks_sparse(env[i], thr[i], max_peaks=det.max_peaks)
                 for i in range(env.shape[0])
@@ -210,8 +239,22 @@ def bench_stages(det, x, repeats=3):
         stages["correlate"], corr = timed(corr_fn, trf)
         stages["envelope"], env = timed(env_fn, corr)
         thr = jnp.full((env.shape[0],), 0.5 * float(jnp.max(corr)))
+        peaks_fn = {"sparse": sparse_peaks_fn, "scipy": host_peaks_fn,
+                    "dense": _dense_peaks_fn(det, peak_ops)}[det.pick_mode]
         stages["peaks"], _ = timed(peaks_fn, env, thr)
     return {k: round(v, 4) for k, v in stages.items()}
+
+
+def _dense_peaks_fn(det, peak_ops):
+    def dense_peaks(env, thr):
+        return [
+            np.asarray(peak_ops.find_peaks_prominence_blocked(
+                env[i], float(thr[i]), det.peak_block
+            ))
+            for i in range(env.shape[0])
+        ]
+
+    return dense_peaks
 
 
 def bench_cpu_reference(nx, ns, fs, dx):
@@ -271,12 +314,12 @@ def _run_rung_child(spec: dict) -> int:
         )
         out = {"cpu_wall": cpu_wall, "n_picks": n_picks}
     else:
-        wall, n_picks, device, stages, route = bench_tpu(
+        wall, n_picks, device, stages, route, pick_engine = bench_tpu(
             spec["nx"], spec["ns"], spec["fs"], spec["dx"],
             peak_block=spec["peak_block"], **spec["kw"]
         )
         out = {"wall": wall, "n_picks": n_picks, "device": device,
-               "stages": stages, "route": route}
+               "stages": stages, "route": route, "pick_engine": pick_engine}
     print("RUNG_RESULT:" + json.dumps(out), flush=True)
     return 0
 
@@ -498,6 +541,7 @@ def main():
         "n_picks": n_picks,
         "device": device,
         "route": route,
+        "pick_engine": result.get("pick_engine"),
         "cpu_ref_rate": round(cpu_rate, 1) if cpu_rate else None,
         "stage_wall_s": stages,
     }
