@@ -12,6 +12,13 @@ Measures the two claims VERDICT r1 flagged as asserted-but-unmeasured:
 Prints a JSON document; `--markdown` appends a results section to
 docs/PERF.md. Runs on whatever backend jax resolves (records it) — CPU
 numbers are contention-sensitive context, TPU numbers are the real claim.
+
+Wedge defense (safe to run standalone, not only under
+scripts/tpu_session.py): the accelerator is probed with backoff before
+any in-process jax use, and ``--deadline`` arms a hard watchdog that
+kills the process if the tunnel wedges MID-measurement — after a green
+probe — which would otherwise hang it forever (TESTLOG.md round-3 wedge
+during the first canonical bench rung).
 """
 
 from __future__ import annotations
@@ -136,7 +143,25 @@ def main():
         default=float(os.environ.get("DAS_BENCH_DEVICE_TIMEOUT", 120.0)),
         help="seconds to wait for the accelerator before falling back to CPU",
     )
+    ap.add_argument(
+        "--deadline", type=float,
+        default=float(os.environ.get("DAS_PERF_DEADLINE", 1800.0)),
+        help="hard wall deadline (s); a tunnel wedging mid-measurement "
+             "kills the process instead of hanging it (0 disables)",
+    )
     args = ap.parse_args()
+
+    if args.deadline > 0:
+        import threading
+
+        def _expire():
+            print(f"DEADLINE: exceeded {args.deadline:.0f}s "
+                  f"(tunnel wedged mid-measurement?); aborting", flush=True)
+            os._exit(3)
+
+        timer = threading.Timer(args.deadline, _expire)
+        timer.daemon = True
+        timer.start()
 
     # share bench.py's probe/fallback defense (single implementation: the
     # standalone device.py loader + retry-with-backoff probing)
