@@ -376,6 +376,46 @@ def _spawn_rung(spec: dict, timeout_s: float, cpu: bool = False):
     return None, (tail[-1][:300] if tail else f"rc={proc.returncode}, no output")
 
 
+def _roofline_stage_report(stages, route, device, nx, ns):
+    """Map the measured stage walls onto the v5e roofline model
+    (scripts/roofline.py, pure math) so perf regressions are visible in
+    the JSON without re-deriving the model (VERDICT r3 next-6).
+
+    Returns ``(pred_ms, frac)``: per-stage predicted lower-bound walls,
+    and — only when the headline actually ran on a TPU — the achieved
+    fraction of roofline ``pred/actual`` (1.0 = at the HBM/FLOP bound;
+    the fraction is meaningless for a CPU-fallback line and is null
+    there)."""
+    if not stages:
+        return None, None
+    try:
+        from scripts.roofline import model as roofline_model
+    except ImportError:
+        return None, None
+    rows = roofline_model(c=nx, n=ns, fused="+fusedbp" in (route or ""))
+    by = {}
+    for r in rows:
+        for key in ("bandpass", "f-k", "correlate", "envelope", "peaks"):
+            if r["stage"].startswith(key):
+                by[key] = r["pred_ms"]
+    pred = {}
+    for name in stages:
+        if name == "filter":
+            pred[name] = by.get("bandpass", 0.0) + by.get("f-k", 0.0)
+        elif name == "envelope+peaks":
+            pred[name] = by.get("envelope", 0.0) + by.get("peaks", 0.0)
+        elif name in ("correlate", "envelope", "peaks"):
+            pred[name] = by.get(name, 0.0)
+    pred = {k: round(v, 3) for k, v in pred.items()}
+    on_tpu = "TPU" in device and not device.startswith("cpu-fallback")
+    frac = (
+        {k: round(pred[k] / 1e3 / stages[k], 3) for k in pred if stages.get(k)}
+        if on_tpu
+        else None
+    )
+    return pred, frac
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="small shapes (CI smoke)")
@@ -531,6 +571,13 @@ def main():
         else:
             errors.append(f"cpu-baseline: {err}")
 
+    try:
+        roofline_pred, roofline_frac = _roofline_stage_report(
+            stages, route, device, nx, ns
+        )
+    except Exception as e:  # decorative metadata must never cost the JSON line
+        roofline_pred = roofline_frac = None
+        errors.append(f"roofline-report: {e!r:.200}")
     payload = {
         "metric": "OOI-RCA 60s chunk: fk_filter+mf_detect wall-clock; ch*samples/s/chip",
         "value": round(value, 1),
@@ -544,6 +591,8 @@ def main():
         "pick_engine": result.get("pick_engine"),
         "cpu_ref_rate": round(cpu_rate, 1) if cpu_rate else None,
         "stage_wall_s": stages,
+        "roofline_pred_ms": roofline_pred,
+        "roofline_frac": roofline_frac,
     }
     if errors:
         payload["error"] = "; ".join(errors)

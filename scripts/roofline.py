@@ -1,4 +1,4 @@
-"""Roofline cost model of the flagship pipeline at canonical shape.
+"""Roofline cost model of the flagship pipeline: single chip AND v5e-8.
 
 Computes per-stage FLOPs and HBM traffic for the 22050x12000 matched-
 filter detection pipeline and converts them to lower-bound stage walls on
@@ -8,7 +8,20 @@ against. FFT cost model: 5 N log2 N flops per complex length-N transform,
 2.5 N log2 N for rfft/irfft; every stage is assumed HBM-bound unless its
 arithmetic intensity clears the ridge (~120 flops/byte at f32).
 
-Prints a markdown table (used for the PERF.md "Roofline" section).
+The ``--chips P`` projection models the channel-sharded step
+(parallel/pipeline.py): per-shard compute is the single-chip model over
+C/P channels, plus the ONLY communication that path performs — the two
+banded ``all_to_all`` transposes of the distributed f-k transform
+(parallel/fft.py:fk_apply_local_banded, in-band columns only) and one
+scalar ``pmax`` for the per-file threshold. ICI model: v5e 2-D torus,
+~45 GB/s per axis one-way per chip, both axes usable by all_to_all on an
+8-chip slice => ~90 GB/s effective per-chip injection; each chip sends
+(P-1)/P of its band to peers. Latency (~1 us/hop) is charged to the
+pmax and is negligible at these volumes.
+
+Prints markdown tables (the PERF.md "Roofline" sections). The model is
+importable (``model(...)``, ``model_sharded(...)``) — bench.py uses it
+to report achieved fraction-of-roofline per stage.
 """
 
 from __future__ import annotations
@@ -17,12 +30,13 @@ import math
 
 HBM_GBS = 819e9          # v5e HBM bandwidth
 F32_FLOPS = 98e12        # v5e f32 peak (MXU bf16 is 197e12)
+ICI_GBS = 90e9           # v5e effective per-chip all_to_all injection BW
+PMAX_LATENCY_S = 20e-6   # scalar pmax across the slice (latency-bound)
 
+# canonical OOI working selection (BASELINE.md; 22050 = 2*3^2*5^2*7^2)
 C, N = 22050, 12000
-NF_BP = 12150            # bandpass zero-phase rfft length (padded, 5-smooth)
-NF_XC = 12150            # true-length-template correlate rfft length
-F_HALF = N // 2 + 1      # rfft bins of the f-k spectrum
-BAND = 960               # in-band columns kept by the banded applier (14-30 Hz)
+FS = 200.0
+BAND_HZ = (14.0, 30.0)   # script bandpass band -> in-band rfft columns
 NT = 2                   # templates
 B = 4                    # f32 bytes
 
@@ -35,68 +49,151 @@ def cfft_flops(n):
     return 5.0 * n * math.log2(n)
 
 
-def stage(name, flops, bytes_moved):
+def stage(name, flops, bytes_moved, comm_s=0.0):
     t_flops = flops / F32_FLOPS
     t_hbm = bytes_moved / HBM_GBS
-    bound = "HBM" if t_hbm >= t_flops else "FLOP"
+    if comm_s > max(t_hbm, t_flops):
+        bound = "ICI"
+    elif t_hbm >= t_flops:
+        bound = "HBM"
+    else:
+        bound = "FLOP"
     return {
         "stage": name,
         "gflops": flops / 1e9,
         "hbm_gb": bytes_moved / 1e9,
-        "intensity": flops / bytes_moved,
-        "pred_ms": max(t_hbm, t_flops) * 1e3,
+        "intensity": flops / bytes_moved if bytes_moved else float("inf"),
+        "pred_ms": (max(t_hbm, t_flops) + comm_s) * 1e3,
         "bound": bound,
     }
 
 
-def model():
+def _derived(c, n, fs, band_hz):
+    """Shape-derived model constants: padded rfft lengths and the banded
+    applier's in-band column count."""
+    nf_pad = int(n * 1.0125)             # 5-smooth zero-phase/correlate pad
+    f_half = n // 2 + 1
+    band = min(f_half, int(round((band_hz[1] - band_hz[0]) * n / fs)))
+    return nf_pad, f_half, band
+
+
+def model(c=C, n=N, fs=FS, band_hz=BAND_HZ, nt=NT, fused=False):
+    """Single-chip per-stage roofline rows for a [c x n] block."""
+    nf_bp, f_half, band = _derived(c, n, fs, band_hz)
+    nf_xc = nf_bp
     rows = []
-    # 1. bandpass: rfft -> gain mul -> irfft per channel (ops/filters.py)
-    fl = C * (2 * rfft_flops(NF_BP) + 6 * (NF_BP / 2 + 1))
-    by = B * C * (N + 2 * (NF_BP / 2 + 1) * 2 + N)      # in, spec rw (c64), out
-    rows.append(stage("bandpass |H|^2", fl, by))
+    if not fused:
+        # 1. bandpass: rfft -> gain mul -> irfft per channel (ops/filters.py)
+        fl = c * (2 * rfft_flops(nf_bp) + 6 * (nf_bp / 2 + 1))
+        by = B * c * (n + 2 * (nf_bp / 2 + 1) * 2 + n)  # in, spec rw (c64), out
+        rows.append(stage("bandpass |H|^2", fl, by))
 
     # 2. banded f-k: rfft(time) + band fft/ifft(channel) + mask + irfft(time)
-    fl = C * (rfft_flops(N) + rfft_flops(N)) + BAND * 2 * cfft_flops(C) + 6 * C * BAND
-    by = B * (C * N                       # read
-              + 2 * C * F_HALF * 2        # half-spectrum write+read (c64)
-              + 4 * C * BAND * 2          # band slice rw twice (c64)
-              + C * N)                    # out
-    rows.append(stage("f-k apply (banded)", fl, by))
+    fl = c * (rfft_flops(n) + rfft_flops(n)) + band * 2 * cfft_flops(c) + 6 * c * band
+    by = B * (c * n                       # read
+              + 2 * c * f_half * 2        # half-spectrum write+read (c64)
+              + 4 * c * band * 2          # band slice rw twice (c64)
+              + c * n)                    # out
+    rows.append(stage("f-k apply (banded)" + (" +fusedbp" if fused else ""), fl, by))
 
     # 3. correlate (tiled): norm + rfft + NT (mul + irfft) + suffix cumsum
-    fl = C * (rfft_flops(NF_XC) + NT * (rfft_flops(NF_XC) + 6 * (NF_XC / 2 + 1)) + 4 * N)
-    by = B * (C * N * 2                   # read + normalized rw
-              + C * (NF_XC / 2 + 1) * 2   # spectrum (c64)
-              + NT * C * N)               # correlogram out
-    rows.append(stage(f"correlate x{NT} (tiled)", fl, by))
+    fl = c * (rfft_flops(nf_xc) + nt * (rfft_flops(nf_xc) + 6 * (nf_xc / 2 + 1)) + 4 * n)
+    by = B * (c * n * 2                   # read + normalized rw
+              + c * (nf_xc / 2 + 1) * 2   # spectrum (c64)
+              + nt * c * n)               # correlogram out
+    rows.append(stage(f"correlate x{nt} (tiled)", fl, by))
 
     # 4. envelope: analytic signal = fft + ifft on [NT, C, N] + abs
-    fl = NT * C * (cfft_flops(N) + 2 * N)
-    by = B * (NT * C * N * 2 + NT * C * N * 2 * 2)  # corr rw + c64 spectrum rw
+    fl = nt * c * (cfft_flops(n) + 2 * n)
+    by = B * (nt * c * n * 2 + nt * c * n * 2 * 2)  # corr rw + c64 spectrum rw
     rows.append(stage("envelope (Hilbert)", fl, by))
 
     # 5. sparse peaks: ~6 elementwise/scan passes over [NT, C, N] + top-k
-    fl = NT * C * N * 12
-    by = B * NT * C * N * 6
+    fl = nt * c * n * 12
+    by = B * nt * c * n * 6
     rows.append(stage("peaks (sparse)", fl, by))
 
     return rows
 
 
-def main():
-    rows = model()
+def model_sharded(p=8, c=C, n=N, fs=FS, band_hz=BAND_HZ, nt=NT, fused=False):
+    """Per-chip rows for the channel-sharded step over ``p`` chips.
+
+    Every pipeline stage is embarrassingly parallel over channels (the
+    channel FFT runs on full-c columns but only band/p of them — also a
+    1/p split), so per-shard compute/HBM is the single-chip model at
+    c_pad/p channels. Communication added where it occurs:
+
+    * f-k stage: two banded all_to_alls; each chip sends its local
+      [c_pad/p, band_pad] c64 block minus the diagonal, i.e.
+      (c_pad/p)*band_pad*8*(p-1)/p bytes, at ICI_GBS.
+    * threshold: one scalar pmax (pure latency).
+    """
+    c_pad = -(-c // p) * p               # sharded step divisibility pad
+    lc = c_pad // p
+    _, _, band = _derived(c_pad, n, fs, band_hz)
+    band_pad = -(-band // p) * p
+
+    rows = model(c=lc, n=n, fs=fs, band_hz=band_hz, nt=nt, fused=fused)
+    # correction: the channel FFT/IFFT inside the local model was costed at
+    # lc-length transforms; the sharded step runs c_pad-length transforms on
+    # band_pad/p columns. Same 1/p scaling of the single-chip cost, but the
+    # log factor differs — recompute the f-k row exactly.
+    fk_i = 0 if fused else 1
+    fl = (lc * (rfft_flops(n) + rfft_flops(n))
+          + (band_pad / p) * 2 * cfft_flops(c_pad) + 6 * lc * band)
+    by = rows[fk_i]["hbm_gb"] * 1e9    # HBM traffic is per-row: reuse model()'s
+    a2a_bytes = lc * band_pad * 8 * (p - 1) / p
+    comm_s = 2 * a2a_bytes / ICI_GBS
+    rows[fk_i] = stage(
+        rows[fk_i]["stage"] + f" +2*all_to_all({2 * a2a_bytes / 1e6:.1f} MB)",
+        fl, by, comm_s=comm_s,
+    )
+    rows.insert(fk_i + 1, {
+        "stage": "threshold pmax", "gflops": 0.0, "hbm_gb": 0.0,
+        "intensity": 0.0, "pred_ms": PMAX_LATENCY_S * 1e3, "bound": "ICI",
+    })
+    return rows, c_pad
+
+
+def print_rows(rows, c_total, n, label):
     total = sum(r["pred_ms"] for r in rows)
+    print(f"### {label}")
+    print()
     print("| stage | GFLOPs | HBM GB | flops/byte | bound | predicted ms |")
     print("|---|---|---|---|---|---|")
     for r in rows:
         print(f"| {r['stage']} | {r['gflops']:.0f} | {r['hbm_gb']:.1f} "
-              f"| {r['intensity']:.0f} | {r['bound']} | {r['pred_ms']:.1f} |")
-    print(f"| **total** | | | | | **{total:.0f}** |")
-    rate = C * N / (total / 1e3)
+              f"| {r['intensity']:.0f} | {r['bound']} | {r['pred_ms']:.2f} |")
+    print(f"| **total** | | | | | **{total:.1f}** |")
+    rate = c_total * n / (total / 1e3)
     print()
-    print(f"Predicted single-chip rate: {rate:.2e} ch*samples/s "
-          f"({total:.0f} ms per 60 s file)")
+    print(f"Predicted rate: {rate:.2e} ch*samples/s "
+          f"({total:.1f} ms per 60 s file)")
+    print()
+    return total
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chips", type=int, default=8)
+    ap.add_argument("--fused", action="store_true",
+                    help="model the fused-bandpass route (bench default)")
+    args = ap.parse_args()
+
+    t1 = print_rows(model(fused=args.fused), C, N, "single v5e chip (per-file)")
+    rows8, c_pad = model_sharded(args.chips, fused=args.fused)
+    t8 = print_rows(
+        rows8, c_pad, N,
+        f"v5e-{args.chips} channel-sharded (per-chip, {c_pad // args.chips} "
+        f"rows/chip of {c_pad} padded channels)",
+    )
+    print(f"Projected v5e-{args.chips} wall for one canonical file: "
+          f"{t8:.1f} ms — north star is <2000 ms (BASELINE.md), "
+          f"headroom {2000 / t8:.0f}x; scaling efficiency vs ideal "
+          f"single-chip/{args.chips}: {t1 / args.chips / t8:.0%}.")
 
 
 if __name__ == "__main__":

@@ -171,6 +171,10 @@ def test_fallback_stage_breakdown_consistent_with_wall():
     # separately-synced stage programs slightly exceed the fused wall;
     # an engine mismatch is an order-of-magnitude disagreement
     assert 0.3 * p["wall_s"] <= ssum <= 3.0 * p["wall_s"], (ssum, p)
+    # the v5e roofline predictions ride along for every stage, but the
+    # achieved-fraction field is null off-TPU (meaningless on a CPU wall)
+    assert set(p["roofline_pred_ms"]) == set(stages)
+    assert p["roofline_frac"] is None
 
 
 def test_truncated_rung_result_line_is_a_rung_failure():

@@ -124,6 +124,56 @@ def test_detector_auto_route_would_tile_at_canonical_shape():
     assert xcorr._xcorr_full_len(N, M_TRUE) < 0.55 * nfft
 
 
+@pytest.fixture(scope="module")
+def sharded_canonical():
+    """Canonical-shape design (channels padded to a multiple of 8) + the
+    (file=1, channel=8) mesh for per-shard AOT analysis. One ~90 s f-k
+    design build shared by the sharded-budget tests."""
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import design_matched_filter
+    from das4whales_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device host mesh (tests/conftest.py)")
+    c8 = -(-C // 8) * 8                     # 22056
+    meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=c8, ns=N)
+    design = design_matched_filter((c8, N), [0, c8, 1], meta)
+    mesh = make_mesh(shape=(1, 8), axis_names=("file", "channel"))
+    return design, mesh, c8
+
+
+@pytest.mark.parametrize("outputs,out_cap_gib", [("picks", 1 / 32), ("full", 1.0)])
+def test_sharded_step_per_shard_budget(sharded_canonical, outputs, out_cap_gib):
+    """Per-shard AOT memory of the channel-sharded step at canonical shape
+    over 8 shards (VERDICT r3 next-4): ``memory_analysis()`` of the SPMD
+    executable reports PER-DEVICE sizes (verified: argument size equals
+    the [1, 22056, 12000] input / 8), so the assertion bounds what ONE
+    v5e chip must hold. Campaign mode ('picks') must additionally keep
+    program outputs tiny — the whole point of not materializing the
+    correlograms. Same CPU-buffer-assignment lower-bound caveat as the
+    single-chip tests above."""
+    from das4whales_tpu.parallel import make_sharded_mf_step
+    from das4whales_tpu.parallel.pipeline import input_sharding
+
+    design, mesh, c8 = sharded_canonical
+    step = make_sharded_mf_step(
+        design, mesh, outputs=outputs, fused_bandpass=True
+    )
+    aval = jax.ShapeDtypeStruct(
+        (1, c8, N), jnp.float32, sharding=input_sharding(mesh)
+    )
+    ma = step.lower(aval).compile().memory_analysis()
+    per_shard = ma.temp_size_in_bytes + ma.output_size_in_bytes
+    # 8 GiB: the detector's single-chip routing budget — per-shard usage
+    # beyond it would erase the sharding's memory advantage on 16 GiB HBM
+    assert per_shard < 8 * 2**30, f"{per_shard/2**30:.2f} GiB/shard"
+    assert ma.output_size_in_bytes < out_cap_gib * 2**30, (
+        f"{ma.output_size_in_bytes/2**30:.2f} GiB outputs ({outputs})"
+    )
+    # per-device argument size proves the analysis is per-shard, not global
+    assert ma.argument_size_in_bytes < 2 * (4 * c8 * N) / 8
+
+
 def test_spectro_chunk_rfft_footprint(monkeypatch):
     """The spectro detector's per-chunk program under the rFFT engine must
     stay under ~2.5 GiB of temps at the shipped rFFT default batch — the
