@@ -1,0 +1,313 @@
+"""Full-scale certification of the DISTRIBUTED paths (VERDICT r3 next-5).
+
+VALIDATION.md certifies the single-chip detector at canonical shape; the
+sharded and long-record paths were certified only at tiny CI shapes.
+This script runs, on the 8-virtual-device CPU host mesh:
+
+1. **Channel-sharded parity at canonical shape** — the multi-chip step
+   (`parallel/pipeline.py:make_sharded_mf_step`, the two banded
+   ``all_to_all`` transposes + ``pmax`` threshold) on a
+   ``[22056 x 12000]`` scene vs the single-chip
+   ``MatchedFilterDetector`` on the same block, pick-for-pick (±2
+   samples). Both run the sparse pick engine so the comparison isolates
+   the *distribution* (pencil f-k decomposition, collectives), not the
+   pick algorithm. The reference accepts per-chunk boundary ERROR in its
+   only scale-out path (dask ``filtfilt``, tools.py:166) — this proves
+   the sharded path is exact at scale instead.
+
+2. **Multi-file long-record parity** — ``detect_long_record`` (halo-
+   exchange time-sharded, workflows/longrecord.py) over consecutive
+   files written to disk, vs the single-chip detector on the
+   concatenated record, at the largest shape the host sustains.
+
+Appends/refreshes a marker-delimited section in VALIDATION.md and dumps
+raw numbers to artifacts/validate_sharded.json. All CPU (forced off the
+accelerator); walls are recorded for the record, not as perf claims —
+the host here has ONE core under the 8-device mesh.
+
+Usage: python scripts/validate_sharded.py [--nx 22056] [--ns 12000]
+       [--lr-nx 4096] [--lr-files 4] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from datetime import datetime, timezone
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+MARKER = "## Sharded-path certification"
+END_MARKER = "<!-- /sharded-path-certification -->"
+FS, DX = 200.0, 2.042
+
+
+def _force_cpu_mesh(n=8):
+    from bench import _device_utils  # shared pre-jax device.py loader
+
+    _device_utils().force_cpu_host_devices(n)
+
+
+def sharded_canonical_parity(nx, ns):
+    """Part 1: channel-sharded step vs single-chip detector, same block."""
+    import jax
+    import jax.numpy as jnp
+
+    from scripts.validate_full_scale import make_scene, match_picks
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import (
+        MatchedFilterDetector,
+        design_matched_filter,
+    )
+    from das4whales_tpu.parallel import make_sharded_mf_step
+    from das4whales_tpu.parallel.mesh import make_mesh
+    from das4whales_tpu.parallel.pipeline import input_sharding
+    from das4whales_tpu.ops import peaks as peak_ops
+
+    assert nx % 8 == 0, "channel-sharded step needs nx divisible by 8"
+    block, truth = make_scene(nx, ns)
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+
+    # single-chip reference: sparse engine to isolate the distribution
+    t0 = time.perf_counter()
+    det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns), pick_mode="sparse",
+                                max_peaks=256)
+    t_design = time.perf_counter() - t0
+    x = jnp.asarray(block)
+    t0 = time.perf_counter()
+    res = det(x)
+    jax.block_until_ready(res.trf_fk)
+    t_single_first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = det(x)
+    jax.block_until_ready(res.trf_fk)
+    t_single = time.perf_counter() - t0
+    single_picks = {k: np.asarray(v) for k, v in res.picks.items()}
+
+    # sharded step on the (file=1, channel=8) mesh, campaign outputs
+    mesh = make_mesh(shape=(1, 8), axis_names=("file", "channel"))
+    design = design_matched_filter((nx, ns), [0, nx, 1], meta)
+    step = make_sharded_mf_step(design, mesh, outputs="picks")
+    xb = jax.device_put(x[None], input_sharding(mesh))
+    t0 = time.perf_counter()
+    sp_picks, thres = jax.block_until_ready(step(xb))
+    t_shard_first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sp_picks, thres = jax.block_until_ready(step(xb))
+    t_shard = time.perf_counter() - t0
+
+    names = design.template_names
+    positions = np.asarray(sp_picks.positions)[:, 0]     # [nT, C, K]
+    selected = np.asarray(sp_picks.selected)[:, 0]
+    rows = []
+    for i, name in enumerate(names):
+        shard_pk = peak_ops.sparse_to_pick_times(positions[i], selected[i])
+        m, only_s, only_1, moff = match_picks(shard_pk, single_picks[name])
+        rows.append({
+            "template": name,
+            "sharded_picks": int(shard_pk.shape[1]),
+            "single_picks": int(single_picks[name].shape[1]),
+            "matched_pm2": m, "only_sharded": only_s, "only_single": only_1,
+            "max_offset": moff,
+        })
+        print(f"  {name}: {json.dumps(rows[-1])}", flush=True)
+    timings = {
+        "design_s": t_design,
+        "single_first_s": t_single_first, "single_steady_s": t_single,
+        "sharded_first_s": t_shard_first, "sharded_steady_s": t_shard,
+    }
+    return rows, timings
+
+
+def longrecord_parity(nx, n_files, ns_file, workdir):
+    """Part 2: detect_long_record over files vs single-chip on the
+    concatenated record."""
+    import jax
+    import jax.numpy as jnp
+
+    from scripts.validate_full_scale import make_scene, match_picks
+    from das4whales_tpu import io as dio
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.workflows.longrecord import detect_long_record
+
+    total = n_files * ns_file
+    block, truth = make_scene(nx, total, n_calls=16, seed=11)
+    # write as int counts; detection is scale-invariant (relative thresholds)
+    q = float(np.max(np.abs(block))) / 2**23
+    paths = []
+    for k in range(n_files):
+        raw = np.round(block[:, k * ns_file:(k + 1) * ns_file] / q).astype(np.int32)
+        paths.append(dio.write_optasense(
+            os.path.join(workdir, f"seg{k}.h5"), raw, fs=FS, dx=DX
+        ))
+
+    meta = dio.get_acquisition_parameters(paths[0], "optasense")
+    t0 = time.perf_counter()
+    lr = detect_long_record(paths, [0, nx, 1], meta, halo=512)
+    t_lr = time.perf_counter() - t0
+
+    # single-chip reference on the same loaded record
+    record = np.concatenate(
+        [np.asarray(dio.load_das_data(p, [0, nx, 1], meta).trace) for p in paths],
+        axis=-1,
+    )
+    det = MatchedFilterDetector(meta, [0, nx, 1], (nx, total),
+                                pick_mode="sparse", max_peaks=512)
+    t0 = time.perf_counter()
+    res = det(jnp.asarray(record))
+    jax.block_until_ready(res.trf_fk)
+    t_single = time.perf_counter() - t0
+
+    rows = []
+    for name in lr.picks:
+        m, only_lr, only_1, moff = match_picks(
+            np.asarray(lr.picks[name]), np.asarray(res.picks[name])
+        )
+        rows.append({
+            "template": name,
+            "longrecord_picks": int(lr.picks[name].shape[1]),
+            "single_picks": int(np.asarray(res.picks[name]).shape[1]),
+            "matched_pm2": m, "only_longrecord": only_lr,
+            "only_single": only_1, "max_offset": moff,
+        })
+        print(f"  {name}: {json.dumps(rows[-1])}", flush=True)
+    return rows, {"longrecord_s": t_lr, "single_incl_compile_s": t_single,
+                  "shape": [nx, total], "n_files": n_files}
+
+
+def write_section(path, shape1, rows1, t1, rows2, t2):
+    stamp = datetime.now(timezone.utc).strftime("%Y-%m-%d %H:%MZ")
+    lines = [
+        MARKER,
+        "",
+        f"Generated {stamp} by `scripts/validate_sharded.py` on the "
+        "8-virtual-device CPU host mesh (single-core host; walls are "
+        "records, not perf claims). The reference's only scale-out path "
+        "accepts per-chunk boundary error (`tools.py:166`); both "
+        "distributed paths here are certified pick-for-pick against the "
+        "single-chip detector at scale.",
+        "",
+        f"### Channel-sharded step at `[{shape1[0]} x {shape1[1]}]` "
+        "(1 file x 8 channel shards)",
+        "",
+        "Same block, same sparse pick engine; differences isolate the "
+        "pencil f-k decomposition + collectives.",
+        "",
+        "| template | sharded picks | single-chip picks | matched ±2 "
+        "| only sharded | only single | max offset |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows1:
+        lines.append(
+            f"| {r['template']} | {r['sharded_picks']} | {r['single_picks']} "
+            f"| {r['matched_pm2']} | {r['only_sharded']} | {r['only_single']} "
+            f"| {r['max_offset']} |"
+        )
+    lines += [
+        "",
+        f"Walls: single-chip steady {t1['single_steady_s']:.1f} s, sharded "
+        f"steady {t1['sharded_steady_s']:.1f} s (first calls "
+        f"{t1['single_first_s']:.0f}/{t1['sharded_first_s']:.0f} s incl. "
+        "compile; 8 shards timeshare one host core here — on real chips the "
+        "shards run concurrently, see the v5e-8 roofline projection in "
+        "docs/PERF.md).",
+        "",
+        f"### Long-record (time-sharded) over {t2['n_files']} files, "
+        f"record `[{t2['shape'][0]} x {t2['shape'][1]}]`",
+        "",
+        "`detect_long_record` (halo-exchange sequence parallelism) vs the "
+        "single-chip detector on the concatenated record:",
+        "",
+        "| template | long-record picks | single-chip picks | matched ±2 "
+        "| only long-record | only single | max offset |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows2:
+        lines.append(
+            f"| {r['template']} | {r['longrecord_picks']} "
+            f"| {r['single_picks']} | {r['matched_pm2']} "
+            f"| {r['only_longrecord']} | {r['only_single']} "
+            f"| {r['max_offset']} |"
+        )
+    lines += [
+        "",
+        f"Walls: long-record workflow {t2['longrecord_s']:.1f} s "
+        "(streamed ingest + sharded detect, incl. compile), single-chip "
+        f"{t2['single_incl_compile_s']:.1f} s (detect only, incl. compile).",
+        "",
+        END_MARKER,
+        "",
+    ]
+    try:
+        with open(path) as fh:
+            existing = fh.read()
+    except OSError:
+        existing = "# Full-scale validation\n\n"
+    if MARKER in existing:
+        # replace ONLY the marker-delimited section; content after the end
+        # marker (or the whole tail, for a legacy end-marker-less section
+        # this script itself wrote) is preserved
+        head = existing[: existing.index(MARKER)].rstrip() + "\n\n"
+        rest = existing[existing.index(MARKER):]
+        tail = ""
+        if END_MARKER in rest:
+            tail = rest[rest.index(END_MARKER) + len(END_MARKER):].lstrip("\n")
+            if tail:
+                tail = "\n" + tail
+        existing = head
+    else:
+        tail = ""
+        if not existing.endswith("\n\n"):
+            existing = existing.rstrip() + "\n\n"
+    with open(path, "w") as fh:
+        fh.write(existing + "\n".join(lines) + tail)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nx", type=int, default=22056)      # canonical, /8
+    ap.add_argument("--ns", type=int, default=12000)
+    ap.add_argument("--lr-nx", type=int, default=4096)
+    ap.add_argument("--lr-files", type=int, default=4)
+    ap.add_argument("--lr-ns-file", type=int, default=12000)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes (smoke): 512x3000 + 256x4x2048")
+    ap.add_argument("--out", default=os.path.join(ROOT, "VALIDATION.md"))
+    args = ap.parse_args()
+    if args.quick:
+        args.nx, args.ns = 512, 3000
+        args.lr_nx, args.lr_files, args.lr_ns_file = 256, 4, 2048
+
+    _force_cpu_mesh(8)
+
+    print(f"[1/2] channel-sharded parity at [{args.nx} x {args.ns}]", flush=True)
+    rows1, t1 = sharded_canonical_parity(args.nx, args.ns)
+    print(f"  walls: {json.dumps({k: round(v, 1) for k, v in t1.items()})}",
+          flush=True)
+
+    print(f"[2/2] long-record parity at [{args.lr_nx} x "
+          f"{args.lr_files}*{args.lr_ns_file}]", flush=True)
+    with tempfile.TemporaryDirectory() as d:
+        rows2, t2 = longrecord_parity(args.lr_nx, args.lr_files,
+                                      args.lr_ns_file, d)
+    print(f"  walls: {json.dumps({k: (round(v, 1) if isinstance(v, float) else v) for k, v in t2.items()})}",
+          flush=True)
+
+    os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
+    with open(os.path.join(ROOT, "artifacts", "validate_sharded.json"), "w") as fh:
+        json.dump({"sharded": {"shape": [args.nx, args.ns], "rows": rows1,
+                               "timings": t1},
+                   "longrecord": {"rows": rows2, "timings": t2}}, fh, indent=1)
+    write_section(args.out, (args.nx, args.ns), rows1, t1, rows2, t2)
+    print("wrote", args.out, "and artifacts/validate_sharded.json")
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)
