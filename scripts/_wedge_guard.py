@@ -1,0 +1,62 @@
+"""Shared wedge defense for the standalone measurement scripts.
+
+Two hazards on this image (TESTLOG.md): a wedged accelerator tunnel can
+(a) hang the first in-process jax backend use forever, and (b) wedge
+MID-measurement after a green probe. ``resolve_backend`` fences (a) with
+bench.py's subprocess probe-with-backoff + CPU fallback; ``arm_deadline``
+fences (b) with a hard process-killing timer. Scripts run under
+``scripts/tpu_session.py`` are additionally deadline-guarded from
+outside; these make them safe to run by hand too.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+def arm_deadline(seconds: float) -> None:
+    """Kill the process (exit 3) after ``seconds`` — a tunnel wedging
+    mid-measurement must never hang a standalone run. 0 disables."""
+    if seconds <= 0:
+        return
+    import threading
+
+    def _expire():
+        print(f"DEADLINE: exceeded {seconds:.0f}s "
+              f"(tunnel wedged mid-measurement?); aborting", flush=True)
+        os._exit(3)
+
+    timer = threading.Timer(seconds, _expire)
+    timer.daemon = True
+    timer.start()
+
+
+def resolve_backend(device_timeout_s: float | None = None) -> bool:
+    """Decide the backend BEFORE any in-process jax use.
+
+    ``JAX_PLATFORMS=cpu`` is honored directly through the live config (the
+    env var alone is applied too late under this image's sitecustomize).
+    ANY other value — including this image's profile default
+    ``JAX_PLATFORMS=axon`` — still means an accelerator backend, so the
+    tunnel is probed in subprocesses with backoff first
+    (``DAS_BENCH_DEVICE_TIMEOUT`` overrides the budget — tpu_session sets
+    it low for its children, which run right after a green probe) and a
+    dead tunnel falls back to single-device CPU. Treating a non-cpu env
+    value as "trusted, skip the probe" is exactly how a wedged tunnel
+    hangs the script. Returns True iff it fell back."""
+    from bench import _device_utils, _probe_device_with_backoff
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _device_utils().force_cpu_host_devices(1)
+        return False
+    if device_timeout_s is None:
+        device_timeout_s = float(os.environ.get("DAS_BENCH_DEVICE_TIMEOUT", 120.0))
+    if not _probe_device_with_backoff(device_timeout_s):
+        _device_utils().force_cpu_host_devices(1)
+        return True
+    return False

@@ -21,13 +21,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     nx, ns = (22050, 12000) if "--quick" not in sys.argv else (1050, 3000)
-    if os.environ.get("JAX_PLATFORMS"):
-        # honor the env var through the live config too — under this
-        # image's sitecustomize the env var alone cannot keep jax off a
-        # wedged accelerator (tests/conftest.py)
-        import jax
+    from scripts._wedge_guard import arm_deadline, resolve_backend
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    arm_deadline(float(os.environ.get("DAS_PERF_DEADLINE", 1800.0)))
+    fallback = resolve_backend()
+    if fallback:
+        print("accelerator unreachable; timing the A/B on CPU fallback",
+              flush=True)
     import jax
     import jax.numpy as jnp
 

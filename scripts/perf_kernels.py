@@ -151,28 +151,10 @@ def main():
     )
     args = ap.parse_args()
 
-    if args.deadline > 0:
-        import threading
+    from scripts._wedge_guard import arm_deadline, resolve_backend
 
-        def _expire():
-            print(f"DEADLINE: exceeded {args.deadline:.0f}s "
-                  f"(tunnel wedged mid-measurement?); aborting", flush=True)
-            os._exit(3)
-
-        timer = threading.Timer(args.deadline, _expire)
-        timer.daemon = True
-        timer.start()
-
-    # share bench.py's probe/fallback defense (single implementation: the
-    # standalone device.py loader + retry-with-backoff probing)
-    from bench import _device_utils, _probe_device_with_backoff
-
-    fallback = False
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        _device_utils().force_cpu_host_devices(1)
-    elif not _probe_device_with_backoff(args.device_timeout):
-        _device_utils().force_cpu_host_devices(1)
-        fallback = True
+    arm_deadline(args.deadline)
+    fallback = resolve_backend(args.device_timeout)
     import jax
 
     device = str(jax.devices()[0])

@@ -26,12 +26,15 @@ def main():
     ap.add_argument("--logdir", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "artifacts", "profile"))
+    ap.add_argument("--deadline", type=float,
+                    default=float(os.environ.get("DAS_PERF_DEADLINE", 1500.0)))
     args = ap.parse_args()
 
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
+    from scripts._wedge_guard import arm_deadline, resolve_backend
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    arm_deadline(args.deadline)
+    if resolve_backend():
+        print("accelerator unreachable; tracing the CPU fallback", flush=True)
     import jax
     import jax.numpy as jnp
 

@@ -77,9 +77,14 @@ def log_event(event: dict) -> None:
 
 def run_step(name: str, argv, timeout_s: float) -> dict:
     t0 = time.perf_counter()
+    # children run right after a green probe: shrink their own probe
+    # budgets so the short live window goes to measurements, not re-probing
+    env = dict(os.environ)
+    env.setdefault("DAS_BENCH_DEVICE_TIMEOUT", "45")
     try:
         proc = subprocess.run(
-            argv, cwd=ROOT, timeout=timeout_s, capture_output=True, text=True
+            argv, cwd=ROOT, timeout=timeout_s, capture_output=True, text=True,
+            env=env,
         )
         out = {"step": name, "rc": proc.returncode,
                "wall_s": round(time.perf_counter() - t0, 1),
