@@ -579,6 +579,7 @@ def main():
     value = nx * ns / wall
 
     cpu_rate = None
+    cpu_ref_mode = None
     vs = float("nan")
     if not args.no_cpu:
         base_spec = {"cpu_baseline": True, "nx": cpu_nx, "ns": ns, "fs": fs, "dx": dx}
@@ -588,6 +589,16 @@ def main():
         if base is not None:
             cpu_rate = cpu_nx * ns / base["cpu_wall"]  # linear-in-channels extrapolation
             vs = value / cpu_rate
+            # the extrapolation FLATTERS the baseline when nx >> cpu_nx:
+            # the direct canonical-shape golden measured 226 s where the
+            # 1050-channel rate extrapolates to ~105 s (float64 fft2 at
+            # [22k x 12k] thrashes; VALIDATION.md) — so vs_baseline is a
+            # LOWER bound at full shape. Name the mode so the artifact
+            # can't be read as a same-shape measurement.
+            cpu_ref_mode = (
+                "measured-same-shape" if cpu_nx == nx
+                else f"linear-extrapolated(nx={cpu_nx})"
+            )
         else:
             errors.append(f"cpu-baseline: {err}")
 
@@ -610,6 +621,7 @@ def main():
         "route": route,
         "pick_engine": result.get("pick_engine"),
         "cpu_ref_rate": round(cpu_rate, 1) if cpu_rate else None,
+        "cpu_ref_mode": cpu_ref_mode,
         "stage_wall_s": stages,
         "roofline_pred_ms": roofline_pred,
         "roofline_frac": roofline_frac,

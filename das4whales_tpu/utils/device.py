@@ -44,17 +44,22 @@ def probe_backend(timeout_s: float) -> int:
 
 
 def force_cpu_host_devices(n_devices: int) -> None:
-    """Point this process at an ``n_devices``-device virtual CPU mesh.
+    """Point this process at a virtual CPU mesh of AT LEAST ``n_devices``.
 
-    Must run before the first JAX backend use. Overwrites any existing
-    ``--xla_force_host_platform_device_count`` flag (a stale smaller value
-    would silently cap the mesh below ``n_devices``).
+    Must run before the first JAX backend use. A stale smaller
+    ``--xla_force_host_platform_device_count`` flag is raised to
+    ``n_devices`` (it would silently cap the mesh), but a LARGER
+    pre-set count is kept: a caller that only needs one device (the
+    bench fallback) must not collapse a deliberately requested 8-device
+    mesh (the multi-chip dry run, tests/conftest.py).
     """
     import jax
 
-    flag = f"--xla_force_host_platform_device_count={n_devices}"
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" in flags:
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    count = max(n_devices, int(m.group(1)) if m else 0)
+    flag = f"--xla_force_host_platform_device_count={count}"
+    if m:
         flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
     else:
         flags = (flags + " " + flag).strip()

@@ -1,0 +1,131 @@
+"""Control-flow tests for the session-long tunnel watchdog.
+
+The watchdog's job is to spend a short, unpredictable TPU window on the
+measurement agenda (scripts/tpu_session.py) without human latency. These
+tests script probe()/run_session() (no subprocesses, no jax) and assert
+the vigil's decisions: fire on the first green probe, exit once the
+agenda is done, back off exponentially when a step fails
+deterministically while the tunnel stays up, and keep probing after a
+mid-agenda wedge.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import scripts.tpu_watchdog as wd  # noqa: E402
+from scripts.tpu_session import AGENDA  # noqa: E402
+
+
+@pytest.fixture
+def quiet_log(monkeypatch, tmp_path):
+    monkeypatch.setattr(wd, "LOG", str(tmp_path / "log.jsonl"))
+    return wd.LOG
+
+
+def _state_file(tmp_path, monkeypatch, done_steps):
+    state = str(tmp_path / "state.json")
+    monkeypatch.setattr(wd, "SESSION_STATE", state)
+    with open(state, "w") as fh:
+        json.dump({n: {"status": "done"} for n in done_steps}, fh)
+    return state
+
+
+def run_main(monkeypatch, argv):
+    monkeypatch.setattr(sys, "argv", ["tpu_watchdog.py", *argv])
+    return wd.main()
+
+
+def test_agenda_progress_counts(monkeypatch, tmp_path):
+    _state_file(tmp_path, monkeypatch, [n for n, _, _ in AGENDA][:2])
+    assert wd.agenda_progress() == (2, len(AGENDA))
+    assert wd.agenda_done() is False
+    _state_file(tmp_path, monkeypatch, [n for n, _, _ in AGENDA])
+    assert wd.agenda_done() is True
+
+
+def test_exits_zero_once_agenda_done(monkeypatch, tmp_path, quiet_log):
+    _state_file(tmp_path, monkeypatch, [n for n, _, _ in AGENDA])
+    probes = []
+    monkeypatch.setattr(wd, "probe", lambda t: probes.append(t) or True)
+    assert run_main(monkeypatch, ["--max-hours", "1"]) == 0
+    assert probes == []          # done before any probe was spent
+
+
+def test_fires_session_on_first_green_probe(monkeypatch, tmp_path, quiet_log):
+    _state_file(tmp_path, monkeypatch, [])
+    sequence = iter([False, False, True])
+    fired = []
+
+    def fake_session(timeout_s, skip_probe=False):
+        fired.append(skip_probe)
+        # session completes the agenda
+        _state_file(tmp_path, monkeypatch, [n for n, _, _ in AGENDA])
+        return 0
+
+    monkeypatch.setattr(wd, "probe", lambda t: next(sequence))
+    monkeypatch.setattr(wd, "run_session", fake_session)
+    monkeypatch.setattr(wd.time, "sleep", lambda s: None)
+    assert run_main(monkeypatch, ["--max-hours", "1"]) == 0
+    # fired exactly once, with the redundant second probe skipped
+    assert fired == [True]
+
+
+def test_backoff_on_deterministic_step_failure(monkeypatch, tmp_path, quiet_log):
+    """Tunnel up, a step fails fast every time: the vigil must not hammer
+    the accelerator with back-to-back full-agenda retries."""
+    _state_file(tmp_path, monkeypatch, [])
+    calls = {"sessions": 0}
+    sleeps = []
+
+    def fake_session(timeout_s, skip_probe=False):
+        calls["sessions"] += 1
+        if calls["sessions"] >= 4:       # eventually the agenda completes
+            _state_file(tmp_path, monkeypatch, [n for n, _, _ in AGENDA])
+        return 0                          # rc 0 but no step progress
+
+    monkeypatch.setattr(wd, "probe", lambda t: True)
+    monkeypatch.setattr(wd, "run_session", fake_session)
+    monkeypatch.setattr(wd.time, "sleep", lambda s: sleeps.append(s))
+    assert run_main(monkeypatch, ["--max-hours", "1", "--interval", "10"]) == 0
+    assert calls["sessions"] == 4
+    # exponential: 1x, 3x, 7x the interval after attempts 1..3
+    assert sleeps == [10.0, 30.0, 70.0]
+
+
+def test_keeps_probing_after_midagenda_wedge(monkeypatch, tmp_path, quiet_log):
+    """A session that banks SOME steps then dies (tunnel wedge) resets the
+    stall counter and the vigil keeps probing for the next window."""
+    _state_file(tmp_path, monkeypatch, [])
+    probes = iter([True, False, False, True])
+    sessions = {"n": 0}
+    sleeps = []
+
+    def fake_session(timeout_s, skip_probe=False):
+        sessions["n"] += 1
+        if sessions["n"] == 1:           # banked 2 steps, then wedged
+            _state_file(tmp_path, monkeypatch, [n for n, _, _ in AGENDA][:2])
+        else:                             # second window finishes the agenda
+            _state_file(tmp_path, monkeypatch, [n for n, _, _ in AGENDA])
+        return None
+
+    monkeypatch.setattr(wd, "probe", lambda t: next(probes))
+    monkeypatch.setattr(wd, "run_session", fake_session)
+    monkeypatch.setattr(wd.time, "sleep", lambda s: sleeps.append(s))
+    assert run_main(monkeypatch, ["--max-hours", "1", "--interval", "5"]) == 0
+    assert sessions["n"] == 2
+    # progress was made each time -> no backoff sleeps beyond the dead-probe
+    # interval waits
+    assert all(s == 5.0 for s in sleeps)
+
+
+def test_deadline_exit_code(monkeypatch, tmp_path, quiet_log):
+    _state_file(tmp_path, monkeypatch, [])
+    monkeypatch.setattr(wd, "probe", lambda t: False)
+    monkeypatch.setattr(wd.time, "sleep", lambda s: None)
+    assert run_main(monkeypatch, ["--max-hours", "1e-7"]) == 3

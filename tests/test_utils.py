@@ -70,3 +70,30 @@ def test_logger_and_metadata(caplog):
 
 def test_progress_passthrough():
     assert list(utils.progress(range(5), desc="x")) == [0, 1, 2, 3, 4]
+
+
+def test_force_cpu_host_devices_keeps_larger_preset():
+    """A caller that needs only 1 device (the bench fallback) must not
+    collapse a deliberately larger virtual mesh request — the bug that
+    made direct __graft_entry__ runs shrink the 8-device dry run to one
+    device. Subprocess: the flag only matters before first backend use."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        f"import sys; sys.path.insert(0, {root!r});"
+        "from das4whales_tpu.utils.device import force_cpu_host_devices;"
+        "force_cpu_host_devices(1);"
+        "import jax; print(len(jax.devices()))"
+    )
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        JAX_PLATFORMS="cpu",
+    )
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert out.stdout.strip().splitlines()[-1] == "4"
