@@ -1,0 +1,34 @@
+"""Marker-delimited section upsert for the validation reports.
+
+Each certification script owns one ``(marker, end_marker)``-delimited
+section of VALIDATION.md and refreshes ONLY that region on re-runs;
+content before the marker and after the end marker survives (including
+other scripts' sections).
+"""
+
+from __future__ import annotations
+
+
+def upsert_section(path: str, marker: str, end_marker: str,
+                   lines: list[str]) -> None:
+    body = "\n".join([marker, ""] + lines + ["", end_marker, ""])
+    try:
+        with open(path) as fh:
+            existing = fh.read()
+    except OSError:
+        existing = "# Full-scale validation\n\n"
+    if marker in existing:
+        head = existing[: existing.index(marker)].rstrip() + "\n\n"
+        rest = existing[existing.index(marker):]
+        tail = ""
+        if end_marker in rest:
+            # preserve everything after the end marker (other sections);
+            # a legacy end-marker-less section is replaced to EOF
+            tail = rest[rest.index(end_marker) + len(end_marker):].lstrip("\n")
+            if tail:
+                tail = "\n" + tail
+    else:
+        head = existing if existing.endswith("\n\n") else existing.rstrip() + "\n\n"
+        tail = ""
+    with open(path, "w") as fh:
+        fh.write(head + body + tail)

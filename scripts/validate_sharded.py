@@ -185,8 +185,6 @@ def longrecord_parity(nx, n_files, ns_file, workdir):
 def write_section(path, shape1, rows1, t1, rows2, t2):
     stamp = datetime.now(timezone.utc).strftime("%Y-%m-%d %H:%MZ")
     lines = [
-        MARKER,
-        "",
         f"Generated {stamp} by `scripts/validate_sharded.py` on the "
         "8-virtual-device CPU host mesh (single-core host; walls are "
         "records, not perf claims). The reference's only scale-out path "
@@ -241,33 +239,10 @@ def write_section(path, shape1, rows1, t1, rows2, t2):
         f"Walls: long-record workflow {t2['longrecord_s']:.1f} s "
         "(streamed ingest + sharded detect, incl. compile), single-chip "
         f"{t2['single_incl_compile_s']:.1f} s (detect only, incl. compile).",
-        "",
-        END_MARKER,
-        "",
     ]
-    try:
-        with open(path) as fh:
-            existing = fh.read()
-    except OSError:
-        existing = "# Full-scale validation\n\n"
-    if MARKER in existing:
-        # replace ONLY the marker-delimited section; content after the end
-        # marker (or the whole tail, for a legacy end-marker-less section
-        # this script itself wrote) is preserved
-        head = existing[: existing.index(MARKER)].rstrip() + "\n\n"
-        rest = existing[existing.index(MARKER):]
-        tail = ""
-        if END_MARKER in rest:
-            tail = rest[rest.index(END_MARKER) + len(END_MARKER):].lstrip("\n")
-            if tail:
-                tail = "\n" + tail
-        existing = head
-    else:
-        tail = ""
-        if not existing.endswith("\n\n"):
-            existing = existing.rstrip() + "\n\n"
-    with open(path, "w") as fh:
-        fh.write(existing + "\n".join(lines) + tail)
+    from scripts._report import upsert_section
+
+    upsert_section(path, MARKER, END_MARKER, lines)
 
 
 def main():
