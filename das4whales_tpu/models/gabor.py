@@ -153,7 +153,18 @@ class GaborDetector:
             hf_discount = 0.9 if (name == "HF" and threshold is None) else 1.0
             thr = thres * hf_discount  # HF picked at 0.9*thres (relative policy)
             env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
-            pos, _, _, sel, _ = peak_ops.find_peaks_sparse(env, thr, max_peaks=self.max_peaks)
+            pos, _, _, sel, saturated = peak_ops.find_peaks_sparse(
+                env, thr, max_peaks=self.max_peaks
+            )
+            if bool(np.asarray(saturated).any()):
+                # same contract as MatchedFilterDetector: a capacity-
+                # truncated channel must never pass silently
+                import warnings
+
+                warnings.warn(
+                    f"peak capacity saturated for note {name}; "
+                    f"raise max_peaks (now {self.max_peaks})"
+                )
             picks[name] = peak_ops.sparse_to_pick_times(pos, sel)
         return {
             "score": score,

@@ -1,0 +1,167 @@
+"""Full-scale certification of the GABOR/IMAGE family (float64 vs float32).
+
+The first two families carry float64 *golden* certificates
+(VALIDATION.md): independent reference-algorithm implementations exist
+because their dependencies (scipy/numpy) are installed. The gabor
+family's reference stack (OpenCV + torchvision) is NOT in this image,
+and the rebuild documents deliberate deviations from it anyway
+(`ops/image.binning` is jax antialiased bilinear, capability parity
+with torchvision Resize; `apply_smooth_mask` fixes the reference's
+raw-mask bug, improcess.py:452) — so pick-for-pick parity against the
+reference stack is neither runnable nor the design contract. What CAN
+and SHOULD be certified at full scale is the dtype claim the TPU path
+rests on (docs/PRECISION.md): the float32 pipeline is
+decision-identical to a float64 evaluation of the SAME pipeline.
+
+Runs ``GaborDetector`` on a ``[nx x ns]`` scene (through the float64
+golden front end) twice — float64 (x64 enabled) and float32 — each
+deriving its own 0.5·max threshold, and compares pick sets at ±2
+samples. Appends a marker-delimited VALIDATION.md section; raw numbers
+to artifacts/validate_gabor.json.
+
+Usage: python scripts/validate_gabor_full.py [--nx 4096] [--ns 12000] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from datetime import datetime, timezone
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+MARKER = "## Gabor/image family full-scale certification (f64 vs f32)"
+END_MARKER = "<!-- /gabor-family-certification -->"
+FS, DX = 200.0, 2.042
+
+
+def run_detector(trf: np.ndarray, selected_channels):
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.gabor import GaborDetector
+
+    nx, ns = trf.shape
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    det = GaborDetector(meta, selected_channels, max_peaks=512)
+    t0 = time.perf_counter()
+    with warnings.catch_warnings():
+        # a capacity-truncated channel would masquerade as (or mask) a
+        # dtype disagreement in the parity table — fail loudly instead
+        warnings.filterwarnings("error", message=".*peak capacity saturated.*")
+        out = det(jnp.asarray(trf))
+    picks = {k: np.asarray(v) for k, v in out["picks"].items()}
+    jax.block_until_ready(out["masked_trace"])
+    wall = time.perf_counter() - t0
+    return picks, float(out["threshold"]), wall
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nx", type=int, default=4096)
+    ap.add_argument("--ns", type=int, default=12000)
+    ap.add_argument("--quick", action="store_true", help="256x3000 smoke")
+    ap.add_argument("--out", default=os.path.join(ROOT, "VALIDATION.md"))
+    args = ap.parse_args()
+    if args.quick:
+        args.nx, args.ns = 256, 3000
+
+    # x64 must be on before first jax use so the float64 run is genuinely
+    # float64; float32 inputs still stay float32 under x64 (the pipeline
+    # is dtype-polymorphic end to end)
+    os.environ["JAX_ENABLE_X64"] = "1"
+    from bench import _device_utils
+
+    _device_utils().force_cpu_host_devices(1)
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from scripts.validate_full_scale import (
+        golden_front_end,
+        make_scene,
+        match_picks,
+    )
+
+    print(f"scene [{args.nx} x {args.ns}] + float64 front end ...", flush=True)
+    block, _ = make_scene(args.nx, args.ns)
+    t0 = time.perf_counter()
+    trf64 = golden_front_end(block.astype(np.float64))
+    t_front = time.perf_counter() - t0
+
+    sel = [0, args.nx, 1]
+    print("float64 gabor pipeline ...", flush=True)
+    picks64, thr64, wall64 = run_detector(trf64, sel)
+    print(f"  thr {thr64:.6g}  wall {wall64:.1f}s", flush=True)
+    print("float32 gabor pipeline ...", flush=True)
+    picks32, thr32, wall32 = run_detector(trf64.astype(np.float32), sel)
+    print(f"  thr {thr32:.6g}  wall {wall32:.1f}s", flush=True)
+
+    rows = []
+    for name in picks64:
+        m, oa, ob, moff = match_picks(picks32[name], picks64[name], tol=2)
+        rows.append({
+            "note": name,
+            "f32_picks": int(picks32[name].shape[1]),
+            "f64_picks": int(picks64[name].shape[1]),
+            "matched_pm2": m, "only_f32": oa, "only_f64": ob,
+            "max_offset": moff,
+        })
+        print(f"  {name}: {json.dumps(rows[-1])}", flush=True)
+
+    os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
+    with open(os.path.join(ROOT, "artifacts", "validate_gabor.json"), "w") as fh:
+        json.dump({"shape": [args.nx, args.ns], "rows": rows,
+                   "thr_f32": thr32, "thr_f64": thr64,
+                   "wall_f32_s": wall32, "wall_f64_s": wall64,
+                   "front_end_s": t_front}, fh, indent=1)
+
+    stamp = datetime.now(timezone.utc).strftime("%Y-%m-%d %H:%MZ")
+    lines = [
+        f"Generated {stamp} by `scripts/validate_gabor_full.py` "
+        "(single run, fixed seed, CPU, x64 enabled).",
+        "",
+        "The gabor family's reference stack (OpenCV + torchvision) is not "
+        "installable here and the rebuild documents deliberate deviations "
+        "from it (antialiased-resize binning, fixed smooth-mask bug "
+        "improcess.py:452) — so this section certifies the claim the TPU "
+        "path rests on instead (docs/PRECISION.md): **float32 is "
+        "decision-identical to float64** for the full image pipeline "
+        f"(trace→image→binning→Gabor pair→mask→masked matched filter→"
+        f"envelope picks) at `[{args.nx} x {args.ns}]`, each run deriving "
+        "its own 0.5·max threshold.",
+        "",
+        "| note | f32 picks | f64 picks | matched ±2 | only f32 "
+        "| only f64 | max offset |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['note']} | {r['f32_picks']} | {r['f64_picks']} "
+            f"| {r['matched_pm2']} | {r['only_f32']} | {r['only_f64']} "
+            f"| {r['max_offset']} |"
+        )
+    lines += [
+        "",
+        f"Thresholds: f32 {thr32:.6g} vs f64 {thr64:.6g} "
+        f"(relative difference {abs(thr32 - thr64) / max(abs(thr64), 1e-30):.2e}). "
+        f"Walls (1-core host, incl. compile): f32 {wall32:.1f} s, "
+        f"f64 {wall64:.1f} s, front end {t_front:.1f} s.",
+    ]
+    from scripts._report import upsert_section
+
+    upsert_section(args.out, MARKER, END_MARKER, lines)
+    print("wrote", args.out, "and artifacts/validate_gabor.json")
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -63,23 +63,6 @@ def golden_stft_mag(x64: np.ndarray, nfft: int, hop: int) -> np.ndarray:
     return np.abs(np.fft.rfft(xp[idx] * win, axis=-1)).T  # [nf, n_frames]
 
 
-def golden_front_end(block64: np.ndarray):
-    """The flagship's float64 golden front end (validate_full_scale
-    semantics): Butterworth-8 filtfilt + fftshifted fft2 f-k mask."""
-    import scipy.signal as sp
-
-    from das4whales_tpu.ops import fk as fk_ops
-
-    nx, ns = block64.shape
-    mask = np.asarray(fk_ops.hybrid_ninf_filter_design(
-        (nx, ns), [0, nx, 1], DX, FS, 1350, 1450, 3300, 3450, 14, 30
-    ), dtype=np.float64)
-    b, a = sp.butter(8, [FLIMS[0] / (FS / 2), FLIMS[1] / (FS / 2)], "bp")
-    tr = sp.filtfilt(b, a, block64, axis=1)
-    spec = np.fft.fftshift(np.fft.fft2(tr))
-    return np.fft.ifft2(np.fft.ifftshift(spec * mask)).real
-
-
 def golden_spectro(trf64: np.ndarray, kernels: dict):
     """Independent float64 spectro-correlation over all channels. The
     per-channel STFT and normalization are kernel-independent, so each
@@ -189,7 +172,11 @@ def main():
 
     _device_utils().force_cpu_host_devices(1)
 
-    from scripts.validate_full_scale import make_scene, match_picks
+    from scripts.validate_full_scale import (
+        golden_front_end,
+        make_scene,
+        match_picks,
+    )
     from das4whales_tpu.config import SPECTRO_HF_KERNEL, SPECTRO_LF_KERNEL
 
     kernels = {"HF": SPECTRO_HF_KERNEL, "LF": SPECTRO_LF_KERNEL}
