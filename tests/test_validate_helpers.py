@@ -1,0 +1,127 @@
+"""Tests for the full-scale certification helpers.
+
+Every VALIDATION.md table is produced by these: a broken ``match_picks``
+would fake (or fake-break) parity, a drifted ``golden_stft_mag`` would
+invalidate the spectro golden, and a broken ``upsert_section`` could
+silently eat other scripts' sections. Pin them.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from scripts._report import upsert_section  # noqa: E402
+from scripts.validate_full_scale import match_picks  # noqa: E402
+
+
+def _picks(pairs):
+    """(2, n) pick array from [(channel, time), ...]."""
+    if not pairs:
+        return np.zeros((2, 0), dtype=int)
+    return np.asarray(pairs).T
+
+
+class TestMatchPicks:
+    def test_identical_sets_match_exactly(self):
+        a = _picks([(0, 10), (0, 50), (3, 7)])
+        m, oa, ob, moff = match_picks(a, a.copy())
+        assert (m, oa, ob, moff) == (3, 0, 0, 0)
+
+    def test_tolerance_window(self):
+        a = _picks([(1, 100)])
+        b = _picks([(1, 102)])
+        assert match_picks(a, b, tol=2)[:3] == (1, 0, 0)
+        assert match_picks(a, b, tol=1)[:3] == (0, 1, 1)
+
+    def test_max_offset_reported(self):
+        a = _picks([(1, 100), (1, 200)])
+        b = _picks([(1, 101), (1, 198)])
+        m, oa, ob, moff = match_picks(a, b, tol=2)
+        assert m == 2 and moff == 2
+
+    def test_channel_mismatch_never_matches(self):
+        # same time on a DIFFERENT channel is not a match
+        a = _picks([(1, 100)])
+        b = _picks([(2, 100)])
+        m, oa, ob, _ = match_picks(a, b)
+        assert (m, oa, ob) == (0, 1, 1)
+
+    def test_each_pick_consumed_once(self):
+        # two a-picks near one b-pick: only one may match (no double count)
+        a = _picks([(0, 100), (0, 101)])
+        b = _picks([(0, 100)])
+        m, oa, ob, _ = match_picks(a, b, tol=2)
+        assert (m, oa, ob) == (1, 1, 0)
+
+    def test_asymmetric_extras_counted_on_both_sides(self):
+        a = _picks([(0, 10), (0, 500)])
+        b = _picks([(0, 10), (0, 900), (4, 3)])
+        m, oa, ob, _ = match_picks(a, b, tol=2)
+        assert (m, oa, ob) == (1, 1, 2)
+
+    def test_empty_sides(self):
+        e = _picks([])
+        a = _picks([(0, 1)])
+        assert match_picks(e, e) == (0, 0, 0, 0)
+        assert match_picks(a, e)[:3] == (0, 1, 0)
+        assert match_picks(e, a)[:3] == (0, 0, 1)
+
+
+def test_golden_stft_mag_matches_production_convention(rng):
+    """The spectro golden's float64 STFT must equal the production op
+    (librosa convention: periodic Hann, centered, 1 + n//hop frames) —
+    the cross-check the validator also runs before any parity claim."""
+    jnp = pytest.importorskip("jax.numpy")
+    from scripts.validate_spectro_full import golden_stft_mag
+    from das4whales_tpu.ops import spectral
+
+    x = rng.standard_normal(1000)
+    g = golden_stft_mag(x, 64, 16)
+    p = np.asarray(jnp.abs(spectral.stft(jnp.asarray(x), 64, 16)))
+    assert g.shape == p.shape == (33, 1 + 1000 // 16)
+    np.testing.assert_allclose(g, p, atol=1e-4)
+
+
+class TestUpsertSection:
+    M1, E1 = "## Section one", "<!-- /one -->"
+    M2, E2 = "## Section two", "<!-- /two -->"
+
+    def test_fresh_file_and_idempotent_refresh(self, tmp_path):
+        p = str(tmp_path / "V.md")
+        upsert_section(p, self.M1, self.E1, ["body"])
+        upsert_section(p, self.M1, self.E1, ["body"])
+        out = open(p).read()
+        assert out.count(self.M1) == 1 and out.count(self.E1) == 1
+
+    def test_refresh_preserves_other_sections(self, tmp_path):
+        p = str(tmp_path / "V.md")
+        upsert_section(p, self.M1, self.E1, ["one v1"])
+        upsert_section(p, self.M2, self.E2, ["two v1"])
+        upsert_section(p, self.M1, self.E1, ["one v2"])
+        out = open(p).read()
+        assert "one v2" in out and "one v1" not in out
+        assert "two v1" in out
+        assert out.index(self.M1) < out.index(self.M2)
+        upsert_section(p, self.M2, self.E2, ["two v2"])
+        out = open(p).read()
+        assert "one v2" in out and "two v2" in out and "two v1" not in out
+
+    def test_head_content_preserved(self, tmp_path):
+        p = str(tmp_path / "V.md")
+        with open(p, "w") as fh:
+            fh.write("# Title\n\nhand-written preamble\n")
+        upsert_section(p, self.M1, self.E1, ["body"])
+        out = open(p).read()
+        assert out.startswith("# Title") and "hand-written preamble" in out
+
+    def test_legacy_endmarkerless_section_replaced_to_eof(self, tmp_path):
+        p = str(tmp_path / "V.md")
+        with open(p, "w") as fh:
+            fh.write(f"# Title\n\n{self.M1}\n\nstale body no end marker\n")
+        upsert_section(p, self.M1, self.E1, ["fresh body"])
+        out = open(p).read()
+        assert "stale body" not in out and "fresh body" in out
+        assert out.count(self.M1) == 1
