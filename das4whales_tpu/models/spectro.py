@@ -188,16 +188,27 @@ def compute_cross_correlogram_spectrocorr(
     )
     ker_dev = jnp.asarray(ker, dtype=data.dtype)
 
-    @jax.jit
-    def chunk_correlogram(chunk):
-        spec, _, _ = sliced_spectrogram(chunk, fs, fmin, fmax, nperseg, nhop)
-        return xcorr2d(spec, ker_dev)
-
     chunks = [
-        chunk_correlogram(norm[i : i + batch_channels])
+        _chunk_correlogram(norm[i : i + batch_channels], ker_dev,
+                           fs=fs, fmin=fmin, fmax=fmax,
+                           nperseg=nperseg, nhop=nhop)
         for i in range(0, norm.shape[0], batch_channels)
     ]
     return jnp.concatenate(chunks, axis=0) if len(chunks) > 1 else chunks[0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("fs", "fmin", "fmax", "nperseg", "nhop")
+)
+def _chunk_correlogram(chunk, ker, *, fs, fmin, fmax, nperseg, nhop):
+    """One channel-chunk's sliced spectrogram + hat-kernel correlation.
+
+    Module-level jit (NOT a closure inside the caller): a nested
+    ``@jax.jit`` function is a fresh callable per call, so every file of
+    a campaign re-traced the whole chunk program; here repeat calls at
+    the same shapes/knobs hit the jit cache."""
+    spec, _, _ = sliced_spectrogram(chunk, fs, fmin, fmax, nperseg, nhop)
+    return xcorr2d(spec, ker)
 
 
 class SpectroCorrDetector:

@@ -130,14 +130,20 @@ def sharded_fk_apply(
     nf = nns // 2 + 1
     pad_f = (-nf) % p
     mask_half = jnp.asarray(prepare_mask_half(mask, nns, pad_f))
+    return _fk_channel_fn(mesh, channel_axis)(trace, mask_half)
 
-    fn = shard_map(
+
+@functools.lru_cache(maxsize=32)
+def _fk_channel_fn(mesh: Mesh, channel_axis: str):
+    """Cached jitted program per (mesh, axis): rebuilding shard_map + jit
+    per call is a fresh function object, re-tracing on every file of a
+    campaign (the mask stays a runtime argument)."""
+    return jax.jit(shard_map(
         functools.partial(fk_apply_local, axis_name=channel_axis),
         mesh=mesh,
         in_specs=(P(channel_axis, None), P(None, channel_axis)),
         out_specs=P(channel_axis, None),
-    )
-    return jax.jit(fn)(trace, mask_half)
+    ))
 
 
 def pfft2(x, mesh: Mesh, channel_axis: str = "channel"):
@@ -149,13 +155,17 @@ def pfft2(x, mesh: Mesh, channel_axis: str = "channel"):
     p = mesh.shape[channel_axis]
     if nnx % p or nns % p:
         raise ValueError("both axes must be divisible by the mesh axis size")
+    return _pfft2_fn(mesh, channel_axis)(x)
 
+
+@functools.lru_cache(maxsize=32)
+def _pfft2_fn(mesh: Mesh, channel_axis: str):
     def body(xs):
         s = jnp.fft.fft(xs, axis=-1)
         s = jax.lax.all_to_all(s, channel_axis, split_axis=1, concat_axis=0, tiled=True)
         return jnp.fft.fft(s, axis=-2)
 
-    fn = shard_map(
-        body, mesh=mesh, in_specs=(P(channel_axis, None),), out_specs=P(None, channel_axis)
-    )
-    return jax.jit(fn)(x)
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(channel_axis, None),),
+        out_specs=P(None, channel_axis),
+    ))

@@ -104,16 +104,35 @@ def sharded_bp_filt_time(
     local = nns // p
     if halo >= local:
         raise ValueError(f"halo {halo} must be < local shard length {local}")
-    sos = sp.butter(order, [fmin / (fs / 2), fmax / (fs / 2)], "bp", output="sos")
-    gain = jnp.asarray(zero_phase_gain(np.fft.rfftfreq(local + 2 * halo), sos).astype(np.float32))
+    gain = _bp_time_gain(order, fs, fmin, fmax, local, halo)
+    return _bp_time_fn(mesh, time_axis, halo)(trace, gain)
 
+
+@functools.lru_cache(maxsize=32)
+def _bp_time_gain(order: int, fs: float, fmin: float, fmax: float,
+                  local: int, halo: int):
+    """Cached zero-phase gain per filter design + shard geometry: the
+    host-side Butterworth evaluation over rfftfreq(local + 2*halo) and
+    the device upload are per-record overhead otherwise."""
+    sos = sp.butter(order, [fmin / (fs / 2), fmax / (fs / 2)], "bp", output="sos")
+    return jnp.asarray(
+        zero_phase_gain(np.fft.rfftfreq(local + 2 * halo), sos).astype(np.float32)
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _bp_time_fn(mesh: Mesh, time_axis: str, halo: int):
+    """Cached jitted program per (mesh, axis, halo): rebuilding the
+    shard_map + jit wrapper on every call is a fresh function object and
+    re-traces per record in multi-record campaigns (the filter response
+    itself stays a runtime argument, so band/order changes don't grow
+    the cache)."""
     body = functools.partial(_bp_time_local, halo=halo, axis_name=time_axis)
-    fn = shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(None, time_axis), P(None)),
         out_specs=P(None, time_axis),
-    )
-    return jax.jit(fn)(trace, gain)
+    ))
 
 
 def prepare_mask_full(mask: np.ndarray) -> np.ndarray:
@@ -154,14 +173,18 @@ def sharded_fk_apply_time(trace, mask, mesh: Mesh, time_axis: str = "time"):
     if nnx % p or nns % p:
         raise ValueError(f"both axes must divide the mesh axis size {p}")
     mask_rows = jnp.asarray(prepare_mask_full(mask))
+    return _fk_time_fn(mesh, time_axis)(trace, mask_rows)
 
-    fn = shard_map(
+
+@functools.lru_cache(maxsize=32)
+def _fk_time_fn(mesh: Mesh, time_axis: str):
+    """Cached jitted program per (mesh, axis) — see ``_bp_time_fn``."""
+    return jax.jit(shard_map(
         functools.partial(fk_apply_time_local, axis_name=time_axis),
         mesh=mesh,
         in_specs=(P(None, time_axis), P(time_axis, None)),
         out_specs=P(None, time_axis),
-    )
-    return jax.jit(fn)(trace, mask_rows)
+    ))
 
 
 def make_sharded_mf_step_time(
