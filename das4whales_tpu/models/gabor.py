@@ -156,15 +156,7 @@ class GaborDetector:
             pos, _, _, sel, saturated = peak_ops.find_peaks_sparse(
                 env, thr, max_peaks=self.max_peaks
             )
-            if bool(np.asarray(saturated).any()):
-                # same contract as MatchedFilterDetector: a capacity-
-                # truncated channel must never pass silently
-                import warnings
-
-                warnings.warn(
-                    f"peak capacity saturated for note {name}; "
-                    f"raise max_peaks (now {self.max_peaks})"
-                )
+            peak_ops.warn_saturated(saturated, f"note {name}", self.max_peaks)
             picks[name] = peak_ops.sparse_to_pick_times(pos, sel)
         return {
             "score": score,

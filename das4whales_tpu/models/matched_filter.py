@@ -438,13 +438,7 @@ class MatchedFilterDetector:
         return self.channel_tile if isinstance(self.channel_tile, int) else 512
 
     def _warn_saturated(self, name: str, saturated) -> None:
-        if bool(np.asarray(saturated).any()):
-            import warnings
-
-            warnings.warn(
-                f"peak capacity saturated for template {name}; "
-                f"raise max_peaks (now {self.max_peaks})"
-            )
+        peak_ops.warn_saturated(saturated, f"template {name}", self.max_peaks)
 
     @property
     def fk_pad_rows(self) -> int:

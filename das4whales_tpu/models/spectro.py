@@ -247,9 +247,10 @@ class SpectroCorrDetector:
             correlograms[name] = corr
             # correlograms are half-wave rectified (nonnegative), so the
             # sparse height-prefiltered route is exact
-            pos, _, _, sel, _ = peak_ops.find_peaks_sparse(
+            pos, _, _, sel, saturated = peak_ops.find_peaks_sparse(
                 corr, self.threshold, max_peaks=self.max_peaks
             )
+            peak_ops.warn_saturated(saturated, f"kernel {name}", self.max_peaks)
             picks[name] = peak_ops.sparse_to_pick_times(pos, sel)
         nt = next(iter(correlograms.values())).shape[-1]
         spectro_fs = nt / (self.metadata.ns / fs)

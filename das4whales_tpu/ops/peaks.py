@@ -416,3 +416,28 @@ def select_picked_times(idx_tp, tstart: float, tend: float, fs: float):
     detect.py:306-330)."""
     sel = (idx_tp[1] >= tstart * fs) & (idx_tp[1] <= tend * fs)
     return idx_tp[0][sel], idx_tp[1][sel]
+
+
+def warn_saturated(saturated, label: str, max_peaks: int) -> bool:
+    """Surface pick-capacity saturation; returns True iff any slot saturated.
+
+    Shared by all three detector families (a truncated pick list must
+    never pass silently). Fires BOTH ways on purpose: a logger warning,
+    which repeats on every saturated call (``warnings`` dedups by source
+    location, so in a detect-many campaign only the first file would
+    warn), and a ``warnings.warn``, which callers can catch or escalate
+    (the full-scale validators turn it into an error).
+    """
+    import warnings
+
+    n = int(np.asarray(saturated).sum())
+    if not n:
+        return False
+    from ..utils.log import get_logger
+
+    msg = (f"peak capacity saturated for {label} on {n} channel slots; "
+           f"picks beyond the {max_peaks} tallest were dropped — raise "
+           f"max_peaks to keep them")
+    get_logger("das4whales_tpu.ops.peaks").warning(msg)
+    warnings.warn(msg)
+    return True
