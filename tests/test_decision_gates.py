@@ -1,0 +1,129 @@
+"""Scripted tests for the decision-gate reporter.
+
+The reporter converts a harvested TPU session into default-flip
+recommendations; a parsing or evidence-filtering bug would either hide a
+banked on-chip number or — worse — recommend closing a gate from a run
+that never completed. No jax, no subprocess agenda: sessions are
+synthesized jsonl files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from scripts.decision_gates import load_session, tail_json  # noqa: E402
+
+SCRIPT = os.path.join(ROOT, "scripts", "decision_gates.py")
+
+BENCH_TPU = json.dumps({
+    "value": 6.1e9, "vs_baseline": 210.0, "wall_s": 0.043,
+    "shape": [22050, 12000], "device": "TPU v5 lite0",
+    "route": "mono+fusedbp", "cpu_ref_mode": "linear-extrapolated(nx=1050)",
+    "roofline_frac": {"filter": 0.75},
+})
+RUNG_FRAGMENT = "RUNG_RESULT:" + json.dumps(
+    {"wall": 1.0, "device": "TPU v5 lite0", "route": "mono+fusedbp"}
+)
+
+
+def write_session(path, events):
+    with open(path, "w") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+    return str(path)
+
+
+def run_report(jsonl, *extra):
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--jsonl", str(jsonl), *extra],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+def test_tail_json_last_line_and_indented_doc():
+    assert tail_json("noise\n" + BENCH_TPU)["value"] == 6.1e9
+    # perf_kernels prints an indented doc followed by a status line
+    doc = json.dumps({"device": "TPU v5", "stft": [{"speedup": 1.2}]}, indent=1)
+    assert tail_json("banner\n" + doc + "\nappended to docs/PERF.md")["device"] == "TPU v5"
+    assert tail_json("no json here") is None
+
+
+def test_failed_steps_never_close_gates(tmp_path):
+    """A timed-out bench whose partial stdout banked a RUNG_RESULT line
+    (TPU device string, fused route) must be excluded from evidence."""
+    p = write_session(tmp_path / "s.jsonl", [
+        {"step": "bench-full", "rc": None, "stdout_tail": RUNG_FRAGMENT},
+    ])
+    completed, seen = load_session(p)
+    assert "bench-full" in seen and "bench-full" not in completed
+    report = run_report(p)
+    assert "FAILED/TIMEOUT" in report
+    assert "OPEN**: no parsed bench payload" in report
+    assert "flip the library default" not in report
+
+
+def test_green_tpu_session_closes_gates(tmp_path):
+    perf = json.dumps({"device": "TPU v5 lite0", "stft": [
+        {"overlap": 0.75, "speedup": 1.4}, {"overlap": 0.875, "speedup": 1.2},
+        {"overlap": 0.95, "speedup": 0.9}]}, indent=1)
+    ab = json.dumps({"device": "TPU v5 lite0", "shape": [22050, 12000], "rows": [
+        {"label": "exact", "fk_channels": 22050, "wall_s": 0.0101},
+        {"label": "5-smooth", "fk_channels": 22500, "wall_s": 0.0099},
+        {"label": "exact+fused", "fk_channels": 22050, "wall_s": 0.0062}]})
+    p = write_session(tmp_path / "s.jsonl", [
+        {"step": "bench-full", "rc": 0, "stdout_tail": "x\n" + BENCH_TPU},
+        {"step": "perf-kernels-full", "rc": 0, "stdout_tail": perf + "\nappended"},
+        {"step": "ab-channel-pad", "rc": 0, "stdout_tail": ab},
+    ])
+    report = run_report(p)
+    assert "**MET**" in report                       # north star at 43 ms
+    assert "keep Pallas default" in report           # majority on-chip win
+    assert "keep channel_pad=None" in report         # 1.02x < threshold
+    assert "flip the library default to fused" in report
+
+
+def test_cpu_fallback_numbers_stay_open(tmp_path):
+    cpu_bench = json.dumps({
+        "value": 3.5e6, "vs_baseline": 1.38, "wall_s": 75.5,
+        "shape": [22050, 12000],
+        "device": "cpu-fallback (accelerator unreachable within 180s): TFRT_CPU_0",
+        "route": "tiled(tile=512)+fusedbp", "cpu_ref_mode": "linear-extrapolated(nx=1050)",
+        "roofline_frac": None,
+    })
+    p = write_session(tmp_path / "s.jsonl", [
+        {"step": "bench-full", "rc": 0, "stdout_tail": cpu_bench},
+    ])
+    report = run_report(p)
+    # the honest CPU line is reported but no gate closes on it
+    assert "cpu-fallback" in report
+    assert "**MET**" not in report
+    assert "flip the library default" not in report
+
+
+def test_out_file_written_even_when_stdout_closes(tmp_path):
+    """Deterministic broken-pipe: the child writes to a pipe whose read
+    end is already closed (unbuffered, so print raises inside the run,
+    not at interpreter exit) — `| head` only sometimes races this way."""
+    p = write_session(tmp_path / "s.jsonl", [
+        {"step": "bench-full", "rc": 0, "stdout_tail": BENCH_TPU},
+    ])
+    dg = tmp_path / "DG.md"
+    r, w = os.pipe()
+    os.close(r)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", SCRIPT, "--jsonl", p, "--out", str(dg)],
+            stdout=w, stderr=subprocess.PIPE, timeout=60,
+        )
+    finally:
+        os.close(w)
+    assert proc.returncode == 0, proc.stderr[-300:]
+    assert dg.exists() and "Decision gates" in dg.read_text()
