@@ -48,6 +48,22 @@ AGENDA = [
 ]
 
 
+def write_gates_report() -> None:
+    """Regenerate artifacts/DECISION_GATES.md from whatever evidence the
+    session log holds so far. Pure post-processing (no accelerator), run
+    on EVERY exit path — including a mid-agenda tunnel death, exactly the
+    partial-evidence case the reporter exists for — and never tracked in
+    the done-state (new evidence must always refresh it)."""
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join("scripts", "decision_gates.py"),
+             "--out", os.path.join("artifacts", "DECISION_GATES.md")],
+            cwd=ROOT, timeout=120, capture_output=True,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        pass  # the report is derived; losing it must not change the rc
+
+
 def probe(timeout_s: float = 60.0) -> bool:
     from das4whales_tpu.utils.device import probe_backend
 
@@ -117,26 +133,29 @@ def main() -> int:
         log_event({"step": "probe", "ok": True})
     print("running agenda")
 
-    for name, argv, timeout_s in AGENDA:
-        if state.get(name, {}).get("status") == "done":
-            print(f"skip {name} (done)")
-            continue
-        print(f"== {name} (deadline {timeout_s}s)")
-        result = run_step(name, argv, timeout_s)
-        ok = result.get("rc") == 0
-        result_status = "done" if ok else "failed"
-        state[name] = {"status": result_status, "wall_s": result["wall_s"]}
-        save_state(state)
-        log_event(result)
-        print(f"   -> {result_status} in {result['wall_s']}s")
-        if not ok:
-            # step failed or timed out — is the tunnel still alive?
-            if not probe(45.0):
-                print("tunnel died during/after step; stopping agenda")
-                log_event({"step": "probe", "ok": False, "after": name})
-                return 2
-    print("agenda complete")
-    return 0
+    try:
+        for name, argv, timeout_s in AGENDA:
+            if state.get(name, {}).get("status") == "done":
+                print(f"skip {name} (done)")
+                continue
+            print(f"== {name} (deadline {timeout_s}s)")
+            result = run_step(name, argv, timeout_s)
+            ok = result.get("rc") == 0
+            result_status = "done" if ok else "failed"
+            state[name] = {"status": result_status, "wall_s": result["wall_s"]}
+            save_state(state)
+            log_event(result)
+            print(f"   -> {result_status} in {result['wall_s']}s")
+            if not ok:
+                # step failed or timed out — is the tunnel still alive?
+                if not probe(45.0):
+                    print("tunnel died during/after step; stopping agenda")
+                    log_event({"step": "probe", "ok": False, "after": name})
+                    return 2
+        print("agenda complete")
+        return 0
+    finally:
+        write_gates_report()
 
 
 if __name__ == "__main__":
