@@ -51,17 +51,27 @@ AGENDA = [
 def write_gates_report() -> None:
     """Regenerate artifacts/DECISION_GATES.md from whatever evidence the
     session log holds so far. Pure post-processing (no accelerator), run
-    on EVERY exit path — including a mid-agenda tunnel death, exactly the
-    partial-evidence case the reporter exists for — and never tracked in
-    the done-state (new evidence must always refresh it)."""
+    on EVERY exit path — after the agenda (even a mid-agenda tunnel
+    death) AND on the probe-failed early exit, which is how evidence
+    banked by a previous session that was killed at the outer deadline
+    (killpg skips any finally) finally becomes a report. Never tracked
+    in the done-state: new evidence must always refresh it. A reporter
+    failure is logged but never changes the session's exit code."""
     try:
-        subprocess.run(
+        proc = subprocess.run(
             [sys.executable, os.path.join("scripts", "decision_gates.py"),
              "--out", os.path.join("artifacts", "DECISION_GATES.md")],
-            cwd=ROOT, timeout=120, capture_output=True,
+            cwd=ROOT, timeout=120, capture_output=True, text=True,
         )
-    except (subprocess.TimeoutExpired, OSError):
-        pass  # the report is derived; losing it must not change the rc
+        if proc.returncode != 0:
+            log_event({"step": "decision-gates-report", "rc": proc.returncode,
+                       "stderr_tail": (proc.stderr or "")[-800:]})
+            print(f"decision-gates report FAILED (rc {proc.returncode}); "
+                  f"artifacts/DECISION_GATES.md may be stale")
+    except (subprocess.TimeoutExpired, OSError) as e:
+        log_event({"step": "decision-gates-report", "rc": None,
+                   "error": repr(e)[:300]})
+        print("decision-gates report did not run; it may be stale")
 
 
 def probe(timeout_s: float = 60.0) -> bool:
@@ -128,6 +138,9 @@ def main() -> int:
     elif not probe(60.0):
         print("tunnel down; nothing to do (re-run when it answers)")
         log_event({"step": "probe", "ok": False})
+        # evidence banked by an earlier (possibly deadline-killed) session
+        # still deserves a report
+        write_gates_report()
         return 1
     else:
         log_event({"step": "probe", "ok": True})

@@ -1,0 +1,90 @@
+"""Tests for the shared wedge guard used by the measurement scripts.
+
+The load-bearing rule (found the hard way, round 4): this image's shell
+profile exports ``JAX_PLATFORMS=axon``, and trusting ANY non-cpu value
+as skip-the-probe is exactly how a wedged tunnel hangs a script for its
+whole timeout. Only the literal ``cpu`` may bypass the probe.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import bench  # noqa: E402
+from scripts import _wedge_guard as wg  # noqa: E402
+
+
+def test_noncpu_platform_env_still_probes(monkeypatch):
+    """JAX_PLATFORMS=axon (the image default) must NOT skip the probe;
+    with the tunnel dead it must fall back to CPU."""
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    probes = []
+    monkeypatch.setattr(bench, "_probe_device_with_backoff",
+                        lambda budget: probes.append(budget) or False)
+    forced = []
+    monkeypatch.setattr(bench, "_device_utils", lambda: type(
+        "D", (), {"force_cpu_host_devices": staticmethod(
+            lambda n: forced.append(n))}
+    ))
+    assert wg.resolve_backend(device_timeout_s=5.0) is True
+    assert probes == [5.0] and forced == [1]
+
+
+def test_noncpu_platform_env_with_live_tunnel_no_fallback(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setattr(bench, "_probe_device_with_backoff", lambda b: True)
+    forced = []
+    monkeypatch.setattr(bench, "_device_utils", lambda: type(
+        "D", (), {"force_cpu_host_devices": staticmethod(
+            lambda n: forced.append(n))}
+    ))
+    assert wg.resolve_backend(device_timeout_s=5.0) is False
+    assert forced == []
+
+
+def test_explicit_cpu_skips_probe(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    def boom(budget):
+        raise AssertionError("explicit cpu must not probe")
+
+    monkeypatch.setattr(bench, "_probe_device_with_backoff", boom)
+    forced = []
+    monkeypatch.setattr(bench, "_device_utils", lambda: type(
+        "D", (), {"force_cpu_host_devices": staticmethod(
+            lambda n: forced.append(n))}
+    ))
+    assert wg.resolve_backend() is False
+    assert forced == [1]
+
+
+def test_env_budget_honored(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("DAS_BENCH_DEVICE_TIMEOUT", "17.5")
+    budgets = []
+    monkeypatch.setattr(bench, "_probe_device_with_backoff",
+                        lambda b: budgets.append(b) or True)
+    assert wg.resolve_backend() is False
+    assert budgets == [17.5]
+
+
+def test_arm_deadline_zero_disables(monkeypatch):
+    import threading
+
+    started = []
+    orig = threading.Timer
+
+    class SpyTimer(orig):
+        def start(self):
+            started.append(self.interval)
+            # never actually arm in tests
+    monkeypatch.setattr(threading, "Timer", SpyTimer)
+    wg.arm_deadline(0)
+    assert started == []
+    wg.arm_deadline(-1)
+    assert started == []
+    wg.arm_deadline(12.0)
+    assert started == [12.0]
