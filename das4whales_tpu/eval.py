@@ -22,7 +22,7 @@ the sweep doubles as an end-to-end regression harness.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from typing import Dict, Sequence
 
 import numpy as np

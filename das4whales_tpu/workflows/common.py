@@ -11,7 +11,7 @@ from typing import Sequence
 from ..config import SELECTED_CHANNELS_M, as_metadata
 from ..io import synth
 from ..io.download import dl_file
-from ..io.hdf5 import StrainBlock, load_das_data
+from ..io.hdf5 import load_das_data
 from ..io.interrogators import get_acquisition_parameters
 from ..utils.log import get_logger, log_metadata
 

@@ -64,14 +64,24 @@ def write_gates_report() -> None:
             cwd=ROOT, timeout=120, capture_output=True, text=True,
         )
         if proc.returncode != 0:
-            log_event({"step": "decision-gates-report", "rc": proc.returncode,
-                       "stderr_tail": (proc.stderr or "")[-800:]})
-            print(f"decision-gates report FAILED (rc {proc.returncode}); "
-                  f"artifacts/DECISION_GATES.md may be stale")
+            _log_report_failure({"step": "decision-gates-report",
+                                 "rc": proc.returncode,
+                                 "stderr_tail": (proc.stderr or "")[-800:]})
     except (subprocess.TimeoutExpired, OSError) as e:
-        log_event({"step": "decision-gates-report", "rc": None,
-                   "error": repr(e)[:300]})
-        print("decision-gates report did not run; it may be stale")
+        _log_report_failure({"step": "decision-gates-report", "rc": None,
+                             "error": repr(e)[:300]})
+
+
+def _log_report_failure(event: dict) -> None:
+    """Best-effort diagnostics: if even the session log is unwritable
+    (disk full), the guarantee that a reporter failure never changes the
+    session's exit code still holds."""
+    try:
+        log_event(event)
+        print(f"decision-gates report FAILED ({event}); "
+              f"artifacts/DECISION_GATES.md may be stale")
+    except OSError:
+        pass
 
 
 def probe(timeout_s: float = 60.0) -> bool:
