@@ -78,6 +78,26 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("--fused", action="store_true",
                     help="fold the bandpass into the f-k mask (golden-"
                          "certified fused route, VALIDATION.md; mf only)")
+    pl = sub.add_parser(
+        "longrecord",
+        help="continuous detection across file boundaries: consecutive "
+             "files become ONE time-sharded record (workflows.longrecord; "
+             "boundary-straddling calls the per-file reference mode loses)",
+    )
+    pl.add_argument("files", nargs="+",
+                    help="consecutive segments of one recording, in order")
+    pl.add_argument("--outdir", default="out_longrecord")
+    pl.add_argument("--channels", default=None,
+                    help="start,stop,step channel selection (default: all of file 0)")
+    pl.add_argument("--family", default="mf", choices=("mf", "spectro", "gabor"))
+    pl.add_argument("--halo", type=int, default=512,
+                    help="time-shard halo samples (boundary exactness of "
+                         "the zero-phase bandpass, all families)")
+    pl.add_argument("--fused", action="store_true",
+                    help="fused bandpass∘f-k route (mf only)")
+    pl.add_argument("--max-peaks", type=int, default=512,
+                    help="pick capacity per channel")
+    pl.add_argument("--interrogator", default="optasense")
     for name, help_text in WORKFLOWS.items():
         p = sub.add_parser(name, help=help_text)
         p.add_argument("url", nargs="?", default=None,
@@ -152,6 +172,50 @@ def main(argv=None) -> int:
         }
         print(json.dumps(out if args.family == "all" else out[args.family],
                          indent=1))
+        return 0
+    if args.workflow == "longrecord":
+        import json as _json
+
+        import numpy as np
+
+        from das4whales_tpu.io.interrogators import get_acquisition_parameters
+        from das4whales_tpu.workflows.longrecord import detect_long_record
+
+        meta = get_acquisition_parameters(args.files[0], args.interrogator)
+        sel = ([int(v) for v in args.channels.split(",")]
+               if args.channels else [0, meta.nx, 1])
+        # pass --fused through unconditionally: the workflow itself rejects
+        # it for non-mf families, and silently dropping the flag would let
+        # a user believe the fused route ran when it did not
+        res = detect_long_record(
+            args.files, sel, meta,
+            family=args.family, halo=args.halo,
+            fused_bandpass=args.fused,
+            max_peaks_per_channel=args.max_peaks,
+            interrogator=args.interrogator,
+        )
+        os.makedirs(args.outdir, exist_ok=True)
+        np.savez(
+            os.path.join(args.outdir, "picks.npz"),
+            **{f"picks_{k}": v for k, v in res.picks.items()},
+            **{f"times_s_{k}": v for k, v in res.pick_times_s.items()},
+        )
+        summary = {
+            "files": list(args.files), "family": args.family,
+            "n_files": res.n_files, "n_samples": res.n_samples,
+            "t0_utc": str(res.t0_utc),
+            "thresholds": res.thresholds,
+            "n_picks": {k: int(v.shape[1]) for k, v in res.picks.items()},
+        }
+        with open(os.path.join(args.outdir, "summary.json"), "w") as fh:
+            _json.dump(summary, fh, indent=1)
+        for name, pk in res.picks.items():
+            span = (f" [{res.pick_times_s[name].min():.1f}, "
+                    f"{res.pick_times_s[name].max():.1f}] s"
+                    if pk.shape[1] else "")
+            print(f"longrecord: {name}: {pk.shape[1]} picks{span}")
+        print(f"longrecord: {res.n_files} files as one "
+              f"{res.n_samples / meta.fs:.0f} s record -> {args.outdir}")
         return 0
     if args.workflow == "campaign":
         from das4whales_tpu.io.interrogators import get_acquisition_parameters

@@ -38,3 +38,42 @@ def test_cli_mfdetect_offline(tmp_path):
 def test_cli_unknown_workflow():
     res = _run(["definitely-not-a-workflow"])
     assert res.returncode != 0
+
+
+def test_cli_longrecord(tmp_path):
+    """Two consecutive synthetic files through the longrecord subcommand:
+    picks npz + summary.json land in --outdir and the record is treated
+    as one continuous block."""
+    import json
+
+    import numpy as np
+
+    sys.path.insert(0, ROOT)
+    from das4whales_tpu import io as dio
+    from das4whales_tpu.models.templates import gen_template_fincall
+
+    fs, nx, ns = 200.0, 24, 3072
+    rng = np.random.default_rng(5)
+    record = rng.standard_normal((nx, 2 * ns)) * 1e-9
+    t = np.arange(ns) / fs
+    call = np.asarray(gen_template_fincall(t, fs, 17.8, 28.8, 0.68, True))
+    n_call = int(0.68 * fs) + 1
+    # one call STRADDLING the file boundary
+    onset = ns - n_call // 2
+    record[7, onset:onset + n_call] += 8e-9 * call[:n_call]
+    paths = []
+    for k in range(2):
+        raw = np.round(record[:, k * ns:(k + 1) * ns] / 1e-12).astype(np.int32)
+        paths.append(dio.write_optasense(
+            str(tmp_path / f"seg{k}.h5"), raw, fs=fs, dx=4.0))
+
+    out = tmp_path / "lr"
+    res = _run(["longrecord", *paths, "--outdir", str(out), "--halo", "384"])
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "2 files as one" in res.stdout
+    summary = json.loads((out / "summary.json").read_text())
+    assert summary["n_files"] == 2 and summary["n_samples"] == 2 * ns
+    picks = np.load(out / "picks.npz")
+    hf = picks["picks_HF"]
+    sel = hf[1][hf[0] == 7]
+    assert len(sel) and np.abs(sel - onset).min() < 120, sel[:10]
