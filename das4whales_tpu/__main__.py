@@ -11,6 +11,8 @@ Examples::
     python -m das4whales_tpu mfdetect --outdir out            # offline demo
     python -m das4whales_tpu mfdetect https://.../file.h5
     python -m das4whales_tpu mfdetect --no-snr
+    python -m das4whales_tpu longrecord seg0.h5 seg1.h5       # one record
+    python -m das4whales_tpu campaign *.h5 --outdir out_camp
     python -m das4whales_tpu list
 """
 
