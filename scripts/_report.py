@@ -32,3 +32,20 @@ def upsert_section(path: str, marker: str, end_marker: str,
         tail = ""
     with open(path, "w") as fh:
         fh.write(head + body + tail)
+
+
+def preserve_tail(existing: str, end_marker: str, fallback_markers=()) -> str:
+    """Everything after ``end_marker``, ready to re-append ("" if none).
+
+    For legacy files written before the end marker existed, cut at the
+    EARLIEST of ``fallback_markers`` instead — a regeneration must never
+    destroy sections other scripts appended (the data-loss failure a
+    whole-file rewrite caused once in round 4)."""
+    if end_marker in existing:
+        tail = existing[existing.index(end_marker) + len(end_marker):]
+    else:
+        cuts = [existing.find(m) for m in fallback_markers]
+        cuts = [c for c in cuts if c >= 0]
+        tail = existing[min(cuts):] if cuts else ""
+    tail = tail.lstrip("\n")
+    return "\n" + tail if tail else ""

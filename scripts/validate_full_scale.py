@@ -38,6 +38,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 FS, DX = 200.0, 2.042
 BP_BAND = (14.0, 30.0)
 REL_THRESHOLD, HF_FACTOR = 0.5, 0.9
+FLAGSHIP_END = "<!-- /flagship-certification -->"
 
 
 def make_scene(nx, ns, n_calls=24, seed=7):
@@ -427,9 +428,30 @@ def write_report(path, nx, ns, rows, p_t, g_t, n_calls):
         "`python -m das4whales_tpu.workflows.mfdetect <url>` to close the "
         "loop.",
         "",
+        FLAGSHIP_END,
+        "",
     ]
+    # regenerate ONLY the flagship report: VALIDATION.md also carries the
+    # fused addendum and the sharded/spectro/gabor certification sections
+    # (other scripts' marker-delimited regions) — overwriting the whole
+    # file silently destroyed them once (round 4). Legacy files without
+    # the end marker cut at the earliest known foreign section instead.
+    from scripts._report import preserve_tail
+
+    tail = ""
+    try:
+        with open(path) as fh:
+            existing = fh.read()
+        tail = preserve_tail(existing, FLAGSHIP_END, (
+            "\n## Fused-route addendum",
+            "\n## Sharded-path certification",
+            "\n## Spectro-correlation family",
+            "\n## Gabor/image family",
+        ))
+    except OSError:
+        pass
     with open(path, "w") as fh:
-        fh.write("\n".join(lines))
+        fh.write("\n".join(lines) + tail)
 
 
 if __name__ == "__main__":
