@@ -74,11 +74,14 @@ def global_mesh(
     """Mesh over ALL devices of all processes, laid out DCN-friendly.
 
     The FIRST axis (``file`` — data parallelism) is the slowest-varying
-    and spans hosts, so only per-file scalars (the ``pmax`` threshold)
-    ever cross DCN; the LAST axis (``channel``/``time`` — the
-    ``all_to_all`` pencil-FFT axis) stays within a host's devices, i.e. on
-    ICI. With ``files_per_host=None`` the file axis gets exactly one shard
-    per process (the natural layout: each host ingests its own files —
+    and spans hosts; the LAST axis (``channel``/``time`` — the
+    ``all_to_all`` pencil-FFT axis) stays within a host's devices, i.e.
+    on ICI. Since every collective in the detection step reduces over
+    the channel/time axis, NOTHING in the step crosses DCN under this
+    layout — only result gathering does (verified by the two-process
+    runtime test, tests/test_multiprocess.py). With
+    ``files_per_host=None`` the file axis gets exactly one shard per
+    process (the natural layout: each host ingests its own files —
     ``io.stream`` reads locally, no cross-host data motion).
 
     Single-process: degenerates to ``make_mesh`` over local devices with

@@ -1,0 +1,121 @@
+"""Worker for the TRUE multi-process distributed test (spawned by
+tests/test_multiprocess.py with JAX_COORDINATOR/JAX_NUM_PROCESSES/
+JAX_PROCESS_ID in the environment; repo root arrives via PYTHONPATH).
+
+Each of two processes owns 2 virtual CPU devices; `initialize_from_env`
+forms the 4-device global runtime (Gloo TCP collectives here — ICI/DCN
+on a real pod). Two phases:
+
+1. `global_mesh` production layout (file=2, channel=2, process-major):
+   each file's channel collectives stay INSIDE one process by design —
+   this phase proves runtime formation, process-spanning global arrays,
+   and result gathering.
+2. a (file=1, channel=4) mesh whose channel axis SPANS both processes:
+   the step's `all_to_all` f-k transposes and `pmax` threshold now
+   genuinely traverse the inter-process backend, and the threshold must
+   equal phase 1's intra-process value for the same file.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import design_matched_filter
+    from das4whales_tpu.models.templates import gen_template_fincall
+    from das4whales_tpu.parallel import distributed, make_sharded_mf_step
+    from das4whales_tpu.parallel.pipeline import input_sharding
+
+    assert distributed.initialize_from_env() is True
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4 and len(jax.local_devices()) == 2
+
+    mesh = distributed.global_mesh()
+    assert dict(mesh.shape) == {"file": 2, "channel": 2}, dict(mesh.shape)
+    # each process ingests its own file (process-major file axis)
+    assert distributed.local_device_batch(2) == slice(
+        jax.process_index(), jax.process_index() + 1
+    )
+
+    nx, ns, fs = 16, 768, 200.0
+    meta = AcquisitionMetadata(fs=fs, dx=8.0, nx=nx, ns=ns)
+    design = design_matched_filter((nx, ns), [0, nx, 1], meta)
+    step = make_sharded_mf_step(design, mesh, outputs="picks")
+
+    # deterministic scene on every process; one HF call per file
+    rng = np.random.default_rng(0)
+    batch = (rng.standard_normal((2, nx, ns)) * 1e-9).astype(np.float32)
+    t = np.arange(ns) / fs
+    call = np.asarray(gen_template_fincall(t, fs, 17.8, 28.8, 0.68, True))
+    n_call = int(0.68 * fs) + 1
+    onsets = {0: (5, 100), 1: (11, 300)}
+    for f, (ch, on) in onsets.items():
+        batch[f, ch, on:on + n_call] += 8e-9 * call[:n_call]
+
+    sharding = input_sharding(mesh)
+    x = jax.make_array_from_callback(batch.shape, sharding,
+                                     lambda idx: batch[idx])
+    picks, thres = step(x)
+    jax.block_until_ready((picks, thres))
+
+    from jax.experimental import multihost_utils
+
+    positions = np.asarray(multihost_utils.process_allgather(
+        picks.positions, tiled=True))
+    selected = np.asarray(multihost_utils.process_allgather(
+        picks.selected, tiled=True))
+    thres_np = np.asarray(multihost_utils.process_allgather(thres, tiled=True))
+    assert positions.shape[:3] == (2, 2, nx)        # [nT, file, channel]
+    assert (thres_np > 0).all()
+
+    for f, (ch, on) in onsets.items():
+        pos = positions[0, f, ch][selected[0, f, ch]]   # HF template
+        assert pos.size and np.abs(pos - on).min() <= 2, (f, ch, pos[:8])
+
+    # phase 2 — channel axis SPANS the two processes: the all_to_all
+    # transposes and the pmax threshold now cross the inter-process
+    # backend (this is what rides DCN when a channel axis spans hosts)
+    from das4whales_tpu.parallel.mesh import make_mesh
+
+    mesh2 = make_mesh(shape=(1, 4), axis_names=("file", "channel"),
+                      devices=jax.devices())
+    step2 = make_sharded_mf_step(design, mesh2, outputs="picks")
+    x2 = jax.make_array_from_callback(
+        (1, nx, ns), input_sharding(mesh2), lambda idx: batch[:1][idx]
+    )
+    picks2, thres2 = step2(x2)
+    jax.block_until_ready((picks2, thres2))
+    pos2 = np.asarray(multihost_utils.process_allgather(picks2.positions,
+                                                        tiled=True))
+    sel2 = np.asarray(multihost_utils.process_allgather(picks2.selected,
+                                                        tiled=True))
+    t2 = float(np.asarray(multihost_utils.process_allgather(
+        thres2, tiled=True))[0])
+    ch, on = onsets[0]
+    hits = pos2[0, 0, ch][sel2[0, 0, ch]]
+    assert hits.size and np.abs(hits - on).min() <= 2, hits[:8]
+    # cross-layout consistency: the cross-process pmax must reproduce the
+    # intra-process threshold for the same file (a wrong-axis reduction
+    # cannot pass this)
+    t1_file0 = float(np.atleast_1d(thres_np)[0])
+    assert abs(t2 - t1_file0) < 1e-5 * max(1.0, abs(t1_file0)), (t2, t1_file0)
+
+    print(f"MP_OK pid={jax.process_index()} "
+          f"thres={[round(float(v), 4) for v in np.atleast_1d(thres_np)]}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
