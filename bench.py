@@ -81,6 +81,62 @@ def _force_cpu():
 # install step (heavy imports happen only after the fallback decision)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# Accelerator-result bank: the tunnel answers in short unpredictable
+# windows (TESTLOG.md), so a live window caught mid-session (watchdog →
+# tpu_session → bench.py) must survive until the round-end bench run even
+# if the tunnel is wedged again by then. A successful accelerator headline
+# is persisted here; a later invocation whose probe fails replays it —
+# honestly annotated — instead of emitting only a CPU-fallback line.
+BANK_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "artifacts", "bench_tpu_banked.json"
+)
+
+
+def _bank_payload(payload: dict) -> None:
+    """Persist an accelerator headline for later replay. Best-effort: the
+    bank is a bonus artifact and must never cost the JSON line."""
+    if os.environ.get("DAS_BENCH_NO_BANK"):
+        return
+    try:
+        os.makedirs(os.path.dirname(BANK_PATH), exist_ok=True)
+        with open(BANK_PATH, "w") as fh:
+            json.dump(dict(payload, banked_at_unix=time.time()), fh)
+    except OSError:
+        pass
+
+
+def _load_banked(max_age_h: float | None = None) -> dict | None:
+    """Return a previously banked accelerator payload, or None.
+
+    Age-capped (default 20 h, env ``DAS_BENCH_BANK_MAX_AGE_H``) so one
+    round's measurement can never masquerade as a later round's: the bank
+    only bridges wedge windows WITHIN a session, not across rounds.
+    """
+    if os.environ.get("DAS_BENCH_NO_BANK"):
+        return None
+    if max_age_h is None:
+        try:
+            max_age_h = float(os.environ.get("DAS_BENCH_BANK_MAX_AGE_H", 20.0))
+        except ValueError:
+            max_age_h = 20.0
+    # a corrupted/truncated bank (non-dict JSON, bad timestamp) must read
+    # as "no bank", never crash the wedged-tunnel path it protects
+    try:
+        with open(BANK_PATH) as fh:
+            payload = json.load(fh)
+        if not isinstance(payload, dict):
+            return None
+        age_h = (time.time() - float(payload.get("banked_at_unix", 0.0))) / 3600.0
+    except (OSError, json.JSONDecodeError, TypeError, ValueError):
+        return None
+    if age_h < 0 or age_h > max_age_h:
+        return None
+    device = str(payload.get("device", ""))
+    if not device or "cpu" in device.lower():
+        return None  # never replay a CPU line as accelerator evidence
+    payload["banked_age_h"] = round(age_h, 2)
+    return payload
+
 
 def _make_block(nx, ns, fs, dx, seed=0):
     """OOI-scale noise block with a handful of injected fin-call chirps."""
@@ -452,6 +508,21 @@ def main():
         # with backoff inside the budget — wedged tunnels sometimes recover.
         if not _probe_device_with_backoff(args.device_timeout):
             fallback = True
+            # --quick is the CI smoke: it must exercise the ladder for
+            # real, never return a stale full-shape payload
+            banked = None if args.quick else _load_banked()
+            if banked is not None:
+                # a live window earlier this session already produced an
+                # accelerator headline; replay it rather than degrade the
+                # round artifact to a CPU line (VERDICT r3 next-1: "the
+                # moment the chip answers, bank the number")
+                banked["banked"] = True
+                banked["device"] = (
+                    f"{banked['device']} [banked {banked['banked_age_h']}h ago; "
+                    "accelerator unreachable at report time]"
+                )
+                print(json.dumps(banked))
+                return 0
 
     fs, dx = 200.0, 2.042
     quick_shape = (1024, 3000, 256, 512)     # nx, ns, cpu_nx, peak_block
@@ -628,6 +699,10 @@ def main():
     }
     if errors:
         payload["error"] = "; ".join(errors)
+    if "cpu" not in device.lower() and not args.quick:
+        # full-ladder accelerator headlines only: a --quick (CI smoke)
+        # payload must never become the replayed round artifact
+        _bank_payload(payload)
     print(json.dumps(payload))
     return 0
 
