@@ -98,9 +98,21 @@ def _bank_payload(payload: dict) -> None:
     if os.environ.get("DAS_BENCH_NO_BANK"):
         return
     try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except (OSError, subprocess.TimeoutExpired):
+        commit = None
+    try:
         os.makedirs(os.path.dirname(BANK_PATH), exist_ok=True)
         with open(BANK_PATH, "w") as fh:
-            json.dump(dict(payload, banked_at_unix=time.time()), fh)
+            # banked_commit pins the measured code version; the replay
+            # carries it so a headline measured on commit X is never
+            # silently presented as evidence about later code
+            json.dump(dict(payload, banked_at_unix=time.time(),
+                           banked_commit=commit), fh)
     except OSError:
         pass
 
@@ -699,9 +711,11 @@ def main():
     }
     if errors:
         payload["error"] = "; ".join(errors)
-    if "cpu" not in device.lower() and not args.quick:
-        # full-ladder accelerator headlines only: a --quick (CI smoke)
-        # payload must never become the replayed round artifact
+    if not (ran_cpu or fallback or explicit_cpu or args.quick):
+        # full-ladder accelerator headlines only — gated on the explicit
+        # routing flags, not device-string sniffing: a --quick (CI smoke)
+        # or any CPU-routed payload must never become the replayed round
+        # artifact
         _bank_payload(payload)
     print(json.dumps(payload))
     return 0

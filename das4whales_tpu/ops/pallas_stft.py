@@ -69,9 +69,13 @@ def _span_blocks(xp: jnp.ndarray, nb: int, stride: int, span: int) -> jnp.ndarra
 
 
 def _stft_kernel(spans_ref, dft_ref, out_ref, frames_ref, *, fpb, cb, nfft, hop, nfreq):
-    # spans_ref [cb, 1, span]; frames_ref scratch [fpb, cb, nfft]
+    # spans_ref [1, cb, span]; frames_ref scratch [fpb, cb, nfft].
+    # The block's LAST TWO dims are (cb, span) — cb a multiple of 8, span
+    # the full array dim — which is what the Mosaic TPU lowering requires
+    # of block shapes; a (cb, 1, span) layout put a size-1 dim second-to-
+    # minor and failed to lower on the chip (round-4 on-chip session).
     for i in range(fpb):  # static unroll, static slices
-        frames_ref[i, :, :] = spans_ref[:, 0, i * hop : i * hop + nfft]
+        frames_ref[i, :, :] = spans_ref[0, :, i * hop : i * hop + nfft]
     flat = frames_ref[...].reshape(fpb * cb, nfft)
     prod = jnp.dot(flat, dft_ref[...], preferred_element_type=jnp.float32)
     re = prod[:, :nfreq]
@@ -103,7 +107,9 @@ def _stft_power_impl(x, dftm, nfft, hop, center, frames_per_block, channel_block
     nb = nf_pad // fpb
     stride = fpb * hop
     span = (fpb - 1) * hop + nfft
-    spans = _span_blocks(x, nb, stride, span)  # [c_pad, nb, span]
+    # [nb, c_pad, span]: block-index-major layout so each grid step's block
+    # keeps (channels, span) as its last two dims (see _stft_kernel note)
+    spans = jnp.swapaxes(_span_blocks(x, nb, stride, span), 0, 1)
 
     kernel = functools.partial(_stft_kernel, fpb=fpb, cb=cb, nfft=nfft, hop=hop, nfreq=nfreq)
     vmem = {} if _VMEM is None else {"memory_space": _VMEM}
@@ -116,7 +122,7 @@ def _stft_power_impl(x, dftm, nfft, hop, center, frames_per_block, channel_block
         kernel,
         grid=(c_pad // cb, nb),
         in_specs=[
-            pl.BlockSpec((cb, 1, span), lambda ci, bi: (ci, bi, 0), **vmem),
+            pl.BlockSpec((1, cb, span), lambda ci, bi: (bi, ci, 0), **vmem),
             pl.BlockSpec((nfft, 2 * nfreq), lambda ci, bi: (0, 0), **vmem),
         ],
         out_specs=pl.BlockSpec((cb, fpb, nfreq), lambda ci, bi: (ci, bi, 0), **vmem),
@@ -166,6 +172,12 @@ def stft_power(
         raise ValueError(f"unknown window {window!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not interpret:
+        # compiled Mosaic lowering requires the sublane-position block dims
+        # (cb for the spans block, fpb for the output block) to be
+        # multiples of 8; interpret mode has no such constraint
+        frames_per_block = -(-frames_per_block // 8) * 8
+        channel_block = -(-channel_block // 8) * 8
     dftm = jnp.asarray(_dft_matrix(nfft, win))
     return _stft_power_impl(
         jnp.asarray(x, jnp.float32), dftm, nfft, hop, center,
