@@ -303,6 +303,24 @@ def mf_envelope_tiled(corr_tiles: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+@functools.partial(jax.jit, static_argnames=("n_channels", "capacity"))
+def mf_compact_tiled_picks(positions, selected, n_channels: int, capacity: int):
+    """Tiled ``SparsePicks`` -> per-template compacted (channel, time)
+    buffers ON DEVICE (ops.peaks.compact_picks_rowmajor): the flattened
+    (tile-block, row) index IS the global channel index, so packing in
+    row-major slot order reproduces ``merge_tiled_picks``'s
+    reference-order output while moving only O(capacity) ints to the
+    host. Padding rows (channel >= n_channels) are masked out before
+    packing. Returns ``(chan [nT, capacity], times [nT, capacity],
+    count [nT])``; ``count > capacity`` means overflow — caller falls
+    back to the full-transfer merge."""
+    nt_, nT, t_, K = positions.shape
+    pos = jnp.swapaxes(positions, 0, 1).reshape(nT, nt_ * t_, K)
+    sel = jnp.swapaxes(selected, 0, 1).reshape(nT, nt_ * t_, K)
+    valid = (jnp.arange(nt_ * t_) < n_channels)[None, :, None]
+    return peak_ops.compact_picks_rowmajor(pos, sel & valid, capacity)
+
+
 def merge_tiled_picks(picks, template_idx: int, tile: int, n_channels: int) -> np.ndarray:
     """Tiled ``SparsePicks`` -> the reference's stacked ``(2, n)``
     [channel_idx, time_idx] array (detect.py:277-303 row-major order),
@@ -531,8 +549,28 @@ class MatchedFilterDetector:
         if self.pick_mode == "sparse":
             sp_picks = mf_pick_tiled(corr_tiles, thr_dev, self.max_peaks)
             sat = np.asarray(sp_picks.saturated)          # [n_tiles, nT, tile]
+            # device-side compaction: the full [n_tiles, nT, tile, K] slot
+            # grid is tens of MB per call (through the axon tunnel it
+            # dominated the measured on-chip wall, docs/PERF.md round-4);
+            # only the packed picks cross to the host. Overflow (count >
+            # capacity) falls back to the exact full-transfer merge.
+            cap = min(C * self.max_peaks, 1 << 20)
+            chan_d, times_d, cnt_d = mf_compact_tiled_picks(
+                sp_picks.positions, sp_picks.selected, C, cap
+            )
+            cnt = np.asarray(cnt_d)
+            kmax = int(cnt.max(initial=0))
+            if kmax <= cap:
+                # int64 to match np.nonzero's dtype on the fallback/mono
+                # routes: the public picks dtype must not vary by path
+                chan_np = np.asarray(chan_d[:, :kmax]).astype(np.int64)
+                times_np = np.asarray(times_d[:, :kmax]).astype(np.int64)
             for i, name in enumerate(names):
-                picks[name] = merge_tiled_picks(sp_picks, i, tile, C)
+                if kmax <= cap:
+                    k = int(cnt[i])
+                    picks[name] = np.asarray([chan_np[i, :k], times_np[i, :k]])
+                else:
+                    picks[name] = merge_tiled_picks(sp_picks, i, tile, C)
                 self._warn_saturated(name, sat[:, i].reshape(-1)[:C])
         else:
             env_tiles = mf_envelope_tiled(corr_tiles)

@@ -339,6 +339,41 @@ def sparse_to_pick_times(positions, selected) -> np.ndarray:
     return np.asarray([chan, positions[chan, slot]])
 
 
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def compact_picks_rowmajor(positions, selected, capacity: int):
+    """Stable on-device compaction of fixed-capacity picks.
+
+    ``positions``/``selected`` are ``[B, R, K]`` (batch, row, slot). For
+    each batch entry the selected picks are packed — in the same
+    row-major (row, slot) order ``np.nonzero`` walks — into fixed
+    ``capacity``-length buffers, so only ``O(capacity)`` ints cross the
+    device→host boundary instead of the full ``R*K`` slot grid. At the
+    canonical detection shape that grid is hundreds of MB per call and
+    dominated the measured on-chip wall (round-4 session, docs/PERF.md);
+    real pick counts are 3-4 orders smaller.
+
+    Returns ``(rows [B, capacity] int32, times [B, capacity] int32,
+    count [B] int32)``. Entries past ``count`` are undefined padding; a
+    ``count > capacity`` signals overflow — the caller must fall back to
+    the full-transfer path (picks are NOT truncated silently).
+    """
+    B, R, K = positions.shape
+    sel = selected.reshape(B, R * K)
+    pos = positions.reshape(B, R * K)
+    row_of = (jnp.arange(R * K, dtype=jnp.int32) // K)[None, :]
+    # stable pack: cumsum gives each selected slot its output index
+    idx = jnp.cumsum(sel.astype(jnp.int32), axis=-1) - 1
+    dest = jnp.where(sel, idx, capacity)  # unselected -> dropped
+    rows_out = jnp.zeros((B, capacity), jnp.int32).at[
+        jnp.arange(B, dtype=jnp.int32)[:, None], dest
+    ].set(jnp.broadcast_to(row_of, (B, R * K)), mode="drop")
+    times_out = jnp.zeros((B, capacity), jnp.int32).at[
+        jnp.arange(B, dtype=jnp.int32)[:, None], dest
+    ].set(pos.astype(jnp.int32), mode="drop")
+    count = jnp.sum(sel, axis=-1).astype(jnp.int32)
+    return rows_out, times_out, count
+
+
 @functools.partial(jax.jit, static_argnames=("block_size",))
 def find_peaks_prominence_blocked(x: jnp.ndarray, threshold, block_size: int = 1024) -> jnp.ndarray:
     """Channel-blocked variant of ``find_peaks_prominence`` for large

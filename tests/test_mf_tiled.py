@@ -226,3 +226,54 @@ def test_keep_correlograms_false_campaign_mode():
         r_snr = det_lean(block, with_snr=True)
         assert set(r_snr.snr) == set(det_full.design.template_names)
         assert r_snr.correlograms == {}
+
+
+def test_device_compaction_matches_full_transfer_merge():
+    """The on-device pick compaction (mf_compact_tiled_picks) must equal
+    the full-transfer merge_tiled_picks output exactly — same picks, same
+    reference row-major order — including with padding rows (nx not a
+    multiple of the tile)."""
+    from das4whales_tpu.models.matched_filter import (
+        mf_compact_tiled_picks,
+        mf_pick_tiled,
+        merge_tiled_picks,
+    )
+
+    nx, ns, tile = 50, 800, 16          # 50 -> 4 tiles with 14 padding rows
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    det = MatchedFilterDetector(
+        meta, [0, nx, 1], (nx, ns), channel_tile=tile, pick_mode="sparse"
+    )
+    block = _block(nx, ns)
+    trf_fk = det.filter_block(jnp.asarray(block))
+    corr_tiles, gmax = mf_correlate_tiled(
+        trf_fk, det._templates_true, det._template_mu, det._template_scale, tile
+    )
+    thr = jnp.asarray([0.45 * float(gmax), 0.35 * float(gmax)], jnp.float32)
+    sp = mf_pick_tiled(corr_tiles, thr, det.max_peaks)
+    cap = nx * det.max_peaks
+    chan, times, cnt = mf_compact_tiled_picks(sp.positions, sp.selected, nx, cap)
+    cnt = np.asarray(cnt)
+    for i in range(2):
+        ref = merge_tiled_picks(sp, i, tile, nx)
+        k = int(cnt[i])
+        assert k == ref.shape[1] and k > 0
+        np.testing.assert_array_equal(np.asarray(chan)[i, :k], ref[0])
+        np.testing.assert_array_equal(np.asarray(times)[i, :k], ref[1])
+
+
+def test_detector_sparse_route_uses_compaction_and_matches_monolithic():
+    """End-to-end: tiled+sparse picks (compaction path) == monolithic
+    sparse picks."""
+    nx, ns = 48, 900
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    block = _block(nx, ns)
+    det_mono = MatchedFilterDetector(
+        meta, [0, nx, 1], (nx, ns), channel_tile=None, pick_mode="sparse"
+    )
+    det_tiled = MatchedFilterDetector(
+        meta, [0, nx, 1], (nx, ns), channel_tile=16, pick_mode="sparse"
+    )
+    r_mono, r_tiled = det_mono(block), det_tiled(block)
+    for name in det_mono.design.template_names:
+        np.testing.assert_array_equal(r_mono.picks[name], r_tiled.picks[name])

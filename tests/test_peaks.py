@@ -175,3 +175,24 @@ def test_template_parity_with_scipy_chirp():
     want = np.zeros(1000)
     want[: len(want_hyp)] = want_hyp * np.hanning(len(want_hyp))
     np.testing.assert_allclose(tmpl, want, atol=1e-9)
+
+
+def test_compact_picks_rowmajor_order_and_overflow():
+    """Stable row-major packing; overflow reports count > capacity and
+    never silently truncates without signalling."""
+    import jax.numpy as jnp
+    from das4whales_tpu.ops.peaks import compact_picks_rowmajor
+
+    pos = jnp.asarray(
+        [[[3, 7, 999], [1, 999, 999], [2, 5, 8]]], dtype=jnp.int32
+    )  # [1, 3 rows, 3 slots]
+    sel = jnp.asarray([[[1, 1, 0], [1, 0, 0], [1, 1, 1]]], dtype=bool)
+    rows, times, cnt = compact_picks_rowmajor(pos, sel, capacity=8)
+    assert int(cnt[0]) == 6
+    np.testing.assert_array_equal(np.asarray(rows)[0, :6], [0, 0, 1, 2, 2, 2])
+    np.testing.assert_array_equal(np.asarray(times)[0, :6], [3, 7, 1, 2, 5, 8])
+
+    rows, times, cnt = compact_picks_rowmajor(pos, sel, capacity=4)
+    assert int(cnt[0]) == 6                      # overflow is visible
+    np.testing.assert_array_equal(np.asarray(rows)[0], [0, 0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(times)[0], [3, 7, 1, 2])
