@@ -1,0 +1,115 @@
+"""A/B the tiled detection stage knobs on the live chip.
+
+The round-4 on-chip bench measured envelope+peaks at 1.89 s against a
+31 ms roofline bound (docs/PERF.md) — the worst stage by far. This
+script splits that stage and sweeps its two knobs at canonical shape:
+
+* ``channel_tile`` (512 default): fewer, larger ``lax.map`` iterations
+  amortize per-iteration overhead but raise the per-tile working set
+  (HBM-budget-routed);
+* ``max_peaks`` K (256 default): drives the sparse kernel's top-k and
+  block-table sizes AND the pick-slot grid the compaction packs.
+
+Also times the correlate stage per tile size and one end-to-end
+``det(x)`` wall (device-side compaction path, models/matched_filter.py).
+Prints ONE JSON line; probe-guarded and deadline-guarded like every
+measurement script here (scripts/_wedge_guard.py); safe-but-slow on CPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    quick = "--quick" in sys.argv
+    nx, ns = (1050, 3000) if quick else (22050, 12000)
+    from scripts._wedge_guard import arm_deadline, resolve_backend
+
+    arm_deadline(float(os.environ.get("DAS_PERF_DEADLINE", 1500.0)))
+    fallback = resolve_backend()
+    if fallback:
+        print("accelerator unreachable; timing the A/B on CPU fallback", flush=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _make_block
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import (
+        MatchedFilterDetector,
+        mf_compact_tiled_picks,
+        mf_correlate_tiled,
+        mf_envelope_tiled,
+        mf_pick_tiled,
+    )
+
+    meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=nx, ns=ns)
+    det = MatchedFilterDetector(
+        meta, [0, nx, 1], (nx, ns), fused_bandpass=True, pick_mode="sparse"
+    )
+    block = _make_block(nx, ns, 200.0, 2.042)
+    slab = 4096
+    x = jnp.concatenate(
+        [jax.device_put(block[i : i + slab]) for i in range(0, nx, slab)], axis=0
+    )
+
+    def timed(fn, *args):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best, compile_s, out
+
+    trf = jax.block_until_ready(det.filter_block(x))
+    rows = []
+
+    for tile in (512, 2048):
+        corr_fn = lambda a: mf_correlate_tiled(
+            a, det._templates_true, det._template_mu, det._template_scale, tile
+        )
+        corr_s, corr_c, (corr_tiles, gmax) = timed(corr_fn, trf)
+        thr = jnp.asarray([0.45 * float(gmax), 0.5 * float(gmax)], jnp.float32)
+        env_s, env_c, _ = timed(mf_envelope_tiled, corr_tiles)
+        row = {"tile": tile, "correlate_s": round(corr_s, 4),
+               "envelope_only_s": round(env_s, 4)}
+        for K in (64, 256):
+            pick_fn = lambda ct, t: mf_pick_tiled(ct, t, K)
+            pick_s, pick_c, sp = timed(pick_fn, corr_tiles, thr)
+            comp_fn = lambda p, s: mf_compact_tiled_picks(
+                p, s, nx, min(nx * K, 1 << 20)
+            )
+            comp_s, comp_c, (_, _, cnt) = timed(comp_fn, sp.positions, sp.selected)
+            row[f"env_peaks_K{K}_s"] = round(pick_s, 4)
+            row[f"compact_K{K}_s"] = round(comp_s, 4)
+            row[f"n_picks_K{K}"] = int(np.asarray(cnt).sum())
+        rows.append(row)
+        del corr_tiles
+
+    e2e_s, e2e_compile, _ = timed(lambda a: det(a).picks, x)
+
+    print(json.dumps({
+        "metric": "tiled detection knobs A/B (correlate / envelope / peaks / compaction)",
+        "shape": [nx, ns],
+        "device": str(jax.devices()[0]),
+        "fallback": fallback,
+        "rows": rows,
+        "end_to_end_s": round(e2e_s, 4),
+        "end_to_end_compile_s": round(e2e_compile, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
