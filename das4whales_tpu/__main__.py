@@ -33,6 +33,15 @@ WORKFLOWS = {
 }
 
 
+def _add_route_flags(p, default, extra=""):
+    """The one filter-route knob, spelled once: --fused (library default)
+    vs --staged (the golden-validation baseline route)."""
+    p.add_argument("--fused", dest="fused", action="store_true", default=default,
+                   help="fused bandpass∘f-k route" + extra)
+    p.add_argument("--staged", dest="fused", action="store_false",
+                   help="opt back to the staged bandpass->f-k route")
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="das4whales_tpu",
@@ -55,8 +64,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="detector family to score (all: cross-family table)")
     pe.add_argument("--time-tol", type=float, default=0.5,
                     help="pick-to-arrival match tolerance [s]")
-    pe.add_argument("--fused", action="store_true",
-                    help="evaluate the fused bandpass∘f-k route")
+    _add_route_flags(pe, default=True, extra=" (the library default)")
     pc = sub.add_parser(
         "campaign",
         help="fault-tolerant resumable detection over many files "
@@ -77,9 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=("mf", "spectro", "gabor"),
                     help="detector family (spectro/gabor run through the "
                          "shared bandpass+f-k front end; single-chip only)")
-    pc.add_argument("--fused", action="store_true",
-                    help="fold the bandpass into the f-k mask (golden-"
-                         "certified fused route, VALIDATION.md; mf only)")
+    _add_route_flags(pc, default=True,
+                     extra=" (library default; also governs the spectro/"
+                           "gabor families' shared bandpass+f-k front end)")
     pl = sub.add_parser(
         "longrecord",
         help="continuous detection across file boundaries: consecutive "
@@ -93,10 +101,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="start,stop,step channel selection (default: all of file 0)")
     pl.add_argument("--family", default="mf", choices=("mf", "spectro", "gabor"))
     pl.add_argument("--halo", type=int, default=512,
-                    help="time-shard halo samples (boundary exactness of "
-                         "the zero-phase bandpass, all families)")
-    pl.add_argument("--fused", action="store_true",
-                    help="fused bandpass∘f-k route (mf only)")
+                    help="time-shard halo samples for the STAGED bandpass "
+                         "(all families; the mf fused default has no "
+                         "halo-exchange bandpass and ignores it — pass "
+                         "--staged to make --halo effective)")
+    _add_route_flags(pl, default=None,
+                     extra=" (mf-family default; spectro/gabor design "
+                           "their own bandpass)")
     pl.add_argument("--max-peaks", type=int, default=512,
                     help="pick capacity per channel")
     pl.add_argument("--interrogator", default="optasense")
@@ -254,7 +265,8 @@ def main(argv=None) -> int:
 
             csel = ChannelSelection.from_list(sel)
             shape = (csel.n_channels(meta0.nx), meta0.ns)
-            mf = MatchedFilterDetector(meta0, sel, shape)
+            mf = MatchedFilterDetector(meta0, sel, shape,
+                                        fused_bandpass=args.fused)
             if args.family == "spectro":
                 from das4whales_tpu.eval import SpectroEvalAdapter
                 from das4whales_tpu.models.spectro import SpectroCorrDetector

@@ -373,7 +373,7 @@ class MatchedFilterDetector:
         hbm_budget_bytes: int | None = None,
         keep_correlograms: bool = True,
         channel_pad: int | str | None = None,
-        fused_bandpass: bool = False,
+        fused_bandpass: bool = True,
     ):
         self.metadata = as_metadata(metadata)
         if templates is None:

@@ -94,7 +94,7 @@ def make_sharded_mf_step(
     pick_mode: str = "sparse",
     max_peaks: int = 256,
     outputs: str = "full",
-    fused_bandpass: bool = False,
+    fused_bandpass: bool = True,
 ):
     """Build the jitted multi-chip detection step for a
     ``[file x channel x time]`` batch.

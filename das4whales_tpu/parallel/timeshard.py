@@ -198,7 +198,7 @@ def make_sharded_mf_step_time(
     pick_mode: str = "sparse",
     max_peaks: int = 256,
     outputs: str = "full",
-    fused_bandpass: bool = False,
+    fused_bandpass: bool = True,
 ):
     """Full flagship detection step for a TIME-sharded ``[C, T]`` block.
 

@@ -263,7 +263,7 @@ def run_campaign_sharded(
     engine: str = "h5py",
     relative_threshold: float = 0.5,
     hf_factor: float = 0.9,
-    fused_bandpass: bool = False,
+    fused_bandpass: bool = True,
 ) -> CampaignResult:
     """Multi-chip campaign: file batches land pre-sharded on the mesh and
     the whole batch detects in ONE program (data-parallel over files,

@@ -69,7 +69,7 @@ def detect_long_record(
     fk_config=None,
     max_peaks_per_channel: int = 512,
     family: str = "mf",
-    fused_bandpass: bool = False,
+    fused_bandpass: bool | None = None,
     family_kwargs: dict | None = None,
 ) -> LongRecordResult:
     """Detect calls over a continuous multi-file record.
@@ -98,10 +98,24 @@ def detect_long_record(
             "family_kwargs only apply to family='spectro'/'gabor' — "
             f"got {sorted(fam_kw)} with family='mf' (did you forget family=?)"
         )
+    if fused_bandpass is None:
+        # library default: fused for the flagship family (the on-chip
+        # gate-3 decision, docs/PERF.md round-4); the spectro/gabor front
+        # end designs its own bandpass, so "fused" has no meaning there
+        fused_bandpass = family == "mf"
     if family != "mf" and fused_bandpass:
         raise ValueError(
             "fused_bandpass applies to the flagship family only; the "
             "spectro/gabor front end designs its own bandpass"
+        )
+    if family == "mf" and fused_bandpass and halo != 512:
+        import warnings
+
+        warnings.warn(
+            f"halo={halo} has no effect on the fused mf route (no "
+            "halo-exchange bandpass stage); pass fused_bandpass=False to "
+            "tune staged-bandpass boundary exactness",
+            stacklevel=2,
         )
     files = list(files)
     if not files:

@@ -38,6 +38,7 @@ AGENDA = [
      [sys.executable, "scripts/perf_kernels.py", "--full",
       "--markdown", "docs/PERF.md"], 2400),
     ("ab-channel-pad", [sys.executable, "scripts/ab_channel_pad.py"], 1800),
+    ("ab-detect-knobs", [sys.executable, "scripts/ab_detect_knobs.py"], 1500),
     ("profile-flagship", [sys.executable, "scripts/profile_flagship.py"], 1500),
     ("cli-mfdetect-on-tpu",
      [sys.executable, "-m", "das4whales_tpu", "mfdetect",

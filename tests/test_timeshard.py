@@ -94,7 +94,10 @@ def test_full_time_sharded_step_matches_single_device(tmesh, rng):
     tmpl = np.asarray(design.templates[0])
     x[10, 500 : 500 + tmpl.shape[-1]] += 5e-9 * tmpl[: min(tmpl.shape[-1], nns - 500)]
 
-    step = make_sharded_mf_step_time(design, tmesh, halo=384)
+    # staged explicitly: the comparison target below is the staged
+    # legacy program; fused time-sharding is pinned elsewhere
+    step = make_sharded_mf_step_time(design, tmesh, halo=384,
+                                     fused_bandpass=False)
     xd = jax.device_put(jnp.asarray(x), time_sharding(tmesh))
     trf_t, corr_t, env_t, picks_t, thres_t = jax.block_until_ready(step(xd))
 
@@ -157,7 +160,10 @@ def test_time_sharded_validation(tmesh):
     meta = AcquisitionMetadata(fs=FS, dx=DX, nx=32, ns=4096)
     design = design_matched_filter((32, 4096), [0, 32, 1], meta)
     with pytest.raises(ValueError, match="halo"):
-        make_sharded_mf_step_time(design, tmesh, halo=2048)
+        # the halo constraint belongs to the staged bandpass stage; the
+        # fused default has no halo-exchange bandpass to constrain
+        make_sharded_mf_step_time(design, tmesh, halo=2048,
+                                  fused_bandpass=False)
     bad = design_matched_filter((30, 4096), [0, 30, 1], meta)  # 30 % 4 != 0
     with pytest.raises(ValueError, match="divide"):
         make_sharded_mf_step_time(bad, tmesh)
@@ -171,7 +177,10 @@ def test_time_sharded_step_honors_design_band(tmesh, rng):
     design = design_matched_filter((nnx, nns), [0, nnx, 1], meta, bp_band=(20.0, 40.0))
     assert design.bp_band == (20.0, 40.0)
     x = rng.standard_normal((nnx, nns)).astype(np.float32) * 1e-9
-    step = make_sharded_mf_step_time(design, tmesh, halo=384)
+    # staged explicitly: the comparison target below is the staged
+    # legacy program; fused time-sharding is pinned elsewhere
+    step = make_sharded_mf_step_time(design, tmesh, halo=384,
+                                     fused_bandpass=False)
     xd = jax.device_put(jnp.asarray(x), time_sharding(tmesh))
     trf_t, *_ = jax.block_until_ready(step(xd))
     trf_s, _ = mf_filter_and_correlate(
