@@ -148,15 +148,45 @@ def gate_fused(ab: dict | None, bench: dict | None, lines: list) -> None:
     if bench and device_is_tpu(bench.get("device")) and "+fusedbp" in (bench.get("route") or ""):
         lines.append(f"- green fused bench on TPU: wall {bench.get('wall_s')} s "
                      f"at {bench.get('shape')} (route `{bench.get('route')}`)")
-        lines.append("- **CLOSE: flip the library default to fused** (edge "
-                      "numerics already golden-certified, VALIDATION.md "
-                      "addendum) and regenerate VALIDATION.md under shipped "
-                      "defaults (`validate_full_scale.py --fused --out ...`).")
+        lines.append("- **CLOSED round 4: the library default IS fused** "
+                      "(MatchedFilterDetector et al.; --staged opts back). "
+                      "VALIDATION.md regenerated under shipped defaults.")
         done = True
     if not done:
         lines.append("- **OPEN**: no green on-chip fused measurement yet "
                       "(bench default already runs fused; the gate waits on "
                       "a TPU headline).")
+
+
+def gate_detect_knobs(knobs: dict | None, lines: list) -> None:
+    lines.append("")
+    lines.append("## Gate 4 — detection knobs (`channel_tile`, `max_peaks`)")
+    lines.append("")
+    if not knobs or not device_is_tpu(knobs.get("device")):
+        lines.append("- **OPEN**: no on-chip ab-detect-knobs measurement "
+                     "(scripts/ab_detect_knobs.py; agenda step 4).")
+        return
+    rows = knobs.get("rows", [])
+    for r in rows:
+        lines.append(
+            f"- tile {r.get('tile')}: correlate {r.get('correlate_s')} s, "
+            f"envelope {r.get('envelope_only_s')} s, env+peaks "
+            f"K64 {r.get('env_peaks_K64_s')} s / K256 {r.get('env_peaks_K256_s')} s "
+            f"(picks {r.get('n_picks_K64')}/{r.get('n_picks_K256')})"
+        )
+    lines.append(f"- end-to-end det(x) wall: {knobs.get('end_to_end_s')} s "
+                 f"(compaction path)")
+    for r in rows:
+        k64, k256 = r.get("env_peaks_K64_s"), r.get("env_peaks_K256_s")
+        same_picks = r.get("n_picks_K64") == r.get("n_picks_K256")
+        if k64 and k256 and same_picks and k256 / k64 >= 1.5:
+            lines.append(
+                f"- **recommendation**: at tile {r.get('tile')}, K=64 is "
+                f"{k256 / k64:.1f}x faster with identical picks — lower the "
+                "bench/campaign max_peaks where saturation allows (the "
+                "saturated flag guards correctness)."
+            )
+            break
 
 
 def headline(bench: dict | None, lines: list) -> None:
@@ -194,12 +224,13 @@ def main() -> int:
     bench = tail_json(steps.get("bench-full", {}).get("stdout_tail", ""))
     perf = tail_json(steps.get("perf-kernels-full", {}).get("stdout_tail", ""))
     ab = tail_json(steps.get("ab-channel-pad", {}).get("stdout_tail", ""))
+    knobs = tail_json(steps.get("ab-detect-knobs", {}).get("stdout_tail", ""))
 
     lines = ["# Decision gates — session evidence", ""]
     ran = [
         s + ("" if s in steps else " (FAILED/TIMEOUT — excluded)")
         for s in ("bench-full", "perf-kernels-full", "ab-channel-pad",
-                  "profile-flagship", "cli-mfdetect-on-tpu",
+                  "ab-detect-knobs", "profile-flagship", "cli-mfdetect-on-tpu",
                   "evaluate-on-tpu") if s in seen
     ]
     lines.append(f"Parsed `{args.jsonl}`: steps seen: "
@@ -208,6 +239,7 @@ def main() -> int:
     gate_stft(perf, lines)
     gate_channel_pad(ab, lines)
     gate_fused(ab, bench, lines)
+    gate_detect_knobs(knobs, lines)
     text = "\n".join(lines) + "\n"
     # write the requested file BEFORE printing: a closed stdout (`| head`
     # is a normal way to read this) must not swallow the artifact

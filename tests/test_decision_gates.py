@@ -87,7 +87,7 @@ def test_green_tpu_session_closes_gates(tmp_path):
     assert "**MET**" in report                       # north star at 43 ms
     assert "keep Pallas default" in report           # majority on-chip win
     assert "keep channel_pad=None" in report         # 1.02x < threshold
-    assert "flip the library default to fused" in report
+    assert "the library default IS fused" in report
 
 
 def test_cpu_fallback_numbers_stay_open(tmp_path):
@@ -127,3 +127,28 @@ def test_out_file_written_even_when_stdout_closes(tmp_path):
         os.close(w)
     assert proc.returncode == 0, proc.stderr[-300:]
     assert dg.exists() and "Decision gates" in dg.read_text()
+
+
+def test_detect_knobs_gate(tmp_path):
+    knobs = json.dumps({
+        "device": "TPU v5 lite0", "shape": [22050, 12000], "rows": [
+            {"tile": 512, "correlate_s": 0.28, "envelope_only_s": 0.6,
+             "env_peaks_K64_s": 0.5, "env_peaks_K256_s": 1.6,
+             "compact_K64_s": 0.01, "compact_K256_s": 0.01,
+             "n_picks_K64": 176435, "n_picks_K256": 176435}],
+        "end_to_end_s": 3.1})
+    p = write_session(tmp_path / "s.jsonl", [
+        {"step": "ab-detect-knobs", "rc": 0, "stdout_tail": knobs},
+    ])
+    report = run_report(p)
+    assert "K64 0.5 s / K256 1.6 s" in report
+    assert "K=64 is 3.2x faster with identical picks" in report
+
+    # CPU-fallback knob data must not close the gate
+    knobs_cpu = json.loads(knobs)
+    knobs_cpu["device"] = "TFRT_CPU_0"
+    p2 = write_session(tmp_path / "s2.jsonl", [
+        {"step": "ab-detect-knobs", "rc": 0, "stdout_tail": json.dumps(knobs_cpu)},
+    ])
+    report2 = run_report(p2)
+    assert "OPEN**: no on-chip ab-detect-knobs measurement" in report2
