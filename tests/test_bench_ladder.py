@@ -323,6 +323,44 @@ def test_quick_smoke_never_replays_bank_and_corrupt_bank_is_ignored(
         assert p["device"].startswith("cpu-fallback")
 
 
+def test_chpad_rung_wins_headline_when_faster(monkeypatch):
+    """The canonical pow2-channel-pad rung is an in-path A/B: when it
+    beats the exact-length rung, IT is the headline (same shape, lower
+    wall)."""
+    def spawn(spec, timeout_s, cpu=False):
+        if spec.get("cpu_baseline"):
+            return {"cpu_wall": 10.0, "n_picks": 4}, None
+        if spec["kw"].get("channel_pad"):
+            return dict(TPU_OK, wall=0.3, route="tiled+fusedbp+chpad32768"), None
+        return dict(TPU_OK, wall=0.5), None
+
+    rc, p = run_scenario(monkeypatch, spawn)
+    assert p["shape"] == [22050, 12000]
+    assert p["wall_s"] == 0.3 and "chpad" in p["route"]
+    # the losing exact-length wall stays reconstructable from the artifact
+    assert p["rung_walls_s"]["full"] == 0.5
+    assert p["rung_walls_s"]["full-chpad-pow2"] == 0.3
+
+
+def test_chpad_rung_failure_keeps_exact_headline_and_skips_backup(monkeypatch):
+    attempts = []
+
+    def spawn(spec, timeout_s, cpu=False):
+        if spec.get("cpu_baseline"):
+            return {"cpu_wall": 10.0, "n_picks": 4}, None
+        attempts.append((spec["kw"].get("channel_tile"),
+                         spec["kw"].get("channel_pad")))
+        if spec["kw"].get("channel_pad"):
+            return None, "RESOURCE_EXHAUSTED: out of HBM"
+        return dict(TPU_OK, wall=0.5), None
+
+    rc, p = run_scenario(monkeypatch, spawn)
+    assert p["shape"] == [22050, 12000] and p["wall_s"] == 0.5
+    assert "full-chpad-pow2: RESOURCE_EXHAUSTED" in p["error"]
+    # the tile-1024 backup never runs once a canonical number is banked
+    assert (1024, None) not in attempts
+
+
 def test_bank_keeps_best_payload(monkeypatch, tmp_path):
     """Re-banking must never replace a better session number with a worse
     one (larger shape wins; same shape, higher throughput wins)."""
