@@ -249,6 +249,45 @@ def run_campaign(
     return CampaignResult(outdir=outdir, records=records)
 
 
+# per-(template, file) pack capacity for the sharded campaign's pick
+# transfer; counts above it trigger the exact full-grid fallback
+_PICK_PACK_CAP = 1 << 18
+
+
+def _compact_batch_picks(positions, selected, n_samples: int, capacity: int):
+    """Sharded-step ``SparsePicks`` ``[nT, B, C, K]`` -> per-(template,
+    file) packed ``(chan [nT, B, cap], time [nT, B, cap], count [nT, B])``
+    ON the mesh (``ops.peaks.compact_picks_rowmajor``; GSPMD inserts the
+    gathers). Applies the same time-padding mask
+    (``positions < n_samples``) as ``eval.sharded_picks_to_dict`` so the
+    packed picks equal the full-transfer path's output exactly, in the
+    same row-major order. Module-level jit: one trace per batch shape
+    across the whole campaign (no-retrace discipline, docs/DESIGN.md)."""
+    import functools
+
+    import jax
+
+    global _compact_batch_picks_jit
+    if _compact_batch_picks_jit is None:
+        from ..ops import peaks as peak_ops
+
+        @functools.partial(jax.jit, static_argnames=("ns_", "cap"))
+        def _run(pos, sel, ns_, cap):
+            nT, B, C, K = pos.shape
+            sel = sel & (pos < ns_)
+            rows, times, cnt = peak_ops.compact_picks_rowmajor(
+                pos.reshape(nT * B, C, K), sel.reshape(nT * B, C, K), cap
+            )
+            return (rows.reshape(nT, B, cap), times.reshape(nT, B, cap),
+                    cnt.reshape(nT, B))
+
+        _compact_batch_picks_jit = _run
+    return _compact_batch_picks_jit(positions, selected, n_samples, capacity)
+
+
+_compact_batch_picks_jit = None
+
+
 def run_campaign_sharded(
     files: Sequence[str],
     selected_channels,
@@ -337,17 +376,46 @@ def run_campaign_sharded(
         sp_picks, thres = jax.block_until_ready(step(stack))
         wall = time.perf_counter() - t0
         thres_np = np.asarray(thres)
-        # one device->host conversion per batch, not per file
-        host_picks = types.SimpleNamespace(
-            positions=np.asarray(sp_picks.positions),
-            selected=np.asarray(sp_picks.selected),
+        # pack picks on the mesh before they cross to the host (same
+        # boundary-crossing reduction as the single-chip detector's
+        # device-side compaction, models/matched_filter.py): only
+        # O(actual picks) ints transfer instead of the [nT, B, C, K]
+        # slot grid. Overflow (count > cap) falls back to the exact
+        # full-grid transfer — never silent truncation.
+        nT, B, Cr, K = sp_picks.positions.shape
+        cap = min(Cr * K, _PICK_PACK_CAP)
+        rows_d, times_d, cnt_d = _compact_batch_picks(
+            sp_picks.positions, sp_picks.selected, spec0.meta.ns, cap
         )
+        cnt = np.asarray(cnt_d)
+        kmax = int(cnt.max(initial=0))
+        host_picks = None
+        if kmax <= cap:
+            # pow2-rounded slice: at most log2(cap) distinct transfer
+            # shapes across a campaign (per-file exact slicing happens
+            # host-side below) — no per-batch retrace
+            kpad = min(cap, 1 << max(kmax - 1, 0).bit_length())
+            rows_np = np.asarray(rows_d[..., :kpad]).astype(np.int64)
+            times_np = np.asarray(times_d[..., :kpad]).astype(np.int64)
+        else:
+            # one device->host conversion per batch, not per file
+            host_picks = types.SimpleNamespace(
+                positions=np.asarray(sp_picks.positions),
+                selected=np.asarray(sp_picks.selected),
+            )
         for k, _block in enumerate(blocks):
             path = healthy[consumed + k]
-            picks = sharded_picks_to_dict(
-                host_picks, design.template_names, file_index=k,
-                n_samples=spec0.meta.ns,
-            )
+            if host_picks is None:
+                picks = {
+                    name: np.asarray([rows_np[i, k, : cnt[i, k]],
+                                      times_np[i, k, : cnt[i, k]]])
+                    for i, name in enumerate(design.template_names)
+                }
+            else:
+                picks = sharded_picks_to_dict(
+                    host_picks, design.template_names, file_index=k,
+                    n_samples=spec0.meta.ns,
+                )
             thresholds = {name: float(thres_np[k]) * factors[name]
                           for name in design.template_names}
             rec = FileRecord(

@@ -177,3 +177,30 @@ def test_failure_free_run(tmp_path):
     res = run_campaign([p], SEL, str(tmp_path / "camp"))
     assert res.n_done == 1 and res.n_failed == 0
     assert res.records[0].wall_s > 0
+
+
+def test_sharded_campaign_packed_picks_match_full_transfer(file_set, tmp_path, monkeypatch):
+    """The on-mesh pick pack must produce byte-identical picks artifacts
+    to the full-grid-transfer fallback (forced via a tiny pack cap)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8-device mesh")
+    import das4whales_tpu.workflows.campaign as camp
+    from das4whales_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    out_packed = str(tmp_path / "packed")
+    res_p = camp.run_campaign_sharded(file_set, SEL, out_packed, mesh)
+    monkeypatch.setattr(camp, "_PICK_PACK_CAP", 1)     # force overflow path
+    out_full = str(tmp_path / "full")
+    res_f = camp.run_campaign_sharded(file_set, SEL, out_full, mesh)
+    assert res_p.n_done == res_f.n_done == 2
+    done_p = sorted((r.path, r.picks_file) for r in res_p.records if r.status == "done")
+    done_f = sorted((r.path, r.picks_file) for r in res_f.records if r.status == "done")
+    for (path_p, pf_p), (path_f, pf_f) in zip(done_p, done_f):
+        assert os.path.basename(path_p) == os.path.basename(path_f)
+        picks_p, picks_f = load_picks(pf_p), load_picks(pf_f)
+        assert set(picks_p) == set(picks_f)
+        for name in picks_p:
+            np.testing.assert_array_equal(picks_p[name], picks_f[name])
