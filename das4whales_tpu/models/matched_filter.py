@@ -558,15 +558,10 @@ class MatchedFilterDetector:
             chan_d, times_d, cnt_d = mf_compact_tiled_picks(
                 sp_picks.positions, sp_picks.selected, C, cap
             )
-            cnt = np.asarray(cnt_d)
-            kmax = int(cnt.max(initial=0))
-            if kmax <= cap:
-                # int64 to match np.nonzero's dtype on the fallback/mono
-                # routes: the public picks dtype must not vary by path
-                chan_np = np.asarray(chan_d[:, :kmax]).astype(np.int64)
-                times_np = np.asarray(times_d[:, :kmax]).astype(np.int64)
+            packed = peak_ops.compacted_to_host(chan_d, times_d, cnt_d, cap)
             for i, name in enumerate(names):
-                if kmax <= cap:
+                if packed is not None:
+                    chan_np, times_np, cnt = packed
                     k = int(cnt[i])
                     picks[name] = np.asarray([chan_np[i, :k], times_np[i, :k]])
                 else:

@@ -374,6 +374,29 @@ def compact_picks_rowmajor(positions, selected, capacity: int):
     return rows_out, times_out, count
 
 
+def compacted_to_host(rows_d, times_d, cnt_d, capacity: int):
+    """Bring ``compact_picks_rowmajor`` outputs to the host, or report
+    overflow.
+
+    Returns ``(rows int64 [..., kpad], times int64 [..., kpad],
+    count np [...])`` with the slot axis sliced to the pow2-rounded max
+    count (at most log2(capacity) distinct transfer shapes — no
+    per-call retrace), or ``None`` when any count exceeds ``capacity``
+    (caller must fall back to its exact full-grid path). int64 matches
+    the ``np.nonzero`` dtype of the full-transfer paths so the public
+    picks dtype never varies by route."""
+    cnt = np.asarray(cnt_d)
+    kmax = int(cnt.max(initial=0))
+    if kmax > capacity:
+        return None
+    kpad = min(capacity, 1 << max(kmax - 1, 0).bit_length())
+    return (
+        np.asarray(rows_d[..., :kpad]).astype(np.int64),
+        np.asarray(times_d[..., :kpad]).astype(np.int64),
+        cnt,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("block_size",))
 def find_peaks_prominence_blocked(x: jnp.ndarray, threshold, block_size: int = 1024) -> jnp.ndarray:
     """Channel-blocked variant of ``find_peaks_prominence`` for large

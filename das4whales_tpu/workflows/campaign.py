@@ -325,6 +325,7 @@ def run_campaign_sharded(
 
     from ..eval import sharded_picks_to_dict
     from ..io.stream import _probe, stream_file_batches
+    from ..ops.peaks import compacted_to_host
     from ..parallel.pipeline import make_sharded_mf_step
 
     os.makedirs(outdir, exist_ok=True)
@@ -387,16 +388,10 @@ def run_campaign_sharded(
         rows_d, times_d, cnt_d = _compact_batch_picks(
             sp_picks.positions, sp_picks.selected, spec0.meta.ns, cap
         )
-        cnt = np.asarray(cnt_d)
-        kmax = int(cnt.max(initial=0))
         host_picks = None
-        if kmax <= cap:
-            # pow2-rounded slice: at most log2(cap) distinct transfer
-            # shapes across a campaign (per-file exact slicing happens
-            # host-side below) — no per-batch retrace
-            kpad = min(cap, 1 << max(kmax - 1, 0).bit_length())
-            rows_np = np.asarray(rows_d[..., :kpad]).astype(np.int64)
-            times_np = np.asarray(times_d[..., :kpad]).astype(np.int64)
+        packed = compacted_to_host(rows_d, times_d, cnt_d, cap)
+        if packed is not None:
+            rows_np, times_np, cnt = packed
         else:
             # one device->host conversion per batch, not per file
             host_picks = types.SimpleNamespace(

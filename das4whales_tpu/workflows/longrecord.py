@@ -17,6 +17,7 @@ Returns picks with absolute times from the first file's UTC start.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Dict, Sequence
 
@@ -50,6 +51,23 @@ def _pad_to_multiple(x: np.ndarray, mult: int) -> np.ndarray:
     if pad:
         x = np.pad(x, ((0, 0), (0, pad)))
     return x
+
+
+# record-level pack capacity; counts above it trigger the exact
+# full-grid fallback (kept monkeypatchable for the parity test)
+_PICK_PACK_CAP = 1 << 20
+
+
+@functools.partial(jax.jit, static_argnames=("capacity",))
+def _pack_record_picks(positions, selected, ns_eff, capacity: int):
+    """Pack the record's ``[nT, C, K]`` pick grid on device (same
+    boundary-crossing reduction as the campaign/single-chip paths;
+    ``ops.peaks.compact_picks_rowmajor`` keeps the reference row-major
+    order). ``ns_eff`` drops picks inside the divisibility padding —
+    already divided by any frame→sample scale so the comparison runs on
+    raw positions."""
+    sel = selected & (positions < ns_eff)
+    return peak_ops.compact_picks_rowmajor(positions, sel, capacity)
 
 
 def detect_long_record(
@@ -240,9 +258,22 @@ def detect_long_record(
             pos_scale = 1
 
     picks, times_s, thr_out = {}, {}, {}
-    positions = np.asarray(sp_picks.positions) * pos_scale
-    selected = np.asarray(sp_picks.selected)
     saturated = np.asarray(sp_picks.saturated)
+    # drop picks inside the divisibility padding (padded zeros cannot
+    # raise the pmax threshold, but the envelope can ring there); the
+    # mask runs on raw (pre-scale) positions inside the device pack
+    ns_eff = (n_samples - 1) // pos_scale + 1
+    cap = min(int(np.prod(sp_picks.positions.shape[-2:])), _PICK_PACK_CAP)
+    rows_d, times_d, cnt_d = _pack_record_picks(
+        sp_picks.positions, sp_picks.selected, ns_eff, cap
+    )
+    packed = peak_ops.compacted_to_host(rows_d, times_d, cnt_d, cap)
+    if packed is not None:
+        rows_np, times_np, cnt = packed
+        positions = selected = None
+    else:  # pack overflow: exact full-grid fallback
+        positions = np.asarray(sp_picks.positions)
+        selected = np.asarray(sp_picks.selected)
     for i, name in enumerate(names):
         if saturated[i].any():
             log.warning(
@@ -251,10 +282,13 @@ def detect_long_record(
                 "max_peaks_per_channel to keep them",
                 name, int(saturated[i].sum()), nnx, max_peaks_per_channel,
             )
-        # drop picks inside the divisibility padding (padded zeros cannot
-        # raise the pmax threshold, but the envelope can ring there)
-        sel = selected[i] & (positions[i] < n_samples)
-        pk = peak_ops.sparse_to_pick_times(positions[i], sel)
+        if positions is None:
+            k = int(cnt[i])
+            pk = np.asarray([rows_np[i, :k], times_np[i, :k] * pos_scale])
+        else:
+            sel = selected[i] & (positions[i] < ns_eff)
+            pk = peak_ops.sparse_to_pick_times(positions[i], sel)
+            pk = np.asarray([pk[0], pk[1] * pos_scale])
         picks[name] = pk
         times_s[name] = pk[1] / meta.fs
         thr_out[name] = thr_map[name]
