@@ -291,7 +291,12 @@ def bench_stages(det, x, repeats=3):
         thres = 0.5 * float(gmax)
         thr = jnp.asarray([0.9 * thres] + [thres] * (nT - 1), x.dtype)
         if det.pick_mode == "sparse":
-            pick_fn = lambda ct, t: mf_pick_tiled(ct, t, det.max_peaks)
+            # time the exact production pattern — THE escalation policy
+            # (ops.peaks.picks_with_escalation), including its saturation
+            # check and any full-capacity rerun
+            pick_fn = lambda ct, t: peak_ops.picks_with_escalation(
+                lambda k: mf_pick_tiled(ct, t, k), det.pick_k0, det.max_peaks
+            )
             stages["envelope+peaks"], _ = timed(pick_fn, corr_tiles, thr)
         else:  # scipy/dense engines untile the envelope (matched_filter._call_tiled)
             C = trf.shape[0]
@@ -314,8 +319,13 @@ def bench_stages(det, x, repeats=3):
         env_fn = jax.jit(lambda a: jnp.abs(spectral.analytic_signal(a, axis=-1)))
 
         def sparse_peaks_fn(env, thr):
+            # the detector's per-template adaptive-K pattern, via THE
+            # escalation policy helper
             return [
-                peak_ops.find_peaks_sparse(env[i], thr[i], max_peaks=det.max_peaks)
+                peak_ops.picks_with_escalation(
+                    lambda k: peak_ops.find_peaks_sparse(env[i], thr[i], max_peaks=k),
+                    det.pick_k0, det.max_peaks,
+                )
                 for i in range(env.shape[0])
             ]
 

@@ -374,6 +374,22 @@ def compact_picks_rowmajor(positions, selected, capacity: int):
     return rows_out, times_out, count
 
 
+def picks_with_escalation(run, k0: int, k_full: int):
+    """Adaptive-K sparse picking: ``run(k)`` must return a result with a
+    ``.saturated`` row mask. Runs at ``k0`` and reruns at ``k_full``
+    only when a row saturated — bit-identical to running at ``k_full``
+    directly, because ``saturated`` is precisely "more candidates than K
+    passed the height prefilter" and a non-saturated row's picks are
+    exact at any K. The kernel's top-k and block tables scale with K, so
+    the saturation-free common case is several times cheaper
+    (docs/PERF.md knob A/B). THE escalation policy: the detector routes
+    and the bench's stage mirror all call this one function."""
+    res = run(k0)
+    if k0 < k_full and bool(np.asarray(res.saturated).any()):
+        res = run(k_full)
+    return res
+
+
 def compacted_to_host(rows_d, times_d, cnt_d, capacity: int):
     """Bring ``compact_picks_rowmajor`` outputs to the host, or report
     overflow.

@@ -277,3 +277,28 @@ def test_detector_sparse_route_uses_compaction_and_matches_monolithic():
     r_mono, r_tiled = det_mono(block), det_tiled(block)
     for name in det_mono.design.template_names:
         np.testing.assert_array_equal(r_mono.picks[name], r_tiled.picks[name])
+
+
+def test_adaptive_k_escalation_is_exact():
+    """A saturating pick_k0 must escalate to the full-capacity kernel and
+    produce picks identical to running at full capacity directly — on
+    both the tiled and monolithic sparse routes."""
+    nx, ns = 48, 900
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    block = _block(nx, ns)
+    for tile in (16, None):
+        det = MatchedFilterDetector(
+            meta, [0, nx, 1], (nx, ns), channel_tile=tile, pick_mode="sparse"
+        )
+        det_full = MatchedFilterDetector(
+            meta, [0, nx, 1], (nx, ns), channel_tile=tile, pick_mode="sparse"
+        )
+        det_full.pick_k0 = det_full.max_peaks      # escalation disabled
+        # a low threshold makes many noise maxima pass the prefilter, so
+        # k0=2 must saturate and escalate
+        det.pick_k0 = 2
+        thr = 1e-12
+        r_ad, r_full = det(block, threshold=thr), det_full(block, threshold=thr)
+        for name in det.design.template_names:
+            assert r_full.picks[name].shape[1] > det.pick_k0
+            np.testing.assert_array_equal(r_ad.picks[name], r_full.picks[name])
