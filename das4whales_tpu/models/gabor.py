@@ -153,8 +153,10 @@ class GaborDetector:
             hf_discount = 0.9 if (name == "HF" and threshold is None) else 1.0
             thr = thres * hf_discount  # HF picked at 0.9*thres (relative policy)
             env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
-            pos, _, _, sel, saturated = peak_ops.find_peaks_sparse(
-                env, thr, max_peaks=self.max_peaks
+            # adaptive K with exact escalation on saturation (ops.peaks)
+            pos, _, _, sel, saturated = peak_ops.picks_with_escalation(
+                lambda k: peak_ops.find_peaks_sparse(env, thr, max_peaks=k),
+                min(64, self.max_peaks), self.max_peaks,
             )
             peak_ops.warn_saturated(saturated, f"note {name}", self.max_peaks)
             picks[name] = peak_ops.sparse_to_pick_times(pos, sel)

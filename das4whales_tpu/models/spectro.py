@@ -246,9 +246,13 @@ class SpectroCorrDetector:
             )
             correlograms[name] = corr
             # correlograms are half-wave rectified (nonnegative), so the
-            # sparse height-prefiltered route is exact
-            pos, _, _, sel, saturated = peak_ops.find_peaks_sparse(
-                corr, self.threshold, max_peaks=self.max_peaks
+            # sparse height-prefiltered route is exact; adaptive K with
+            # exact escalation on saturation (ops.peaks)
+            pos, _, _, sel, saturated = peak_ops.picks_with_escalation(
+                lambda k: peak_ops.find_peaks_sparse(
+                    corr, self.threshold, max_peaks=k
+                ),
+                min(64, self.max_peaks), self.max_peaks,
             )
             peak_ops.warn_saturated(saturated, f"kernel {name}", self.max_peaks)
             picks[name] = peak_ops.sparse_to_pick_times(pos, sel)
