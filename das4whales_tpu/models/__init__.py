@@ -2,3 +2,6 @@
 
 from . import matched_filter, templates  # noqa: F401
 from .matched_filter import MatchedFilterDetector  # noqa: F401
+# the learned (CNN) family imports lazily where used — it pulls optax,
+# which the signal-processing families never need:
+#   from das4whales_tpu.models import learned

@@ -1,0 +1,282 @@
+"""Learned call detector: a trainable CNN spectrogram classifier.
+
+A fourth detector family the reference does not have. The three
+signal-processing families (matched filter, spectrogram correlation,
+Gabor/image — SURVEY.md §2) all assume a known call shape; this family
+LEARNS the call signature from labeled (or synthetic, ``io/synth``)
+data, which is the standard modern route for call types without clean
+templates.
+
+TPU-first by construction:
+
+* features are the framework's own batched STFT
+  (``ops.spectral.stft_magnitude`` — MXU Pallas engine on TPU), log
+  compressed, framed into overlapping windows;
+* the classifier is a small plain-jnp CNN (two strided convs + linear
+  head) whose convs are MXU work; the whole train step (forward, BCE
+  loss, backward, adamw update) is ONE jitted XLA program;
+* data parallelism is plain GSPMD: batches placed with a
+  ``NamedSharding`` over the mesh's batch axis make jit insert the
+  gradient ``psum`` — no hand-written collectives
+  (``make_sharded_train_step``);
+* inference slides the classifier over every (channel, window) of a
+  block in one program and emits the same ``picks`` contract as the
+  other families, so the eval harness (``eval.evaluate_detector``) and
+  campaign plumbing apply unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import spectral
+
+
+@dataclass(frozen=True)
+class LearnedConfig:
+    """Feature + model + optimization hyperparameters."""
+
+    nfft: int = 128          # STFT size (fs=200 -> 1.56 Hz bins)
+    hop: int = 32            # STFT hop (0.16 s at 200 Hz)
+    win_frames: int = 8      # frames per classified window (~1.3 s)
+    win_stride: int = 4      # window stride in frames (~0.64 s)
+    fmax_bin: int = 32       # keep bins [0, fmax_bin) (~50 Hz at fs=200)
+    features: tuple = (16, 32)
+    lr: float = 1e-2
+    weight_decay: float = 1e-4
+
+
+def window_features(block, cfg: LearnedConfig):
+    """``[C, T]`` strain block -> per-channel log-spectrogram windows.
+
+    Returns ``(windows [C, n_win, F, W], centers [n_win])`` where
+    ``centers`` are window-center SAMPLE indices. Per-window
+    standardization (mean/std over the window) makes the classifier
+    amplitude-invariant — the analog of the reference detectors'
+    per-channel normalization (detect.py:157).
+    """
+    x = jnp.asarray(block, jnp.float32)
+    mag = spectral.stft_magnitude(x, cfg.nfft, cfg.hop)   # [C, F, n_frames]
+    mag = mag[:, : cfg.fmax_bin, :]
+    logm = jnp.log1p(mag * 1e6)  # strain ~1e-9..1e-6; keep well-scaled
+    n_frames = logm.shape[-1]
+    n_win = max(0, (n_frames - cfg.win_frames) // cfg.win_stride + 1)
+    idx = (np.arange(n_win)[:, None] * cfg.win_stride
+           + np.arange(cfg.win_frames)[None, :])          # [n_win, W]
+    win = jnp.transpose(logm[:, :, idx], (0, 2, 1, 3))    # [C, n_win, F, W]
+    mu = jnp.mean(win, axis=(-2, -1), keepdims=True)
+    sd = jnp.std(win, axis=(-2, -1), keepdims=True)
+    win = (win - mu) / jnp.maximum(sd, 1e-6)
+    centers = (idx.mean(axis=1) * cfg.hop).astype(np.int64)
+    return win, centers
+
+
+def window_labels(scene, centers: np.ndarray, cfg: LearnedConfig) -> np.ndarray:
+    """``[C, n_win]`` {0,1} labels: window center within half a window of
+    any call's arrival-plus-half-duration at that channel (the same
+    forward model the eval matcher uses, ``eval.arrival_times``)."""
+    from ..eval import arrival_times
+
+    half = (cfg.win_frames * cfg.hop) / 2.0 / scene.fs
+    labels = np.zeros((scene.nx, len(centers)), bool)
+    t_centers = np.asarray(centers) / scene.fs            # [n_win]
+    for call in scene.calls:
+        arr = arrival_times(call, scene) + call.duration / 2.0   # [C]
+        labels |= np.abs(t_centers[None, :] - arr[:, None]) <= half
+    return labels.astype(np.float32)
+
+
+def _init_cnn_params(rng: np.random.Generator, cfg: LearnedConfig):
+    """Parameter pytree of the small CNN (plain jnp — no framework dep in
+    the hot path; flax would add nothing to two convs and a head)."""
+    params = {}
+    c_in = 1
+    for li, c_out in enumerate(cfg.features):
+        fan_in = 3 * 3 * c_in
+        params[f"conv{li}"] = {
+            "w": jnp.asarray(rng.standard_normal((3, 3, c_in, c_out))
+                             * np.sqrt(2.0 / fan_in), jnp.float32),
+            "b": jnp.zeros((c_out,), jnp.float32),
+        }
+        c_in = c_out
+    params["head"] = {
+        "w": jnp.asarray(rng.standard_normal((c_in,)) * 0.01, jnp.float32),
+        "b": jnp.zeros((), jnp.float32),
+    }
+    return params
+
+
+def cnn_logits(params, windows: jnp.ndarray) -> jnp.ndarray:
+    """``[B, F, W]`` standardized windows -> ``[B]`` call logits.
+
+    Two stride-2 3x3 conv blocks (MXU work under XLA) + global average
+    pool + linear head.
+    """
+    x = windows[..., None]                                # [B, F, W, 1]
+    for li in range(len([k for k in params if k.startswith("conv")])):
+        p = params[f"conv{li}"]
+        x = jax.lax.conv_general_dilated(
+            x, p["w"], window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + p["b"]
+        x = jax.nn.gelu(x)
+    feat = jnp.mean(x, axis=(1, 2))                       # [B, C]
+    return feat @ params["head"]["w"] + params["head"]["b"]
+
+
+def bce_loss(params, windows, labels):
+    logits = cnn_logits(params, windows)
+    # numerically stable BCE-with-logits
+    loss = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+    return jnp.mean(loss)
+
+
+def init_train_state(cfg: LearnedConfig, seed: int = 0):
+    """(params, opt_state, optimizer) for adamw training. The CNN is
+    fully convolutional with a global pool, so parameters are
+    input-shape-independent."""
+    import optax
+
+    params = _init_cnn_params(np.random.default_rng(seed), cfg)
+    tx = optax.adamw(cfg.lr, weight_decay=cfg.weight_decay)
+    return params, tx.init(params), tx
+
+
+@functools.partial(jax.jit, static_argnames=("tx",), donate_argnums=(0, 1))
+def train_step(params, opt_state, tx, windows, labels):
+    """One jitted adamw step on a ``[B, F, W]`` batch. Place the batch
+    with a ``NamedSharding(mesh, P('batch'))`` and GSPMD turns this same
+    program into synchronous data-parallel SGD (gradient psum inserted
+    by XLA) — see ``make_sharded_train_step``."""
+    import optax
+
+    loss, grads = jax.value_and_grad(bce_loss)(params, windows, labels)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def make_sharded_train_step(mesh, batch_axis: str = "batch"):
+    """Returns ``(step, put)``: ``put(batch)`` lands a host batch
+    sharded over ``mesh``'s ``batch_axis``; ``step`` is ``train_step``
+    (the IDENTICAL program — parameters replicated, batch sharded, XLA
+    inserts the gradient all-reduce over ICI)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P(batch_axis))
+
+    def put(windows, labels):
+        # shard straight from host — no full-batch stop on device 0
+        return (jax.device_put(np.asarray(windows, np.float32), sh),
+                jax.device_put(np.asarray(labels, np.float32), sh))
+
+    return train_step, put
+
+
+def fit(cfg: LearnedConfig, scenes: Sequence, epochs: int = 8,
+        batch: int = 1024, seed: int = 0, mesh=None, log_every: int = 0):
+    """Train on synthetic scenes (``io.synth.SyntheticScene``); returns
+    ``(params, history)``. Windows of every scene are pooled, classes
+    rebalanced by duplicating positives (calls are rare), and shuffled
+    per epoch. With ``mesh`` the batches run data-parallel."""
+    from ..io.synth import synthesize_scene
+
+    xs, ys = [], []
+    for scene in scenes:
+        block = synthesize_scene(scene)
+        win, centers = window_features(block, cfg)
+        lab = window_labels(scene, centers, cfg)
+        xs.append(np.asarray(win).reshape(-1, *win.shape[-2:]))
+        ys.append(np.asarray(lab).reshape(-1))
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    pos = np.nonzero(y > 0.5)[0]
+    if len(pos):  # rebalance ~1:4
+        dup = max(0, len(y) // (4 * len(pos)) - 1)
+        if dup:
+            x = np.concatenate([x] + [x[pos]] * dup)
+            y = np.concatenate([y] + [y[pos]] * dup)
+
+    params, opt_state, tx = init_train_state(cfg, seed)
+    step, put = (make_sharded_train_step(mesh) if mesh is not None
+                 else (train_step, lambda w, l: (jnp.asarray(w), jnp.asarray(l))))
+    bmult = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    batch = min(batch, (len(y) // bmult) * bmult)
+    if batch <= 0:
+        raise ValueError(
+            f"pool of {len(y)} windows cannot fill one batch over "
+            f"{bmult} devices — use more/larger scenes"
+        )
+    batch = -(-batch // bmult) * bmult
+
+    rng = np.random.default_rng(seed)
+    history = []
+    n = len(y)
+    for ep in range(epochs):
+        order = rng.permutation(n)
+        losses = []
+        for s in range(0, n - batch + 1, batch):
+            sel = order[s : s + batch]
+            wb, lb = put(x[sel], y[sel])
+            params, opt_state, loss = step(params, opt_state, tx, wb, lb)
+            losses.append(float(loss))
+        history.append(float(np.mean(losses)) if losses else float("nan"))
+        if log_every and (ep + 1) % log_every == 0:
+            print(f"epoch {ep + 1}: loss {history[-1]:.4f}")
+    return params, history
+
+
+@dataclass
+class LearnedResult:
+    picks: dict
+    scores: np.ndarray        # [C, n_win] sigmoid scores
+    centers: np.ndarray       # [n_win] window-center samples
+    thresholds: dict = field(default_factory=dict)
+
+
+@jax.jit
+def _score_windows(params, win_flat):
+    return jax.nn.sigmoid(cnn_logits(params, win_flat))
+
+
+class LearnedDetector:
+    """Detection with a trained classifier, same calling convention as
+    the other families: ``detector(block)`` -> ``.picks`` dict of
+    ``(2, n) [channel_idx, time_idx]`` arrays (window centers of
+    above-threshold windows, non-max-suppressed per channel so one call
+    yields one pick per channel, like the prominence picker's single
+    peak per envelope lobe)."""
+
+    def __init__(self, params, cfg: LearnedConfig, threshold: float = 0.5,
+                 name: str = "CALL"):
+        self.params = params
+        self.cfg = cfg
+        self.threshold = threshold
+        self.name = name
+
+    def __call__(self, block, threshold: float | None = None) -> LearnedResult:
+        thr = self.threshold if threshold is None else float(threshold)
+        win, centers = window_features(block, self.cfg)
+        C, n_win = win.shape[0], win.shape[1]
+        scores = np.asarray(
+            _score_windows(self.params, win.reshape(-1, *win.shape[-2:]))
+        ).reshape(C, n_win)
+        above = scores > thr
+        # per-channel NMS over the window axis: keep local score maxima
+        left = np.pad(scores, ((0, 0), (1, 0)))[:, :-1]
+        right = np.pad(scores, ((0, 0), (0, 1)))[:, 1:]
+        keep = above & (scores >= left) & (scores > right)
+        chan, wins = np.nonzero(keep)
+        picks = np.asarray([chan, np.asarray(centers)[wins]])
+        return LearnedResult(
+            picks={self.name: picks}, scores=scores,
+            centers=np.asarray(centers), thresholds={self.name: thr},
+        )
