@@ -60,8 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
     pe.add_argument("--nx", type=int, default=256)
     pe.add_argument("--ns", type=int, default=6000)
     pe.add_argument("--family", default="mf",
-                    choices=("mf", "spectro", "gabor", "all"),
-                    help="detector family to score (all: cross-family table)")
+                    choices=("mf", "spectro", "gabor", "learned", "all"),
+                    help="detector family to score (all: cross-family table; "
+                         "learned trains its CNN on synthetic scenes first)")
     pe.add_argument("--time-tol", type=float, default=0.5,
                     help="pick-to-arrival match tolerance [s]")
     _add_route_flags(pe, default=True, extra=" (the library default)")
@@ -174,6 +175,29 @@ def main(argv=None) -> int:
             detectors["gabor"] = GaborEvalAdapter(
                 mf, GaborDetector(scene.metadata, [0, scene.nx, 1])
             )
+        if args.family in ("learned", "all"):
+            # trained on the fly: synthetic scenes disjoint from the eval
+            # scene (different seeds/geometry), ~a minute on one core
+            from das4whales_tpu.io.synth import SyntheticCall, SyntheticScene
+            from das4whales_tpu.models import learned
+
+            cfg = learned.LearnedConfig()
+            train_scenes = [
+                SyntheticScene(
+                    nx=min(64, scene.nx), ns=min(4000, scene.ns),
+                    dx=scene.dx, noise_rms=scene.noise_rms or 0.08,
+                    seed=1000 + s,
+                    calls=[
+                        SyntheticCall(t0=2.5 + 3.5 * k,
+                                      x0_m=(0.15 + 0.18 * k) * min(64, scene.nx) * scene.dx,
+                                      amplitude=0.3 + 0.18 * k + 0.05 * s)
+                        for k in range(4)
+                    ],
+                )
+                for s in range(3)
+            ]
+            params, _ = learned.fit(cfg, train_scenes, epochs=25, batch=512)
+            detectors["learned"] = learned.LearnedDetector(params, cfg)
         if args.family != "all":
             detectors = {args.family: detectors[args.family]}
         amps = [float(a) for a in args.amplitudes.split(",")]
