@@ -234,6 +234,41 @@ def fit(cfg: LearnedConfig, scenes: Sequence, epochs: int = 8,
     return params, history
 
 
+def save_params(path: str, params, cfg: LearnedConfig) -> str:
+    """Persist trained parameters + config as one ``.npz`` (flattened
+    pytree keys) — campaign-grade: a model trained once applies to a
+    month of files, the same design-once/apply-many pattern as the
+    filter designs (utils/checkpoint.py)."""
+    flat = {f"{k}.{kk}": np.asarray(v)
+            for k, sub in params.items() for kk, v in sub.items()}
+    cfg_arr = np.asarray([
+        cfg.nfft, cfg.hop, cfg.win_frames, cfg.win_stride, cfg.fmax_bin,
+    ], np.int64)
+    np.savez(path, __cfg__=cfg_arr,
+             __features__=np.asarray(cfg.features, np.int64), **flat)
+    return path
+
+
+def load_params(path: str):
+    """Inverse of :func:`save_params`: returns ``(params, cfg)``. Only
+    the feature-geometry fields round-trip (lr/weight_decay are training
+    concerns, irrelevant at inference)."""
+    with np.load(path) as z:
+        c = z["__cfg__"]
+        cfg = LearnedConfig(
+            nfft=int(c[0]), hop=int(c[1]), win_frames=int(c[2]),
+            win_stride=int(c[3]), fmax_bin=int(c[4]),
+            features=tuple(int(f) for f in z["__features__"]),
+        )
+        params = {}
+        for key in z.files:
+            if key.startswith("__"):
+                continue
+            k, kk = key.split(".", 1)
+            params.setdefault(k, {})[kk] = jnp.asarray(z[key])
+    return params, cfg
+
+
 @dataclass
 class LearnedResult:
     picks: dict

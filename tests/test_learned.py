@@ -104,3 +104,21 @@ def test_sharded_train_step_matches_single_device(trained):
             np.testing.assert_allclose(
                 np.asarray(p1[k][kk]), np.asarray(p2[k][kk]), atol=1e-5
             )
+
+
+def test_params_roundtrip(tmp_path, trained):
+    params, _ = trained
+    path = learned.save_params(str(tmp_path / "model.npz"), params, CFG)
+    params2, cfg2 = learned.load_params(path)
+    assert cfg2.nfft == CFG.nfft and cfg2.features == CFG.features
+    for k in params:
+        for kk in params[k]:
+            np.testing.assert_array_equal(
+                np.asarray(params[k][kk]), np.asarray(params2[k][kk])
+            )
+    # the reloaded model detects identically
+    scene = _scene(99, [0.8])
+    block = synthesize_scene(scene)
+    r1 = learned.LearnedDetector(params, CFG, threshold=0.5)(block)
+    r2 = learned.LearnedDetector(params2, cfg2, threshold=0.5)(block)
+    np.testing.assert_array_equal(r1.picks["CALL"], r2.picks["CALL"])
