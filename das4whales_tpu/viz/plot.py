@@ -273,6 +273,37 @@ def detection_grad(trace, peaks_idx, time, dist, fs, dx, selected_channels,
         file_begin_time_utc=file_begin_time_utc, show=show)
 
 
+def detection_learned(scores, centers, picks, fs, dist, threshold=None,
+                      show=None):
+    """Learned-family diagnostics: the classifier's ``[C, n_win]`` score
+    map on (time, distance) axes with above-threshold picks overlaid —
+    the family's analog of the correlogram waterfalls (no reference
+    counterpart; the learned family is new)."""
+    import matplotlib.pyplot as plt
+
+    scores = np.asarray(scores)
+    centers = np.asarray(centers)
+    fig, ax = plt.subplots(figsize=(12, 6))
+    t = centers / fs
+    extent = [t[0], t[-1], dist[0] / 1e3, dist[-1] / 1e3]
+    im = ax.imshow(scores, aspect="auto", origin="lower", extent=extent,
+                   cmap="viridis", vmin=0.0, vmax=1.0)
+    if picks is not None and np.asarray(picks).size:
+        pk = np.asarray(picks)
+        ax.scatter(pk[1] / fs, np.asarray(dist)[pk[0]] / 1e3,
+                   s=14, facecolors="none", edgecolors="red", label="picks")
+        ax.legend(loc="upper right")
+    ax.set_xlabel("Time (s)")
+    ax.set_ylabel("Distance (km)")
+    title = "Learned detector scores"
+    if threshold is not None:
+        title += f" (threshold {threshold:.2f})"
+    ax.set_title(title)
+    fig.colorbar(im, ax=ax, label="call probability")
+    fig.tight_layout()
+    return _finish(fig, show)
+
+
 def snr_matrix(snr_m, time, dist, vmax, file_begin_time_utc=None, title=None, show=None):
     """Local-SNR waterfall in turbo (reference plot.py:508-539)."""
     fig = plt.figure(figsize=(12, 10))

@@ -122,3 +122,19 @@ def test_params_roundtrip(tmp_path, trained):
     r1 = learned.LearnedDetector(params, CFG, threshold=0.5)(block)
     r2 = learned.LearnedDetector(params2, cfg2, threshold=0.5)(block)
     np.testing.assert_array_equal(r1.picks["CALL"], r2.picks["CALL"])
+
+
+def test_detection_learned_figure(trained):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    params, _ = trained
+    scene = _scene(99, [0.8])
+    det = learned.LearnedDetector(params, CFG, threshold=0.5)
+    res = det(synthesize_scene(scene))
+    from das4whales_tpu.viz.plot import detection_learned
+
+    dist = np.arange(scene.nx) * scene.dx
+    fig = detection_learned(res.scores, res.centers, res.picks["CALL"],
+                            scene.fs, dist, threshold=0.5, show=False)
+    assert fig is not None
