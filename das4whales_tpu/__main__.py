@@ -83,9 +83,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="detect batches on a (file x channel) device mesh "
                          "(workflows.campaign.run_campaign_sharded)")
     pc.add_argument("--family", default="mf",
-                    choices=("mf", "spectro", "gabor"),
+                    choices=("mf", "spectro", "gabor", "learned"),
                     help="detector family (spectro/gabor run through the "
-                         "shared bandpass+f-k front end; single-chip only)")
+                         "shared bandpass+f-k front end; learned needs "
+                         "--model; all three single-chip only)")
+    pc.add_argument("--model", default=None,
+                    help="trained learned-family model (.npz from "
+                         "models.learned.save_params; required for "
+                         "--family learned)")
     _add_route_flags(pc, default=True,
                      extra=" (library default; also governs the spectro/"
                            "gabor families' shared bandpass+f-k front end)")
@@ -277,7 +282,19 @@ def main(argv=None) -> int:
             print("campaign: no file in the list is probeable; nothing to do")
             return 3
         detector = None
-        if args.family != "mf":
+        if args.family == "learned":
+            if args.sharded:
+                print("campaign: --family learned is single-chip only")
+                return 2
+            if not args.model:
+                print("campaign: --family learned requires --model "
+                      "(train with models.learned.fit + save_params)")
+                return 2
+            from das4whales_tpu.models import learned as _learned
+
+            params, lcfg = _learned.load_params(args.model)
+            detector = _learned.LearnedDetector(params, lcfg)
+        elif args.family != "mf":
             if args.sharded:
                 print("campaign: --family spectro/gabor is single-chip only")
                 return 2

@@ -138,3 +138,34 @@ def test_detection_learned_figure(trained):
     fig = detection_learned(res.scores, res.centers, res.picks["CALL"],
                             scene.fs, dist, threshold=0.5, show=False)
     assert fig is not None
+
+
+def test_campaign_cli_with_trained_model(trained, tmp_path):
+    """Operational loop: save the trained model, run the campaign CLI
+    with --family learned --model over synthetic files."""
+    from das4whales_tpu.__main__ import main as cli_main
+    from das4whales_tpu.io.synth import write_synthetic_file
+    from das4whales_tpu.workflows.campaign import load_picks
+
+    params, _ = trained
+    model = learned.save_params(str(tmp_path / "m.npz"), params, CFG)
+    files = [
+        write_synthetic_file(str(tmp_path / f"f{k}.h5"), _scene(k, [0.9]))
+        for k in range(2)
+    ]
+    out = str(tmp_path / "camp")
+    rc = cli_main(["campaign", *files, "--outdir", out,
+                   "--family", "learned", "--model", model])
+    assert rc == 0
+    import json as _json
+
+    recs = [_json.loads(l) for l in open(f"{out}/manifest.jsonl")]
+    done = [r for r in recs if r["status"] == "done"]
+    assert len(done) == 2
+    assert any(sum(r["n_picks"].values()) > 0 for r in done)
+
+    # guard rails: --model required, --sharded rejected
+    assert cli_main(["campaign", *files, "--outdir", out,
+                     "--family", "learned"]) == 2
+    assert cli_main(["campaign", *files, "--outdir", out, "--sharded",
+                     "--family", "learned", "--model", model]) == 2
