@@ -50,6 +50,10 @@ class LearnedConfig:
     features: tuple = (16, 32)
     lr: float = 1e-2
     weight_decay: float = 1e-4
+    # conv compute dtype: "bfloat16" feeds the MXU at its native width
+    # (params and accumulation stay float32 — mixed precision the TPU
+    # way); "float32" is the CPU-test default
+    compute_dtype: str = "float32"
 
 
 def window_features(block, cfg: LearnedConfig):
@@ -112,26 +116,31 @@ def _init_cnn_params(rng: np.random.Generator, cfg: LearnedConfig):
     return params
 
 
-def cnn_logits(params, windows: jnp.ndarray) -> jnp.ndarray:
+def cnn_logits(params, windows: jnp.ndarray,
+               compute_dtype: str = "float32") -> jnp.ndarray:
     """``[B, F, W]`` standardized windows -> ``[B]`` call logits.
 
     Two stride-2 3x3 conv blocks (MXU work under XLA) + global average
-    pool + linear head.
+    pool + linear head. ``compute_dtype="bfloat16"`` runs the convs at
+    the MXU's native width with float32 accumulation
+    (``preferred_element_type``); parameters stay float32.
     """
-    x = windows[..., None]                                # [B, F, W, 1]
+    cdt = jnp.dtype(compute_dtype)
+    x = windows[..., None].astype(cdt)                    # [B, F, W, 1]
     for li in range(len([k for k in params if k.startswith("conv")])):
         p = params[f"conv{li}"]
         x = jax.lax.conv_general_dilated(
-            x, p["w"], window_strides=(2, 2), padding="SAME",
+            x, p["w"].astype(cdt), window_strides=(2, 2), padding="SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
         ) + p["b"]
-        x = jax.nn.gelu(x)
-    feat = jnp.mean(x, axis=(1, 2))                       # [B, C]
+        x = jax.nn.gelu(x).astype(cdt)
+    feat = jnp.mean(x.astype(jnp.float32), axis=(1, 2))   # [B, C]
     return feat @ params["head"]["w"] + params["head"]["b"]
 
 
-def bce_loss(params, windows, labels):
-    logits = cnn_logits(params, windows)
+def bce_loss(params, windows, labels, compute_dtype: str = "float32"):
+    logits = cnn_logits(params, windows, compute_dtype)
     # numerically stable BCE-with-logits
     loss = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
         jnp.exp(-jnp.abs(logits))
@@ -150,15 +159,19 @@ def init_train_state(cfg: LearnedConfig, seed: int = 0):
     return params, tx.init(params), tx
 
 
-@functools.partial(jax.jit, static_argnames=("tx",), donate_argnums=(0, 1))
-def train_step(params, opt_state, tx, windows, labels):
+@functools.partial(jax.jit, static_argnames=("tx", "compute_dtype"),
+                   donate_argnums=(0, 1))
+def train_step(params, opt_state, tx, windows, labels,
+               compute_dtype: str = "float32"):
     """One jitted adamw step on a ``[B, F, W]`` batch. Place the batch
     with a ``NamedSharding(mesh, P('batch'))`` and GSPMD turns this same
     program into synchronous data-parallel SGD (gradient psum inserted
     by XLA) — see ``make_sharded_train_step``."""
     import optax
 
-    loss, grads = jax.value_and_grad(bce_loss)(params, windows, labels)
+    loss, grads = jax.value_and_grad(bce_loss)(
+        params, windows, labels, compute_dtype
+    )
     updates, opt_state = tx.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
     return params, opt_state, loss
@@ -226,7 +239,8 @@ def fit(cfg: LearnedConfig, scenes: Sequence, epochs: int = 8,
         for s in range(0, n - batch + 1, batch):
             sel = order[s : s + batch]
             wb, lb = put(x[sel], y[sel])
-            params, opt_state, loss = step(params, opt_state, tx, wb, lb)
+            params, opt_state, loss = step(params, opt_state, tx, wb, lb,
+                                            cfg.compute_dtype)
             losses.append(float(loss))
         history.append(float(np.mean(losses)) if losses else float("nan"))
         if log_every and (ep + 1) % log_every == 0:
@@ -245,7 +259,8 @@ def save_params(path: str, params, cfg: LearnedConfig) -> str:
         cfg.nfft, cfg.hop, cfg.win_frames, cfg.win_stride, cfg.fmax_bin,
     ], np.int64)
     np.savez(path, __cfg__=cfg_arr,
-             __features__=np.asarray(cfg.features, np.int64), **flat)
+             __features__=np.asarray(cfg.features, np.int64),
+             __compute_dtype__=np.asarray(cfg.compute_dtype), **flat)
     return path
 
 
@@ -255,10 +270,13 @@ def load_params(path: str):
     concerns, irrelevant at inference)."""
     with np.load(path) as z:
         c = z["__cfg__"]
+        cdt = (str(z["__compute_dtype__"]) if "__compute_dtype__" in z.files
+               else "float32")
         cfg = LearnedConfig(
             nfft=int(c[0]), hop=int(c[1]), win_frames=int(c[2]),
             win_stride=int(c[3]), fmax_bin=int(c[4]),
             features=tuple(int(f) for f in z["__features__"]),
+            compute_dtype=cdt,
         )
         params = {}
         for key in z.files:
@@ -277,9 +295,9 @@ class LearnedResult:
     thresholds: dict = field(default_factory=dict)
 
 
-@jax.jit
-def _score_windows(params, win_flat):
-    return jax.nn.sigmoid(cnn_logits(params, win_flat))
+@functools.partial(jax.jit, static_argnames=("compute_dtype",))
+def _score_windows(params, win_flat, compute_dtype: str = "float32"):
+    return jax.nn.sigmoid(cnn_logits(params, win_flat, compute_dtype))
 
 
 class LearnedDetector:
@@ -302,7 +320,8 @@ class LearnedDetector:
         win, centers = window_features(block, self.cfg)
         C, n_win = win.shape[0], win.shape[1]
         scores = np.asarray(
-            _score_windows(self.params, win.reshape(-1, *win.shape[-2:]))
+            _score_windows(self.params, win.reshape(-1, *win.shape[-2:]),
+                           self.cfg.compute_dtype)
         ).reshape(C, n_win)
         above = scores > thr
         # per-channel NMS over the window axis: keep local score maxima

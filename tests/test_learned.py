@@ -169,3 +169,21 @@ def test_campaign_cli_with_trained_model(trained, tmp_path):
                      "--family", "learned"]) == 2
     assert cli_main(["campaign", *files, "--outdir", out, "--sharded",
                      "--family", "learned", "--model", model]) == 2
+
+
+def test_bf16_compute_matches_f32_decisions(trained):
+    """The MXU-width compute path must keep the same detections on a
+    clear scene (params/accumulation stay f32 — only conv compute width
+    changes)."""
+    from dataclasses import replace
+
+    params, _ = trained
+    scene = _scene(99, [0.9])
+    block = synthesize_scene(scene)
+    r32 = learned.LearnedDetector(params, CFG, threshold=0.5)(block)
+    cfg16 = replace(CFG, compute_dtype="bfloat16")
+    r16 = learned.LearnedDetector(params, cfg16, threshold=0.5)(block)
+    np.testing.assert_allclose(r16.scores, r32.scores, atol=0.05)
+    # picks on the clear injected call agree
+    ch = int(round(100.0 / scene.dx))
+    assert ch in r16.picks["CALL"][0] and ch in r32.picks["CALL"][0]
