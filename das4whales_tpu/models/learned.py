@@ -56,17 +56,19 @@ class LearnedConfig:
     compute_dtype: str = "float32"
 
 
-def window_features(block, cfg: LearnedConfig):
+def window_features(block, cfg: LearnedConfig, engine: str = "auto"):
     """``[C, T]`` strain block -> per-channel log-spectrogram windows.
 
     Returns ``(windows [C, n_win, F, W], centers [n_win])`` where
     ``centers`` are window-center SAMPLE indices. Per-window
     standardization (mean/std over the window) makes the classifier
     amplitude-invariant — the analog of the reference detectors'
-    per-channel normalization (detect.py:157).
+    per-channel normalization (detect.py:157). ``engine`` threads to
+    ``ops.spectral.stft_magnitude`` (the sharded inference pins "rfft",
+    which GSPMD partitions over channels collective-free).
     """
     x = jnp.asarray(block, jnp.float32)
-    mag = spectral.stft_magnitude(x, cfg.nfft, cfg.hop)   # [C, F, n_frames]
+    mag = spectral.stft_magnitude(x, cfg.nfft, cfg.hop, engine=engine)
     mag = mag[:, : cfg.fmax_bin, :]
     logm = jnp.log1p(mag * 1e6)  # strain ~1e-9..1e-6; keep well-scaled
     n_frames = logm.shape[-1]
@@ -285,6 +287,36 @@ def load_params(path: str):
             k, kk = key.split(".", 1)
             params.setdefault(k, {})[kk] = jnp.asarray(z[key])
     return params, cfg
+
+
+def make_sharded_inference(params, cfg: LearnedConfig, mesh,
+                           channel_axis: str = "channel"):
+    """Channel-sharded scoring: returns ``(score_fn, put)`` where
+    ``put(block)`` lands a ``[C, T]`` block row-sharded over the mesh and
+    ``score_fn`` maps it to ``[C, n_win]`` sigmoid scores in ONE program.
+
+    Channels are independent end-to-end (STFT, windowing, CNN), so the
+    program is collective-free — the same zero-collective layout as the
+    sharded spectro family (parallel/spectro.py). Thresholding/NMS stays
+    host-side (identical to ``LearnedDetector.__call__``).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P(channel_axis, None))
+
+    @jax.jit
+    def score_fn(block):
+        win, _ = window_features(block, cfg, engine="rfft")
+        C, n_win = win.shape[0], win.shape[1]
+        flat = win.reshape(C * n_win, *win.shape[-2:])
+        return jax.nn.sigmoid(
+            cnn_logits(params, flat, cfg.compute_dtype)
+        ).reshape(C, n_win)
+
+    def put(block):
+        return jax.device_put(np.asarray(block, np.float32), sh)
+
+    return score_fn, put
 
 
 @dataclass

@@ -187,3 +187,22 @@ def test_bf16_compute_matches_f32_decisions(trained):
     # picks on the clear injected call agree
     ch = int(round(100.0 / scene.dx))
     assert ch in r16.picks["CALL"][0] and ch in r32.picks["CALL"][0]
+
+
+def test_sharded_inference_matches_single_device(trained):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8-device mesh")
+    from das4whales_tpu.parallel.mesh import make_mesh
+
+    params, _ = trained
+    scene = _scene(55, [0.8], nx=32)        # 32 channels / 8 shards
+    block = synthesize_scene(scene)
+    det = learned.LearnedDetector(params, CFG, threshold=0.5)
+    ref = det(block)
+
+    mesh = make_mesh(shape=(8,), axis_names=("channel",))
+    score_fn, put = learned.make_sharded_inference(params, CFG, mesh)
+    scores = np.asarray(score_fn(put(block)))
+    np.testing.assert_allclose(scores, ref.scores, atol=2e-5)
