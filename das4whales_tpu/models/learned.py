@@ -300,21 +300,19 @@ def make_sharded_inference(params, cfg: LearnedConfig, mesh,
     sharded spectro family (parallel/spectro.py). Thresholding/NMS stays
     host-side (identical to ``LearnedDetector.__call__``).
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    sh = NamedSharding(mesh, P(channel_axis, None))
+    from ..parallel.mesh import shard_block
 
     @jax.jit
     def score_fn(block):
         win, _ = window_features(block, cfg, engine="rfft")
         C, n_win = win.shape[0], win.shape[1]
         flat = win.reshape(C * n_win, *win.shape[-2:])
-        return jax.nn.sigmoid(
-            cnn_logits(params, flat, cfg.compute_dtype)
-        ).reshape(C, n_win)
+        # ONE scoring definition (_score_windows) for both the sharded
+        # and single-device paths; nested jit is inlined
+        return _score_windows(params, flat, cfg.compute_dtype).reshape(C, n_win)
 
     def put(block):
-        return jax.device_put(np.asarray(block, np.float32), sh)
+        return shard_block(np.asarray(block, np.float32), mesh, channel_axis)
 
     return score_fn, put
 
