@@ -212,3 +212,32 @@ def test_sharded_inference_matches_single_device(trained):
     score_fn, put = learned.make_sharded_inference(params, CFG, mesh)
     scores = np.asarray(score_fn(put(block)))
     np.testing.assert_allclose(scores, ref, atol=2e-5)
+
+
+def test_pretrained_model_detects_out_of_the_box():
+    """The shipped fin_cnn artifact loads and detects a held-out scene
+    — the family's analog of the built-in call templates."""
+    params, cfg = learned.load_pretrained()
+    det = learned.LearnedDetector(params, cfg, threshold=0.5)
+    scene = SyntheticScene(
+        nx=96, ns=5000, dx=2.042, noise_rms=0.05, seed=77,
+        calls=[SyntheticCall(t0=5.0, x0_m=100.0, amplitude=0.7)],
+    )
+    from das4whales_tpu.eval import evaluate_detector
+
+    m = evaluate_detector(det, scene, time_tol_s=1.0)["CALL"]
+    assert m["recall"] >= 0.9
+    assert m["false_per_channel_minute"] < 0.5
+
+    with pytest.raises(FileNotFoundError):
+        learned.load_pretrained("nope")
+
+
+def test_threshold_sweep_supports_learned():
+    from das4whales_tpu.eval import threshold_sweep
+
+    params, cfg = learned.load_pretrained()
+    det = learned.LearnedDetector(params, cfg)
+    scene = _scene(31, [0.8])
+    rows = threshold_sweep(det, scene, thresholds=[0.3, 0.6, 0.9])
+    assert len(rows) == 3
