@@ -52,8 +52,18 @@ def _probe(path: str, interrogator: str, metadata) -> _FileSpec:
         path, interrogator=interrogator
     )
     if _is_tdms(path) or meta.interrogator == "silixa":
-        # TDMS: no native layout; t0 is extracted by the reader (one parse
-        # serves data + timestamp instead of a second full-file parse here)
+        # single-segment contiguous TDMS reads through the SAME native
+        # engine as HDF5 (io/tdms.py contiguous_layout probes metadata
+        # only and also yields the GPS t0); irregular files keep the
+        # pure-host reader, which extracts t0 during its own parse
+        if native.available():
+            from .tdms import contiguous_layout as _tdms_layout
+
+            lay = _tdms_layout(path)
+            if lay is not None:
+                off, dt, nx, ns, t0_us = lay
+                return _FileSpec(path=path, meta=meta, t0_us=t0_us,
+                                 layout=(off, dt, nx, ns))
         return _FileSpec(path=path, meta=meta, t0_us=0, layout=None)
     layout = None
     with h5py.File(path, "r") as fp:

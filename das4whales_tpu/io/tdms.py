@@ -17,7 +17,7 @@ from __future__ import annotations
 import os
 import struct
 from dataclasses import dataclass, field
-from datetime import datetime, timedelta
+from datetime import datetime, timedelta, timezone
 from typing import Dict
 
 import numpy as np
@@ -48,7 +48,11 @@ _TYPE_STRING = 0x20
 _TYPE_BOOL = 0x21
 _TYPE_TIMESTAMP = 0x44
 
-_EPOCH_1904 = datetime(1904, 1, 1)
+# the TDMS epoch is UTC; an AWARE datetime keeps .timestamp() (and hence
+# every t0_us derived from GPSTimeStamp) correct on non-UTC hosts — a
+# naive epoch would silently shift campaign pick times by the local
+# UTC offset
+_EPOCH_1904 = datetime(1904, 1, 1, tzinfo=timezone.utc)
 
 
 def _parse_path(path: str):
@@ -120,6 +124,37 @@ class _RawIndex:
     n_values: int
 
 
+def _iter_segment_objects(cur: "_Cursor"):
+    """Walk ONE segment's metadata block: yields
+    ``(path, index, props)`` per object, where ``index`` is
+    ``("none",)`` (property-only object), ``("reuse",)`` (raw-index
+    carried over from an earlier segment) or
+    ``("new", type_id, dim, n_values)``. The ONE metadata parser —
+    ``TdmsFile.read`` and the native-layout probe both walk through
+    here, so a format accommodation cannot land in only one of them."""
+    n_objects = cur.u32()
+    for _ in range(n_objects):
+        path = _parse_path(cur.string())
+        idx_len = cur.u32()
+        if idx_len == 0xFFFFFFFF:
+            index = ("none",)
+        elif idx_len == 0x00000000:
+            index = ("reuse",)
+        else:
+            type_id = cur.u32()
+            dim = cur.u32()
+            n_values = cur.u64()
+            if type_id == _TYPE_STRING:
+                cur.u64()  # total raw bytes of the string channel
+            index = ("new", type_id, dim, n_values)
+        props = {}
+        n_props = cur.u32()
+        for _ in range(n_props):
+            name = cur.string()
+            props[name] = cur.value(cur.u32())
+        yield path, index, props
+
+
 @dataclass
 class TdmsObject:
     path: tuple
@@ -187,33 +222,21 @@ class TdmsFile:
                 cur = _Cursor(buf, pos + 28)
                 if toc & _TOC_NEW_OBJ_LIST:
                     active = []
-                n_objects = cur.u32()
-                for _ in range(n_objects):
-                    path = _parse_path(cur.string())
+                for path, index, props in _iter_segment_objects(cur):
                     obj = self.objects.setdefault(path, TdmsObject(path))
-                    idx_len = cur.u32()
-                    if idx_len == 0xFFFFFFFF:
-                        pass  # no raw data for this object
-                    elif idx_len == 0x00000000:
+                    if index[0] == "reuse":
                         if path not in active:
                             active.append(path)  # reuse previous index
-                    else:
-                        type_id = cur.u32()
-                        dim = cur.u32()
-                        n_values = cur.u64()
+                    elif index[0] == "new":
+                        _, type_id, dim, n_values = index
                         if type_id == _TYPE_STRING:
-                            cur.u64()  # total bytes; string channels unsupported below
                             raise NotImplementedError("string channel data")
                         if dim != 1:
                             raise NotImplementedError("multi-dimensional TDMS arrays")
                         indexes[path] = _RawIndex(_TDMS_DTYPES[type_id], n_values)
                         if path not in active:
                             active.append(path)
-                    n_props = cur.u32()
-                    for _ in range(n_props):
-                        name = cur.string()
-                        type_id = cur.u32()
-                        obj.properties[name] = cur.value(type_id)
+                    obj.properties.update(props)
 
             if toc & _TOC_RAW_DATA:
                 if toc & _TOC_INTERLEAVED:
@@ -231,6 +254,97 @@ class TdmsFile:
                         dpos += nbytes
             pos = seg_end
         return self
+
+
+def contiguous_layout(filepath: str):
+    """Native-ingest layout probe: ``(data_offset, dtype, nx, ns, t0_us)``
+    when the file is ONE TDMS segment whose ``Measurement`` channels are
+    equal-length, same-dtype and stored contiguously channel-after-channel
+    in natural name order — byte-identical to the ``[nx x ns]`` row-major
+    block the C++ engine reads (native/ingest.cpp; the same split as the
+    HDF5 path: host parses metadata once, the engine preads the bulk).
+    Returns ``None`` for anything irregular (multi-segment, multi-chunk,
+    interleaved, mixed dtypes, non-natural channel order) — the pure-host
+    reader handles those. Reads ONLY the lead-in + metadata block.
+    """
+    from .interrogators import _natural_key
+
+    try:
+        with open(filepath, "rb") as f:
+            head = f.read(28)
+            if len(head) < 28:
+                return None
+            tag, toc, _version, next_off, raw_off = struct.unpack(
+                "<4sIIQQ", head
+            )
+            if tag != b"TDSm":
+                return None
+            bad = _TOC_BIG_ENDIAN | _TOC_DAQMX | _TOC_INTERLEAVED
+            if (toc & bad) or not (toc & _TOC_METADATA) or not (toc & _TOC_RAW_DATA):
+                return None
+            meta = f.read(raw_off)
+            if len(meta) < raw_off:
+                return None
+            f.seek(0, 2)
+            fsize = f.tell()
+            seg_end = fsize if next_off == 0xFFFFFFFFFFFFFFFF else 28 + next_off
+            if seg_end > fsize:
+                return None
+            if fsize - seg_end >= 28:
+                # enough room for another segment header: whether it is a
+                # real segment or corruption, the host reader is the
+                # arbiter (it parses further segments, or raises on a bad
+                # tag — the native engine must not silently serve a
+                # truncated view the fallback engine would reject)
+                return None
+    except OSError:
+        return None
+
+    cur = _Cursor(meta, 0)
+    chans: list = []
+    t0 = None
+    try:
+        for path, index, props in _iter_segment_objects(cur):
+            if path == () and "GPSTimeStamp" in props:
+                t0 = props["GPSTimeStamp"]
+            if index[0] == "reuse":
+                return None  # index reuse implies an earlier segment
+            if index[0] == "new":
+                _, type_id, dim, n_values = index
+                if type_id == _TYPE_STRING or dim != 1:
+                    return None
+                dtype = _TDMS_DTYPES.get(type_id)
+                if dtype is None:
+                    return None
+                if len(path) != 2 or path[0] != "Measurement":
+                    return None
+                chans.append((path[1], dtype, int(n_values)))
+    except Exception:  # noqa: BLE001 — malformed metadata -> host reader
+        return None
+
+    if not chans:
+        return None
+    names = [c[0] for c in chans]
+    if names != sorted(names, key=_natural_key):
+        # the host reader selects channels in natural name order; native
+        # row slicing must agree with it or the selection silently shifts
+        return None
+    dtypes = {np.dtype(c[1]) for c in chans}
+    lengths = {c[2] for c in chans}
+    if len(dtypes) != 1 or len(lengths) != 1:
+        return None
+    dt = dtypes.pop()
+    if dt not in (np.dtype(np.int16), np.dtype(np.int32),
+                  np.dtype(np.float32), np.dtype(np.float64)):
+        return None
+    ns = lengths.pop()
+    nx = len(chans)
+    chunk = nx * ns * dt.itemsize
+    avail = seg_end - (28 + raw_off)
+    if avail < chunk or avail >= 2 * chunk:
+        return None  # incomplete, or multiple chunks (data would repeat)
+    t0_us = int(t0.timestamp() * 1e6) if hasattr(t0, "timestamp") else 0
+    return (28 + raw_off, dt, nx, ns, t0_us)
 
 
 def write_tdms(
@@ -262,6 +376,8 @@ def write_tdms(
         if isinstance(value, str):
             return out + struct.pack("<I", _TYPE_STRING) + enc_string(value)
         if isinstance(value, datetime):
+            if value.tzinfo is None:
+                value = value.replace(tzinfo=timezone.utc)  # TDMS times are UTC
             delta = value - _EPOCH_1904
             secs = int(delta.total_seconds())
             frac = int((delta.total_seconds() - secs) * 2**64)

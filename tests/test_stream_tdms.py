@@ -79,3 +79,88 @@ def test_probe_infers_silixa_from_extension(tmp_path):
     block = next(stream_strain_blocks([p_td], SEL, as_numpy=True))  # engine=auto
     assert block.metadata.interrogator == "silixa"
     assert block.metadata.fs == pytest.approx(200.0)
+
+
+def test_tdms_native_layout_probe_and_parity(tmp_path):
+    """Single-segment contiguous TDMS reads through the C++ engine
+    byte-identically to the pure-host reader, with the GPS t0 surfaced
+    by the metadata-only probe."""
+    from das4whales_tpu.io import native
+    from das4whales_tpu.io.stream import _probe
+    from das4whales_tpu.io.tdms import contiguous_layout
+
+    scene = _scene(3)
+    p_td = write_synthetic_tdms(str(tmp_path / "n.tdms"), scene)
+
+    lay = contiguous_layout(p_td)
+    assert lay is not None
+    off, dt, nx, ns, t0_us = lay
+    assert (nx, ns) == (NX, NS)
+    assert dt == np.dtype(np.int16)
+    assert t0_us > 0                       # GPSTimeStamp surfaced
+
+    # raw bytes at the probed offset ARE the [nx x ns] row-major block
+    raw = np.fromfile(p_td, dtype=np.int16, count=nx * ns,
+                      offset=off).reshape(nx, ns)
+    from das4whales_tpu.io.tdms import TdmsFile
+
+    ref = TdmsFile.read(p_td)["Measurement"]
+    names = sorted(ref)
+    np.testing.assert_array_equal(raw[0], ref[names[0]])
+
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    spec = _probe(p_td, "silixa", None)
+    assert spec.layout is not None and spec.t0_us == t0_us
+
+    sel = [0, NX, 2]                       # strided selection
+    b_nat = next(stream_strain_blocks([p_td], sel, engine="native",
+                                      as_numpy=True))
+    b_host = next(stream_strain_blocks([p_td], sel, engine="h5py",
+                                       as_numpy=True))
+    np.testing.assert_allclose(b_nat.trace, b_host.trace, atol=1e-7)
+    assert b_nat.t0_utc == b_host.t0_utc
+
+
+def test_tdms_multisegment_falls_back_to_host(tmp_path):
+    """Two concatenated segments -> the probe declines and the host
+    reader (which handles multi-segment) serves the file."""
+    from das4whales_tpu.io.tdms import TdmsFile, contiguous_layout
+
+    scene = _scene(4)
+    p1 = write_synthetic_tdms(str(tmp_path / "s1.tdms"), scene)
+    data = open(p1, "rb").read()
+    p2 = str(tmp_path / "multi.tdms")
+    with open(p2, "wb") as f:
+        f.write(data + data)               # second TDSm segment
+    assert contiguous_layout(p2) is None
+    f2 = TdmsFile.read(p2)                 # host reader still parses it
+    ch = f2["Measurement"]
+    assert next(iter(ch.values())).shape[-1] == 2 * NS
+
+
+def test_gps_timestamp_is_utc_aware():
+    """TDMS times are UTC: the parsed GPSTimeStamp must be tz-aware so
+    .timestamp() (and every derived t0_us) is identical on any host
+    timezone — a naive epoch shifted campaign picks by the UTC offset."""
+    import datetime as dt
+    import io as _io
+    import tempfile
+
+    from das4whales_tpu.io.tdms import TdmsFile, write_tdms
+
+    when = dt.datetime(2024, 6, 1, 12, 0, 0, tzinfo=dt.timezone.utc)
+    with tempfile.TemporaryDirectory() as td:
+        path = f"{td}/t.tdms"
+        write_tdms(path, {"GPSTimeStamp": when}, "Measurement",
+                   {"ch0": np.zeros(8, np.int16)})
+        got = TdmsFile.read(path).properties["GPSTimeStamp"]
+    assert got.tzinfo is not None
+    assert got.timestamp() == when.timestamp()
+    # a naive (assumed-UTC) write round-trips to the same instant
+    with tempfile.TemporaryDirectory() as td:
+        path = f"{td}/t2.tdms"
+        write_tdms(path, {"GPSTimeStamp": when.replace(tzinfo=None)},
+                   "Measurement", {"ch0": np.zeros(8, np.int16)})
+        got2 = TdmsFile.read(path).properties["GPSTimeStamp"]
+    assert got2.timestamp() == when.timestamp()
