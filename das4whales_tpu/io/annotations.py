@@ -1,0 +1,84 @@
+"""Pick exchange with the bioacoustics annotation ecosystem.
+
+Detections are only useful once an analyst can review them in the tools
+the field actually uses; Raven's tab-separated "selection table" is the
+de-facto exchange format there. The reference has no export at all —
+its picks die inside matplotlib figures (plot.py:373-415).
+
+A selection table row spans a time/frequency box; picks are points, so
+each pick becomes a box centered on its time with the template's
+duration and frequency band (the call geometry the detector was looking
+for). ``channel`` column carries the DAS channel index so array context
+survives the round trip.
+"""
+
+from __future__ import annotations
+
+import csv
+from typing import Dict
+
+import numpy as np
+
+_COLUMNS = [
+    "Selection", "View", "Channel", "Begin Time (s)", "End Time (s)",
+    "Low Freq (Hz)", "High Freq (Hz)", "Template", "DAS Channel",
+]
+
+
+def to_raven_selection_table(
+    path: str,
+    picks: Dict[str, np.ndarray],
+    fs: float,
+    template_configs: dict | None = None,
+    t_offset_s: float = 0.0,
+) -> str:
+    """Write ``{template: (2, n) [channel_idx, time_idx]}`` picks as ONE
+    Raven selection table (rows sorted by begin time; selection numbers
+    are 1-based as Raven expects). ``template_configs`` supplies each
+    template's ``(fmin, fmax, duration)`` box geometry — e.g.
+    ``MatchedFilterDetector.template_configs``; templates without a
+    config get a zero-height box at the pick instant. ``t_offset_s``
+    shifts times to absolute (e.g. a file's UTC offset in seconds).
+    """
+    rows = []
+    cfgs = template_configs or {}
+    for name, pk in picks.items():
+        pk = np.asarray(pk)
+        cfg = cfgs.get(name)
+        fmin = getattr(cfg, "fmin", 0.0) if cfg is not None else 0.0
+        fmax = getattr(cfg, "fmax", 0.0) if cfg is not None else 0.0
+        dur = getattr(cfg, "duration", 0.0) if cfg is not None else 0.0
+        for ch, t_idx in pk.T:
+            t0 = t_offset_s + float(t_idx) / fs - dur / 2.0
+            rows.append((t0, t0 + dur, float(fmin), float(fmax),
+                         name, int(ch)))
+    rows.sort()
+    with open(path, "w", newline="") as fh:
+        w = csv.writer(fh, delimiter="\t")
+        w.writerow(_COLUMNS)
+        for i, (b, e, lo, hi, name, ch) in enumerate(rows, start=1):
+            w.writerow([i, "Spectrogram 1", 1, f"{b:.6f}", f"{e:.6f}",
+                        f"{lo:.3f}", f"{hi:.3f}", name, ch])
+    return path
+
+
+def from_raven_selection_table(path: str, fs: float) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`to_raven_selection_table`: selection table ->
+    ``{template: (2, n)}`` picks (box centers back to sample indices).
+    Tables from Raven itself work too — rows missing the ``Template`` /
+    ``DAS Channel`` extension columns land under template ``"SELECTION"``
+    with channel 0."""
+    groups: Dict[str, list] = {}
+    with open(path, newline="") as fh:
+        reader = csv.DictReader(fh, delimiter="\t")
+        for row in reader:
+            name = row.get("Template") or "SELECTION"
+            begin = float(row["Begin Time (s)"])
+            end = float(row.get("End Time (s)") or begin)
+            center = (begin + end) / 2.0
+            ch = int(float(row.get("DAS Channel") or 0))
+            groups.setdefault(name, []).append((ch, int(round(center * fs))))
+    return {
+        name: np.asarray(sorted(v), dtype=np.int64).T.reshape(2, -1)
+        for name, v in groups.items()
+    }
