@@ -164,3 +164,28 @@ def test_gps_timestamp_is_utc_aware():
                    "Measurement", {"ch0": np.zeros(8, np.int16)})
         got2 = TdmsFile.read(path).properties["GPSTimeStamp"]
     assert got2.timestamp() == when.timestamp()
+
+
+def test_layout_probe_never_crashes_on_truncation(tmp_path):
+    """Property: contiguous_layout on ANY truncation of a valid file
+    either declines (None) or returns the exact full-file layout — it
+    must never raise or mis-describe bytes that are not there."""
+    from das4whales_tpu.io.tdms import contiguous_layout
+
+    scene = _scene(5)
+    p = str(tmp_path / "full.tdms")
+    write_synthetic_tdms(p, scene)
+    data = open(p, "rb").read()
+    full = contiguous_layout(p)
+    assert full is not None
+
+    t = str(tmp_path / "trunc.tdms")
+    for cut in [0, 4, 27, 28, 100, len(data) // 2, len(data) - 1]:
+        with open(t, "wb") as f:
+            f.write(data[:cut])
+        lay = contiguous_layout(t)
+        assert lay is None, f"accepted a file truncated at {cut} bytes"
+    # corrupt tail (>=28 junk bytes after the segment) declines too
+    with open(t, "wb") as f:
+        f.write(data + b"\x00" * 64)
+    assert contiguous_layout(t) is None
