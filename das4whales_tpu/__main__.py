@@ -105,7 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--outdir", default="out_longrecord")
     pl.add_argument("--channels", default=None,
                     help="start,stop,step channel selection (default: all of file 0)")
-    pl.add_argument("--family", default="mf", choices=("mf", "spectro", "gabor"))
+    pl.add_argument("--family", default="mf",
+                    choices=("mf", "spectro", "gabor", "learned"))
+    pl.add_argument("--model", default=None,
+                    help="trained learned-family model (.npz; required for "
+                         "--family learned)")
     pl.add_argument("--halo", type=int, default=512,
                     help="time-shard halo samples for the STAGED bandpass "
                          "(all families; the mf fused default has no "
@@ -231,12 +235,19 @@ def main(argv=None) -> int:
         # pass --fused through unconditionally: the workflow itself rejects
         # it for non-mf families, and silently dropping the flag would let
         # a user believe the fused route ran when it did not
+        fam_kw = None
+        if args.family == "learned":
+            if not args.model:
+                print("longrecord: --family learned requires --model")
+                return 2
+            fam_kw = {"model": args.model}
         res = detect_long_record(
             args.files, sel, meta,
             family=args.family, halo=args.halo,
             fused_bandpass=args.fused,
             max_peaks_per_channel=args.max_peaks,
             interrogator=args.interrogator,
+            family_kwargs=fam_kw,
         )
         os.makedirs(args.outdir, exist_ok=True)
         np.savez(

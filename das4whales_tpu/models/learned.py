@@ -79,8 +79,15 @@ def window_features(block, cfg: LearnedConfig, engine: str = "auto"):
     mu = jnp.mean(win, axis=(-2, -1), keepdims=True)
     sd = jnp.std(win, axis=(-2, -1), keepdims=True)
     win = (win - mu) / jnp.maximum(sd, 1e-6)
-    centers = (idx.mean(axis=1) * cfg.hop).astype(np.int64)
-    return win, centers
+    return win, window_centers(n_win, cfg)
+
+
+def window_centers(n_win: int, cfg: LearnedConfig) -> np.ndarray:
+    """Window-center SAMPLE indices for ``n_win`` windows — the one
+    definition shared by feature extraction and pick assembly."""
+    idx = (np.arange(n_win)[:, None] * cfg.win_stride
+           + np.arange(cfg.win_frames)[None, :])
+    return (idx.mean(axis=1) * cfg.hop).astype(np.int64)
 
 
 def window_labels(scene, centers: np.ndarray, cfg: LearnedConfig) -> np.ndarray:
@@ -366,21 +373,29 @@ class LearnedDetector:
         self.name = name
 
     def __call__(self, block, threshold: float | None = None) -> LearnedResult:
-        thr = self.threshold if threshold is None else float(threshold)
         win, centers = window_features(block, self.cfg)
-        C, n_win = win.shape[0], win.shape[1]
         scores = np.asarray(
             _score_windows(self.params, win.reshape(-1, *win.shape[-2:]),
                            self.cfg.compute_dtype)
-        ).reshape(C, n_win)
+        ).reshape(win.shape[0], win.shape[1])
+        return self.picks_from_scores(scores, threshold=threshold)
+
+    def picks_from_scores(self, scores: np.ndarray,
+                          threshold: float | None = None) -> LearnedResult:
+        """``[C, n_win]`` scores -> picks (threshold + per-channel NMS) —
+        shared by ``__call__`` and the sharded/long-record paths, which
+        compute scores through their own placement."""
+        thr = self.threshold if threshold is None else float(threshold)
+        scores = np.asarray(scores)
+        centers = window_centers(scores.shape[1], self.cfg)
         above = scores > thr
         # per-channel NMS over the window axis: keep local score maxima
         left = np.pad(scores, ((0, 0), (1, 0)))[:, :-1]
         right = np.pad(scores, ((0, 0), (0, 1)))[:, 1:]
         keep = above & (scores >= left) & (scores > right)
         chan, wins = np.nonzero(keep)
-        picks = np.asarray([chan, np.asarray(centers)[wins]])
+        picks = np.asarray([chan, centers[wins]])
         return LearnedResult(
             picks={self.name: picks}, scores=scores,
-            centers=np.asarray(centers), thresholds={self.name: thr},
+            centers=centers, thresholds={self.name: thr},
         )

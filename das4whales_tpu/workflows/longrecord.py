@@ -108,13 +108,21 @@ def detect_long_record(
     to the family's step factory (e.g. ``threshold`` for spectro,
     ``ksize``/``bin_factor``/``channel_halo`` for gabor).
     """
-    if family not in ("mf", "spectro", "gabor"):
+    if family not in ("mf", "spectro", "gabor", "learned"):
         raise ValueError(f"unknown family {family!r}")
     fam_kw = dict(family_kwargs or {})
     if family == "mf" and fam_kw:
         raise ValueError(
-            "family_kwargs only apply to family='spectro'/'gabor' — "
-            f"got {sorted(fam_kw)} with family='mf' (did you forget family=?)"
+            "family_kwargs only apply to family='spectro'/'gabor'/"
+            f"'learned' — got {sorted(fam_kw)} with family='mf' (did you "
+            "forget family=?)"
+        )
+    if family == "learned" and not (
+        "model" in fam_kw or ("params" in fam_kw and "cfg" in fam_kw)
+    ):
+        raise ValueError(
+            "family='learned' needs family_kwargs={'model': <npz path>} "
+            "(models.learned.save_params) or {'params': ..., 'cfg': ...}"
         )
     if fused_bandpass is None:
         # library default: fused for the flagship family (the on-chip
@@ -163,6 +171,41 @@ def detect_long_record(
     nnx, nns = record.shape
     log.info("continuous record: %d files -> [%d x %d] (%.1f s)",
              len(files), nnx, nns, n_samples / meta.fs)
+
+    if family == "learned":
+        # no bandpass/f-k front end (the classifier consumes raw
+        # spectrogram windows) and no time sharding: scoring is
+        # per-channel independent, so the record CHANNEL-shards over the
+        # same devices collective-free (models.learned
+        # make_sharded_inference) and picks come from the detector's own
+        # NMS with absolute window centers. Padding windows past the real
+        # record end are dropped like every family's divisibility pad.
+        from ..models import learned as _learned
+
+        if "model" in fam_kw:
+            params_l, cfg_l = _learned.load_params(fam_kw["model"])
+        else:
+            params_l, cfg_l = fam_kw["params"], fam_kw["cfg"]
+        thr_l = float(fam_kw.get("threshold", 0.5))
+        if nnx % p:
+            raise ValueError(
+                f"family='learned' channel-shards the record: channel "
+                f"count {nnx} must be divisible by {p}"
+            )
+        cmesh = make_mesh(shape=(p,), axis_names=("channel",),
+                          devices=np.asarray(mesh.devices).reshape(-1))
+        score_fn, put = _learned.make_sharded_inference(params_l, cfg_l, cmesh)
+        scores = np.asarray(jax.block_until_ready(score_fn(put(record))))
+        det = _learned.LearnedDetector(params_l, cfg_l, threshold=thr_l)
+        res = det.picks_from_scores(scores)
+        pk = res.picks[det.name]
+        pk = pk[:, pk[1] < n_samples]      # drop divisibility-padding picks
+        return LongRecordResult(
+            picks={det.name: pk},
+            pick_times_s={det.name: pk[1] / meta.fs},
+            thresholds={det.name: thr_l},
+            t0_utc=blocks[0].t0_utc, n_samples=n_samples, n_files=len(files),
+        )
 
     from ..config import SCRIPT_FK
 

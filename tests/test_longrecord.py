@@ -220,3 +220,39 @@ def test_packed_picks_match_full_transfer(campaign, monkeypatch):
         for name in rp.picks:
             np.testing.assert_array_equal(rp.picks[name], rf.picks[name])
             np.testing.assert_allclose(rp.pick_times_s[name], rf.pick_times_s[name])
+
+
+def test_long_record_learned_family(campaign):
+    """The learned family detects across the whole continuous record —
+    including the boundary-straddling call — via channel-sharded
+    inference with the shipped pretrained model."""
+    from das4whales_tpu.io.synth import SyntheticCall, SyntheticScene
+    from das4whales_tpu.models import learned
+
+    paths, onsets = campaign
+    meta = dio.get_acquisition_parameters(paths[0], "optasense")
+    cfg = learned.LearnedConfig()
+    scenes = [
+        SyntheticScene(nx=NX, ns=4000, dx=DX, noise_rms=0.17, seed=70 + s,
+                       calls=[SyntheticCall(t0=2.5 + 4 * k,
+                                            x0_m=(8 + 7 * k) * DX,
+                                            amplitude=0.7 + 0.15 * k)
+                              for k in range(3)])
+        for s in range(2)
+    ]
+    params, _ = learned.fit(cfg, scenes, epochs=25, batch=512, seed=0)
+    res = detect_long_record(
+        paths, [0, NX, 1], meta, family="learned",
+        family_kwargs={"params": params, "cfg": cfg, "threshold": 0.5},
+    )
+    pk = res.picks["CALL"]
+    assert res.n_files == 3 and pk.shape[1] > 0
+    assert int(pk[1].max()) < res.n_samples
+    for name, (ch, onset) in onsets.items():
+        sel = pk[1][pk[0] == ch]
+        near = sel[np.abs(sel - onset - 68) < 300] if len(sel) else []
+        assert len(near) > 0, f"{name} call at ch{ch}/{onset} missed: {sel[:10]}"
+
+    # model-path loading + validation errors
+    with pytest.raises(ValueError, match="learned"):
+        detect_long_record(paths, [0, NX, 1], meta, family="learned")
