@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "learned trains its CNN on synthetic scenes first)")
     pe.add_argument("--time-tol", type=float, default=0.5,
                     help="pick-to-arrival match tolerance [s]")
+    pe.add_argument("--out", default=None,
+                    help="also write the sweep JSON here")
+    pe.add_argument("--figure", default=None,
+                    help="also render recall/precision curves (PNG; "
+                         "per-family suffix with --family all)")
     _add_route_flags(pe, default=True, extra=" (the library default)")
     pc = sub.add_parser(
         "campaign",
@@ -218,8 +223,24 @@ def main(argv=None) -> int:
                                  time_tol_s=args.time_tol)
             for fam, det in detectors.items()
         }
-        print(json.dumps(out if args.family == "all" else out[args.family],
-                         indent=1))
+        payload = out if args.family == "all" else out[args.family]
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(payload, fh, indent=1)
+            print("wrote", args.out)
+        if args.figure:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            from das4whales_tpu.viz.plot import plot_eval_curves
+
+            for fam, rows in out.items():
+                fig = plot_eval_curves(rows, show=False)
+                path = (args.figure if args.family != "all" else
+                        args.figure.replace(".png", f"_{fam}.png"))
+                fig.savefig(path, dpi=90)
+                print("wrote", path)
+        print(json.dumps(payload, indent=1))
         return 0
     if args.workflow == "longrecord":
         import json as _json
