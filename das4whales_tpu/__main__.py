@@ -223,23 +223,35 @@ def main(argv=None) -> int:
                                  time_tol_s=args.time_tol)
             for fam, det in detectors.items()
         }
-        payload = out if args.family == "all" else out[args.family]
+        def _no_nan(v):
+            # zero-pick sweep points carry precision=NaN; strict-JSON
+            # consumers (jq, json.load) reject bare NaN tokens
+            if isinstance(v, dict):
+                return {k: _no_nan(x) for k, x in v.items()}
+            if isinstance(v, list):
+                return [_no_nan(x) for x in v]
+            if isinstance(v, float) and v != v:
+                return None
+            return v
+
+        payload = _no_nan(out if args.family == "all" else out[args.family])
         if args.out:
             with open(args.out, "w") as fh:
                 json.dump(payload, fh, indent=1)
-            print("wrote", args.out)
+            print("wrote", args.out, file=sys.stderr)
         if args.figure:
             import matplotlib
 
             matplotlib.use("Agg")
             from das4whales_tpu.viz.plot import plot_eval_curves
 
+            stem, ext = os.path.splitext(args.figure)
             for fam, rows in out.items():
                 fig = plot_eval_curves(rows, show=False)
                 path = (args.figure if args.family != "all" else
-                        args.figure.replace(".png", f"_{fam}.png"))
+                        f"{stem}_{fam}{ext or '.png'}")
                 fig.savefig(path, dpi=90)
-                print("wrote", path)
+                print("wrote", path, file=sys.stderr)
         print(json.dumps(payload, indent=1))
         return 0
     if args.workflow == "longrecord":
