@@ -95,17 +95,22 @@ def _append_manifest(outdir: str, rec: FileRecord) -> None:
         fh.write(json.dumps(rec.__dict__) + "\n")
 
 
-def _save_picks(outdir: str, path: str, picks: Dict[str, np.ndarray],
-                thresholds: Dict[str, float]) -> str:
+def _picks_path(outdir: str, path: str) -> str:
+    """Deterministic artifact path for one file's picks (every process of
+    a multi-host campaign computes the same name; only process 0 writes)."""
     import hashlib
 
     stem = os.path.splitext(os.path.basename(path))[0]
     # disambiguate same-named files from different directories (a campaign
     # over day1/seg.h5 + day2/seg.h5 must not overwrite artifacts)
     digest = hashlib.sha1(os.path.abspath(path).encode()).hexdigest()[:8]
-    pdir = os.path.join(outdir, "picks")
-    os.makedirs(pdir, exist_ok=True)
-    out = os.path.join(pdir, f"{stem}-{digest}.npz")
+    return os.path.join(outdir, "picks", f"{stem}-{digest}.npz")
+
+
+def _save_picks(outdir: str, path: str, picks: Dict[str, np.ndarray],
+                thresholds: Dict[str, float]) -> str:
+    out = _picks_path(outdir, path)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
     arrays = {f"picks_{name}": np.asarray(pk) for name, pk in picks.items()}
     arrays["thresholds"] = np.asarray([thresholds[name] for name in picks])
     arrays["template_names"] = np.asarray(list(picks), dtype="U")
@@ -149,9 +154,11 @@ def _split_resume(files, outdir: str, resume: bool, records: List[FileRecord]):
     return pending, idx
 
 
-def _failure_recorder(outdir: str, records: List[FileRecord], max_failures):
+def _failure_recorder(outdir: str, records: List[FileRecord], max_failures,
+                      write: bool = True):
     """Shared per-file failure bookkeeping: manifest record + warning +
-    max_failures enforcement."""
+    max_failures enforcement. ``write=False`` keeps the bookkeeping but
+    skips the manifest append (multi-host non-writer processes)."""
     state = {"n": 0}
 
     def fail(path: str, exc: Exception) -> None:
@@ -159,7 +166,8 @@ def _failure_recorder(outdir: str, records: List[FileRecord], max_failures):
         rec = FileRecord(path=path, status="failed",
                          error=f"{type(exc).__name__}: {exc}")
         records.append(rec)
-        _append_manifest(outdir, rec)
+        if write:
+            _append_manifest(outdir, rec)
         log.warning("file failed (%d so far): %s — %s", state["n"], path, rec.error)
         if max_failures is not None and state["n"] > max_failures:
             raise CampaignAborted(
@@ -288,6 +296,57 @@ def _compact_batch_picks(positions, selected, n_samples: int, capacity: int):
 _compact_batch_picks_jit = None
 
 
+def _probe_healthy(pairs, interrogator, fail, expect_shape=None):
+    """Probe (path, metadata) pairs; returns ``(healthy [(path, spec)],
+    spec0)``. ``expect_shape=(nx, ns)`` routes shape mismatches to
+    ``fail`` — in a multi-host campaign a wrong-shape file would
+    otherwise raise on only the host that reads it while its peers sit
+    in the step's collectives (DCN-timeout deadlock, not a per-file
+    failure)."""
+    from ..io.stream import _probe
+
+    healthy, spec0 = [], None
+    for path, meta_j in pairs:
+        try:
+            spec = _probe(path, interrogator, meta_j)
+            shape = (spec.meta.nx, spec.meta.ns)
+            want = expect_shape or (
+                (spec0.meta.nx, spec0.meta.ns) if spec0 is not None else shape
+            )
+            if shape != want:
+                raise ValueError(
+                    f"file shape {shape} != campaign shape {want} "
+                    "(one step serves one shape; run mismatched files "
+                    "in their own campaign)"
+                )
+            if spec0 is None:
+                spec0 = spec
+            healthy.append((path, spec))
+        except Exception as exc:  # noqa: BLE001 — per-file isolation
+            fail(path, exc)
+    return healthy, spec0
+
+
+def _file_record(outdir, path, picks, thresholds, wall_s, records,
+                 write: bool = True) -> FileRecord:
+    """One completed file's bookkeeping — artifact + manifest + record —
+    shared by every campaign flavor (``write=False``: multi-host
+    non-writer processes compute identical records, write nothing)."""
+    if write:
+        picks_file = _save_picks(outdir, path, picks, thresholds)
+    else:
+        picks_file = _picks_path(outdir, path)
+    rec = FileRecord(
+        path=path, status="done",
+        n_picks={n: int(p.shape[1]) for n, p in picks.items()},
+        wall_s=wall_s, picks_file=picks_file,
+    )
+    records.append(rec)
+    if write:
+        _append_manifest(outdir, rec)
+    return rec
+
+
 def run_campaign_sharded(
     files: Sequence[str],
     selected_channels,
@@ -335,18 +394,11 @@ def run_campaign_sharded(
     pend_metas = [metas[j] for j in pend_idx]
     fail = _failure_recorder(outdir, records, max_failures)
 
-    healthy: List[str] = []
-    healthy_metas: List = []
-    spec0 = None
-    for path, meta_j in zip(pending, pend_metas):
-        try:
-            spec = _probe(path, interrogator, meta_j)
-            if spec0 is None:
-                spec0 = spec
-            healthy.append(path)
-            healthy_metas.append(spec.meta)
-        except Exception as exc:  # noqa: BLE001 — per-file isolation
-            fail(path, exc)
+    healthy_specs, spec0 = _probe_healthy(
+        zip(pending, pend_metas), interrogator, fail
+    )
+    healthy = [p for p, _ in healthy_specs]
+    healthy_metas = [sp.meta for _, sp in healthy_specs]
     if not healthy:
         return CampaignResult(outdir=outdir, records=records)
 
@@ -413,15 +465,158 @@ def run_campaign_sharded(
                 )
             thresholds = {name: float(thres_np[k]) * factors[name]
                           for name in design.template_names}
-            rec = FileRecord(
-                path=path, status="done",
-                n_picks={n: int(p.shape[1]) for n, p in picks.items()},
-                wall_s=round(wall / max(len(blocks), 1), 3),
-                picks_file=_save_picks(outdir, path, picks, thresholds),
-            )
-            records.append(rec)
-            _append_manifest(outdir, rec)
+            _file_record(outdir, path, picks, thresholds,
+                         round(wall / max(len(blocks), 1), 3), records)
         consumed += len(blocks)
+    return CampaignResult(outdir=outdir, records=records)
+
+
+def run_campaign_multiprocess(
+    files: Sequence[str],
+    selected_channels,
+    outdir: str,
+    metadata=None,
+    resume: bool = True,
+    max_failures: int | None = None,
+    interrogator: str = "optasense",
+    relative_threshold: float = 0.5,
+    hf_factor: float = 0.9,
+    fused_bandpass: bool = True,
+) -> CampaignResult:
+    """Multi-HOST campaign: one SPMD program per batch across all
+    processes of the JAX runtime.
+
+    Every process runs this same call with the same arguments after
+    ``parallel.distributed.initialize_from_env()`` formed the runtime
+    (single-process degenerates to a local mesh). The file list and
+    ``outdir`` must be on storage every process can read — the probe
+    runs everywhere so the healthy set is identical — and process 0
+    alone writes the manifest/picks artifacts (every process returns the
+    same ``CampaignResult``).
+
+    Data placement is the DCN-friendly ``distributed.global_mesh()``
+    layout: the file axis is process-major and
+    ``jax.make_array_from_callback`` materializes only each process's
+    addressable shards, so EVERY HOST READS JUST ITS OWN FILES and raw
+    strain never crosses DCN — only the packed picks (kB) are
+    allgathered for writing. The reference's only multi-machine story is
+    a human running per-file scripts on several nodes (SURVEY.md §5.8).
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    from ..config import ChannelSelection
+    from ..eval import sharded_picks_to_dict
+    from ..io.stream import _probe, _read_host
+    from ..models.matched_filter import design_matched_filter
+    from ..parallel import distributed
+    from ..parallel.pipeline import input_sharding, make_sharded_mf_step
+
+    is_writer = jax.process_index() == 0
+    mesh = distributed.global_mesh()
+    batch = int(mesh.shape["file"])
+
+    os.makedirs(outdir, exist_ok=True)
+    metas = _normalize_metas(metadata, list(files))
+    records: List[FileRecord] = []
+    pending, pend_idx = _split_resume(list(files), outdir, resume, records)
+    pend_metas = [metas[j] for j in pend_idx]
+    fail = _failure_recorder(outdir, records, max_failures, write=is_writer)
+
+    healthy_specs, spec0 = _probe_healthy(
+        zip(pending, pend_metas), interrogator, fail
+    )
+    if not healthy_specs:
+        return CampaignResult(outdir=outdir, records=records)
+
+    sel = ChannelSelection.from_list(selected_channels)
+    C = sel.n_channels(spec0.meta.nx)
+    ns = spec0.meta.ns
+    design = design_matched_filter((C, ns), selected_channels, spec0.meta)
+    step = jax.jit(make_sharded_mf_step(
+        design, mesh, outputs="picks",
+        relative_threshold=relative_threshold, hf_factor=hf_factor,
+        fused_bandpass=fused_bandpass,
+    ))
+    sharding = input_sharding(mesh)
+    factors = {name: (hf_factor if i == 0 else 1.0)
+               for i, name in enumerate(design.template_names)}
+
+    for s in range(0, len(healthy_specs), batch):
+        group = healthy_specs[s : s + batch]
+        n_real = len(group)
+        padded = group + [group[-1]] * (batch - n_real)
+        cache: dict = {}
+
+        def _shard(idx, padded=padded, cache=cache):
+            fsl, csl, tsl = idx
+            rows = []
+            for fi in range(fsl.start or 0, fsl.stop if fsl.stop is not None
+                            else (fsl.start or 0) + 1):
+                spec = padded[fi][1]
+                if fi not in cache:
+                    cache[fi] = _read_host(spec, sel)      # [C, ns] float32
+                rows.append(cache[fi][csl, tsl])
+            return np.stack(rows)
+
+        t0 = time.perf_counter()
+        x = jax.make_array_from_callback((batch, C, ns), sharding, _shard)
+        sp_picks, thres = jax.block_until_ready(step(x))
+        wall = time.perf_counter() - t0
+        thres_np = np.asarray(
+            multihost_utils.process_allgather(thres, tiled=True)
+        ).reshape(batch)
+
+        nT, _, Cr, K = sp_picks.positions.shape
+        cap = min(Cr * K, _PICK_PACK_CAP)
+        rows_d, times_d, cnt_d = _compact_batch_picks(
+            sp_picks.positions, sp_picks.selected, ns, cap
+        )
+        # counts first (nT*B ints), then DEVICE-slice to the pow2 max
+        # before the cross-host gather — only actual picks ride DCN, the
+        # same trick compacted_to_host plays for the device->host hop
+        cnt = np.asarray(
+            multihost_utils.process_allgather(cnt_d, tiled=True)
+        ).reshape(nT, batch)
+        kmax = int(cnt.max(initial=0))
+        host_picks = None
+        if kmax <= cap:
+            kpad = min(cap, 1 << max(kmax - 1, 0).bit_length())
+            rows_np = np.asarray(multihost_utils.process_allgather(
+                rows_d[..., :kpad], tiled=True)
+            ).reshape(nT, batch, kpad).astype(np.int64)
+            times_np = np.asarray(multihost_utils.process_allgather(
+                times_d[..., :kpad], tiled=True)
+            ).reshape(nT, batch, kpad).astype(np.int64)
+        else:  # pack overflow: exact full-grid fallback (allgathered)
+            import types
+
+            host_picks = types.SimpleNamespace(
+                positions=np.asarray(multihost_utils.process_allgather(
+                    sp_picks.positions, tiled=True)),
+                selected=np.asarray(multihost_utils.process_allgather(
+                    sp_picks.selected, tiled=True)),
+            )
+
+        for k, (path, _spec) in enumerate(group):
+            if host_picks is None:
+                picks = {
+                    name: np.asarray([rows_np[i, k, : cnt[i, k]],
+                                      times_np[i, k, : cnt[i, k]]])
+                    for i, name in enumerate(design.template_names)
+                }
+            else:
+                picks = sharded_picks_to_dict(
+                    host_picks, design.template_names, file_index=k,
+                    n_samples=ns,
+                )
+            thresholds = {name: float(thres_np[k]) * factors[name]
+                          for name in design.template_names}
+            _file_record(outdir, path, picks, thresholds,
+                         round(wall / max(n_real, 1), 3), records,
+                         write=is_writer)
+    # writer must finish artifacts before any process reads them
+    multihost_utils.sync_global_devices("das4whales-campaign-end")
     return CampaignResult(outdir=outdir, records=records)
 
 

@@ -111,6 +111,40 @@ def main() -> int:
     t1_file0 = float(np.atleast_1d(thres_np)[0])
     assert abs(t2 - t1_file0) < 1e-5 * max(1.0, abs(t1_file0)), (t2, t1_file0)
 
+    # phase 3 — a TRUE multi-process CAMPAIGN: four synthetic files over
+    # the two processes (file axis process-major; each process reads only
+    # its own files via make_array_from_callback), process 0 writing the
+    # manifest/picks artifacts, every process returning the same result.
+    workdir = os.environ["MP_CAMPAIGN_DIR"]
+    from das4whales_tpu.io.synth import SyntheticCall, SyntheticScene, write_synthetic_file
+    from das4whales_tpu.workflows.campaign import (
+        load_picks,
+        run_campaign_multiprocess,
+    )
+
+    cfiles = []
+    for k in range(4):
+        path = os.path.join(workdir, f"c{k}.h5")
+        if jax.process_index() == 0 and not os.path.exists(path):
+            write_synthetic_file(path, SyntheticScene(
+                nx=nx, ns=ns, dx=8.0, noise_rms=0.05, seed=k,
+                calls=[SyntheticCall(t0=1.0 + 0.4 * k, x0_m=(4 + 2 * k) * 8.0,
+                                     amplitude=1.0)],
+            ))
+        cfiles.append(path)
+    multihost_utils.sync_global_devices("campaign-files-written")
+
+    res = run_campaign_multiprocess(cfiles, [0, nx, 1], os.path.join(workdir, "out"))
+    assert res.n_done == 4, [r.__dict__ for r in res.records]
+    done = {r.path: r for r in res.records if r.status == "done"}
+    for k, path in enumerate(cfiles):
+        picks = load_picks(done[path].picks_file)     # process 0 wrote them
+        ch = 4 + 2 * k
+        assert ch in picks["HF"][0], (k, picks["HF"][:, :6])
+    # resume: a second run skips everything (manifest read on every process)
+    res2 = run_campaign_multiprocess(cfiles, [0, nx, 1], os.path.join(workdir, "out"))
+    assert res2.n_skipped == 4 and res2.n_done == 0
+
     print(f"MP_OK pid={jax.process_index()} "
           f"thres={[round(float(v), 4) for v in np.atleast_1d(thres_np)]}",
           flush=True)

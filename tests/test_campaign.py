@@ -204,3 +204,33 @@ def test_sharded_campaign_packed_picks_match_full_transfer(file_set, tmp_path, m
         assert set(picks_p) == set(picks_f)
         for name in picks_p:
             np.testing.assert_array_equal(picks_p[name], picks_f[name])
+
+
+def test_multiprocess_campaign_single_process_degenerate(file_set, tmp_path):
+    """run_campaign_multiprocess on one process = a local-mesh campaign
+    with identical artifacts to run_campaign_sharded."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8-device mesh")
+    from das4whales_tpu.parallel.mesh import make_mesh
+    from das4whales_tpu.workflows.campaign import (
+        run_campaign_multiprocess,
+        run_campaign_sharded,
+    )
+
+    out_mp = str(tmp_path / "mp")
+    res = run_campaign_multiprocess(file_set, SEL, out_mp)
+    assert res.n_done == 2 and res.n_failed == 1
+    out_sh = str(tmp_path / "sh")
+    ref = run_campaign_sharded(file_set, SEL, out_sh, make_mesh())
+    done_mp = sorted((os.path.basename(r.path), r.picks_file)
+                     for r in res.records if r.status == "done")
+    done_sh = sorted((os.path.basename(r.path), r.picks_file)
+                     for r in ref.records if r.status == "done")
+    assert len(done_mp) == len(done_sh) == 2
+    for (n1, p1), (n2, p2) in zip(done_mp, done_sh):
+        assert n1 == n2
+        a, b = load_picks(p1), load_picks(p2)
+        for name in a:
+            np.testing.assert_array_equal(a[name], b[name])

@@ -28,12 +28,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_sharded_detection():
+def test_two_process_sharded_detection(tmp_path):
     port = _free_port()
+    campaign_dir = str(tmp_path)
     procs = []
     for rank in range(2):
         env = dict(
             os.environ,
+            MP_CAMPAIGN_DIR=campaign_dir,
             JAX_COORDINATOR=f"127.0.0.1:{port}",
             JAX_NUM_PROCESSES="2",
             JAX_PROCESS_ID=str(rank),
