@@ -87,6 +87,12 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("--sharded", action="store_true",
                     help="detect batches on a (file x channel) device mesh "
                          "(workflows.campaign.run_campaign_sharded)")
+    pc.add_argument("--multihost", action="store_true",
+                    help="one SPMD campaign across ALL processes of a "
+                         "multi-process JAX runtime (launch every host "
+                         "with JAX_COORDINATOR/JAX_NUM_PROCESSES/"
+                         "JAX_PROCESS_ID and the same command; "
+                         "workflows.campaign.run_campaign_multiprocess)")
     pc.add_argument("--family", default="mf",
                     choices=("mf", "spectro", "gabor", "learned"),
                     help="detector family (spectro/gabor run through the "
@@ -365,7 +371,21 @@ def main(argv=None) -> int:
 
                 detector = GaborEvalAdapter(mf, GaborDetector(meta0, sel))
         try:
-            if args.sharded:
+            if args.multihost:
+                if detector is not None:
+                    print("campaign: --multihost supports the mf family only")
+                    return 2
+                from das4whales_tpu.workflows.campaign import (
+                    run_campaign_multiprocess,
+                )
+
+                res = run_campaign_multiprocess(
+                    args.files, sel, args.outdir,
+                    resume=not args.no_resume, max_failures=args.max_failures,
+                    interrogator=args.interrogator,
+                    fused_bandpass=args.fused,
+                )
+            elif args.sharded:
                 from das4whales_tpu.parallel.mesh import make_mesh
                 from das4whales_tpu.workflows.campaign import run_campaign_sharded
 
@@ -389,6 +409,13 @@ def main(argv=None) -> int:
             return 4
         print(f"campaign: {res.n_done} done, {res.n_failed} failed, "
               f"{res.n_skipped} skipped -> {res.outdir}")
+        if args.multihost:
+            # one report writer: every process prints its result, but
+            # only process 0 regenerates summary.json/density.png
+            import jax as _jax
+
+            if _jax.process_index() != 0:
+                return 0 if res.n_failed == 0 else 3
         if res.n_done:
             import json as _json
 
