@@ -3,7 +3,7 @@
 A ground-up JAX/XLA rebuild of the capability surface of DAS4Whales
 (github.com/leabouffaut/DAS4Whales): ingest interrogator recordings into a
 ``[channel x time]`` strain tensor, filter in the frequency-wavenumber
-domain, detect baleen-whale calls with three detector families
+domain, detect baleen-whale calls with four detector families
 (matched-filter, spectrogram correlation, Gabor/image), localize sources by
 TDOA least squares, and visualize — with jit+vmap kernels instead of
 per-channel Python loops and ``jax.sharding`` meshes instead of dask chunks.

@@ -495,7 +495,7 @@ def select_picked_times(idx_tp, tstart: float, tend: float, fs: float):
 def warn_saturated(saturated, label: str, max_peaks: int) -> bool:
     """Surface pick-capacity saturation; returns True iff any slot saturated.
 
-    Shared by all three detector families (a truncated pick list must
+    Shared by every detector family (a truncated pick list must
     never pass silently). Fires BOTH ways on purpose: a logger warning,
     which repeats on every saturated call (``warnings`` dedups by source
     location, so in a detect-many campaign only the first file would

@@ -1,6 +1,6 @@
 """Channel-sharded spectrogram-correlation detection.
 
-The spectro family is the easiest of the three detectors to scale out:
+The spectro family is the easiest detector family to scale out:
 every stage (per-channel normalization, sliced STFT, 2-D hat-kernel
 correlation, absolute-threshold picking — reference detect.py:650-708 +
 main_spectrodetect.py:118-121) is channel-local, and the threshold is
