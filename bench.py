@@ -164,6 +164,19 @@ def _load_banked(max_age_h: float | None = None) -> dict | None:
     return payload
 
 
+def _replay_banked(banked: dict, suffix: str, errors=None) -> None:
+    """Print a banked accelerator payload as the run's JSON line, with an
+    honest provenance annotation (one definition for the probe-fail and
+    rungs-fail replay paths)."""
+    banked["banked"] = True
+    banked["device"] = (
+        f"{banked['device']} [banked {banked['banked_age_h']}h ago; {suffix}]"
+    )
+    if errors:
+        banked["error"] = "; ".join(errors)
+    print(json.dumps(banked))
+
+
 def _make_block(nx, ns, fs, dx, seed=0):
     """OOI-scale noise block with a handful of injected fin-call chirps."""
     rng = np.random.default_rng(seed)
@@ -545,20 +558,16 @@ def main():
         # with backoff inside the budget — wedged tunnels sometimes recover.
         if not _probe_device_with_backoff(args.device_timeout):
             fallback = True
-            # --quick is the CI smoke: it must exercise the ladder for
-            # real, never return a stale full-shape payload
-            banked = None if args.quick else _load_banked()
+            # --quick is the CI smoke and --strict is the did-THIS-run-
+            # measure gate: both must exercise the ladder for real, never
+            # return a stale payload
+            banked = None if (args.quick or args.strict) else _load_banked()
             if banked is not None:
                 # a live window earlier this session already produced an
                 # accelerator headline; replay it rather than degrade the
                 # round artifact to a CPU line (VERDICT r3 next-1: "the
                 # moment the chip answers, bank the number")
-                banked["banked"] = True
-                banked["device"] = (
-                    f"{banked['device']} [banked {banked['banked_age_h']}h ago; "
-                    "accelerator unreachable at report time]"
-                )
-                print(json.dumps(banked))
+                _replay_banked(banked, "accelerator unreachable at report time")
                 return 0
 
     fs, dx = 200.0, 2.042
@@ -648,6 +657,27 @@ def main():
                 errors.append("accelerator unresponsive after rung timeout; "
                               "degrading remaining rungs to CPU")
                 on_cpu = True
+                if (not args.quick and not args.strict
+                        and not any(not s[4] for s in successes)
+                        and _load_banked() is not None):
+                    # no accelerator number from THIS run and a banked one
+                    # exists: the replay below will outrank anything the
+                    # CPU rungs could add — skip their wall-clock entirely
+                    errors.append("bank replay available; skipping CPU rungs")
+                    break
+
+    # a banked accelerator payload also outranks any CPU-routed outcome
+    # from THIS run: a tunnel that probes green but wedges every rung
+    # (the round-3 second-wedge signature) must not demote the round
+    # artifact to a CPU line while a real measurement sits in the bank
+    if not args.quick and not args.strict and not explicit_cpu and not any(
+        not s[4] for s in successes
+    ):
+        banked = _load_banked()
+        if banked is not None:
+            _replay_banked(banked, "accelerator rungs failed at report time",
+                           errors)
+            return 0
 
     if not successes and not (args.quick or fallback or explicit_cpu):
         # nothing succeeded on the accelerator ladder — one last CPU rung
