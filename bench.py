@@ -92,6 +92,19 @@ BANK_PATH = os.path.join(
 )
 
 
+def _git_head() -> str | None:
+    """Short HEAD hash of the repo this bench lives in, or None (bank
+    provenance and stale-replay detection share this)."""
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
 def _bank_payload(payload: dict) -> None:
     """Persist an accelerator headline for later replay. Best-effort: the
     bank is a bonus artifact and must never cost the JSON line.
@@ -111,14 +124,7 @@ def _bank_payload(payload: dict) -> None:
     existing = _load_banked()
     if existing is not None and _rank(existing) > _rank(payload):
         return
-    try:
-        commit = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            capture_output=True, text=True, timeout=10,
-        ).stdout.strip() or None
-    except (OSError, subprocess.TimeoutExpired):
-        commit = None
+    commit = _git_head()
     try:
         os.makedirs(os.path.dirname(BANK_PATH), exist_ok=True)
         with open(BANK_PATH, "w") as fh:
@@ -167,8 +173,16 @@ def _load_banked(max_age_h: float | None = None) -> dict | None:
 def _replay_banked(banked: dict, suffix: str, errors=None) -> None:
     """Print a banked accelerator payload as the run's JSON line, with an
     honest provenance annotation (one definition for the probe-fail and
-    rungs-fail replay paths)."""
+    rungs-fail replay paths). A payload measured on an earlier commit is
+    visibly marked stale (``stale_commit`` flag + device suffix) so a
+    number from commit X is never silently presented as evidence about
+    later code (ADVICE r4)."""
     banked["banked"] = True
+    head = _git_head()
+    banked_commit = banked.get("banked_commit")
+    if head and banked_commit and head != banked_commit:
+        banked["stale_commit"] = True
+        suffix += f"; stale-commit (measured on {banked_commit}, HEAD {head})"
     banked["device"] = (
         f"{banked['device']} [banked {banked['banked_age_h']}h ago; {suffix}]"
     )
@@ -213,6 +227,12 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
         # canonical rung is faster); DAS_BENCH_CHANNEL_PAD still overrides.
         fused_bandpass=os.environ.get("DAS_BENCH_FUSED", "1") == "1",
         channel_pad=os.environ.get("DAS_BENCH_CHANNEL_PAD") or channel_pad,
+        # campaign configuration (VERDICT r4 next-1b: time the path a
+        # campaign runs): picks-only output routes the sparse engine
+        # through the one-program detect (single dispatch + single packed
+        # fetch) instead of materializing user-facing correlograms and
+        # paying 4-6 tunnel round trips per call
+        keep_correlograms=os.environ.get("DAS_BENCH_KEEP_CORR", "0") == "1",
     )
     block = _make_block(nx, ns, fs, dx)
     # stage the host->device transfer in channel slabs: one ~1 GB RPC is a
@@ -228,7 +248,10 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
 
     def run():
         res = det(x)
-        jax.block_until_ready(res.trf_fk)
+        # the one-program route returns host-resident picks (the fetch IS
+        # the sync); other routes still expose the device trf_fk
+        if res.trf_fk is not None:
+            jax.block_until_ready(res.trf_fk)
         return res
 
     run()  # compile (design reuse means this cost amortizes across files)
@@ -244,6 +267,8 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
         route = f"tiled(tile={det.effective_channel_tile})"
     if det.fused_bandpass:
         route += "+fusedbp"
+    if det.pick_mode == "sparse" and not det.keep_correlograms:
+        route += "+1prog"
     if det.fk_pad_rows:
         route += f"+chpad{det.design.fk_channels}"
     return min(times), n_picks, str(jax.devices()[0]), stages, route, det.pick_mode
@@ -308,7 +333,10 @@ def bench_stages(det, x, repeats=3):
             # (ops.peaks.picks_with_escalation), including its saturation
             # check and any full-capacity rerun
             pick_fn = lambda ct, t: peak_ops.picks_with_escalation(
-                lambda k: mf_pick_tiled(ct, t, k), det.pick_k0, det.max_peaks
+                lambda k: mf_pick_tiled(
+                    ct, t, k, peak_ops.escalation_method(k, det.max_peaks)
+                ),
+                det.pick_k0, det.max_peaks,
             )
             stages["envelope+peaks"], _ = timed(pick_fn, corr_tiles, thr)
         else:  # scipy/dense engines untile the envelope (matched_filter._call_tiled)
@@ -336,7 +364,10 @@ def bench_stages(det, x, repeats=3):
             # escalation policy helper
             return [
                 peak_ops.picks_with_escalation(
-                    lambda k: peak_ops.find_peaks_sparse(env[i], thr[i], max_peaks=k),
+                    lambda k: peak_ops.find_peaks_sparse(
+                        env[i], thr[i], max_peaks=k,
+                        method=peak_ops.escalation_method(k, det.max_peaks),
+                    ),
                     det.pick_k0, det.max_peaks,
                 )
                 for i in range(env.shape[0])
@@ -576,6 +607,22 @@ def main():
     # canonical OOI working selection (tutorial.md:71-88)
     full_shape = (22050, 12000, 1050, 2048)
 
+    # Measured SAME-SHAPE CPU reference walls (the golden-certification
+    # runs, VALIDATION.md "Wall time" table): the in-run subset baseline
+    # extrapolates linearly in channels, which FLATTERS the CPU when
+    # nx >> cpu_nx (float64 fft2 at [22k x 12k] thrashes: measured 226.2 s
+    # where the 1050-channel rate extrapolates to ~105 s). When the
+    # headline lands on a shape with a direct measurement, vs_baseline
+    # uses it and the extrapolation is demoted to a secondary field
+    # (VERDICT r4 next-3).
+    measured_cpu_walls = {
+        (22050, 12000): (
+            226.2,
+            "golden f64 scipy stack, single x86 core (VALIDATION.md, "
+            "measured 2026-07-30)",
+        ),
+    }
+
     # Attempt ladder: a runtime failure (the round-2 HBM OOM) must degrade
     # to the next rung and ANNOTATE, never exit without the JSON line
     # (VERDICT r2 weak-2). Each rung is (label, shape, kwargs, final, tags);
@@ -726,6 +773,7 @@ def main():
 
     cpu_rate = None
     cpu_ref_mode = None
+    cpu_rate_extrapolated = None
     vs = float("nan")
     if not args.no_cpu:
         base_spec = {"cpu_baseline": True, "nx": cpu_nx, "ns": ns, "fs": fs, "dx": dx}
@@ -748,6 +796,16 @@ def main():
         else:
             errors.append(f"cpu-baseline: {err}")
 
+    meas = measured_cpu_walls.get((nx, ns))
+    if meas is not None and cpu_ref_mode != "measured-same-shape":
+        # a recorded direct measurement at the headline shape beats the
+        # subset extrapolation as the vs_baseline denominator
+        cpu_wall_meas, provenance = meas
+        cpu_rate_extrapolated = cpu_rate
+        cpu_rate = nx * ns / cpu_wall_meas
+        vs = value / cpu_rate
+        cpu_ref_mode = f"measured-same-shape({provenance})"
+
     try:
         roofline_pred, roofline_frac = _roofline_stage_report(
             stages, route, device, nx, ns
@@ -768,6 +826,9 @@ def main():
         "pick_engine": result.get("pick_engine"),
         "cpu_ref_rate": round(cpu_rate, 1) if cpu_rate else None,
         "cpu_ref_mode": cpu_ref_mode,
+        "cpu_ref_rate_extrapolated": (
+            round(cpu_rate_extrapolated, 1) if cpu_rate_extrapolated else None
+        ),
         "stage_wall_s": stages,
         "roofline_pred_ms": roofline_pred,
         "roofline_frac": roofline_frac,

@@ -397,7 +397,10 @@ def main(argv=None) -> int:
                 )
             else:
                 kwargs = {} if detector is not None else {
-                    "fused_bandpass": args.fused
+                    "fused_bandpass": args.fused,
+                    # campaigns consume picks only: the one-program route
+                    # (single dispatch + single packed fetch per file)
+                    "keep_correlograms": False,
                 }
                 res = run_campaign(
                     args.files, sel, args.outdir, detector=detector,

@@ -62,21 +62,51 @@ def to_raven_selection_table(
     return path
 
 
-def from_raven_selection_table(path: str, fs: float) -> Dict[str, np.ndarray]:
+def from_raven_selection_table(
+    path: str, fs: float, skipped: list | None = None
+) -> Dict[str, np.ndarray]:
     """Inverse of :func:`to_raven_selection_table`: selection table ->
     ``{template: (2, n)}`` picks (box centers back to sample indices).
     Tables from Raven itself work too — rows missing the ``Template`` /
     ``DAS Channel`` extension columns land under template ``"SELECTION"``
-    with channel 0."""
+    with channel 0. Header matching tolerates Raven's capitalization and
+    spacing variants (lookup is case/whitespace-insensitive); a table
+    without any recognizable ``Begin Time (s)`` column raises a
+    descriptive ``ValueError`` up front, and rows whose time cells are
+    empty/unparseable are skipped (reported via ``skipped``, a list that
+    receives ``(line_number, reason)`` tuples) instead of crashing
+    mid-iteration (ADVICE r4)."""
+    def norm(s: str) -> str:
+        return " ".join(str(s).split()).lower()
+
     groups: Dict[str, list] = {}
     with open(path, newline="") as fh:
         reader = csv.DictReader(fh, delimiter="\t")
-        for row in reader:
-            name = row.get("Template") or "SELECTION"
-            begin = float(row["Begin Time (s)"])
-            end = float(row.get("End Time (s)") or begin)
+        headers = {norm(h): h for h in (reader.fieldnames or [])}
+
+        def col(name: str) -> str | None:
+            return headers.get(norm(name))
+
+        begin_col = col("Begin Time (s)")
+        if begin_col is None:
+            raise ValueError(
+                f"{path}: not a Raven selection table — no 'Begin Time (s)' "
+                f"column (found: {reader.fieldnames})"
+            )
+        end_col = col("End Time (s)")
+        tmpl_col = col("Template")
+        ch_col = col("DAS Channel")
+        for lineno, row in enumerate(reader, start=2):
+            name = (row.get(tmpl_col) if tmpl_col else None) or "SELECTION"
+            try:
+                begin = float(row[begin_col])
+                end = float((row.get(end_col) if end_col else None) or begin)
+                ch = int(float((row.get(ch_col) if ch_col else None) or 0))
+            except (TypeError, ValueError) as e:
+                if skipped is not None:
+                    skipped.append((lineno, repr(e)))
+                continue
             center = (begin + end) / 2.0
-            ch = int(float(row.get("DAS Channel") or 0))
             groups.setdefault(name, []).append((ch, int(round(center * fs))))
     return {
         name: np.asarray(sorted(v), dtype=np.int64).T.reshape(2, -1)

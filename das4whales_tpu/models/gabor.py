@@ -155,7 +155,10 @@ class GaborDetector:
             env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
             # adaptive K with exact escalation on saturation (ops.peaks)
             pos, _, _, sel, saturated = peak_ops.picks_with_escalation(
-                lambda k: peak_ops.find_peaks_sparse(env, thr, max_peaks=k),
+                lambda k: peak_ops.find_peaks_sparse(
+                    env, thr, max_peaks=k,
+                    method=peak_ops.escalation_method(k, self.max_peaks),
+                ),
                 min(64, self.max_peaks), self.max_peaks,
             )
             peak_ops.warn_saturated(saturated, f"note {name}", self.max_peaks)

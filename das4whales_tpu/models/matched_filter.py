@@ -275,8 +275,13 @@ def mf_correlate_tiled(
     return corr_tiles, jnp.max(tile_maxes)
 
 
-@functools.partial(jax.jit, static_argnames=("max_peaks",))
-def mf_pick_tiled(corr_tiles: jnp.ndarray, thresholds: jnp.ndarray, max_peaks: int):
+@functools.partial(jax.jit, static_argnames=("max_peaks", "pick_method"))
+def mf_pick_tiled(
+    corr_tiles: jnp.ndarray,
+    thresholds: jnp.ndarray,
+    max_peaks: int,
+    pick_method: str = "topk",
+):
     """Envelope + sparse prominence picking over channel tiles.
 
     Second program of the memory-lean route: for each tile the analytic
@@ -284,11 +289,13 @@ def mf_pick_tiled(corr_tiles: jnp.ndarray, thresholds: jnp.ndarray, max_peaks: i
     sparse peak kernel run back-to-back so the full [nT, C, n] envelope is
     never materialized. Returns an ``ops.peaks.SparsePicks`` of
     ``[n_tiles, nT, tile, K]`` arrays (merge with
-    ``merge_tiled_picks``)."""
+    ``merge_tiled_picks``). ``pick_method``: see
+    ``ops.peaks.find_peaks_sparse`` (the escalating callers pass
+    ``ops.peaks.escalation_method(k, k_full)``)."""
     def per_tile(ct):                                    # [nT, tile, n]
         env = jnp.abs(spectral.analytic_signal(ct, axis=-1))
         return peak_ops.find_peaks_sparse_batched(
-            env, thresholds[:, None], max_peaks=max_peaks
+            env, thresholds[:, None], max_peaks=max_peaks, method=pick_method
         )
 
     return jax.lax.map(per_tile, corr_tiles)
@@ -333,16 +340,120 @@ def merge_tiled_picks(picks, template_idx: int, tile: int, n_channels: int) -> n
     return np.asarray([chan[keep], pos[tiles, rows, slots][keep]])
 
 
+# THE reference threshold policy (main_mfdetect.py:94-99): every route —
+# in-graph (mf_envelope_and_threshold, mf_detect_picks_program) and host
+# (_call_tiled) — derives its thresholds from these two constants via
+# reference_threshold_factors; a policy change edits exactly one place.
+REL_THRESHOLD = 0.5
+HF_FACTOR = 0.9
+
+
+def reference_threshold_factors(n_templates: int, dtype=None) -> jnp.ndarray:
+    """Per-template multipliers on ``REL_THRESHOLD * global_max``: the
+    first (HF) template picks at ``HF_FACTOR`` of the threshold."""
+    return jnp.ones((n_templates,), dtype or jnp.float32).at[0].set(HF_FACTOR)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp",
+        "tile", "max_peaks", "capacity", "use_threshold", "pick_method",
+    ),
+)
+def mf_detect_picks_program(
+    trace: jnp.ndarray,
+    mask_band: jnp.ndarray,
+    bp_gain: jnp.ndarray,
+    templates_true: jnp.ndarray,
+    mu: jnp.ndarray,
+    scale: jnp.ndarray,
+    thr_in: jnp.ndarray,
+    band_lo: int,
+    band_hi: int,
+    bp_padlen: int,
+    pad_rows: int,
+    staged_bp: bool,
+    tile: int | None,
+    max_peaks: int,
+    capacity: int,
+    use_threshold: bool,
+    pick_method: str = "topk",
+):
+    """The WHOLE detection step as ONE XLA program: bandpass -> f-k filter
+    -> correlate -> in-graph reference threshold (main_mfdetect.py:94-99)
+    -> envelope -> sparse prominence picks -> row-major device compaction.
+
+    The ``__call__`` route runs the same math but with 4-6 host syncs per
+    file (threshold pull, saturation check, compaction count, packed
+    transfer) — each a full host<->device round trip, which through the
+    axon tunnel dominated the round-4 measured on-chip wall
+    (docs/PERF.md: ~1.9 s of the 4.86 s canonical wall was attributable
+    to neither stage compute nor transfer). Here every decision the host
+    used to make is computed in-graph and the caller fetches one packed
+    result.
+
+    ``tile=None`` correlates monolithically (small shapes); an int walks
+    channel tiles via ``lax.map`` (the HBM-fitting canonical route).
+
+    Returns ``(chan [nT, capacity], times [nT, capacity], count [nT],
+    sat_count [nT], thr [nT])``; ``count > capacity`` signals compaction
+    overflow (caller falls back to the exact full-grid path),
+    ``sat_count`` is the number of real channels whose pick slots
+    saturated at ``max_peaks`` (caller escalates K, exactly like
+    ``ops.peaks.picks_with_escalation``).
+    """
+    from ..ops.filters import _fft_zero_phase_jit
+
+    C = trace.shape[0]
+    nT = templates_true.shape[0]
+    x = _fft_zero_phase_jit(trace, bp_gain, bp_padlen) if staged_bp else trace
+    if pad_rows:
+        x = jnp.pad(x, ((0, pad_rows), (0, 0)))
+    trf = fk_ops.fk_filter_apply_rfft_banded(x, mask_band, band_lo, band_hi)
+    if pad_rows:
+        trf = trf[:C]
+
+    def resolve_thr(gmax):
+        if use_threshold:
+            return thr_in.astype(trace.dtype)
+        return (REL_THRESHOLD * gmax) * reference_threshold_factors(
+            nT, trace.dtype
+        )
+
+    if tile is None:
+        corr = xcorr.compute_cross_correlograms_corrected(
+            trf, templates_true, mu, scale
+        )
+        thr = resolve_thr(jnp.max(corr))
+        env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
+        sp = peak_ops.find_peaks_sparse_batched(
+            env, thr[:, None], max_peaks=max_peaks, method=pick_method
+        )
+        chan, times, cnt = peak_ops.compact_picks_rowmajor(
+            sp.positions, sp.selected, capacity
+        )
+        sat_count = jnp.sum(sp.saturated.astype(jnp.int32), axis=-1)
+    else:
+        corr_tiles, gmax = mf_correlate_tiled(trf, templates_true, mu, scale, tile)
+        thr = resolve_thr(gmax)
+        sp = mf_pick_tiled(corr_tiles, thr, max_peaks, pick_method)
+        chan, times, cnt = mf_compact_tiled_picks(
+            sp.positions, sp.selected, C, capacity
+        )
+        sat = jnp.swapaxes(sp.saturated, 0, 1).reshape(nT, -1)[:, :C]
+        sat_count = jnp.sum(sat.astype(jnp.int32), axis=-1)
+    return chan, times, cnt, sat_count, thr
+
+
 @jax.jit
 def mf_envelope_and_threshold(corr: jnp.ndarray):
     """Envelope of the correlograms + the reference's threshold policy:
     ``thres = 0.5 * max(all correlograms)``, first (HF) template picked at
     ``0.9 * thres`` (main_mfdetect.py:94-99)."""
     env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
-    maxv = jnp.max(corr)
-    thres = 0.5 * maxv
-    factors = jnp.ones(corr.shape[0]).at[0].set(0.9)
-    return env, thres * factors
+    thres = REL_THRESHOLD * jnp.max(corr)
+    return env, thres * reference_threshold_factors(corr.shape[0])
 
 
 @dataclass
@@ -374,6 +485,7 @@ class MatchedFilterDetector:
         keep_correlograms: bool = True,
         channel_pad: int | str | None = None,
         fused_bandpass: bool = True,
+        pick_pack_cap: int = 1 << 18,
     ):
         self.metadata = as_metadata(metadata)
         if templates is None:
@@ -414,6 +526,11 @@ class MatchedFilterDetector:
         # skip materializing the user-facing [C, n] correlograms — on the
         # tiled route that's a whole extra [nT, C, n] device copy
         self.keep_correlograms = keep_correlograms
+        # per-template packed-pick capacity of the one-program route's
+        # single fetch (counts above it fall back to the exact full-grid
+        # path; the buffers transfer at full capacity, so this bounds the
+        # fetch at ~2 MB/template of int32)
+        self.pick_pack_cap = pick_pack_cap
         if hbm_budget_bytes is None:
             hbm_budget_bytes = int(float(os.environ.get("DAS_HBM_BUDGET_GB", 8.0)) * 2**30)
         self.hbm_budget_bytes = hbm_budget_bytes
@@ -488,6 +605,75 @@ class MatchedFilterDetector:
 
     def __call__(self, trace: jnp.ndarray, threshold: float | None = None, with_snr: bool = False) -> MatchedFilterResult:
         trace = jnp.asarray(trace, dtype=self._mask_band_dev.dtype)
+        if self.pick_mode == "sparse" and not self.keep_correlograms and not with_snr:
+            # campaign mode wants exactly the picks — take the one-program
+            # route (single dispatch + single fetch; see detect_picks)
+            return self.detect_picks(trace, threshold=threshold)
+        return self._call_full(trace, threshold=threshold, with_snr=with_snr)
+
+    def detect_picks(
+        self, trace: jnp.ndarray, threshold: float | None = None
+    ) -> MatchedFilterResult:
+        """Picks-only detection: ONE XLA program, ONE device->host fetch.
+
+        Numerics-identical to ``__call__``'s pick output (same filter,
+        correlate, threshold policy, peak kernels — the threshold just
+        stays in-graph instead of round-tripping through the host), but
+        the per-file cost is a single dispatch plus a fixed ~4 MB packed
+        fetch instead of 4-6 tunnel round trips (docs/PERF.md round-4
+        wall attribution). Adaptive-K escalation and the
+        capacity-overflow fallback to the exact full-grid path are
+        preserved. ``trf_fk``/``correlograms`` are not materialized
+        (campaign semantics — the reference keeps them only for plotting,
+        main_mfdetect.py:84-92; use ``__call__`` for those).
+        """
+        trace = jnp.asarray(trace, dtype=self._mask_band_dev.dtype)
+        if self.pick_mode != "sparse":
+            return self._call_full(trace, threshold=threshold)
+        C = trace.shape[0]
+        nT = self.design.templates.shape[0]
+        names = self.design.template_names
+        cap = int(min(C * self.max_peaks, self.pick_pack_cap))
+        use_thr = threshold is not None
+        thr_in = jnp.full((nT,), 0.0 if threshold is None else float(threshold),
+                          dtype=trace.dtype)
+        tile = self.effective_channel_tile if self._route() == "tiled" else None
+
+        def run(k):
+            return mf_detect_picks_program(
+                trace, self._mask_band_dev, self._gain_dev,
+                self._templates_true, self._template_mu, self._template_scale,
+                thr_in,
+                band_lo=self._band_lo, band_hi=self._band_hi,
+                bp_padlen=self.design.bp_padlen, pad_rows=self.fk_pad_rows,
+                staged_bp=not self.fused_bandpass,
+                tile=tile, max_peaks=k, capacity=cap,
+                use_threshold=use_thr,
+                pick_method=peak_ops.escalation_method(k, self.max_peaks),
+            )
+
+        chan, times, cnt, satc, thr = jax.device_get(run(self.pick_k0))
+        if self.pick_k0 < self.max_peaks and int(satc.sum()):
+            # some channel saturated at K0 — rerun at full capacity (exact,
+            # same policy as ops.peaks.picks_with_escalation)
+            chan, times, cnt, satc, thr = jax.device_get(run(self.max_peaks))
+        if int(cnt.max(initial=0)) > cap:
+            # packed-capacity overflow: the exact full-transfer route
+            return self._call_full(trace, threshold=threshold)
+        picks, thr_out = {}, {}
+        for i, name in enumerate(names):
+            k = int(cnt[i])
+            picks[name] = np.asarray(
+                [chan[i, :k], times[i, :k]], dtype=np.int64
+            )
+            thr_out[name] = float(thr[i])
+            self._warn_saturated(name, int(satc[i]))
+        return MatchedFilterResult(
+            trf_fk=None, correlograms={}, peak_masks={}, picks=picks,
+            thresholds=thr_out,
+        )
+
+    def _call_full(self, trace: jnp.ndarray, threshold: float | None = None, with_snr: bool = False) -> MatchedFilterResult:
         if self._route() == "tiled":
             return self._call_tiled(trace, threshold=threshold, with_snr=with_snr)
         # both routes share the banded filter program, so their trf_fk (and
@@ -510,7 +696,8 @@ class MatchedFilterDetector:
                 # K with exact escalation on saturation (pick_k0 note)
                 pos, _, _, sel, saturated = peak_ops.picks_with_escalation(
                     lambda k: peak_ops.find_peaks_sparse(
-                        env[i], thresholds[i], max_peaks=k
+                        env[i], thresholds[i], max_peaks=k,
+                        method=peak_ops.escalation_method(k, self.max_peaks),
                     ),
                     self.pick_k0, self.max_peaks,
                 )
@@ -548,12 +735,11 @@ class MatchedFilterDetector:
         corr_tiles, gmax = mf_correlate_tiled(
             trf_fk, self._templates_true, self._template_mu, self._template_scale, tile
         )
-        # reference threshold policy (main_mfdetect.py:94-99): 0.5 * global
-        # max, first (HF) template picked at 0.9x
+        # reference threshold policy (main_mfdetect.py:94-99) via the
+        # shared constants/factors
         if threshold is None:
-            thres = 0.5 * float(gmax)
-            thr_np = np.full((nT,), thres, dtype=np.float32)
-            thr_np[0] *= 0.9
+            thres = REL_THRESHOLD * float(gmax)
+            thr_np = thres * np.asarray(reference_threshold_factors(nT))
         else:
             thr_np = np.full((nT,), float(threshold), dtype=np.float32)
         thr_dev = jnp.asarray(thr_np, dtype=trace.dtype)
@@ -563,7 +749,10 @@ class MatchedFilterDetector:
             # adaptive K (pick_k0 note in __init__): saturation-free runs
             # never pay the full-capacity kernel; escalation is exact
             sp_picks = peak_ops.picks_with_escalation(
-                lambda k: mf_pick_tiled(corr_tiles, thr_dev, k),
+                lambda k: mf_pick_tiled(
+                    corr_tiles, thr_dev, k,
+                    peak_ops.escalation_method(k, self.max_peaks),
+                ),
                 self.pick_k0, self.max_peaks,
             )
             sat = np.asarray(sp_picks.saturated)          # [n_tiles, nT, tile]

@@ -250,7 +250,8 @@ class SpectroCorrDetector:
             # exact escalation on saturation (ops.peaks)
             pos, _, _, sel, saturated = peak_ops.picks_with_escalation(
                 lambda k: peak_ops.find_peaks_sparse(
-                    corr, self.threshold, max_peaks=k
+                    corr, self.threshold, max_peaks=k,
+                    method=peak_ops.escalation_method(k, self.max_peaks),
                 ),
                 min(64, self.max_peaks), self.max_peaks,
             )

@@ -258,13 +258,14 @@ def _one_sided_base_min_sparse(xb, block_max, block_min, pos, h, nb: int):
     return jnp.where(has_own, min_own, other)
 
 
-@functools.partial(jax.jit, static_argnames=("max_peaks", "nb"))
+@functools.partial(jax.jit, static_argnames=("max_peaks", "nb", "method"))
 def find_peaks_sparse(
     x: jnp.ndarray,
     threshold,
     max_peaks: int = 256,
     nb: int = 128,
     prefilter_height: bool = True,
+    method: str = "topk",
 ):
     """Threshold-prominence peak picking via the sparse candidate route.
 
@@ -277,30 +278,75 @@ def find_peaks_sparse(
     For nonnegative inputs this matches
     ``scipy.signal.find_peaks(x, prominence=threshold)`` exactly whenever
     ``saturated`` is False.
+
+    ``method`` selects the candidate-slotting kernel — the RESULT is
+    identical whenever ``saturated`` is False; they differ only in which
+    candidates a saturated row drops:
+
+    * ``"topk"`` keeps the ``max_peaks`` TALLEST candidates
+      (``lax.top_k``). On TPU, top-k lowers to a full per-row sort of
+      the time axis — at the canonical detection shape that sort is the
+      dominant pick-stage cost (docs/PERF.md).
+    * ``"pack"`` keeps the FIRST ``max_peaks`` candidates in time order
+      via a cumsum + scatter pack: no sort anywhere (slots come out
+      position-ascending by construction, so the topk path's final
+      argsort disappears too). This is the adaptive-K fast path: the K0
+      attempt packs, and ``picks_with_escalation`` reruns a saturated
+      row set at full capacity with ``"topk"``, preserving the
+      documented tallest-K semantics wherever truncation CAN happen.
     """
     C, N = x.shape
-    max_peaks = min(max_peaks, N)  # top_k cannot exceed the time axis
+    max_peaks = min(max_peaks, N)  # slot count cannot exceed the time axis
     thr = jnp.asarray(threshold)
     thr_bc = jnp.broadcast_to(thr, (C,)) if thr.ndim <= 1 else thr
 
     mask = local_maxima(x)
     if prefilter_height:
         mask = mask & (x >= thr_bc[:, None])
-    cand_scores = jnp.where(mask, x, -jnp.inf)
-    heights, pos = jax.lax.top_k(cand_scores, max_peaks)          # [C, K]
-    valid = jnp.isfinite(heights)
     n_cand = jnp.sum(mask, axis=-1)
     saturated = n_cand > max_peaks
 
+    if method == "pack":
+        idx = jnp.arange(N, dtype=jnp.int32)
+        cnt = jnp.cumsum(mask, axis=-1)
+        dest = jnp.where(mask, cnt - 1, max_peaks)    # >= K -> dropped
+        rows = jnp.arange(C, dtype=jnp.int32)[:, None]
+        pos = jnp.full((C, max_peaks), N, jnp.int32).at[
+            rows, dest
+        ].set(jnp.broadcast_to(idx, (C, N)), mode="drop")
+        slot_valid = (
+            jnp.arange(max_peaks)[None, :]
+            < jnp.minimum(n_cand, max_peaks)[:, None]
+        )
+        gpos = jnp.where(slot_valid, pos, 0)
+        heights = jnp.take_along_axis(x, gpos, axis=-1)
+        heights = jnp.where(slot_valid, heights, -jnp.inf)
+        valid = slot_valid
+    elif method == "topk":
+        cand_scores = jnp.where(mask, x, -jnp.inf)
+        heights, pos = jax.lax.top_k(cand_scores, max_peaks)      # [C, K]
+        valid = jnp.isfinite(heights)
+        gpos = pos
+    else:
+        raise ValueError(f"unknown method {method!r}")
+
     xb, bmax, bmin = _block_stats(x, nb)
-    left_min = _one_sided_base_min_sparse(xb, bmax, bmin, pos, heights, nb)
+    left_min = _one_sided_base_min_sparse(xb, bmax, bmin, gpos, heights, nb)
     xf = jnp.flip(x, axis=-1)
     xbf, bmaxf, bminf = _block_stats(xf, nb)
-    right_min = _one_sided_base_min_sparse(xbf, bmaxf, bminf, (N - 1) - pos, heights, nb)
+    right_min = _one_sided_base_min_sparse(
+        xbf, bmaxf, bminf, (N - 1) - gpos, heights, nb
+    )
 
     prom = heights - jnp.maximum(left_min, right_min)
     selected = valid & (prom >= thr_bc[:, None])
 
+    if method == "pack":
+        # slots are position-ascending by construction; report invalid
+        # positions as N (the topk path's convention)
+        return SparsePicks(
+            jnp.where(valid, pos, N), heights, prom, selected, saturated
+        )
     # order by position per channel for reference-compatible pick lists
     pos_sorted_key = jnp.where(selected, pos, N)
     order = jnp.argsort(pos_sorted_key, axis=-1)
@@ -315,6 +361,7 @@ def find_peaks_sparse_batched(
     threshold,
     max_peaks: int = 256,
     nb: int = 128,
+    method: str = "topk",
 ) -> SparsePicks:
     """``find_peaks_sparse`` over arbitrary leading axes.
 
@@ -326,7 +373,10 @@ def find_peaks_sparse_batched(
     lead = x.shape[:-1]
     rows = int(np.prod(lead)) if lead else 1
     thr = jnp.broadcast_to(jnp.asarray(threshold), lead).reshape(rows)
-    res = find_peaks_sparse(x.reshape(rows, x.shape[-1]), thr, max_peaks=max_peaks, nb=nb)
+    res = find_peaks_sparse(
+        x.reshape(rows, x.shape[-1]), thr, max_peaks=max_peaks, nb=nb,
+        method=method,
+    )
     return SparsePicks(*(a.reshape(lead + a.shape[1:]) for a in res))
 
 
@@ -374,16 +424,26 @@ def compact_picks_rowmajor(positions, selected, capacity: int):
     return rows_out, times_out, count
 
 
+def escalation_method(k: int, k_full: int) -> str:
+    """THE method policy for adaptive-K picking: any attempt that a
+    larger-capacity rerun can correct uses the sort-free ``"pack"``
+    kernel; the full-capacity run (where truncation is final) uses
+    ``"topk"`` so the documented tallest-K drop semantics hold wherever
+    they can matter. Results are identical whenever no row saturates."""
+    return "pack" if k < k_full else "topk"
+
+
 def picks_with_escalation(run, k0: int, k_full: int):
     """Adaptive-K sparse picking: ``run(k)`` must return a result with a
     ``.saturated`` row mask. Runs at ``k0`` and reruns at ``k_full``
     only when a row saturated — bit-identical to running at ``k_full``
     directly, because ``saturated`` is precisely "more candidates than K
     passed the height prefilter" and a non-saturated row's picks are
-    exact at any K. The kernel's top-k and block tables scale with K, so
-    the saturation-free common case is several times cheaper
-    (docs/PERF.md knob A/B). THE escalation policy: the detector routes
-    and the bench's stage mirror all call this one function."""
+    exact at any K. The kernel's slot tables scale with K, so the
+    saturation-free common case is several times cheaper (docs/PERF.md
+    knob A/B); pair with :func:`escalation_method` so the K0 attempt
+    also skips the top-k sort. THE escalation policy: the detector
+    routes and the bench's stage mirror all call this one function."""
     res = run(k0)
     if k0 < k_full and bool(np.asarray(res.saturated).any()):
         res = run(k_full)

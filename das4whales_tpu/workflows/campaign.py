@@ -546,20 +546,59 @@ def run_campaign_multiprocess(
         group = healthy_specs[s : s + batch]
         n_real = len(group)
         padded = group + [group[-1]] * (batch - n_real)
+
+        # Pre-read this process's OWN files BEFORE entering the collective
+        # region (ADVICE r4): a read failure inside the
+        # make_array_from_callback shard callback (truncated bulk data, a
+        # transient FS error past the metadata-only probe) would raise on
+        # one process while its peers sit in the SPMD step's collectives
+        # until DCN timeout. Reading first and allgathering a per-file ok
+        # mask keeps every process in lockstep: a failed file becomes a
+        # zero shard inside the step (its outputs are discarded) and a
+        # deterministic per-file failure record on every process.
+        t0 = time.perf_counter()
         cache: dict = {}
+        read_errs: dict = {}
+        idx_map = sharding.addressable_devices_indices_map((batch, C, ns))
+        my_fis = sorted({
+            fi
+            for sl in idx_map.values()
+            for fi in range(
+                sl[0].start or 0,
+                batch if sl[0].stop is None else sl[0].stop,
+            )
+        })
+        ok_local = np.ones(batch, dtype=np.int32)
+        for fi in my_fis:
+            spec = padded[fi][1]
+            try:
+                cache[fi] = _read_host(spec, sel)          # [C, ns] float32
+            except Exception as exc:  # noqa: BLE001 — per-file isolation
+                ok_local[fi] = 0
+                read_errs[fi] = f"{type(exc).__name__}: {exc}"
+        ok = (
+            np.asarray(multihost_utils.process_allgather(ok_local, tiled=True))
+            .reshape(-1, batch).min(axis=0).astype(bool)
+        )
 
         def _shard(idx, padded=padded, cache=cache):
             fsl, csl, tsl = idx
             rows = []
             for fi in range(fsl.start or 0, fsl.stop if fsl.stop is not None
                             else (fsl.start or 0) + 1):
-                spec = padded[fi][1]
-                if fi not in cache:
-                    cache[fi] = _read_host(spec, sel)      # [C, ns] float32
-                rows.append(cache[fi][csl, tsl])
+                buf = cache.get(fi)
+                if buf is None:
+                    # failed read: zeros keep the SPMD program in lockstep;
+                    # this slot's outputs are never recorded. Allocate at
+                    # the SLICE shape — a full [C, ns] zeros temp would be
+                    # ~1 GB per shard at canonical shape
+                    rows.append(np.zeros(
+                        (len(range(C)[csl]), len(range(ns)[tsl])), np.float32
+                    ))
+                else:
+                    rows.append(buf[csl, tsl])
             return np.stack(rows)
 
-        t0 = time.perf_counter()
         x = jax.make_array_from_callback((batch, C, ns), sharding, _shard)
         sp_picks, thres = jax.block_until_ready(step(x))
         wall = time.perf_counter() - t0
@@ -599,6 +638,14 @@ def run_campaign_multiprocess(
             )
 
         for k, (path, _spec) in enumerate(group):
+            if not ok[k]:
+                # same mask on every process -> identical record streams
+                # and a synchronized max_failures abort (the error TEXT is
+                # only exact on the owning process; peers record a pointer)
+                fail(path, RuntimeError(
+                    read_errs.get(k, "read failed (see owning process log)")
+                ))
+                continue
             if host_picks is None:
                 picks = {
                     name: np.asarray([rows_np[i, k, : cnt[i, k]],

@@ -1,0 +1,219 @@
+"""Canonical-shape on-chip walls for the non-flagship detector families.
+
+bench.py's ladder covers only the matched-filter flagship; this script
+records what VERDICT r4 next-6 asked for — the spectro-correlation and
+Gabor families' end-to-end detection walls at the canonical OOI shape
+([22050 x 12000], tutorial.md:56-62), plus the learned-CNN scoring wall
+from the packaged pretrained artifact. The spectro family runs under
+BOTH STFT engines (Pallas MXU-DFT and batched rFFT), which is decision
+gate 1's A/B at the exact production shape
+(scripts/decision_gates.py; ref: librosa STFT at detect.py:382).
+
+Each family times the same production path its workflow runs
+(``workflows/{spectrodetect,gabordetect}.py``) on a device-resident
+f-k-filtered block — the shared front end is timed once separately.
+Results: one JSON document to stdout + ``artifacts/bench_families.json``,
+and an appended section in ``docs/PERF.md`` with ``--markdown``.
+
+Usage: python scripts/bench_families.py [--quick] [--markdown docs/PERF.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from datetime import datetime, timezone
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+FS, DX = 200.0, 2.042
+
+
+def _make_block(nx, ns, fs=FS, seed=0):
+    rng = np.random.default_rng(seed)
+    block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
+    t = np.arange(0, 0.68, 1 / fs)
+    f0, f1 = 28.8, 17.8
+    sing = -f1 * 0.68 / (f0 - f1)
+    chirp = (
+        np.cos(2 * np.pi * (-sing * f0) * np.log(np.abs(1 - t / sing)))
+        * np.hanning(len(t))
+    ).astype(np.float32)
+    for k in range(6):
+        ch = (k + 1) * nx // 8
+        onset = int((4 + 8 * k) * fs)
+        if onset + len(chirp) < ns:
+            block[ch, onset : onset + len(chirp)] += 5e-9 * chirp
+    return block
+
+
+def _timed(fn, repeats=2):
+    import jax
+
+    out = jax.block_until_ready(fn())  # compile + warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def _n_picks(picks) -> int:
+    return sum(int(np.asarray(v).shape[-1]) for v in picks.values())
+
+
+def bench_mf(x, meta, repeats):
+    """Flagship one-program route (cross-check for bench.py's headline)."""
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    det = MatchedFilterDetector(
+        meta, [0, meta.nx, 1], (meta.nx, meta.ns), keep_correlograms=False,
+    )
+    wall, res = _timed(lambda: det.detect_picks(x), repeats)
+    return {"family": "matched_filter", "wall_s": round(wall, 4),
+            "n_picks": _n_picks(res.picks), "note": "one-program route"}
+
+
+def bench_spectro(x_filtered, meta, repeats, engine):
+    from das4whales_tpu.models.spectro import SpectroCorrDetector
+
+    os.environ["DAS4WHALES_STFT_ENGINE"] = engine
+    try:
+        det = SpectroCorrDetector(meta)
+        wall, (_, picks, _) = _timed(lambda: det(x_filtered), repeats)
+        return {"family": f"spectro[{engine}]", "wall_s": round(wall, 4),
+                "n_picks": _n_picks(picks), "note": f"stft engine {engine}"}
+    finally:
+        os.environ.pop("DAS4WHALES_STFT_ENGINE", None)
+
+
+def bench_gabor(x_filtered, meta, repeats):
+    from das4whales_tpu.models.gabor import GaborDetector
+
+    det = GaborDetector(meta, [0, meta.nx, 1])
+    wall, res = _timed(lambda: det(x_filtered), repeats)
+    return {"family": "gabor", "wall_s": round(wall, 4),
+            "n_picks": _n_picks(res["picks"]), "note": ""}
+
+
+def bench_learned(x, meta, repeats):
+    from das4whales_tpu.models.learned import LearnedDetector, load_pretrained
+
+    params, cfg = load_pretrained()
+    det = LearnedDetector(params, cfg)
+    wall, res = _timed(lambda: det(np.asarray(x)), repeats)
+    return {"family": "learned_cnn", "wall_s": round(wall, 4),
+            "n_picks": _n_picks(res.picks), "note": "pretrained fin_cnn scoring"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="small shapes (CI smoke)")
+    ap.add_argument("--nx", type=int, default=None)
+    ap.add_argument("--ns", type=int, default=None)
+    ap.add_argument("--repeats", type=int, default=2)
+    ap.add_argument("--markdown", default=None, help="append a section to this file")
+    ap.add_argument(
+        "--device-timeout", type=float,
+        default=float(os.environ.get("DAS_BENCH_DEVICE_TIMEOUT", 120.0)),
+    )
+    ap.add_argument(
+        "--deadline", type=float,
+        default=float(os.environ.get("DAS_PERF_DEADLINE", 2100.0)),
+        help="hard wall deadline (s); 0 disables",
+    )
+    ap.add_argument("--skip", default="",
+                    help="comma-separated families to skip (e.g. learned)")
+    args = ap.parse_args()
+
+    from scripts._wedge_guard import arm_deadline, resolve_backend
+
+    arm_deadline(args.deadline)
+    fallback = resolve_backend(args.device_timeout)
+    import jax
+    import jax.numpy as jnp
+
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    device = str(jax.devices()[0])
+    if fallback:
+        device = f"cpu-fallback (accelerator unreachable): {device}"
+
+    nx = args.nx or (1024 if args.quick else 22050)
+    ns = args.ns or (3000 if args.quick else 12000)
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    skip = {s.strip() for s in args.skip.split(",") if s.strip()}
+
+    block = _make_block(nx, ns)
+    # slab-staged transfer (same discipline as bench.py: one ~1 GB RPC is
+    # a suspected tunnel-wedge trigger)
+    slab = 4096
+    x = (
+        jnp.concatenate(
+            [jax.device_put(block[i : i + slab]) for i in range(0, nx, slab)], axis=0
+        )
+        if nx > slab
+        else jax.device_put(block)
+    )
+
+    # shared front end, timed once: the f-k-filtered block every image/
+    # spectro family consumes (workflows/{spectro,gabor}detect.py)
+    front = MatchedFilterDetector(
+        meta, [0, nx, 1], (nx, ns), keep_correlograms=False
+    )
+    t_front, x_filt = _timed(lambda: front.filter_block(x), args.repeats)
+
+    rows = [{"family": "frontend(filter)", "wall_s": round(t_front, 4),
+             "n_picks": None, "note": "bandpass+f-k (shared)"}]
+    plans = [
+        ("matched_filter", lambda: bench_mf(x, meta, args.repeats)),
+        ("spectro-rfft", lambda: bench_spectro(x_filt, meta, args.repeats, "rfft")),
+        ("spectro-pallas", lambda: bench_spectro(x_filt, meta, args.repeats, "pallas")),
+        ("gabor", lambda: bench_gabor(x_filt, meta, args.repeats)),
+        ("learned", lambda: bench_learned(block, meta, args.repeats)),
+    ]
+    for name, fn in plans:
+        if name in skip or name.split("-")[0] in skip:
+            continue
+        try:
+            rows.append(fn())
+        except Exception as e:  # noqa: BLE001 — one family must not cost the rest
+            rows.append({"family": name, "wall_s": None, "n_picks": None,
+                         "note": f"FAILED: {e!r:.300}"})
+
+    doc = {"device": device, "shape": [nx, ns], "repeats": args.repeats,
+           "rows": rows}
+    print(json.dumps(doc, indent=1))
+    os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
+    with open(os.path.join(ROOT, "artifacts", "bench_families.json"), "w") as fh:
+        json.dump(dict(doc, measured_at=time.time()), fh, indent=1)
+
+    if args.markdown:
+        stamp = datetime.now(timezone.utc).strftime("%Y-%m-%d %H:%MZ")
+        lines = [
+            "",
+            f"## Per-family walls at [{nx}x{ns}], measured {stamp} on `{device}`",
+            "",
+            "| family | wall (s) | n_picks | note |",
+            "|---|---|---|---|",
+        ]
+        for r in rows:
+            lines.append(
+                f"| {r['family']} | {r['wall_s']} | {r['n_picks']} | {r['note']} |"
+            )
+        with open(args.markdown, "a") as fh:
+            fh.write("\n".join(lines) + "\n")
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

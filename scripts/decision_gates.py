@@ -78,9 +78,40 @@ def device_is_tpu(device: str | None) -> bool:
     return bool(device) and "TPU" in device and not device.startswith("cpu-fallback")
 
 
-def gate_stft(perf: dict | None, lines: list) -> None:
+def gate_stft(perf: dict | None, families: dict | None, lines: list) -> None:
     lines.append("")
     lines.append("## Gate 1 — Pallas STFT default (`ops/spectral.py`)")
+    # production-shape evidence outranks the micro A/B: the spectro
+    # family's end-to-end wall under each engine (scripts/bench_families.py)
+    fam_rows = {r.get("family"): r for r in (families or {}).get("rows", [])}
+    f_pallas = fam_rows.get("spectro[pallas]")
+    f_rfft = fam_rows.get("spectro[rfft]")
+    if (device_is_tpu((families or {}).get("device"))
+            and f_pallas and f_rfft
+            and f_pallas.get("wall_s") and f_rfft.get("wall_s")):
+        ratio = f_rfft["wall_s"] / f_pallas["wall_s"]
+        lines.append("")
+        lines.append(f"- PRODUCTION-shape A/B (`bench_families` at "
+                     f"{(families or {}).get('shape')}): pallas "
+                     f"{f_pallas['wall_s']} s vs rfft {f_rfft['wall_s']} s "
+                     f"({ratio:.2f}x)")
+        if ratio > 1.0:
+            lines.append("- **CLOSE: keep Pallas default on TPU** (wins the "
+                          "spectro family end-to-end on-chip).")
+        else:
+            lines.append("- **CLOSE: flip the TPU default to rfft** "
+                          "(`resolve_stft_engine`), keep Pallas opt-in.")
+        return
+    if (f_pallas and not f_pallas.get("wall_s")
+            and device_is_tpu((families or {}).get("device"))):
+        # only an ON-CHIP failure may drive the TPU default (the Pallas
+        # kernel legitimately cannot lower on a cpu-fallback backend)
+        lines.append("")
+        lines.append(f"- bench_families pallas row FAILED on-chip: "
+                     f"{f_pallas.get('note')}")
+        lines.append("- **flip the TPU default to rfft until the Pallas "
+                      "engine demonstrably lowers and wins on-chip.**")
+        return
     if not perf or "stft" not in (perf or {}):
         lines.append("")
         lines.append("- **OPEN**: no parsed perf-kernels measurement. If the "
@@ -189,6 +220,24 @@ def gate_detect_knobs(knobs: dict | None, lines: list) -> None:
             break
 
 
+def pack_kernel_note(perf: dict | None, lines: list) -> None:
+    """Informational: the sort-free pack kernel vs top-k at K0=64
+    (scripts/perf_kernels.py bench_peaks) — evidence for the adaptive-K
+    fast path's `escalation_method` policy."""
+    rows = [r for r in (perf or {}).get("peaks", [])
+            if r.get("pack_speedup") is not None]
+    if not rows or not device_is_tpu((perf or {}).get("device")):
+        return
+    lines.append("")
+    lines.append("## Pick-kernel method (pack vs top-k at K0=64, on-chip)")
+    lines.append("")
+    for r in rows:
+        lines.append(
+            f"- {r['shape'][0]}x{r['shape'][1]}: pack {r['pack64_s']} s vs "
+            f"topk {r['topk64_s']} s ({r['pack_speedup']}x)"
+        )
+
+
 def headline(bench: dict | None, lines: list) -> None:
     lines.append("")
     lines.append("## Headline vs the north star (BASELINE.md)")
@@ -225,21 +274,25 @@ def main() -> int:
     perf = tail_json(steps.get("perf-kernels-full", {}).get("stdout_tail", ""))
     ab = tail_json(steps.get("ab-channel-pad", {}).get("stdout_tail", ""))
     knobs = tail_json(steps.get("ab-detect-knobs", {}).get("stdout_tail", ""))
+    families = tail_json(
+        steps.get("bench-families-full", {}).get("stdout_tail", "")
+    )
 
     lines = ["# Decision gates — session evidence", ""]
     ran = [
         s + ("" if s in steps else " (FAILED/TIMEOUT — excluded)")
-        for s in ("bench-full", "perf-kernels-full", "ab-channel-pad",
-                  "ab-detect-knobs", "profile-flagship", "cli-mfdetect-on-tpu",
-                  "evaluate-on-tpu") if s in seen
+        for s in ("bench-full", "profile-flagship", "perf-kernels-full",
+                  "bench-families-full", "ab-detect-knobs", "ab-channel-pad",
+                  "cli-mfdetect-on-tpu", "evaluate-on-tpu") if s in seen
     ]
     lines.append(f"Parsed `{args.jsonl}`: steps seen: "
                  f"{', '.join(ran) if ran else 'NONE (session never ran)'}.")
     headline(bench, lines)
-    gate_stft(perf, lines)
+    gate_stft(perf, families, lines)
     gate_channel_pad(ab, lines)
     gate_fused(ab, bench, lines)
     gate_detect_knobs(knobs, lines)
+    pack_kernel_note(perf, lines)
     text = "\n".join(lines) + "\n"
     # write the requested file BEFORE printing: a closed stdout (`| head`
     # is a normal way to read this) must not swallow the artifact

@@ -1,0 +1,320 @@
+"""Derive the v5e-8 projection from RECORDED numbers (VERDICT r4 next-4).
+
+The claim "≈0.65 s/file on 8 chips at 93 % scaling efficiency" rested on
+the analytic roofline alone. This script replaces each modeled input
+with a recorded one:
+
+1. **Collective traffic** — AOT-compile the REAL channel-sharded SPMD
+   step (`parallel/pipeline.py:make_sharded_mf_step`, campaign mode) at
+   canonical shape on the 8-virtual-device mesh and parse the compiled
+   HLO for every collective op and its operand bytes. No model: this is
+   what XLA actually scheduled onto the interconnect.
+2. **Per-shard wall** — execute that compiled step on the virtual mesh
+   (one x86 core emulating 8 devices serially) and compare against the
+   single-chip detector's wall on the SAME host: serialized-mesh wall /
+   single wall measures the sharded program's compute+pack overhead
+   factor independent of any interconnect.
+3. **Single-chip device wall** — the banked on-chip headline
+   (`artifacts/bench_tpu_banked.json`, measured by bench.py on the real
+   chip).
+
+Projection: ``wall_8 = onchip_wall * overhead / 8 + collective_bytes /
+ICI_bandwidth``, with the ICI number (v5e 2-D torus, ~45 GB/s per axis
+one-way, both axes usable by all_to_all ⇒ ~90 GB/s per-chip injection)
+the one remaining modeled constant — it is hardware spec, not workload.
+
+Writes ``artifacts/multichip_derivation.json`` and (with ``--markdown``)
+a PERF.md section.
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python scripts/derive_multichip.py [--quick] [--markdown docs/PERF.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from datetime import datetime, timezone
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # tpu-tunnel-discipline: in-process
+
+import jax.numpy as jnp  # noqa: E402
+
+FS, DX = 200.0, 2.042
+ICI_GBPS = 90.0  # v5e spec: 2-D torus, both axes, per-chip injection
+
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "c64": 8, "c128": 16,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1, "u64": 8, "u32": 4, "u16": 2,
+    "u8": 1, "pred": 1,
+}
+
+
+def _shape_bytes(sig: str) -> int:
+    """``f32[8,2757,960]`` -> operand bytes (0 for tuple/unparsed)."""
+    m = re.match(r"(\w+)\[([\d,]*)\]", sig)
+    if not m:
+        return 0
+    dt, dims = m.groups()
+    if dt not in _DTYPE_BYTES:
+        return 0
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES[dt]
+
+
+def collective_traffic(hlo_text: str) -> dict:
+    """Per-op-kind counts and total bytes of every collective in a
+    compiled HLO module (operand bytes of the instruction's result
+    signature — for all-to-all/all-gather/reduce-scatter that is the
+    payload a chip handles for that op)."""
+    kinds = ("all-to-all", "all-reduce", "all-gather", "reduce-scatter",
+             "collective-permute")
+    out = {k: {"count": 0, "bytes": 0} for k in kinds}
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        # result signature precedes "= <kind>(": either a bare
+        # `f32[1]{0}` or a tuple `(c64[1,32,45]{2,1,0}, ...)`. The -done
+        # halves of async pairs don't match (no "(" right after the
+        # kind), so nothing double-counts.
+        m = re.search(
+            r"=\s*(\(.*?\)|\S+)\s+(all-to-all|all-reduce|all-gather|"
+            r"reduce-scatter|collective-permute)(-start)?\(", s)
+        if not m:
+            continue
+        sig, kind = m.group(1).strip(), m.group(2)
+        total = 0
+        # tuple results: sum the element signatures
+        for part in re.findall(r"\w+\[[\d,]*\]", sig):
+            total += _shape_bytes(part)
+        out[kind]["count"] += 1
+        out[kind]["bytes"] += total
+    out["total_bytes"] = sum(v["bytes"] for k, v in out.items()
+                             if isinstance(v, dict))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="small shape (CI smoke)")
+    ap.add_argument("--nx", type=int, default=None)
+    ap.add_argument("--ns", type=int, default=None)
+    ap.add_argument("--markdown", default=None)
+    args = ap.parse_args()
+
+    nx = args.nx or (256 if args.quick else 22050)
+    ns = args.ns or (3000 if args.quick else 12000)
+
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import (
+        MatchedFilterDetector,
+        design_matched_filter,
+    )
+    from das4whales_tpu.parallel.mesh import make_mesh
+    from das4whales_tpu.parallel.pipeline import input_sharding, make_sharded_mf_step
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh()
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+
+    # channel axis must divide the mesh: pad exactly as the campaign does
+    chans = int(np.prod(mesh.shape.get("channel", 1) if isinstance(
+        mesh.shape, dict) else 1))
+    C = nx
+    design = design_matched_filter((C, ns), [0, C, 1], meta)
+    step = jax.jit(make_sharded_mf_step(design, mesh, outputs="picks"))
+    sharding = input_sharding(mesh)
+    batch = int(mesh.shape["file"])
+
+    rng = np.random.default_rng(0)
+    x_np = (rng.standard_normal((batch, C, ns)) * 1e-9).astype(np.float32)
+
+    # 1) collective traffic from the compiled HLO
+    lowered = step.lower(jax.ShapeDtypeStruct(x_np.shape, jnp.float32))
+    compiled = lowered.compile()
+    traffic = collective_traffic(compiled.as_text())
+
+    # 1b) XLA's own cost model on BOTH compiled programs: the sharded
+    # step's per-device HBM bytes vs the single-chip program's. This
+    # byte ratio is the load-immune structural overhead measure (the
+    # serialized-mesh wall below is wall-clock on a shared host and only
+    # a sanity check).
+    def _cost(c):
+        try:
+            ca = c.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            return {"flops": float(ca.get("flops", 0.0)),
+                    "bytes": float(ca.get("bytes accessed", 0.0))}
+        except Exception:  # noqa: BLE001 — backend-dependent API
+            return None
+
+    step_cost = _cost(compiled)
+
+    # 2) serialized-mesh wall vs single-device wall on the same host
+    x = jax.device_put(x_np, sharding)
+    jax.block_until_ready(step(x))  # warm
+    t0 = time.perf_counter()
+    jax.block_until_ready(step(x))
+    mesh_wall = time.perf_counter() - t0
+
+    # pick_mode pinned to the step's own engine: on this CPU host the
+    # detector would auto-resolve to the scipy walk, and an overhead
+    # factor comparing a sparse-kernel SPMD program against a
+    # scipy-engine single program measures the engines, not the sharding
+    det = MatchedFilterDetector(meta, [0, C, 1], (C, ns),
+                                keep_correlograms=False, pick_mode="sparse")
+    xs = jnp.asarray(x_np[0])
+    det.detect_picks(xs)  # warm
+    t0 = time.perf_counter()
+    det.detect_picks(xs)
+    single_wall = time.perf_counter() - t0
+
+    # single-chip program cost under the same XLA cost model (the
+    # one-program route at the detector's resolved knobs)
+    from das4whales_tpu.models.matched_filter import mf_detect_picks_program
+
+    tile = det.effective_channel_tile if det._route() == "tiled" else None
+    cap = int(min(C * det.max_peaks, det.pick_pack_cap))
+    single_comp = mf_detect_picks_program.lower(
+        jax.ShapeDtypeStruct((C, ns), jnp.float32),
+        det._mask_band_dev, det._gain_dev, det._templates_true,
+        det._template_mu, det._template_scale,
+        jnp.zeros((design.templates.shape[0],), jnp.float32),
+        band_lo=det._band_lo, band_hi=det._band_hi,
+        bp_padlen=design.bp_padlen, pad_rows=det.fk_pad_rows,
+        staged_bp=not det.fused_bandpass, tile=tile,
+        max_peaks=det.max_peaks, capacity=cap, use_threshold=False,
+    ).compile()
+    single_cost = _cost(single_comp)
+    bytes_overhead = None
+    if step_cost and single_cost and single_cost["bytes"]:
+        # cost_analysis reports per-device numbers for an SPMD module;
+        # total sharded bytes = per-device x n_dev, per file
+        bytes_overhead = (step_cost["bytes"] * n_dev / batch) / single_cost["bytes"]
+    # the virtual mesh runs its n_dev shards on one core: per-file compute
+    # equals mesh_wall / batch; overhead factor is that against the
+    # single-chip program (>1 = sharding/pack cost, <1 = the SPMD program
+    # is leaner, e.g. no per-call host round trips)
+    overhead = (mesh_wall / batch) / single_wall
+
+    # 3) banked on-chip wall
+    bank_path = os.path.join(ROOT, "artifacts", "bench_tpu_banked.json")
+    onchip = None
+    try:
+        with open(bank_path) as fh:
+            b = json.load(fh)
+        if list(b.get("shape", [])) == [nx, ns]:
+            onchip = {"wall_s": float(b["wall_s"]),
+                      "device": b.get("device"),
+                      "banked_commit": b.get("banked_commit")}
+    except (OSError, json.JSONDecodeError, KeyError, ValueError):
+        pass
+
+    ici_s = traffic["total_bytes"] / (ICI_GBPS * 1e9)
+    doc = {
+        "shape": [nx, ns], "n_devices": n_dev,
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "collectives": traffic,
+        "ici_gbps_model": ICI_GBPS,
+        "ici_time_s": round(ici_s, 6),
+        "mesh_serialized_wall_s": round(mesh_wall, 4),
+        "files_per_step": batch,
+        "single_program_wall_s": round(single_wall, 4),
+        "sharding_overhead_factor_wallclock": round(overhead, 3),
+        "step_cost_per_device": step_cost,
+        "single_program_cost": single_cost,
+        "sharding_overhead_factor_bytes": (
+            round(bytes_overhead, 3) if bytes_overhead else None
+        ),
+        "onchip": onchip,
+    }
+    # the byte ratio from XLA's cost model is the primary overhead input
+    # (host-load-immune); the wall-clock ratio is the fallback
+    overhead_used = bytes_overhead if bytes_overhead else overhead
+    doc["overhead_factor_used"] = round(overhead_used, 3)
+    if onchip:
+        proj = onchip["wall_s"] * overhead_used / n_dev + ici_s
+        eff = onchip["wall_s"] / n_dev / proj
+        doc["projected_wall_8chip_s"] = round(proj, 4)
+        doc["scaling_efficiency"] = round(eff, 3)
+    print(json.dumps(doc, indent=1))
+    os.makedirs(os.path.join(ROOT, "artifacts"), exist_ok=True)
+    with open(os.path.join(ROOT, "artifacts", "multichip_derivation.json"),
+              "w") as fh:
+        json.dump(dict(doc, derived_at=time.time()), fh, indent=1)
+
+    if args.markdown:
+        stamp = datetime.now(timezone.utc).strftime("%Y-%m-%d %H:%MZ")
+        t = traffic
+        lines = [
+            "",
+            f"## v5e-8 projection derived from recorded numbers ({stamp})",
+            "",
+            f"Inputs (`scripts/derive_multichip.py`, "
+            f"`artifacts/multichip_derivation.json`):",
+            "",
+            f"1. **Collective traffic (recorded)** — compiled HLO of the real "
+            f"campaign-mode SPMD step at [{nx}x{ns}] on the "
+            f"{doc['mesh']} mesh: "
+            + ", ".join(f"{k} ×{v['count']} = {v['bytes']/1e6:.1f} MB"
+                        for k, v in t.items()
+                        if isinstance(v, dict) and v["count"])
+            + f" ⇒ {t['total_bytes']/1e6:.1f} MB total, "
+            f"{ici_s*1e3:.2f} ms at the {ICI_GBPS:.0f} GB/s per-chip ICI "
+            f"injection spec (the one remaining modeled constant).",
+            f"2. **Sharding overhead (recorded)** — XLA's cost model on the "
+            f"two compiled programs: the SPMD step accesses "
+            f"{(step_cost or {}).get('bytes', 0) * n_dev / max(batch, 1) / 1e9:.2f} GB "
+            f"HBM per file (sum over {n_dev} shards) vs "
+            f"{(single_cost or {}).get('bytes', 0) / 1e9:.2f} GB for the "
+            f"single-chip one-program route ⇒ structural overhead factor "
+            f"**{doc['sharding_overhead_factor_bytes']}**. Wall-clock "
+            f"cross-check on the serialized virtual mesh: "
+            f"{doc['mesh_serialized_wall_s']} s / {batch} files vs "
+            f"{doc['single_program_wall_s']} s single "
+            f"(factor {doc['sharding_overhead_factor_wallclock']}; shared "
+            f"1-core host, sanity only).",
+        ]
+        if onchip:
+            lines += [
+                f"3. **On-chip single-chip wall (recorded)** — "
+                f"{onchip['wall_s']} s at [{nx}x{ns}] on `{onchip['device']}` "
+                f"(bench.py, commit {onchip['banked_commit']}).",
+                "",
+                f"Projection: `{onchip['wall_s']} × "
+                f"{doc['overhead_factor_used']} / {n_dev} + "
+                f"{ici_s*1e3:.2f} ms` ≈ "
+                f"**{doc['projected_wall_8chip_s']} s per canonical file on "
+                f"v5e-8** ({doc['scaling_efficiency']:.0%} scaling "
+                f"efficiency vs ideal single-chip/8).",
+            ]
+        else:
+            lines += [
+                "3. On-chip single-chip wall: NOT AVAILABLE at this shape in "
+                "the bank — re-run after the next live bench window.",
+            ]
+        with open(args.markdown, "a") as fh:
+            fh.write("\n".join(lines) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,6 +94,20 @@ def bench_peaks(repeats=3, full=False):
             lambda a: peak_ops.find_peaks_sparse(a, thr, max_peaks=256),
             x, repeats=repeats,
         )
+        # the sort-free scatter-pack kernel at the production K0 vs the
+        # top-k kernel at the same K: the adaptive-K fast path's actual
+        # cost (on TPU top_k lowers to a full per-row sort of the time
+        # axis — the hypothesis this row tests)
+        t_pack64, _ = timed(
+            lambda a: peak_ops.find_peaks_sparse(
+                a, thr, max_peaks=64, method="pack"),
+            x, repeats=repeats,
+        )
+        t_topk64, _ = timed(
+            lambda a: peak_ops.find_peaks_sparse(
+                a, thr, max_peaks=64, method="topk"),
+            x, repeats=repeats,
+        )
         t_dense, _ = timed(
             lambda a: peak_ops.find_peaks_prominence_blocked(a, thr, 1024),
             x, repeats=repeats,
@@ -102,6 +116,8 @@ def bench_peaks(repeats=3, full=False):
             "shape": [c, n],
             "sparse_s": round(t_sparse, 4), "dense_s": round(t_dense, 4),
             "speedup": round(t_dense / t_sparse, 2),
+            "pack64_s": round(t_pack64, 4), "topk64_s": round(t_topk64, 4),
+            "pack_speedup": round(t_topk64 / t_pack64, 2),
         })
     return rows
 
@@ -188,13 +204,16 @@ def main():
             "",
             "### Peak picking: sparse candidate route vs dense prominence",
             "",
-            "| shape | sparse (s) | dense (s) | speedup |",
-            "|---|---|---|---|",
+            "| shape | sparse K=256 (s) | dense (s) | speedup "
+            "| pack K=64 (s) | topk K=64 (s) | pack speedup |",
+            "|---|---|---|---|---|---|---|",
         ]
         for r in peak_rows:
             lines.append(
                 f"| {r['shape'][0]}x{r['shape'][1]} | {r['sparse_s']} "
-                f"| {r['dense_s']} | {r['speedup']}x |"
+                f"| {r['dense_s']} | {r['speedup']}x "
+                f"| {r.get('pack64_s')} | {r.get('topk64_s')} "
+                f"| {r.get('pack_speedup')}x |"
             )
         lines += [
             "",

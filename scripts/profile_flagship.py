@@ -42,9 +42,13 @@ def main():
     from das4whales_tpu.models.matched_filter import MatchedFilterDetector
     from das4whales_tpu.utils.profiling import device_trace
 
+    import time
+
     nx, ns = (1024, 3000) if args.quick else (22050, 12000)
     meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=nx, ns=ns)
-    det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns))
+    # the bench/campaign configuration: picks-only -> the one-program route
+    det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns),
+                                keep_correlograms=False)
     rng = np.random.default_rng(0)
     block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
     slab = 4096
@@ -52,14 +56,34 @@ def main():
         [jax.device_put(block[i : i + slab]) for i in range(0, nx, slab)], axis=0
     )
 
-    res = det(x)                                   # compile + warm
-    jax.block_until_ready(res.trf_fk)
+    def sync(res):
+        if res.trf_fk is not None:
+            jax.block_until_ready(res.trf_fk)
+        return res
+
+    sync(det(x))                                   # compile + warm
     os.makedirs(args.logdir, exist_ok=True)
+    t0 = time.perf_counter()
     with device_trace(args.logdir):
-        res = det(x)
-        jax.block_until_ready(res.trf_fk)
-    print(f"trace written to {args.logdir} "
-          f"(device={jax.devices()[0]}, shape=[{nx}, {ns}], route={det._route()})")
+        sync(det(x))
+    wall_1prog = time.perf_counter() - t0
+    print(f"one-program trace written to {args.logdir} "
+          f"(device={jax.devices()[0]}, shape=[{nx}, {ns}], "
+          f"route={det._route()}, wall {wall_1prog:.3f} s)", flush=True)
+
+    # the multi-dispatch legacy path in a SEPARATE trace dir: diffing the
+    # two attributes exactly how much of the round-4 wall was host syncs
+    legacy_dir = args.logdir + "_multidispatch"
+    det_legacy = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns))
+    jax.block_until_ready(det_legacy(x).trf_fk)    # compile + warm
+    os.makedirs(legacy_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    with device_trace(legacy_dir):
+        jax.block_until_ready(det_legacy(x).trf_fk)
+    wall_legacy = time.perf_counter() - t0
+    print(f"multi-dispatch trace written to {legacy_dir} "
+          f"(wall {wall_legacy:.3f} s; one-program is "
+          f"{wall_legacy / max(wall_1prog, 1e-9):.2f}x)")
 
 
 if __name__ == "__main__":

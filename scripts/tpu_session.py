@@ -33,13 +33,21 @@ sys.path.insert(0, ROOT)
 # nice-to-haves last. Every command must be self-contained and print its
 # evidence to stdout (captured into the jsonl log).
 AGENDA = [
+    # Round-5 priority order (VERDICT r4 next-8: highest-value open gate
+    # first in a short window): the fresh one-program headline, then the
+    # trace that attributes whatever wall remains, then the open decision
+    # gates (1: pallas-vs-rfft STFT, 2: channel pad, 4: detect knobs),
+    # then the per-family canonical walls (VERDICT r4 next-6).
     ("bench-full", [sys.executable, "bench.py", "--rung-timeout", "600"], 3000),
+    ("profile-flagship", [sys.executable, "scripts/profile_flagship.py"], 1500),
     ("perf-kernels-full",
      [sys.executable, "scripts/perf_kernels.py", "--full",
       "--markdown", "docs/PERF.md"], 2400),
-    ("ab-channel-pad", [sys.executable, "scripts/ab_channel_pad.py"], 1800),
+    ("bench-families-full",
+     [sys.executable, "scripts/bench_families.py",
+      "--markdown", "docs/PERF.md"], 2400),
     ("ab-detect-knobs", [sys.executable, "scripts/ab_detect_knobs.py"], 1500),
-    ("profile-flagship", [sys.executable, "scripts/profile_flagship.py"], 1500),
+    ("ab-channel-pad", [sys.executable, "scripts/ab_channel_pad.py"], 1800),
     ("cli-mfdetect-on-tpu",
      [sys.executable, "-m", "das4whales_tpu", "mfdetect",
       "--outdir", "/tmp/out_tpu_mfdetect"], 1200),

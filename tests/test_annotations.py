@@ -74,3 +74,41 @@ def test_plain_raven_table_without_extension_columns(tmp_path):
     )
     back = from_raven_selection_table(str(p), 200.0)
     np.testing.assert_array_equal(back["SELECTION"], [[0], [500]])
+
+
+def test_variant_header_capitalization_and_spacing(tmp_path):
+    """Raven exports vary header case/spacing; lookup must tolerate it
+    (ADVICE r4)."""
+    p = tmp_path / "raven_variant.txt"
+    p.write_text(
+        "selection\tview\tchannel\tbegin  time (s)\tEND TIME (S)\n"
+        "1\tSpectrogram 1\t1\t2.0\t3.0\n"
+    )
+    back = from_raven_selection_table(str(p), 200.0)
+    np.testing.assert_array_equal(back["SELECTION"], [[0], [500]])
+
+
+def test_missing_begin_column_raises_descriptive(tmp_path):
+    p = tmp_path / "not_raven.txt"
+    p.write_text("foo\tbar\n1\t2\n")
+    try:
+        from_raven_selection_table(str(p), 200.0)
+    except ValueError as e:
+        assert "Begin Time (s)" in str(e) and "foo" in str(e)
+    else:
+        raise AssertionError("expected ValueError for a non-Raven table")
+
+
+def test_empty_time_cells_skipped_and_reported(tmp_path):
+    p = tmp_path / "raven_gaps.txt"
+    p.write_text(
+        "Selection\tView\tChannel\tBegin Time (s)\tEnd Time (s)\n"
+        "1\tSpectrogram 1\t1\t2.0\t3.0\n"
+        "2\tSpectrogram 1\t1\t\t\n"          # empty Begin cell
+        "3\tSpectrogram 1\t1\tnot-a-number\t9\n"
+        "4\tSpectrogram 1\t1\t4.0\t5.0\n"
+    )
+    skipped = []
+    back = from_raven_selection_table(str(p), 200.0, skipped=skipped)
+    np.testing.assert_array_equal(back["SELECTION"], [[0, 0], [500, 900]])
+    assert [ln for ln, _ in skipped] == [3, 4]

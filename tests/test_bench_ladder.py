@@ -82,8 +82,14 @@ def test_full_shape_headline_when_everything_succeeds(monkeypatch):
     assert p["shape"] == [22050, 12000]
     assert "error" not in p
     assert p["pick_engine"] == "sparse"
-    expect_vs = (22050 * 12000 / 2.0) / (1050 * 12000 / 100.0)
+    # vs_baseline prefers the recorded SAME-SHAPE CPU measurement (226.2 s
+    # golden, VALIDATION.md) over the subset extrapolation, which is
+    # demoted to a secondary field (VERDICT r4 next-3)
+    expect_vs = (22050 * 12000 / 2.0) / (22050 * 12000 / 226.2)
     assert p["vs_baseline"] == pytest.approx(expect_vs, rel=0.01)
+    assert p["cpu_ref_mode"].startswith("measured-same-shape")
+    expect_extrap = 1050 * 12000 / 100.0
+    assert p["cpu_ref_rate_extrapolated"] == pytest.approx(expect_extrap, rel=0.01)
 
 
 def test_oom_error_degrades_to_tiled_rung_on_accelerator(monkeypatch):

@@ -234,3 +234,33 @@ def test_multiprocess_campaign_single_process_degenerate(file_set, tmp_path):
         a, b = load_picks(p1), load_picks(p2)
         for name in a:
             np.testing.assert_array_equal(a[name], b[name])
+
+
+def test_multiprocess_campaign_read_fault_is_per_file(file_set, tmp_path,
+                                                      monkeypatch):
+    """A bulk-read failure that passes the metadata-only probe must become
+    a per-file failure record, not an exception out of the collective
+    region (ADVICE r4: a raising shard callback on one process wedges the
+    other processes in the step's collectives until DCN timeout)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8-device mesh")
+    from das4whales_tpu.io import stream as stream_mod
+    from das4whales_tpu.workflows.campaign import run_campaign_multiprocess
+
+    real_read = stream_mod._read_host
+
+    def flaky_read(spec, sel, *a, **kw):
+        if os.path.basename(spec.path) == "file2.h5":
+            raise OSError("truncated bulk data past the probe")
+        return real_read(spec, sel, *a, **kw)
+
+    monkeypatch.setattr(stream_mod, "_read_host", flaky_read)
+    out = str(tmp_path / "mp_fault")
+    res = run_campaign_multiprocess(file_set, SEL, out)
+    # file1 fails at probe (corrupt header), file2 fails at bulk read
+    assert res.n_done == 1 and res.n_failed == 2
+    by_path = {os.path.basename(r.path): r for r in res.records}
+    assert "truncated bulk data" in by_path["file2.h5"].error
+    assert by_path["file0.h5"].status == "done"

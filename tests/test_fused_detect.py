@@ -1,0 +1,157 @@
+"""One-program picks route (``MatchedFilterDetector.detect_picks``):
+pick-for-pick parity with the multi-dispatch ``__call__`` route.
+
+The fused program moves the reference's threshold policy
+(main_mfdetect.py:94-99), the saturation decision, and the pick
+compaction in-graph so a detection costs ONE dispatch and ONE packed
+fetch — through the axon tunnel the round trips the old route paid per
+file dominated the round-4 measured wall (docs/PERF.md). These tests pin
+the new route to the old one on both the tiled and monolithic correlate
+paths, through the escalation and overflow fallbacks, and through the
+campaign-mode ``__call__`` dispatch.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from das4whales_tpu.config import AcquisitionMetadata
+from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+FS, DX = 200.0, 4.0
+
+
+def _block(nx, ns, fs=FS, seed=0):
+    rng = np.random.default_rng(seed)
+    block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-2
+    t = np.arange(0, 0.68, 1 / fs)
+    f0, f1 = 28.8, 17.8
+    sing = -f1 * 0.68 / (f0 - f1)
+    chirp = (
+        np.cos(2 * np.pi * (-sing * f0) * np.log(np.abs(1 - t / sing)))
+        * np.hanning(len(t))
+    ).astype(np.float32)
+    for k in range(4):
+        ch = (k + 1) * nx // 5
+        onset = int((1 + 1.5 * k) * fs)
+        if onset + len(chirp) < ns:
+            block[ch, onset : onset + len(chirp)] += 8.0 * chirp
+    return block
+
+
+def _det(nx, ns, **kw):
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    kw.setdefault("pick_mode", "sparse")
+    return MatchedFilterDetector(meta, [0, nx, 1], (nx, ns), **kw)
+
+
+def _assert_same_picks(a, b):
+    assert set(a) == set(b)
+    for name in a:
+        np.testing.assert_array_equal(np.asarray(a[name]), np.asarray(b[name]))
+
+
+@pytest.mark.parametrize("channel_tile", [64, None])
+def test_detect_picks_matches_call(channel_tile):
+    nx, ns = 96, 1200
+    block = jnp.asarray(_block(nx, ns))
+    det = _det(nx, ns, channel_tile=channel_tile)
+    ref = det(block)
+    out = det.detect_picks(block)
+    _assert_same_picks(ref.picks, out.picks)
+    for name in ref.thresholds:
+        assert out.thresholds[name] == pytest.approx(ref.thresholds[name], rel=1e-6)
+    assert out.trf_fk is None and not out.correlograms
+
+
+def test_detect_picks_threshold_override():
+    nx, ns = 64, 1000
+    block = jnp.asarray(_block(nx, ns))
+    det = _det(nx, ns, channel_tile=32)
+    thr = 0.3 * float(max(v for v in det(block).thresholds.values()))
+    ref = det(block, threshold=thr)
+    out = det.detect_picks(block, threshold=thr)
+    _assert_same_picks(ref.picks, out.picks)
+    assert all(v == pytest.approx(thr) for v in out.thresholds.values())
+
+
+def test_detect_picks_escalation_parity():
+    """A K0 too small for the densest channel must escalate and still
+    match the full-capacity reference exactly."""
+    nx, ns = 48, 1200
+    block = jnp.asarray(_block(nx, ns, seed=3))
+    det = _det(nx, ns, channel_tile=16, max_peaks=128)
+    det.pick_k0 = 2  # force saturation at K0 on the chirp channels
+    ref = _det(nx, ns, channel_tile=16, max_peaks=128)(block)
+    with pytest.warns(UserWarning, match="saturated") if _saturates(det, block) \
+            else _nullcontext():
+        out = det.detect_picks(block)
+    _assert_same_picks(ref.picks, out.picks)
+
+
+def _saturates(det, block) -> bool:
+    """Whether the full-K reference itself reports saturation (the warns
+    expectation must track the data, not assume)."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        det.detect_picks(block)
+    return any("saturated" in str(w.message) for w in rec)
+
+
+class _nullcontext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_detect_picks_overflow_falls_back_exact():
+    """pick_pack_cap smaller than the pick count must fall back to the
+    full-grid route, not truncate."""
+    nx, ns = 64, 1000
+    block = jnp.asarray(_block(nx, ns))
+    det = _det(nx, ns, channel_tile=32)
+    ref = det(block)
+    n_max = max(int(v.shape[1]) for v in ref.picks.values())
+    assert n_max > 2  # the fixture must actually pick things
+    small = _det(nx, ns, channel_tile=32, pick_pack_cap=2)
+    out = small.detect_picks(block)
+    _assert_same_picks(ref.picks, out.picks)
+
+
+def test_call_dispatches_to_one_program_in_campaign_mode():
+    nx, ns = 64, 1000
+    block = jnp.asarray(_block(nx, ns))
+    keep = _det(nx, ns, channel_tile=32)
+    camp = _det(nx, ns, channel_tile=32, keep_correlograms=False)
+    ref = keep(block)
+    out = camp(block)  # __call__ must route through detect_picks
+    _assert_same_picks(ref.picks, out.picks)
+    assert out.trf_fk is None and not out.correlograms
+    assert ref.trf_fk is not None
+
+
+def test_channel_padded_design_parity():
+    """The fused program's pad_rows path (channel-padded f-k design) must
+    match the staged route's picks."""
+    nx, ns = 60, 1000
+    block = jnp.asarray(_block(nx, ns))
+    det = _det(nx, ns, channel_tile=32, channel_pad=64)
+    ref = det(block)
+    out = det.detect_picks(block)
+    _assert_same_picks(ref.picks, out.picks)
+
+
+def test_staged_bandpass_variant():
+    """fused_bandpass=False routes the separate zero-phase bandpass
+    through the one-program path too."""
+    nx, ns = 64, 1000
+    block = jnp.asarray(_block(nx, ns))
+    det = _det(nx, ns, channel_tile=32, fused_bandpass=False)
+    ref = det(block)
+    out = det.detect_picks(block)
+    _assert_same_picks(ref.picks, out.picks)

@@ -196,3 +196,62 @@ def test_compact_picks_rowmajor_order_and_overflow():
     assert int(cnt[0]) == 6                      # overflow is visible
     np.testing.assert_array_equal(np.asarray(rows)[0], [0, 0, 1, 2])
     np.testing.assert_array_equal(np.asarray(times)[0], [3, 7, 1, 2])
+
+
+def test_pack_method_matches_scipy_and_topk_when_unsaturated(rng):
+    """The sort-free scatter-pack kernel is exact (== scipy == topk pick
+    sets) whenever no row saturates — the adaptive-K fast-path contract."""
+    import scipy.signal as ssp
+
+    sos = ssp.butter(4, [0.1, 0.3], "bp", output="sos")
+    for trial in range(4):
+        noise = ssp.sosfiltfilt(sos, rng.standard_normal((3, 900)), axis=-1)
+        x = np.abs(ssp.hilbert(noise, axis=-1))
+        thr = np.percentile(x, 75) * 0.5
+        res_p = peaks.find_peaks_sparse(x, thr, max_peaks=128, nb=64,
+                                        method="pack")
+        res_t = peaks.find_peaks_sparse(x, thr, max_peaks=128, nb=64,
+                                        method="topk")
+        assert not np.asarray(res_p.saturated).any()
+        np.testing.assert_array_equal(np.asarray(res_p.saturated),
+                                      np.asarray(res_t.saturated))
+        tp_p = peaks.sparse_to_pick_times(res_p.positions, res_p.selected)
+        tp_t = peaks.sparse_to_pick_times(res_t.positions, res_t.selected)
+        np.testing.assert_array_equal(tp_p, tp_t)
+        for i in range(3):
+            want = ssp.find_peaks(x[i], prominence=thr)[0]
+            got = np.asarray(res_p.positions)[i][np.asarray(res_p.selected)[i]]
+            np.testing.assert_array_equal(got, want)  # ascending already
+            want_prom = ssp.peak_prominences(x[i], want)[0]
+            got_prom = np.asarray(res_p.prominences)[i][
+                np.asarray(res_p.selected)[i]]
+            np.testing.assert_allclose(got_prom, want_prom, atol=1e-9)
+
+
+def test_pack_method_saturation_keeps_first_k_and_flags(rng):
+    x = np.tile(np.array([0.0, 1.0]), 50)[None, :] + 0.001 * rng.standard_normal((1, 100))
+    x = np.abs(x)
+    res = peaks.find_peaks_sparse(x, 0.0001, max_peaks=8, nb=16, method="pack")
+    assert bool(np.asarray(res.saturated)[0])
+    got = np.asarray(res.positions)[0][np.asarray(res.selected)[0]]
+    # first 8 candidates in time order (the pack drop rule)
+    all_pk = np.nonzero(np.asarray(peaks.local_maxima(x[0])))[0]
+    np.testing.assert_array_equal(got, all_pk[:8])
+
+
+def test_escalation_method_policy():
+    assert peaks.escalation_method(64, 256) == "pack"
+    assert peaks.escalation_method(256, 256) == "topk"
+    assert peaks.escalation_method(8, 8) == "topk"
+
+
+def test_pack_batched_leading_axes(rng):
+    x = np.abs(rng.standard_normal((2, 3, 400))) + 0.01
+    thr = np.full((2, 3), 0.8)
+    res_p = peaks.find_peaks_sparse_batched(x, thr, max_peaks=160, method="pack")
+    res_t = peaks.find_peaks_sparse_batched(x, thr, max_peaks=160, method="topk")
+    assert not np.asarray(res_p.saturated).any()
+    for i in range(2):
+        tp_p = peaks.sparse_to_pick_times(res_p.positions[i], res_p.selected[i])
+        tp_t = peaks.sparse_to_pick_times(res_t.positions[i], res_t.selected[i])
+        np.testing.assert_array_equal(tp_p, tp_t)

@@ -125,3 +125,28 @@ class TestUpsertSection:
         out = open(p).read()
         assert "stale body" not in out and "fresh body" in out
         assert out.count(self.M1) == 1
+
+
+def test_collective_traffic_parser_hlo_forms():
+    """derive_multichip's HLO collective scraper: tuple and scalar result
+    signatures count once; -done halves and get-tuple-element mentions
+    don't count at all."""
+    from scripts.derive_multichip import collective_traffic
+
+    hlo = "\n".join([
+        "%all-to-all = (c64[1,32,45]{2,1,0}, c64[1,32,45]{2,1,0}) "
+        "all-to-all(%a, %b), replica_groups={{0,1}}",
+        "%gte = c64[1,32,45]{2,1,0} get-tuple-element(%all-to-all), index=0",
+        "%pmax.7 = f32[1]{0} all-reduce(%w), channel_id=1",
+        "%ar2 = f32[8,4]{1,0} all-reduce-start(%y)",
+        "%ar2d = f32[8,4]{1,0} all-reduce-done(%ar2)",
+        "%ag = bf16[16]{0} all-gather(%z)",
+    ])
+    t = collective_traffic(hlo)
+    assert t["all-to-all"]["count"] == 1
+    assert t["all-to-all"]["bytes"] == 2 * 1 * 32 * 45 * 8
+    assert t["all-reduce"]["count"] == 2           # plain + -start, not -done
+    assert t["all-reduce"]["bytes"] == 4 + 8 * 4 * 4
+    assert t["all-gather"]["bytes"] == 16 * 2
+    assert t["total_bytes"] == sum(
+        v["bytes"] for k, v in t.items() if isinstance(v, dict))
