@@ -380,6 +380,56 @@ def find_peaks_sparse_batched(
     return SparsePicks(*(a.reshape(lead + a.shape[1:]) for a in res))
 
 
+def find_peaks_sparse_tiled(
+    x: jnp.ndarray,
+    threshold,
+    max_peaks: int = 256,
+    tile: int = 512,
+    nb: int = 128,
+    method: str = "topk",
+) -> SparsePicks:
+    """``find_peaks_sparse_batched`` with the row (second-to-last) axis
+    walked in ``tile``-sized chunks via ``lax.map``.
+
+    The kernel's per-candidate block tables are ``[rows, K, T/nb]`` — at
+    a canonical 22k-channel shard with K=256 the untiled intermediates
+    accessed ~17x the HBM bytes of the tiled single-chip route (XLA cost
+    model, scripts/derive_multichip.py). Tiling bounds the working set
+    at tile size exactly like ``models.matched_filter.mf_pick_tiled``;
+    results are identical (the kernel is per-row). Rows are zero-padded
+    up to a tile multiple with an +inf threshold (no candidates) and
+    cropped on output.
+
+    ``x`` is ``[..., C, T]``; ``threshold`` broadcasts to ``x.shape[:-1]``.
+    """
+    lead = x.shape[:-2]
+    C, T = x.shape[-2], x.shape[-1]
+    thr_rows = jnp.broadcast_to(jnp.asarray(threshold), x.shape[:-1])
+    tile = min(tile, C)
+    n_t = -(-C // tile)
+    pad = n_t * tile - C
+    if pad:
+        zeros = [(0, 0)] * len(lead)
+        x = jnp.pad(x, zeros + [(0, pad), (0, 0)])
+        thr_rows = jnp.pad(thr_rows, zeros + [(0, pad)],
+                           constant_values=jnp.inf)
+    xt = jnp.moveaxis(x.reshape(lead + (n_t, tile, T)), -3, 0)
+    tt = jnp.moveaxis(thr_rows.reshape(lead + (n_t, tile)), -2, 0)
+    sp = jax.lax.map(
+        lambda a: find_peaks_sparse_batched(
+            a[0], a[1], max_peaks=max_peaks, nb=nb, method=method
+        ),
+        (xt, tt),
+    )
+
+    def untile(f):
+        f = jnp.moveaxis(f, 0, len(lead))          # [*lead, n_t, tile, ...]
+        f = f.reshape(lead + (n_t * tile,) + f.shape[len(lead) + 2:])
+        return jax.lax.slice_in_dim(f, 0, C, axis=len(lead))
+
+    return SparsePicks(*(untile(f) for f in sp))
+
+
 def sparse_to_pick_times(positions, selected) -> np.ndarray:
     """Sparse picks -> stacked (channel_idx[], time_idx[]) array in the
     reference's row-major order (detect.py:277-303)."""

@@ -37,7 +37,8 @@ def _mf_body(
     trace, mask_band, bp_gain, templates_true, template_mu, template_scale, *,
     band_lo: int, band_hi: int, bp_padlen: int, channel_axis: str,
     relative_threshold: float, hf_factor: float, pick_mode: str, max_peaks: int,
-    outputs: str = "full", fused: bool = False,
+    outputs: str = "full", fused: bool = False, pick_tile: int = 512,
+    pick_method: str = "topk",
 ):
     """shard_map body. Local shapes: trace [B/Pf, C/Pc, T], mask_band
     [K, Bpad/Pc] (band-limited half-spectrum — the all_to_alls and
@@ -67,9 +68,15 @@ def _mf_body(
     if pick_mode == "sparse":
         # TPU production route (ops/peaks.py): envelope peaks are
         # nonnegative, so the height prefilter is exact; time is unsharded
-        # here, so positions are global sample indices already
-        picks = peak_ops.find_peaks_sparse_batched(
-            env, thr[..., 0], max_peaks=max_peaks
+        # here, so positions are global sample indices already. The pick
+        # kernel walks CHANNEL TILES exactly like the single-chip route
+        # (ops.peaks.find_peaks_sparse_tiled): untiled at a canonical
+        # shard shape its [rows, K, blocks] sqrt-decomposition tables
+        # accessed ~17x the single-chip program's HBM bytes
+        # (scripts/derive_multichip.py cost model).
+        picks = peak_ops.find_peaks_sparse_tiled(
+            env, thr[..., 0], max_peaks=max_peaks, tile=pick_tile,
+            method=pick_method,
         )
     else:
         # dense debug route: exact per-sample prominences, gather-heavy
@@ -95,9 +102,19 @@ def make_sharded_mf_step(
     max_peaks: int = 256,
     outputs: str = "full",
     fused_bandpass: bool = True,
+    pick_tile: int = 512,
+    pick_method: str = "topk",
 ):
     """Build the jitted multi-chip detection step for a
     ``[file x channel x time]`` batch.
+
+    ``pick_tile``/``pick_method`` tune the sparse pick stage exactly like
+    the single-chip route (channel tiles via ``lax.map``; see
+    ``ops.peaks.find_peaks_sparse`` for the pack-vs-topk contract). The
+    campaigns run an adaptive two-phase policy: a K0=64 ``"pack"`` step
+    first, escalating to this full-capacity ``"topk"`` step only when a
+    row saturates (``ops.peaks.escalation_method`` semantics across
+    programs).
 
     ``fused_bandpass=True`` folds |H(f)|² into the f-k mask before the
     band crop — the multi-chip analog of
@@ -165,6 +182,8 @@ def make_sharded_mf_step(
         pick_mode=pick_mode,
         max_peaks=max_peaks,
         outputs=outputs,
+        pick_tile=pick_tile,
+        pick_method=pick_method,
     )
     tfc = P(None, file_axis, channel_axis, None)  # [template, file, channel, *]
     if pick_mode == "sparse":

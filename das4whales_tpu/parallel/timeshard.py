@@ -199,6 +199,8 @@ def make_sharded_mf_step_time(
     max_peaks: int = 256,
     outputs: str = "full",
     fused_bandpass: bool = True,
+    pick_tile: int = 512,
+    pick_method: str = "topk",
 ):
     """Full flagship detection step for a TIME-sharded ``[C, T]`` block.
 
@@ -290,9 +292,12 @@ def make_sharded_mf_step_time(
         thr = thres * factors[:, None, None]
         if pick_mode == "sparse":
             # TPU production route: time is whole within each channel
-            # shard here, so positions are global sample indices
-            picks = peak_ops.find_peaks_sparse_batched(
-                env, thr[..., 0], max_peaks=max_peaks
+            # shard here, so positions are global sample indices.
+            # Channel-tiled kernel — same working-set bound as the
+            # single-chip route (ops.peaks.find_peaks_sparse_tiled)
+            picks = peak_ops.find_peaks_sparse_tiled(
+                env, thr[..., 0], max_peaks=max_peaks, tile=pick_tile,
+                method=pick_method,
             )
         else:
             picks = peak_ops.local_maxima(env) & (

@@ -262,6 +262,30 @@ def run_campaign(
 _PICK_PACK_CAP = 1 << 18
 
 
+def _adaptive_sharded_steps(factory, design, mesh, pick_k0: int = 64,
+                            max_peaks: int = 256, **kw):
+    """Jitted ``(K0 pack, full-capacity topk)`` step pair: the adaptive-K
+    policy of ``ops.peaks.picks_with_escalation`` expressed across SPMD
+    programs (``escalation_method`` semantics — the sort-free pack kernel
+    wherever a bigger-K rerun can correct truncation, top-k where it is
+    final). The full-capacity program compiles lazily, only if a batch
+    actually saturates."""
+    import jax
+
+    step_k0 = jax.jit(factory(design, mesh, outputs="picks",
+                              max_peaks=pick_k0, pick_method="pack", **kw))
+    full: dict = {}
+
+    def step_full(stack):
+        if "fn" not in full:
+            full["fn"] = jax.jit(factory(design, mesh, outputs="picks",
+                                         max_peaks=max_peaks,
+                                         pick_method="topk", **kw))
+        return full["fn"](stack)
+
+    return step_k0, step_full
+
+
 def _compact_batch_picks(positions, selected, n_samples: int, capacity: int):
     """Sharded-step ``SparsePicks`` ``[nT, B, C, K]`` -> per-(template,
     file) packed ``(chan [nT, B, cap], time [nT, B, cap], count [nT, B])``
@@ -381,6 +405,7 @@ def run_campaign_sharded(
     import types
 
     import jax
+    import jax.numpy as jnp
 
     from ..eval import sharded_picks_to_dict
     from ..io.stream import _probe, stream_file_batches
@@ -412,11 +437,11 @@ def run_campaign_sharded(
     )
     if batch is None:
         batch = max(int(mesh.shape.get("file", 1)), 1)
-    step = jax.jit(make_sharded_mf_step(
-        design, mesh, outputs="picks",
+    step_k0, step_full = _adaptive_sharded_steps(
+        make_sharded_mf_step, design, mesh,
         relative_threshold=relative_threshold, hf_factor=hf_factor,
         fused_bandpass=fused_bandpass,
-    ))
+    )
 
     factors = {name: (hf_factor if i == 0 else 1.0)
                for i, name in enumerate(design.template_names)}
@@ -426,7 +451,11 @@ def run_campaign_sharded(
         interrogator=interrogator, prefetch=prefetch, engine=engine, tail="pad",
     ):
         t0 = time.perf_counter()
-        sp_picks, thres = jax.block_until_ready(step(stack))
+        sp_picks, thres = jax.block_until_ready(step_k0(stack))
+        if int(np.asarray(jnp.sum(sp_picks.saturated))):
+            # a row saturated at K0: rerun at full capacity (same
+            # escalation contract as ops.peaks.picks_with_escalation)
+            sp_picks, thres = jax.block_until_ready(step_full(stack))
         wall = time.perf_counter() - t0
         thres_np = np.asarray(thres)
         # pack picks on the mesh before they cross to the host (same
@@ -503,6 +532,7 @@ def run_campaign_multiprocess(
     a human running per-file scripts on several nodes (SURVEY.md §5.8).
     """
     import jax
+    import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
     from ..config import ChannelSelection
@@ -533,11 +563,11 @@ def run_campaign_multiprocess(
     C = sel.n_channels(spec0.meta.nx)
     ns = spec0.meta.ns
     design = design_matched_filter((C, ns), selected_channels, spec0.meta)
-    step = jax.jit(make_sharded_mf_step(
-        design, mesh, outputs="picks",
+    step_k0, step_full = _adaptive_sharded_steps(
+        make_sharded_mf_step, design, mesh,
         relative_threshold=relative_threshold, hf_factor=hf_factor,
         fused_bandpass=fused_bandpass,
-    ))
+    )
     sharding = input_sharding(mesh)
     factors = {name: (hf_factor if i == 0 else 1.0)
                for i, name in enumerate(design.template_names)}
@@ -600,7 +630,11 @@ def run_campaign_multiprocess(
             return np.stack(rows)
 
         x = jax.make_array_from_callback((batch, C, ns), sharding, _shard)
-        sp_picks, thres = jax.block_until_ready(step(x))
+        sp_picks, thres = jax.block_until_ready(step_k0(x))
+        # replicated scalar -> the same escalation decision on every
+        # process (no extra collective round)
+        if int(np.asarray(jnp.sum(sp_picks.saturated))):
+            sp_picks, thres = jax.block_until_ready(step_full(x))
         wall = time.perf_counter() - t0
         thres_np = np.asarray(
             multihost_utils.process_allgather(thres, tiled=True)

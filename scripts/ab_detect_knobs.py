@@ -94,6 +94,15 @@ def main():
             row[f"env_peaks_K{K}_s"] = round(pick_s, 4)
             row[f"compact_K{K}_s"] = round(comp_s, 4)
             row[f"n_picks_K{K}"] = int(np.asarray(cnt).sum())
+        # the sort-free pack kernel at the adaptive-K0 — what
+        # escalation_method actually runs first in production
+        pack_s, _, sp_pack = timed(
+            lambda ct, t: mf_pick_tiled(ct, t, 64, "pack"), corr_tiles, thr
+        )
+        row["env_peaks_K64_pack_s"] = round(pack_s, 4)
+        row["n_picks_K64_pack"] = int(np.asarray(
+            mf_compact_tiled_picks(sp_pack.positions, sp_pack.selected, nx,
+                                   min(nx * 64, 1 << 20))[2]).sum())
         rows.append(row)
         del corr_tiles
 

@@ -257,9 +257,18 @@ def headline(bench: dict | None, lines: list) -> None:
     if device_is_tpu(bench.get("device")):
         wall = bench.get("wall_s") or 1e9
         verdict = "MET" if wall < 2.0 else "NOT met single-chip"
+        proj = ""
+        try:
+            with open(os.path.join(ROOT, "artifacts",
+                                   "multichip_derivation.json")) as fh:
+                d = json.load(fh)
+            proj = (f", wall x {d['overhead_factor_used']} / "
+                    f"{d['n_devices']} + {d['ici_time_s'] * 1e3:.2f} ms ICI")
+        except (OSError, json.JSONDecodeError, KeyError):
+            pass
         lines.append(f"- north star (<2 s canonical): **{verdict}** at "
-                      f"{wall:.3g} s on ONE chip (v5e-8 projection: "
-                      "docs/PERF.md, ~5.9 ms/file).")
+                      f"{wall:.3g} s on ONE chip (v5e-8 projection from "
+                      f"recorded inputs: docs/PERF.md{proj}).")
 
 
 def main() -> int:

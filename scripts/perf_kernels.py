@@ -122,6 +122,31 @@ def bench_peaks(repeats=3, full=False):
     return rows
 
 
+def bench_time_fft(repeats=5, full=False):
+    """Time-axis rFFT/irFFT cost vs transform length — is XLA's TPU FFT
+    radix-sensitive along the MINOR axis too? Candidates: the exact
+    canonical length 12000 = 2^5*3*5^3 (already 5-smooth), 12288 =
+    2^12*3 (2-3-smooth), and the next power of two 16384. A big pow2
+    win here motivates a time-pad knob the way channel_pad covers the
+    channel axis."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    c = 22050 if full else 1024
+    x = jnp.asarray(rng.standard_normal((c, 12000)), jnp.float32)
+    rows = []
+    for n in (12000, 12288, 16384):
+        t, _ = timed(
+            lambda a, n=n: jnp.fft.irfft(jnp.fft.rfft(a, n=n, axis=-1),
+                                         n=n, axis=-1),
+            x, repeats=repeats,
+        )
+        rows.append({"n_time": n, "channels": c, "rfft_irfft_s": round(t, 5),
+                     "vs_exact": round(rows[0]["rfft_irfft_s"] / t, 2)
+                     if rows else 1.0})
+    return rows
+
+
 def bench_channel_fft(repeats=5, full=False):
     """Channel-axis complex FFT cost vs transform length — the evidence
     behind ``design_matched_filter(channel_pad=...)``. The canonical OOI
@@ -179,8 +204,9 @@ def main():
     stft_rows = bench_stft()
     peak_rows = bench_peaks(full=args.full)
     chfft_rows = bench_channel_fft(full=args.full)
+    tfft_rows = bench_time_fft(full=args.full)
     doc = {"device": device, "stft": stft_rows, "peaks": peak_rows,
-           "channel_fft": chfft_rows}
+           "channel_fft": chfft_rows, "time_fft": tfft_rows}
     print(json.dumps(doc, indent=1))
 
     if args.markdown:
@@ -225,6 +251,18 @@ def main():
         for r in chfft_rows:
             lines.append(
                 f"| {r['n_channels']} | {r['band']} | {r['fft_ifft_s']} "
+                f"| {r['vs_exact']}x |"
+            )
+        lines += [
+            "",
+            "### Time-axis rFFT+irFFT vs transform length",
+            "",
+            "| n_time | channels | rfft+irfft (s) | vs exact length |",
+            "|---|---|---|---|",
+        ]
+        for r in tfft_rows:
+            lines.append(
+                f"| {r['n_time']} | {r['channels']} | {r['rfft_irfft_s']} "
                 f"| {r['vs_exact']}x |"
             )
         lines.append("")

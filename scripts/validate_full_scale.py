@@ -68,8 +68,15 @@ def make_scene(nx, ns, n_calls=24, seed=7):
     return block, truth
 
 
-def run_production(block, fused_bandpass: bool = False):
-    """das4whales_tpu float32 pipeline; returns picks dict + timings."""
+def run_production(block, fused_bandpass: bool = False,
+                   one_program: bool = False):
+    """das4whales_tpu float32 pipeline; returns picks dict + timings.
+
+    ``one_program=True`` certifies the campaign/bench configuration
+    (``keep_correlograms=False`` + the sparse engine forced, so
+    ``detect_picks`` — the ONE-XLA-program route with in-graph threshold
+    and device compaction — actually executes on this CPU host where
+    ``pick_mode='auto'`` would pick the scipy walk)."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -80,27 +87,33 @@ def run_production(block, fused_bandpass: bool = False):
 
     nx, ns = block.shape
     meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    kw = ({"keep_correlograms": False, "pick_mode": "sparse"}
+          if one_program else {})
     t0 = time.perf_counter()
     det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns), max_peaks=256,
-                                fused_bandpass=fused_bandpass)
+                                fused_bandpass=fused_bandpass, **kw)
     t_design = time.perf_counter() - t0
+
+    def sync(res):
+        if res.trf_fk is not None:
+            jax.block_until_ready(res.trf_fk)
+        return res
 
     x = jnp.asarray(block)
     t0 = time.perf_counter()
-    res = det(x)
-    jax.block_until_ready(res.trf_fk)
+    res = sync(det(x))
     t_first = time.perf_counter() - t0          # includes jit compile
 
     t0 = time.perf_counter()
-    res = det(x)
-    jax.block_until_ready(res.trf_fk)
+    res = sync(det(x))
     t_steady = time.perf_counter() - t0         # per-file cost in a campaign
 
     return res.picks, res.thresholds, {
         "design_s": t_design, "first_call_s": t_first, "steady_s": t_steady,
         # which code paths actually executed — write_report must not claim
         # a route the run never took
-        "route": det._route() + ("+fusedbp" if fused_bandpass else ""),
+        "route": det._route() + ("+fusedbp" if fused_bandpass else "")
+        + ("+1prog" if one_program else ""),
         "pick_engine": det.pick_mode,
     }
 
@@ -231,6 +244,11 @@ def main():
     ap.add_argument("--fused", action="store_true",
                     help="validate the fused bandpass-into-f-k route (the "
                          "bench default) instead of the staged default")
+    ap.add_argument("--one-program", action="store_true",
+                    help="validate the campaign/bench configuration: "
+                         "detect_picks (one XLA program, in-graph "
+                         "threshold, device compaction) with the sparse "
+                         "engine forced")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -239,7 +257,8 @@ def main():
     block, truth = make_scene(args.nx, args.ns)
 
     print("production float32 pipeline ...", flush=True)
-    p_picks, p_thr, p_t = run_production(block, fused_bandpass=args.fused)
+    p_picks, p_thr, p_t = run_production(block, fused_bandpass=args.fused,
+                                         one_program=args.one_program)
     print(f"  design {p_t['design_s']:.1f}s  first {p_t['first_call_s']:.1f}s "
           f"steady {p_t['steady_s']:.1f}s", flush=True)
 
@@ -275,13 +294,13 @@ def main():
                        "prod_timings": p_t, "golden_timings": g_t}, fh, indent=1)
         print("wrote", args.json)
 
-    if args.out and args.fused and args.out == "VALIDATION.md":
-        # --fused must not regenerate the default-route certificate (it
-        # would mislabel the run and destroy the fused addendum section);
+    if args.out and (args.fused or args.one_program) and args.out == "VALIDATION.md":
+        # route variants must not regenerate the default-route certificate
+        # (it would mislabel the run and destroy the addendum sections);
         # results went to stdout/--json — update the addendum by hand or
         # pass an explicit --out.
-        print("(--fused: skipping default VALIDATION.md regeneration; "
-              "use --json or an explicit --out)")
+        print("(route-variant run: skipping default VALIDATION.md "
+              "regeneration; use --json or an explicit --out)")
     elif args.out:
         out = args.out
         if not os.path.isabs(out):

@@ -72,7 +72,10 @@ def test_secure_quick_banked_when_full_rung_wedges(monkeypatch):
 
 
 def test_full_shape_headline_when_everything_succeeds(monkeypatch):
+    attempts = []
+
     def spawn(spec, timeout_s, cpu=False):
+        attempts.append(spec)
         if spec.get("cpu_baseline"):
             return {"cpu_wall": 100.0, "n_picks": 4}, None
         wall = 2.0 if spec["nx"] > 4096 else 0.5
@@ -82,14 +85,15 @@ def test_full_shape_headline_when_everything_succeeds(monkeypatch):
     assert p["shape"] == [22050, 12000]
     assert "error" not in p
     assert p["pick_engine"] == "sparse"
-    # vs_baseline prefers the recorded SAME-SHAPE CPU measurement (226.2 s
-    # golden, VALIDATION.md) over the subset extrapolation, which is
-    # demoted to a secondary field (VERDICT r4 next-3)
+    # vs_baseline uses the recorded SAME-SHAPE CPU measurement (226.2 s
+    # golden, VALIDATION.md; VERDICT r4 next-3), and the redundant subset
+    # extrapolation run is SKIPPED so a live tunnel window never idles
+    # through the 2-5 min scipy baseline
     expect_vs = (22050 * 12000 / 2.0) / (22050 * 12000 / 226.2)
     assert p["vs_baseline"] == pytest.approx(expect_vs, rel=0.01)
     assert p["cpu_ref_mode"].startswith("measured-same-shape")
-    expect_extrap = 1050 * 12000 / 100.0
-    assert p["cpu_ref_rate_extrapolated"] == pytest.approx(expect_extrap, rel=0.01)
+    assert p["cpu_ref_rate_extrapolated"] is None
+    assert not any(s.get("cpu_baseline") for s in attempts)
 
 
 def test_oom_error_degrades_to_tiled_rung_on_accelerator(monkeypatch):
