@@ -144,7 +144,10 @@ class GaborDetector:
             for name, note in self.notes.items()
         }
         if threshold is None:
-            maxv = max(float(jnp.max(c)) for c in correlograms.values())
+            # one device sync for the global max, not one per note
+            maxv = float(jnp.max(jnp.stack(
+                [jnp.max(c) for c in correlograms.values()]
+            )))
             thres = 0.5 * maxv
         else:
             thres = float(threshold)
@@ -162,7 +165,8 @@ class GaborDetector:
                 min(64, self.max_peaks), self.max_peaks,
             )
             peak_ops.warn_saturated(saturated, f"note {name}", self.max_peaks)
-            picks[name] = peak_ops.sparse_to_pick_times(pos, sel)
+            # device-side compaction: only O(picks) ints cross to the host
+            picks[name] = peak_ops.pick_times_compacted(pos, sel)
         return {
             "score": score,
             "mask": mask_binned,

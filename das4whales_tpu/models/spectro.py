@@ -256,7 +256,9 @@ class SpectroCorrDetector:
                 min(64, self.max_peaks), self.max_peaks,
             )
             peak_ops.warn_saturated(saturated, f"kernel {name}", self.max_peaks)
-            picks[name] = peak_ops.sparse_to_pick_times(pos, sel)
+            # device-side compaction: only O(picks) ints cross to the host
+            # (the flagship's boundary-crossing reduction, ops.peaks)
+            picks[name] = peak_ops.pick_times_compacted(pos, sel)
         nt = next(iter(correlograms.values())).shape[-1]
         spectro_fs = nt / (self.metadata.ns / fs)
         return correlograms, picks, spectro_fs

@@ -523,6 +523,27 @@ def compacted_to_host(rows_d, times_d, cnt_d, capacity: int):
     )
 
 
+def pick_times_compacted(positions, selected, capacity: int = 1 << 18) -> np.ndarray:
+    """``[C, K]`` sparse picks -> the reference's ``(2, n)``
+    [channel_idx, time_idx] array with only O(capacity) ints crossing the
+    device→host boundary (``compact_picks_rowmajor`` on device, padded
+    transfer via ``compacted_to_host``) — the same boundary-crossing
+    reduction the flagship detector ships; output order and dtype are
+    identical to :func:`sparse_to_pick_times`, which remains the exact
+    fallback on capacity overflow."""
+    C, K = positions.shape
+    cap = int(min(C * K, capacity))
+    rows_d, times_d, cnt_d = compact_picks_rowmajor(
+        positions[None], selected[None], cap
+    )
+    packed = compacted_to_host(rows_d, times_d, cnt_d, cap)
+    if packed is None:
+        return sparse_to_pick_times(positions, selected)
+    rows, times, cnt = packed
+    k = int(cnt[0])
+    return np.asarray([rows[0, :k], times[0, :k]])
+
+
 @functools.partial(jax.jit, static_argnames=("block_size",))
 def find_peaks_prominence_blocked(x: jnp.ndarray, threshold, block_size: int = 1024) -> jnp.ndarray:
     """Channel-blocked variant of ``find_peaks_prominence`` for large

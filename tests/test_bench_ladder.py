@@ -270,7 +270,9 @@ def test_stale_or_cpu_bank_is_ignored(monkeypatch, tmp_path):
     """A bank older than the age cap (another round) or carrying a CPU
     device string must not short-circuit the fallback ladder."""
     for bad in (
-        {"device": "TPU v5 lite0", "banked_at_unix": time.time() - 30 * 3600.0},
+        # comfortably past the 30 h default cap (not AT it — the check
+        # must not hinge on sub-second elapsed time)
+        {"device": "TPU v5 lite0", "banked_at_unix": time.time() - 40 * 3600.0},
         {"device": "TFRT_CPU_0", "banked_at_unix": time.time() - 60.0},
     ):
         bank = tmp_path / "bank.json"

@@ -255,3 +255,16 @@ def test_pack_batched_leading_axes(rng):
         tp_p = peaks.sparse_to_pick_times(res_p.positions[i], res_p.selected[i])
         tp_t = peaks.sparse_to_pick_times(res_t.positions[i], res_t.selected[i])
         np.testing.assert_array_equal(tp_p, tp_t)
+
+
+def test_pick_times_compacted_matches_full_transfer(rng):
+    x = np.abs(rng.standard_normal((6, 500))) + 0.01
+    res = peaks.find_peaks_sparse(x, 0.9, max_peaks=128, nb=32)
+    want = peaks.sparse_to_pick_times(res.positions, res.selected)
+    got = peaks.pick_times_compacted(res.positions, res.selected)
+    np.testing.assert_array_equal(got, want)
+    assert got.dtype == want.dtype
+    # overflow path falls back to the exact full transfer
+    got_small = peaks.pick_times_compacted(res.positions, res.selected,
+                                           capacity=2)
+    np.testing.assert_array_equal(got_small, want)
