@@ -701,7 +701,7 @@ class MatchedFilterDetector:
                     ),
                     self.pick_k0, self.max_peaks,
                 )
-                picks[name] = peak_ops.sparse_to_pick_times(pos, sel)
+                picks[name] = peak_ops.pick_times_compacted(pos, sel)
                 self._warn_saturated(name, saturated)
             elif self.pick_mode == "scipy":
                 # CPU host route: exact sequential walk, no capacity limit

@@ -505,3 +505,28 @@ def test_degrade_with_bank_skips_cpu_rungs(monkeypatch, tmp_path):
     rc, p = run_scenario(monkeypatch, spawn, bank_path=str(bank))
     assert p["banked"] is True
     assert cpu_attempts == []
+
+
+def test_replay_rederives_vs_baseline_from_measured_wall(monkeypatch, tmp_path):
+    """A banked payload from before the measured-same-shape convention
+    replays with vs_baseline re-derived from the recorded wall and the
+    recorded 226.2 s CPU golden; the extrapolated figure survives as a
+    suffixed field."""
+    bank = tmp_path / "bank.json"
+    bank.write_text(json.dumps({
+        "metric": "m", "value": 5.4e7, "unit": "u", "vs_baseline": 73.32,
+        "wall_s": 4.8559, "shape": [22050, 12000],
+        "cpu_ref_mode": "linear-extrapolated(nx=1050)", "cpu_ref_rate": 743169.9,
+        "device": "TPU v5 lite0", "banked_at_unix": time.time() - 3600.0,
+        "banked_commit": "aaaaaaa",
+    }))
+
+    def spawn(spec, timeout_s, cpu=False):
+        raise AssertionError("replay must not spawn rungs")
+
+    rc, p = run_scenario(monkeypatch, spawn, probe_ok=False, bank_path=str(bank))
+    assert rc == 0 and p["banked"] is True
+    assert p["vs_baseline"] == pytest.approx(226.2 / 4.8559, rel=1e-3)
+    assert p["cpu_ref_mode"].startswith("measured-same-shape")
+    assert p["vs_baseline_extrapolated"] == 73.32
+    assert p["cpu_ref_rate_extrapolated"] == 743169.9
