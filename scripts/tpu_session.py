@@ -38,16 +38,27 @@ AGENDA = [
     # trace that attributes whatever wall remains, then the open decision
     # gates (1: pallas-vs-rfft STFT, 2: channel pad, 4: detect knobs),
     # then the per-family canonical walls (VERDICT r4 next-6).
-    ("bench-full", [sys.executable, "bench.py", "--rung-timeout", "600"], 3000),
-    ("profile-flagship", [sys.executable, "scripts/profile_flagship.py"], 1500),
+    # 900 s per rung: the round-5 one-program route compiles the whole
+    # pipeline as ONE module, and a first-time canonical compile through
+    # the tunnel must not hit the deadline mid-compile. The step deadline
+    # covers the worst LADDER path, not just the success path: quick 480 s
+    # + three 900 s full-shape rungs + 45 s re-probes after timeouts
+    # (~3400 s; the quick-shape CPU baseline after a full degrade adds
+    # ~100 s) — an outer kill mid-rung would cost the JSON line AND the
+    # bank replay.
+    ("bench-full", [sys.executable, "bench.py", "--rung-timeout", "900"], 3900),
+    # every guard-armed step gets an outer deadline ABOVE its in-process
+    # wedge-guard budget (default 1500/1800/2100 s), so on a wedge the
+    # guard's clean in-process report wins the race with the killpg
+    ("profile-flagship", [sys.executable, "scripts/profile_flagship.py"], 1700),
     ("perf-kernels-full",
      [sys.executable, "scripts/perf_kernels.py", "--full",
       "--markdown", "docs/PERF.md"], 2400),
     ("bench-families-full",
      [sys.executable, "scripts/bench_families.py",
       "--markdown", "docs/PERF.md"], 2400),
-    ("ab-detect-knobs", [sys.executable, "scripts/ab_detect_knobs.py"], 1500),
-    ("ab-channel-pad", [sys.executable, "scripts/ab_channel_pad.py"], 1800),
+    ("ab-detect-knobs", [sys.executable, "scripts/ab_detect_knobs.py"], 1700),
+    ("ab-channel-pad", [sys.executable, "scripts/ab_channel_pad.py"], 2000),
     ("cli-mfdetect-on-tpu",
      [sys.executable, "-m", "das4whales_tpu", "mfdetect",
       "--outdir", "/tmp/out_tpu_mfdetect"], 1200),
