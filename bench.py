@@ -683,6 +683,9 @@ def main():
             # worst mixed-radix case; this rung answers the pow2-pad
             # question IN the headline path and the selection below keeps
             # whichever canonical rung is faster
+            # (keeps the stage table: if this A/B rung wins it becomes the
+            # headline, and a headline without stage fractions would blind
+            # the roofline tracking)
             ("full-chpad-pow2", full_shape,
              {"channel_tile": "auto", "channel_pad": 32768}, True, set()),
             ("full-tile-1024", full_shape,

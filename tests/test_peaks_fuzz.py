@@ -60,3 +60,18 @@ def test_find_peaks_sparse_matches_scipy_fuzz():
         assert not bool(np.asarray(res.saturated).any())
         got = res.positions[0][np.asarray(res.selected[0])]
         np.testing.assert_array_equal(np.asarray(got), want, err_msg=f"signal {k}")
+
+
+def test_pack_method_matches_scipy_fuzz():
+    """The sort-free pack kernel under the same plateau-heavy fuzz: equal
+    to scipy (and hence to the topk kernel) whenever capacity suffices."""
+    for k, x in _signals():
+        env = np.abs(x)
+        thr = float(np.quantile(env, 0.7)) + 1e-3
+        want = sp.find_peaks(env, prominence=thr)[0]
+        res = peak_ops.find_peaks_sparse(
+            jnp.asarray(env)[None], thr, max_peaks=env.shape[0], method="pack"
+        )
+        assert not bool(np.asarray(res.saturated).any())
+        got = res.positions[0][np.asarray(res.selected[0])]
+        np.testing.assert_array_equal(np.asarray(got), want, err_msg=f"signal {k}")
