@@ -70,7 +70,22 @@ def _inline(text: str) -> str:
     text = re.sub(r"`([^`]+)`", r"<code>\1</code>", text)
     text = re.sub(r"\*\*([^*]+)\*\*", r"<strong>\1</strong>", text)
     text = re.sub(r"(?<!\*)\*([^*\s][^*]*)\*(?!\*)", r"<em>\1</em>", text)
-    text = re.sub(r"\[([^\]]+)\]\(([^)]+)\)", r'<a href="\2">\1</a>', text)
+    # images BEFORE links (the link regex would otherwise eat the
+    # `[alt](src)` tail of `![alt](src)` — the gallery page is all images)
+    text = re.sub(
+        r"!\[([^\]]*)\]\(([^)]+)\)",
+        r'<img src="\2" alt="\1" style="max-width:100%">', text,
+    )
+
+    def _link(m):
+        label, target = m.group(1), m.group(2)
+        # relative .md links (with or without #anchor) point at their
+        # rendered page in the built site
+        if "://" not in target:
+            target = re.sub(r"\.md(?=#|$)", ".html", target)
+        return f'<a href="{target}">{label}</a>'
+
+    text = re.sub(r"\[([^\]]+)\]\(([^)]+)\)", _link, text)
     return text
 
 
@@ -240,18 +255,45 @@ def main():
     os.makedirs(os.path.join(out, "api"), exist_ok=True)
 
     # guide pages
+    def render_page(src, dst, title, crumbs):
+        """ONE render pipeline for every markdown page (guides + gallery):
+        a converter or template change can never fork between them."""
+        with open(src) as fh:
+            body = md_to_html(fh.read())
+        with open(dst, "w") as fh:
+            fh.write(page(title, body, crumbs=crumbs))
+
     docs_dir = os.path.join(root, "docs")
     guides = []
     for fname in sorted(os.listdir(docs_dir)):
         if not fname.endswith(".md"):
             continue
         name = fname[:-3]
-        with open(os.path.join(docs_dir, fname)) as fh:
-            body = md_to_html(fh.read())
-        with open(os.path.join(out, f"{name}.html"), "w") as fh:
-            fh.write(page(name, body, crumbs="<a href='index.html'>index</a>"))
+        render_page(os.path.join(docs_dir, fname),
+                    os.path.join(out, f"{name}.html"),
+                    title=name, crumbs="<a href='index.html'>index</a>")
         guides.append(name)
         print(f"  guide {name}.html")
+
+    # executed example gallery (docs/gallery/): figures copied as-is, its
+    # README rendered through the same pipeline as the guides (relative
+    # .md links everywhere are rewritten to the rendered pages)
+    gallery_src = os.path.join(docs_dir, "gallery")
+    if os.path.isdir(gallery_src):
+        import shutil
+
+        gallery_out = os.path.join(out, "gallery")
+        os.makedirs(gallery_out, exist_ok=True)
+        for fname in sorted(os.listdir(gallery_src)):
+            src = os.path.join(gallery_src, fname)
+            if fname.endswith(".md"):
+                render_page(src, os.path.join(gallery_out, fname[:-3] + ".html"),
+                            title="gallery",
+                            crumbs="<a href='../index.html'>index</a>")
+            else:
+                shutil.copy2(src, os.path.join(gallery_out, fname))
+        guides.append("gallery/README")
+        print("  guide gallery/README.html (+ figures)")
 
     # API pages
     api_entries = []

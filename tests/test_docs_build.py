@@ -39,3 +39,13 @@ def test_docs_build(tmp_path):
     # one page per module, none silently skipped
     api_pages = list((out / "api").iterdir())
     assert len(api_pages) >= 45, len(api_pages)
+
+    # the executed gallery renders with INLINE images (the md converter
+    # must treat ![alt](src) as <img>, not as a '!'-prefixed link) and
+    # the tutorial's relative .md link points at the rendered page
+    gal = (out / "gallery" / "README.html").read_text()
+    assert gal.count("<img ") >= 10
+    assert '<img src="mf_detection.png"' in gal
+    assert (out / "gallery" / "mf_detection.png").exists()
+    tut = (out / "TUTORIAL.html").read_text()
+    assert 'href="gallery/README.html"' in tut
