@@ -403,16 +403,15 @@ def mf_detect_picks_program(
     saturated at ``max_peaks`` (caller escalates K, exactly like
     ``ops.peaks.picks_with_escalation``).
     """
-    from ..ops.filters import _fft_zero_phase_jit
-
     C = trace.shape[0]
     nT = templates_true.shape[0]
-    x = _fft_zero_phase_jit(trace, bp_gain, bp_padlen) if staged_bp else trace
-    if pad_rows:
-        x = jnp.pad(x, ((0, pad_rows), (0, 0)))
-    trf = fk_ops.fk_filter_apply_rfft_banded(x, mask_band, band_lo, band_hi)
-    if pad_rows:
-        trf = trf[:C]
+    # THE filter graphs (inlined under this jit): identical construction
+    # to the standalone filter programs, so the routes cannot drift
+    if staged_bp:
+        trf = mf_filter_only(trace, mask_band, bp_gain, band_lo, band_hi,
+                             bp_padlen, pad_rows)
+    else:
+        trf = mf_filter_fused(trace, mask_band, band_lo, band_hi, pad_rows)
 
     def resolve_thr(gmax):
         if use_threshold:

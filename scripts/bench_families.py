@@ -34,23 +34,9 @@ sys.path.insert(0, ROOT)
 
 FS, DX = 200.0, 2.042
 
-
-def _make_block(nx, ns, fs=FS, seed=0):
-    rng = np.random.default_rng(seed)
-    block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
-    t = np.arange(0, 0.68, 1 / fs)
-    f0, f1 = 28.8, 17.8
-    sing = -f1 * 0.68 / (f0 - f1)
-    chirp = (
-        np.cos(2 * np.pi * (-sing * f0) * np.log(np.abs(1 - t / sing)))
-        * np.hanning(len(t))
-    ).astype(np.float32)
-    for k in range(6):
-        ch = (k + 1) * nx // 8
-        onset = int((4 + 8 * k) * fs)
-        if onset + len(chirp) < ns:
-            block[ch, onset : onset + len(chirp)] += 5e-9 * chirp
-    return block
+# the bench's own scene builder: identical blocks keep per-family walls
+# comparable with the flagship headline
+from bench import _make_block  # noqa: E402
 
 
 def _timed(fn, repeats=2):
@@ -152,7 +138,7 @@ def main():
     meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
     skip = {s.strip() for s in args.skip.split(",") if s.strip()}
 
-    block = _make_block(nx, ns)
+    block = _make_block(nx, ns, FS, DX)
     # slab-staged transfer (same discipline as bench.py: one ~1 GB RPC is
     # a suspected tunnel-wedge trigger)
     slab = 4096
