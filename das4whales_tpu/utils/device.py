@@ -63,6 +63,17 @@ def force_cpu_host_devices(n_devices: int) -> None:
         flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
     else:
         flags = (flags + " " + flag).strip()
+    # N virtual devices share ONE core, so a device thread can
+    # legitimately take minutes of serialized compute between
+    # collectives; XLA's CPU rendezvous would hard-abort the process
+    # after 40 s ("Termination timeout ... Exiting to ensure a
+    # consistent program state" — observed killing the canonical-shape
+    # long-record certification). Raise both rendezvous timeouts for
+    # every virtual-mesh run; real multi-host backends are unaffected.
+    for tflag in ("--xla_cpu_collective_call_warn_stuck_timeout_seconds=120",
+                  "--xla_cpu_collective_call_terminate_timeout_seconds=1200"):
+        if tflag.split("=")[0] not in flags:
+            flags = (flags + " " + tflag).strip()
     os.environ["XLA_FLAGS"] = flags
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")

@@ -26,8 +26,9 @@ the one remaining modeled constant — it is hardware spec, not workload.
 Writes ``artifacts/multichip_derivation.json`` and (with ``--markdown``)
 a PERF.md section.
 
-Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-       python scripts/derive_multichip.py [--quick] [--markdown docs/PERF.md]
+Usage: python scripts/derive_multichip.py [--quick] [--markdown docs/PERF.md]
+(self-configures the 8-virtual-device CPU mesh via
+utils.device.force_cpu_host_devices — no XLA_FLAGS prefix needed)
 """
 
 from __future__ import annotations
@@ -45,13 +46,13 @@ import numpy as np
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-os.environ.setdefault("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
-    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+# the shared virtual-mesh setup (device count + raised CPU collective
+# rendezvous timeouts + in-process CPU forcing — tpu-tunnel-discipline)
+from das4whales_tpu.utils.device import force_cpu_host_devices  # noqa: E402
+
+force_cpu_host_devices(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")  # tpu-tunnel-discipline: in-process
 
 import jax.numpy as jnp  # noqa: E402
 

@@ -8,18 +8,22 @@ float32/bfloat16 on real TPU hardware.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ONE owner for the virtual-mesh policy (device count, raised CPU
+# collective rendezvous timeouts, JAX_PLATFORMS env + live-config forcing
+# — this image's sitecustomize registers a TPU backend at interpreter
+# start, so the env var alone is too late): utils/device.py. device.py
+# imports only stdlib at module top, so this is safe before any backend
+# use.
+from das4whales_tpu.utils.device import force_cpu_host_devices
+
+force_cpu_host_devices(8)
 
 import jax
 
-# This image's sitecustomize imports jax and registers a TPU backend at
-# interpreter start, so the env var alone is too late — force the platform
-# through the live config as well (must happen before first backend use).
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np
