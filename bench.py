@@ -353,6 +353,14 @@ def bench_stages(det, x, repeats=3):
         ]
 
     stages = {}
+    # bare dispatch+sync round trip (tiny op, best-of-N): every stage wall
+    # below includes ONE of these — through the axon tunnel it is a
+    # substantial constant (the round-4 correlate stage measured 0.28 s
+    # against a 6.5 ms roofline bound, i.e. ~0.27 s of pure sync), so the
+    # payload carries it for stage-wall interpretation
+    one = jnp.ones((8,), x.dtype)
+    stages["sync_overhead"], _ = timed(jax.jit(lambda a: a + 1.0), one)
+
     # the detector's own filter program (covers the staged, fused-bandpass
     # and channel-padded routes uniformly)
     stages["filter"], trf = timed(det.filter_block, x)
