@@ -1,0 +1,35 @@
+"""das4whales_tpu.analysis — JAX/TPU hazard analysis for this codebase.
+
+Two halves, one invariant ("compiled once, on device, in the intended
+dtype" — docs/STATIC_ANALYSIS.md):
+
+* **Static** (:mod:`.rules`, :mod:`.baseline`): an AST linter with rules
+  R1–R5 over the repo's JAX idioms, gated against a checked-in
+  ``baseline.toml``. CLI: ``python -m das4whales_tpu.analysis``.
+* **Runtime** (:mod:`.runtime`, :mod:`.pytest_plugin`): a compile-count
+  guard over hot entry points, wired into tier-1 via the
+  ``compile_guard`` fixture.
+
+This module stays importable without a working JAX backend (the static
+half is pure stdlib); :mod:`.runtime` touches ``jax.monitoring`` only on
+first use.
+"""
+
+from .baseline import apply as apply_baseline  # noqa: F401
+from .baseline import dump as dump_baseline  # noqa: F401
+from .baseline import load as load_baseline  # noqa: F401
+from .rules import (  # noqa: F401
+    ALL_RULES,
+    FLOAT64_DESIGN_ALLOWLIST,
+    Finding,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    canonical_path,
+    iter_python_files,
+)
+
+import os as _os
+
+#: The shipped baseline, package-relative: the gate's default ledger.
+DEFAULT_BASELINE = _os.path.join(_os.path.dirname(__file__), "baseline.toml")

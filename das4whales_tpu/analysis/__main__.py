@@ -1,0 +1,123 @@
+"""daslint CLI: ``python -m das4whales_tpu.analysis [paths ...]``.
+
+Exit codes: 0 clean (every finding baselined or none), 1 findings above
+the baseline, 2 usage/baseline errors. Findings print as
+``path:line:col: RULE[code] message (in symbol)`` — editor/CI clickable.
+
+Examples::
+
+    python -m das4whales_tpu.analysis                    # lint the package
+    python -m das4whales_tpu.analysis das4whales_tpu/ops # one subtree
+    python -m das4whales_tpu.analysis --rules R2 scratch.py
+    python -m das4whales_tpu.analysis --write-baseline   # regenerate ledger
+    python -m das4whales_tpu.analysis --check            # CI/lint entry
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import DEFAULT_BASELINE, baseline as baseline_mod
+from .rules import ALL_RULES, analyze_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m das4whales_tpu.analysis",
+        description="daslint: JAX/TPU hazard analyzer (rules R1-R5; see "
+                    "docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to analyze (default: the "
+                         "installed das4whales_tpu package)")
+    ap.add_argument("--rules", default=",".join(ALL_RULES),
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current findings "
+                         "(preserves reasons of persisting entries) and exit 0")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--check", action="store_true",
+                    help="lint-gate mode (the default behavior, spelled "
+                         "explicitly for CI entry points); also prints a "
+                         "summary line")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = tuple(r.strip().upper() for r in args.rules.split(",") if r.strip())
+    bad = [r for r in rules if r not in ALL_RULES]
+    if bad:
+        print(f"unknown rule(s): {', '.join(bad)} (have {', '.join(ALL_RULES)})",
+              file=sys.stderr)
+        return 2
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    findings = analyze_paths(paths, rules)
+    syntax_errors = [f for f in findings if f.rule == "E0"]
+    findings = [f for f in findings if f.rule != "E0"]
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.write_baseline:
+        # regeneration only replaces what this invocation actually scanned
+        # — entries for unscanned files or unselected rules are carried
+        # over, so a partial `--rules`/path run cannot wipe the ledger
+        merged = list(findings)
+        reasons = {}
+        if os.path.exists(baseline_path):
+            from .rules import canonical_path, iter_python_files
+            scanned = {canonical_path(p) for p in iter_python_files(paths)}
+            try:
+                with open(baseline_path, "r", encoding="utf-8") as fh:
+                    entries = baseline_mod.parse(fh.read())
+            except baseline_mod.BaselineError as exc:
+                print(f"daslint: {exc}", file=sys.stderr)
+                return 2
+            kept = [e for e in entries
+                    if str(e.get("path")) not in scanned
+                    or str(e.get("rule")) not in rules]
+            carried, reasons = baseline_mod.entries_as_findings(kept)
+            merged += carried
+            reasons.update(baseline_mod.reasons_of(baseline_path))
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            fh.write(baseline_mod.dump(merged, reasons))
+        print(f"wrote {baseline_path} ({len(merged)} findings baselined)",
+              file=sys.stderr)
+        return 0
+
+    if args.no_baseline or not os.path.exists(baseline_path):
+        new, suppressed = findings, []
+        new = sorted(new, key=lambda f: (f.path, f.line, f.col))
+    else:
+        try:
+            bl = baseline_mod.load(baseline_path)
+        except baseline_mod.BaselineError as exc:
+            print(f"daslint: {exc}", file=sys.stderr)
+            return 2
+        new, suppressed = baseline_mod.apply(findings, bl)
+
+    new = syntax_errors + new
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in new],
+            "suppressed": len(suppressed),
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.format())
+    if args.check or not args.as_json:
+        print(f"daslint: {len(new)} finding(s), {len(suppressed)} baselined, "
+              f"rules {','.join(rules)}", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

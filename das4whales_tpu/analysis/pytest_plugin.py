@@ -1,0 +1,36 @@
+"""Pytest plugin: the ``compile_guard`` fixture.
+
+Loaded by importing :data:`compile_guard` in tests/conftest.py (or via
+``pytest_plugins = ("das4whales_tpu.analysis.pytest_plugin",)`` from a
+rootdir conftest). The fixture wraps :mod:`analysis.runtime` so tier-1
+tests can pin a compile-count ceiling on hot entry points — a retrace
+introduced by a future PR fails the suite instead of silently multiplying
+the wall time.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from . import runtime
+
+
+class CompileGuard:
+    """Bound helper handed to tests: ceilings + raw counter access."""
+
+    max_compiles = staticmethod(runtime.max_compiles)
+    forbid_recompile = staticmethod(runtime.forbid_recompile)
+    count_compiles = staticmethod(runtime.count_compiles)
+
+    @property
+    def count(self) -> int:
+        return runtime.compile_count()
+
+
+@pytest.fixture
+def compile_guard() -> CompileGuard:
+    """Compile-count guard: ``with compile_guard.max_compiles(1, what=...):``
+    around two same-shape invocations of a hot entry point asserts the
+    no-retrace contract."""
+    runtime.install()
+    return CompileGuard()

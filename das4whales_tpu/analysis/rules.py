@@ -1,0 +1,651 @@
+"""daslint rule engine — AST hazard analysis for this codebase's JAX idioms.
+
+The TPU port's perf story rests on three invariants that nothing enforced
+until now: jitted programs compile once (no silent retraces), data stays on
+device inside jitted code (no host syncs), and device paths stay in the
+intended dtype (no float64 leaks past the host-side design stage). Each
+rule below encodes one of those invariants as a static check over the
+Python AST — the same failure modes TINA (arXiv:2408.16551) and the
+large-scale DFT work (arXiv:2002.03260) identify as the difference between
+accelerator-rate and host-rate DSP.
+
+Rule catalog (see docs/STATIC_ANALYSIS.md for the long-form contract):
+
+R1  host-sync leaks — ``float()``/``int()``/``bool()``/``.item()``/
+    ``.tolist()``/``np.asarray()`` applied to tracer-reachable values
+    inside a jit-decorated function. Parameters named in
+    ``static_argnums``/``static_argnames`` are Python values, not tracers,
+    and are exempt, as are shape/dtype/ndim/size attribute reads.
+R2  retrace hazards — ``jax.jit`` (or ``functools.partial(jax.jit, ...)``)
+    constructed inside a function body (a fresh function object per call is
+    a guaranteed cache miss) or inside a loop, plus array-valued
+    ``static_argnums``/``static_argnames`` specs (unhashable statics fail
+    or retrace per call). Factories whose construction is cached by
+    ``functools.lru_cache``/``functools.cache`` are exempt — that is this
+    repo's blessed factory idiom (``parallel/fft.py``,
+    ``parallel/timeshard.py``).
+R3  dtype drift — explicit float64 references (``np.float64``,
+    ``jnp.float64``, ``np.double``, ``dtype="float64"``) in the device-path
+    packages (``ops/``, ``parallel/``, ``models/``). Host-side filter
+    *design* in float64 is the documented contract of ``ops/fk.py`` and
+    ``ops/filters.py`` (design-once / apply-many) and stays allowed via
+    :data:`FLOAT64_DESIGN_ALLOWLIST`.
+R4  ``np.`` calls on tracer-reachable arguments inside jitted functions —
+    a silent device→host→device round trip on every call.
+R5  donation audit — jitted entry points in ``parallel/`` and
+    ``workflows/`` built without ``donate_argnums``/``donate_argnames``.
+    Large-buffer steps that cannot donate (parity paths reuse their
+    inputs) are recorded in ``analysis/baseline.toml`` with a reason.
+
+Suppression: an inline ``# daslint: allow[R2]`` (comma list, or
+``daslint: ignore`` for all rules) on the finding's line or the line above
+suppresses it at the source; ``baseline.toml`` suppresses known findings
+without touching the code. Both are deliberate, reviewable artifacts.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import PurePosixPath
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
+
+#: (path suffix, function name or "*") pairs where explicit float64 is the
+#: documented host-side design contract (masks and filter coefficients are
+#: designed in float64 numpy once, applied on device in the data dtype).
+FLOAT64_DESIGN_ALLOWLIST: Tuple[Tuple[str, str], ...] = (
+    ("das4whales_tpu/ops/fk.py", "*"),
+    ("das4whales_tpu/ops/filters.py", "*"),
+)
+
+#: Attribute reads that yield Python metadata, not device values — a
+#: tracer's ``.shape`` is a static tuple, so ``float(x.shape[0])`` is host
+#: arithmetic, not a sync.
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "itemsize"})
+
+#: Builtin casts that force a device→host transfer when fed a tracer-backed
+#: value (on concrete arrays they block; under trace they raise — either
+#: way the call site is wrong).
+_SYNC_CASTS = frozenset({"float", "int", "bool", "complex"})
+
+#: Method calls that synchronize (``.item``) or materialize on host
+#: (``.tolist``).
+_SYNC_METHODS = frozenset({"item", "tolist"})
+
+#: Path components whose files are device-path scoped for R3.
+_R3_SCOPE = frozenset({"ops", "parallel", "models"})
+
+#: Path components scoped for the R5 donation audit.
+_R5_SCOPE = frozenset({"parallel", "workflows"})
+
+_ALLOW_RE = re.compile(r"daslint:\s*(?:allow\[([A-Za-z0-9,\s]+)\]|ignore)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One hazard at one source location."""
+
+    rule: str      # "R1".."R5"
+    code: str      # stable slug, e.g. "host-sync-cast"
+    path: str      # canonical repo-relative posix path
+    line: int      # 1-indexed
+    col: int       # 0-indexed
+    symbol: str    # enclosing function chain ("a.b") or "<module>"
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: stable across line-number churn."""
+        return (self.rule, self.path, self.symbol)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"{self.rule}[{self.code}] {self.message} (in {self.symbol})")
+
+
+def canonical_path(path: str) -> str:
+    """Normalize to a repo-anchored posix path: everything from the LAST
+    ``das4whales_tpu`` component on, so baseline entries match regardless
+    of the directory the analyzer was invoked from — including a repo
+    checked out into a directory itself named ``das4whales_tpu``."""
+    parts = PurePosixPath(str(path).replace("\\", "/")).parts
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == "das4whales_tpu":
+            return str(PurePosixPath(*parts[i:]))
+    return str(PurePosixPath(*parts))
+
+
+def _in_scope(path: str, scope: frozenset) -> bool:
+    return any(part in scope for part in PurePosixPath(path).parts[:-1])
+
+
+class _Imports:
+    """Alias resolution: maps local names to dotted module paths so the
+    rules recognize ``np``/``jnp``/``jit``/``partial`` however the file
+    spelled its imports."""
+
+    def __init__(self, tree: ast.AST):
+        self.modules = {}   # local name -> dotted module ("np" -> "numpy")
+        self.names = {}     # local name -> dotted object ("jit" -> "jax.jit")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.modules[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    self.names[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted path of a Name/Attribute chain, aliases applied."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = node.id
+        if head in self.names:
+            base = self.names[head]
+        elif head in self.modules:
+            base = self.modules[head]
+        else:
+            base = head
+        return ".".join([base] + list(reversed(parts)))
+
+
+def _is_jit(imports: _Imports, node: ast.AST) -> bool:
+    """True for the expression ``jax.jit`` (however aliased)."""
+    return imports.resolve(node) == "jax.jit"
+
+
+def _jit_call_info(imports: _Imports, call: ast.Call):
+    """If ``call`` constructs a jitted function — ``jax.jit(...)`` or
+    ``functools.partial(jax.jit, ...)`` — return its keyword list, else
+    None."""
+    if _is_jit(imports, call.func):
+        return call.keywords
+    if imports.resolve(call.func) in ("functools.partial", "partial"):
+        if call.args and _is_jit(imports, call.args[0]):
+            return call.keywords
+    return None
+
+
+def _decorator_jit(imports: _Imports, fn: ast.FunctionDef):
+    """``(keywords, decorator node)`` of a jit decorator on ``fn``, or
+    ``(None, None)`` if not jitted. Handles ``@jax.jit``, ``@jit``,
+    ``@functools.partial(jax.jit, ...)``."""
+    for dec in fn.decorator_list:
+        if _is_jit(imports, dec):
+            return [], dec
+        if isinstance(dec, ast.Call):
+            kws = _jit_call_info(imports, dec)
+            if kws is not None:
+                return kws, dec
+    return None, None
+
+
+def _decorator_jit_keywords(imports: _Imports, fn: ast.FunctionDef):
+    return _decorator_jit(imports, fn)[0]
+
+
+def _is_cached_factory(imports: _Imports, fn: ast.FunctionDef) -> bool:
+    """Functions decorated with functools.lru_cache/functools.cache build
+    their jitted program once per distinct config — the repo's blessed
+    factory idiom, exempt from R2's in-function-body check."""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if imports.resolve(target) in ("functools.lru_cache", "functools.cache",
+                                       "lru_cache", "cache"):
+            return True
+    return False
+
+
+def _static_param_names(fn: ast.FunctionDef, keywords) -> Set[str]:
+    """Parameter names declared static in a jit decorator's
+    static_argnums/static_argnames."""
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: Set[str] = set()
+    for kw in keywords or []:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    static.add(node.value)
+        elif kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                    if 0 <= node.value < len(pos):
+                        static.add(pos[node.value])
+    return static
+
+
+def _expr_tainted(node: ast.AST, taint: Set[str]) -> bool:
+    """Does this expression reach a tracer-typed value? Shape/dtype reads
+    and ``len()`` yield Python metadata and cut the taint."""
+    if isinstance(node, ast.Name):
+        return node.id in taint
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _expr_tainted(node.value, taint)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id == "len":
+            return False
+        return any(_expr_tainted(a, taint) for a in node.args) or any(
+            _expr_tainted(kw.value, taint) for kw in node.keywords
+        ) or _expr_tainted(node.func, taint)
+    if isinstance(node, ast.Constant):
+        return False
+    return any(_expr_tainted(c, taint) for c in ast.iter_child_nodes(node))
+
+
+def _float64_nodes(imports: _Imports, node: ast.AST):
+    """Yield sub-nodes that explicitly reference float64: ``np.float64`` /
+    ``jnp.float64`` / ``np.double`` attributes, and the string constant
+    ``"float64"`` when passed as a dtype keyword."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("float64", "double"):
+            dotted = imports.resolve(sub)
+            if dotted in ("numpy.float64", "numpy.double", "jax.numpy.float64",
+                          "jax.numpy.double"):
+                yield sub
+        elif isinstance(sub, ast.keyword) and sub.arg == "dtype":
+            v = sub.value
+            if isinstance(v, ast.Constant) and v.value == "float64":
+                yield v
+
+
+class _Analyzer(ast.NodeVisitor):
+    def __init__(self, path: str, source_lines: Sequence[str],
+                 rules: Sequence[str]):
+        self.path = path
+        self.lines = source_lines
+        self.rules = set(rules)
+        self.findings: List[Finding] = []
+        self.imports: _Imports = None  # set in run()
+        self._fn_stack: List[ast.FunctionDef] = []
+        self._loop_depth = 0
+        # (fn node, static names, taint set) for the innermost jit scope
+        self._jit_stack: List[Tuple[ast.FunctionDef, Set[str], Set[str]]] = []
+
+    # -- plumbing ----------------------------------------------------------
+
+    def run(self, tree: ast.AST) -> List[Finding]:
+        self.imports = _Imports(tree)
+        self.visit(tree)
+        return [f for f in self.findings if not self._line_allowed(f)]
+
+    def _symbol(self) -> str:
+        return ".".join(f.name for f in self._fn_stack) or "<module>"
+
+    def _emit(self, rule: str, code: str, node: ast.AST, message: str):
+        if rule in self.rules:
+            self.findings.append(Finding(
+                rule=rule, code=code, path=self.path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0),
+                symbol=self._symbol(), message=message,
+            ))
+
+    def _line_allowed(self, f: Finding) -> bool:
+        for ln in (f.line, f.line - 1):
+            if not 1 <= ln <= len(self.lines):
+                continue
+            text = self.lines[ln - 1]
+            if ln != f.line and not text.lstrip().startswith("#"):
+                # a trailing allow comment licenses ONLY its own line —
+                # the line-above form must be a standalone comment, or a
+                # suppression would bleed onto the next statement
+                continue
+            m = _ALLOW_RE.search(text)
+            if m:
+                if m.group(1) is None:  # daslint: ignore
+                    return True
+                allowed = {r.strip().upper() for r in m.group(1).split(",")}
+                if f.rule in allowed:
+                    return True
+        return False
+
+    # -- structure ---------------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        jit_kws, jit_dec = _decorator_jit(self.imports, node)
+        anchor = jit_dec or node
+        in_body = bool(self._fn_stack)
+        if jit_kws is not None and in_body and "R2" in self.rules:
+            # a jit-decorated def inside a function body is a fresh
+            # program per enclosing call, same hazard as jax.jit(...)
+            if not any(_is_cached_factory(self.imports, f) for f in self._fn_stack):
+                self._emit("R2", "jit-in-function-body", anchor,
+                           f"`@jit` function `{node.name}` is constructed on "
+                           "every enclosing call — each build is a fresh "
+                           "function object and a compile-cache miss")
+        if jit_kws is not None:
+            self._check_static_spec(jit_kws, node)
+            self._check_donation(jit_kws, anchor)
+
+        self._fn_stack.append(node)
+        if jit_kws is not None:
+            params = {a.arg for a in (node.args.posonlyargs + node.args.args
+                                      + node.args.kwonlyargs)} - {"self", "cls"}
+            static = _static_param_names(node, jit_kws)
+            taint = set(params - static)
+            self._jit_stack.append((node, static, taint))
+            self._walk_jit_body(node.body, taint)
+            self._jit_stack.pop()
+        else:
+            loop_depth, self._loop_depth = self._loop_depth, 0
+            self.generic_visit(node)
+            self._loop_depth = loop_depth
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_For(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_While = visit_For
+    visit_AsyncFor = visit_For
+
+    def visit_Call(self, node: ast.Call):
+        kws = _jit_call_info(self.imports, node)
+        if kws is not None:
+            if self._loop_depth and "R2" in self.rules:
+                self._emit("R2", "jit-in-loop", node,
+                           "`jax.jit` constructed inside a loop — a fresh "
+                           "function object per iteration defeats the "
+                           "compile cache (hoist the jit out of the loop)")
+            elif self._fn_stack and "R2" in self.rules:
+                if not any(_is_cached_factory(self.imports, f)
+                           for f in self._fn_stack):
+                    self._emit("R2", "jit-in-function-body", node,
+                               "`jax.jit` constructed inside a function body "
+                               "— per-call construction is a compile-cache "
+                               "miss; hoist to module level or cache the "
+                               "factory with functools.lru_cache")
+            self._check_static_spec(kws, node)
+            self._check_donation(kws, node)
+        self.generic_visit(node)
+
+    # -- rule bodies -------------------------------------------------------
+
+    def _check_static_spec(self, keywords, anchor):
+        """R2: static_argnums/static_argnames specs that are themselves
+        arrays or unhashable containers retrace (or fail) per call."""
+        if "R2" not in self.rules:
+            return
+        for kw in keywords or []:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Call):
+                    dotted = self.imports.resolve(sub.func) or ""
+                    if dotted.startswith(("numpy.", "jax.numpy.")):
+                        self._emit("R2", "array-valued-static", kw.value,
+                                   f"{kw.arg} built from `{dotted}` — array "
+                                   "statics are unhashable and defeat the "
+                                   "jit cache")
+                        break
+                if isinstance(sub, (ast.Dict, ast.Set)):
+                    self._emit("R2", "unhashable-static", kw.value,
+                               f"{kw.arg} contains an unhashable "
+                               "container literal")
+                    break
+
+    def _check_donation(self, keywords, anchor):
+        """R5: jitted entry points in parallel/ and workflows/ should
+        either donate their large input buffers or be baselined with a
+        reason (parity paths that reuse inputs cannot donate)."""
+        if "R5" not in self.rules or not _in_scope(self.path, _R5_SCOPE):
+            return
+        kw_names = {kw.arg for kw in keywords or []}
+        if not kw_names & {"donate_argnums", "donate_argnames"}:
+            self._emit("R5", "jit-missing-donate", anchor,
+                       "jitted entry point without donate_argnums/"
+                       "donate_argnames — at canonical shapes the undonated "
+                       "input doubles peak HBM; donate, or baseline with a "
+                       "reason if callers reuse the buffer")
+
+    def _walk_jit_body(self, body, taint: Set[str]):
+        """Statement-ordered walk of a jitted function body with forward
+        taint propagation (R1/R3/R4 checks)."""
+        for stmt in body:
+            self._jit_statement(stmt, taint)
+
+    def _jit_statement(self, stmt: ast.stmt, taint: Set[str]):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a jit-decorated def nested inside a jitted body is a fresh
+            # program per trace, same hazard as jax.jit(...) in a body
+            jit_kws, jit_dec = _decorator_jit(self.imports, stmt)
+            if jit_kws is not None:
+                if "R2" in self.rules and not any(
+                        _is_cached_factory(self.imports, f)
+                        for f in self._fn_stack):
+                    self._emit("R2", "jit-in-function-body", jit_dec or stmt,
+                               f"`@jit` function `{stmt.name}` is constructed "
+                               "on every enclosing call — each build is a "
+                               "fresh function object and a compile-cache "
+                               "miss")
+                self._check_static_spec(jit_kws, stmt)
+                self._check_donation(jit_kws, jit_dec or stmt)
+            # nested defs (lax.fori/scan bodies): their params are tracers
+            inner = set(taint) | {a.arg for a in stmt.args.args}
+            self._fn_stack.append(stmt)
+            self._walk_jit_body(stmt.body, inner)
+            self._fn_stack.pop()
+            return
+        if isinstance(stmt, ast.Assign):
+            self._jit_expr(stmt.value, taint)
+            if _expr_tainted(stmt.value, taint):
+                for tgt in stmt.targets:
+                    for name in ast.walk(tgt):
+                        if isinstance(name, ast.Name):
+                            taint.add(name.id)
+            return
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self._jit_expr(stmt.value, taint)
+                if _expr_tainted(stmt.value, taint):
+                    for name in ast.walk(stmt.target):
+                        if isinstance(name, ast.Name):
+                            taint.add(name.id)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._jit_expr(stmt.iter, taint)
+            if _expr_tainted(stmt.iter, taint):
+                for name in ast.walk(stmt.target):
+                    if isinstance(name, ast.Name):
+                        taint.add(name.id)
+            self._walk_jit_body(stmt.body, taint)
+            self._walk_jit_body(stmt.orelse, taint)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._jit_expr(stmt.test, taint)
+            self._walk_jit_body(stmt.body, taint)
+            self._walk_jit_body(stmt.orelse, taint)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._jit_expr(item.context_expr, taint)
+            self._walk_jit_body(stmt.body, taint)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_jit_body(stmt.body, taint)
+            for h in stmt.handlers:
+                self._walk_jit_body(h.body, taint)
+            self._walk_jit_body(stmt.orelse, taint)
+            self._walk_jit_body(stmt.finalbody, taint)
+            return
+        # Return / Expr / Assert / Raise / Delete: check embedded exprs
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._jit_expr(child, taint)
+
+    def _jit_expr(self, expr: ast.expr, taint: Set[str]):
+        """R1/R3/R4 checks over one expression inside a jitted body."""
+        scoped_r3 = "R3" in self.rules and _in_scope(self.path, _R3_SCOPE)
+        if scoped_r3 and not self._float64_allowed():
+            for node in _float64_nodes(self.imports, expr):
+                self._emit("R3", "float64-in-device-path", node,
+                           "explicit float64 inside a jitted device path — "
+                           "design host-side and cast to the data dtype, or "
+                           "allowlist the design function")
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            kws = _jit_call_info(self.imports, node)
+            if kws is not None:
+                # jax.jit constructed inside a jitted body: a fresh program
+                # per enclosing trace (R2), plus the usual spec audits
+                if "R2" in self.rules and not any(
+                        _is_cached_factory(self.imports, f)
+                        for f in self._fn_stack):
+                    self._emit("R2", "jit-in-function-body", node,
+                               "`jax.jit` constructed inside a jitted "
+                               "function body — per-call construction is a "
+                               "compile-cache miss; hoist to module level "
+                               "or cache the factory with "
+                               "functools.lru_cache")
+                self._check_static_spec(kws, node)
+                self._check_donation(kws, node)
+            func = node.func
+            args_tainted = any(_expr_tainted(a, taint) for a in node.args)
+            if isinstance(func, ast.Name) and func.id in _SYNC_CASTS:
+                if func.id not in self.imports.names and args_tainted:
+                    self._emit("R1", "host-sync-cast", node,
+                               f"`{func.id}()` on a traced value forces a "
+                               "device→host sync (or a trace error) — use "
+                               "jnp ops and keep the value on device")
+                continue
+            if (isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS
+                    and _expr_tainted(func.value, taint)):
+                self._emit("R1", "host-sync-item", node,
+                           f"`.{func.attr}()` on a traced value "
+                           "synchronizes the device stream")
+                continue
+            dotted = self.imports.resolve(func) or ""
+            if dotted in ("numpy.asarray", "numpy.array") and args_tainted:
+                self._emit("R1", "host-transfer-np-asarray", node,
+                           f"`{dotted.replace('numpy', 'np')}` on a traced "
+                           "value copies device→host — use jnp.asarray")
+            elif dotted.startswith("numpy.") and args_tainted:
+                self._emit("R4", "np-call-on-tracer", node,
+                           f"`{dotted.replace('numpy', 'np', 1)}` applied "
+                           "to a traced argument — a silent "
+                           "device→host→device round trip per call; use "
+                           "the jnp equivalent")
+
+    def _float64_allowed(self) -> bool:
+        for suffix, fn in FLOAT64_DESIGN_ALLOWLIST:
+            if self.path.endswith(suffix):
+                if fn == "*" or any(f.name == fn for f in self._fn_stack):
+                    return True
+        return False
+
+    def _float64_symbol_allowed(self, symbol: str) -> bool:
+        for suffix, fn in FLOAT64_DESIGN_ALLOWLIST:
+            if self.path.endswith(suffix) and fn in ("*", symbol):
+                return True
+        return False
+
+    # R3 outside jit bodies: float64 fed directly into a jnp.* call is a
+    # device upload in the wrong dtype even from host code.
+    def visit_Module(self, node):
+        self.generic_visit(node)
+        if "R3" in self.rules and _in_scope(self.path, _R3_SCOPE):
+            self._module_level_float64(node)
+
+    def _module_level_float64(self, tree: ast.Module):
+        in_jit = set()
+        fn_spans = []  # (start, end, name) of every function, innermost wins
+        for fn in ast.walk(tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_spans.append((fn.lineno, fn.end_lineno or fn.lineno, fn.name))
+                if _decorator_jit_keywords(self.imports, fn) is not None:
+                    for sub in ast.walk(fn):
+                        in_jit.add(id(sub))
+
+        def enclosing(line: int) -> str:
+            best = "<module>"
+            best_span = None
+            for start, end, name in fn_spans:
+                if start <= line <= end and (
+                        best_span is None or end - start < best_span):
+                    best, best_span = name, end - start
+            return best
+
+        for call in ast.walk(tree):
+            if id(call) in in_jit or not isinstance(call, ast.Call):
+                continue  # jit bodies already checked (with taint context)
+            dotted = self.imports.resolve(call.func) or ""
+            if not dotted.startswith(("jax.numpy.", "numpy.")):
+                continue
+            for node in _float64_nodes(self.imports, call):
+                symbol = enclosing(node.lineno)
+                if self._float64_symbol_allowed(symbol):
+                    continue
+                if dotted.startswith("jax.numpy."):
+                    code, msg = "float64-into-jnp", (
+                        f"float64 fed into `{dotted}` — the upload lands on "
+                        "device in float64; pass the data dtype explicitly")
+                else:
+                    code, msg = "float64-host-constant", (
+                        "explicit float64 host constant in a device-path "
+                        "package — consumers upload it at double width; "
+                        "design in the data dtype, or allowlist if this is "
+                        "deliberate float64 filter design")
+                self.findings.append(Finding(
+                    rule="R3", code=code, path=self.path,
+                    line=node.lineno, col=node.col_offset,
+                    symbol=symbol, message=msg,
+                ))
+
+
+def analyze_source(source: str, path: str,
+                   rules: Sequence[str] = ALL_RULES) -> List[Finding]:
+    """Analyze one file's source text. ``path`` scopes the path-sensitive
+    rules (R3/R5) and the float64 allowlist, so virtual paths work for
+    tests."""
+    cpath = canonical_path(path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding(rule="E0", code="syntax-error", path=cpath,
+                        line=exc.lineno or 1, col=(exc.offset or 1) - 1,
+                        symbol="<module>", message=f"cannot parse: {exc.msg}")]
+    analyzer = _Analyzer(cpath, source.splitlines(), rules)
+    return analyzer.run(tree)
+
+
+def analyze_file(path, rules: Sequence[str] = ALL_RULES) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return analyze_source(fh.read(), str(path), rules)
+
+
+def iter_python_files(paths: Iterable[str]):
+    """Expand files/directories into .py files, deterministic order."""
+    import os
+
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            yield p
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Sequence[str] = ALL_RULES) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(analyze_file(f, rules))
+    return findings

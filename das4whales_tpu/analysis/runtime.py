@@ -1,0 +1,102 @@
+"""Runtime complement to the static pass: a compile-count guard.
+
+The static rules catch hazards by shape; this guard catches the retraces
+they cannot see (shape-churned inputs, weak-type flips, new non-static
+Python arguments) by counting actual XLA backend compiles via
+``jax.monitoring``'s ``/jax/core/compile/backend_compile_duration`` event.
+A hot entry point called twice with same-shape inputs must compile at most
+once; a second compile IS a retrace and fails tier-1 through the
+``compile_guard`` pytest fixture (analysis/pytest_plugin.py).
+
+Usage::
+
+    from das4whales_tpu.analysis.runtime import max_compiles
+
+    with max_compiles(1, what="fk_filter_apply"):
+        fk_filter_apply(trace, mask)
+        fk_filter_apply(trace, mask)   # same shapes: no second compile
+
+The listener registers once per process and is never unregistered
+(``jax.monitoring`` only offers global clearing, which would drop other
+subscribers); an inactive listener is one integer increment per compile.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_compile_count = 0
+
+
+class RecompileError(AssertionError):
+    """A guarded region compiled more XLA programs than its ceiling."""
+
+
+def _listener(event: str, duration: float, **_kwargs) -> None:
+    global _compile_count
+    if event == _COMPILE_EVENT:
+        _compile_count += 1
+
+
+def install() -> None:
+    """Idempotently register the compile-count listener."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_listener)
+        _installed = True
+
+
+def compile_count() -> int:
+    """Total XLA backend compiles observed since :func:`install`."""
+    install()
+    return _compile_count
+
+
+@contextlib.contextmanager
+def max_compiles(ceiling: int, what: str = "guarded region"):
+    """Fail with :class:`RecompileError` if the block triggers more than
+    ``ceiling`` XLA backend compiles. ``ceiling=0`` after a warm-up call is
+    the no-retrace contract; ``ceiling=1`` over two same-shape invocations
+    is the cold-start form the tier-1 gate asserts."""
+    install()
+    start = _compile_count
+    yield
+    compiled = _compile_count - start
+    if compiled > ceiling:
+        raise RecompileError(
+            f"{what}: {compiled} XLA compiles, ceiling {ceiling} — a jitted "
+            "path is retracing (shape/dtype churn, a fresh jit wrapper per "
+            "call, or a non-static Python argument). See "
+            "docs/STATIC_ANALYSIS.md#recompile-guard."
+        )
+
+
+@contextlib.contextmanager
+def forbid_recompile(what: str = "guarded region"):
+    """``max_compiles(0)``: the steady-state contract for warmed entry
+    points."""
+    with max_compiles(0, what=what):
+        yield
+
+
+def count_compiles(fn, *args, **kwargs):
+    """Run ``fn(*args, **kwargs)``, returning ``(result, n_compiles)``."""
+    install()
+    start = _compile_count
+    result = fn(*args, **kwargs)
+    try:
+        import jax
+
+        jax.block_until_ready(result)
+    except Exception:
+        pass  # non-array results (dicts of host values, None)
+    return result, _compile_count - start

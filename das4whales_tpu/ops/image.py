@@ -179,7 +179,10 @@ _DIAG5 = np.array(
         [-1, -1, -1, 0, 1],
         [-1, -1, -1, -1, 0],
     ],
-    dtype=np.float64,
+    # host-side design constant, cast to the image dtype at use; float64
+    # keeps float64 scipy/golden references exact (a float32 constant makes
+    # the reference's kernel FFT run at complex64)
+    dtype=np.float64,  # daslint: allow[R3] deliberate float64 design constant
 )
 
 

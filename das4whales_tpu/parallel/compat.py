@@ -1,0 +1,45 @@
+"""JAX version compatibility for the parallel package.
+
+``shard_map`` graduated from ``jax.experimental`` to the top-level
+namespace (and its replication-check keyword was renamed ``check_rep`` →
+``check_vma``) across the jax versions this repo meets in the wild. All
+parallel modules import it from here and write the NEW spelling; on older
+jax the adapter maps the keyword back.
+"""
+
+from __future__ import annotations
+
+import inspect as _inspect
+
+try:  # public API (top-level since ~0.5; keyword renamed later)
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x: experimental API only
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _takes_check_vma(fn) -> bool:
+    # the import location and the keyword rename shipped in different jax
+    # releases, so probe the signature rather than keying on the import
+    try:
+        return "check_vma" in _inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # unintrospectable: assume current API
+        return True
+
+
+if _takes_check_vma(_shard_map):
+    shard_map = _shard_map
+else:
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma, **kwargs)
+
+try:  # jax >= 0.6
+    from jax.lax import axis_size  # type: ignore[attr-defined]
+except ImportError:
+    from jax import lax as _lax
+
+    def axis_size(axis_name) -> int:
+        # the classic idiom: psum of the Python int 1 over a mapped axis
+        # constant-folds to the axis size as a Python int, so shard_map
+        # bodies can keep using it in static shape arithmetic
+        return _lax.psum(1, axis_name)

@@ -30,11 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
-
-
-def _axis_size(axis_name: str) -> int:
-    return jax.lax.axis_size(axis_name)
+from .compat import axis_size as _axis_size, shard_map
 
 
 # single source of truth lives beside the appliers; re-exported here for

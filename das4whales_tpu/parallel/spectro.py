@@ -21,7 +21,7 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from .compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..config import SPECTRO_HF_KERNEL, SPECTRO_LF_KERNEL, as_metadata
@@ -133,7 +133,7 @@ def make_sharded_spectro_step(
     )
     # saturated has no trailing slot axis but shares the leading layout
     out_specs = spec_picks if outputs == "picks" else (spec_corr, spec_picks)
-    return jax.jit(
+    return jax.jit(  # daslint: allow[R2] one-shot factory: campaign jits its step once per run
         shard_map(
             _shard_body, mesh=mesh, in_specs=(spec_in,), out_specs=out_specs,
             check_vma=False,
@@ -253,7 +253,7 @@ def make_sharded_spectro_step_time(
         lambda _: P(None, time_axis), peak_ops.SparsePicks(0, 0, 0, 0, 0)
     )
     out_specs = spec_picks if outputs == "picks" else (P(None, time_axis, None), spec_picks)
-    return jax.jit(
+    return jax.jit(  # daslint: allow[R2] one-shot factory: campaign jits its step once per run
         shard_map(
             _body, mesh=mesh, in_specs=(P(None, time_axis),),
             out_specs=out_specs, check_vma=False,

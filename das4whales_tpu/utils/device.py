@@ -43,6 +43,39 @@ def probe_backend(timeout_s: float) -> int:
         return 0
 
 
+#: Raised CPU rendezvous timeouts for virtual-mesh runs (see
+#: force_cpu_host_devices). Not every jaxlib build knows these flags, and
+#: XLA hard-aborts the whole process on an unknown XLA_FLAGS entry, so
+#: they are probed in a subprocess before first use.
+_CPU_TIMEOUT_FLAGS = (
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=120",
+    "--xla_cpu_collective_call_terminate_timeout_seconds=1200",
+)
+
+_TIMEOUT_FLAGS_ENV = "_DAS_XLA_CPU_TIMEOUT_FLAGS"
+
+
+def _supported_cpu_timeout_flags(timeout_s: float = 60.0) -> tuple:
+    """The subset of :data:`_CPU_TIMEOUT_FLAGS` this jaxlib accepts —
+    all or nothing, decided by one subprocess probe (cached in the
+    environment so nested subprocesses and repeat callers skip it)."""
+    cached = os.environ.get(_TIMEOUT_FLAGS_ENV)
+    if cached is not None:
+        return tuple(f for f in cached.split() if f)
+    env = dict(os.environ,
+               XLA_FLAGS=" ".join(_CPU_TIMEOUT_FLAGS), JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env, timeout=timeout_s, capture_output=True,
+        )
+        flags = _CPU_TIMEOUT_FLAGS if proc.returncode == 0 else ()
+    except subprocess.TimeoutExpired:
+        flags = ()
+    os.environ[_TIMEOUT_FLAGS_ENV] = " ".join(flags)
+    return flags
+
+
 def force_cpu_host_devices(n_devices: int) -> None:
     """Point this process at a virtual CPU mesh of AT LEAST ``n_devices``.
 
@@ -70,8 +103,9 @@ def force_cpu_host_devices(n_devices: int) -> None:
     # consistent program state" — observed killing the canonical-shape
     # long-record certification). Raise both rendezvous timeouts for
     # every virtual-mesh run; real multi-host backends are unaffected.
-    for tflag in ("--xla_cpu_collective_call_warn_stuck_timeout_seconds=120",
-                  "--xla_cpu_collective_call_terminate_timeout_seconds=1200"):
+    # Only builds that accept the flags get them — an unknown XLA_FLAGS
+    # entry is itself a hard abort at backend init.
+    for tflag in _supported_cpu_timeout_flags():
         if tflag.split("=")[0] not in flags:
             flags = (flags + " " + tflag).strip()
     os.environ["XLA_FLAGS"] = flags

@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Repo lint entry point: the daslint static gate.
+
+Equivalent to ``python -m das4whales_tpu.analysis --check`` (docs/
+STATIC_ANALYSIS.md), with JAX pinned to CPU *before* any import so the
+gate can never wedge on this image's TPU tunnel — the analysis pass
+itself is pure stdlib, but importing the package pulls in jax.
+
+Usage::
+
+    python scripts/lint.py                # gate the installed package
+    python scripts/lint.py path [...]     # gate specific files/subtrees
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from das4whales_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(["--check", *sys.argv[1:]]))

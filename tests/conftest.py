@@ -29,6 +29,11 @@ jax.config.update("jax_enable_x64", True)
 import numpy as np
 import pytest
 
+# recompile guard (tests/test_daslint.py and any hot-path test): imported
+# here rather than via pytest_plugins so the fixture is available without
+# a rootdir conftest.
+from das4whales_tpu.analysis.pytest_plugin import compile_guard  # noqa: F401
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,650 @@
+"""daslint: the static hazard gate (tier-1) + rule units + recompile guard.
+
+Three layers, mirroring das4whales_tpu/analysis:
+
+* the **gate**: the analyzer over the installed package must report zero
+  findings above ``analysis/baseline.toml`` — a new R1-R5 hazard anywhere
+  in the package fails tier-1 with a file:line message;
+* **rule units**: each rule exercised against small inline snippets via
+  ``analyze_source`` (virtual paths drive the path-scoped rules and the
+  float64 design allowlist);
+* the **recompile guard**: the ``compile_guard`` fixture pins a
+  compile-count ceiling of 1 across two same-shape invocations of each hot
+  entry point (fk filter apply, xcorr, spectrogram, gabor conv) — the
+  runtime complement that catches retraces the AST cannot see.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import das4whales_tpu
+from das4whales_tpu import analysis
+from das4whales_tpu.analysis import baseline as baseline_mod
+from das4whales_tpu.analysis import runtime
+from das4whales_tpu.analysis.__main__ import main as daslint_main
+from das4whales_tpu.ops import fk, image, spectral, xcorr
+
+PKG_DIR = os.path.dirname(os.path.abspath(das4whales_tpu.__file__))
+REPO_DIR = os.path.dirname(PKG_DIR)
+
+
+def run(source: str, path: str = "das4whales_tpu/scratch.py", rules=analysis.ALL_RULES):
+    return analysis.analyze_source(textwrap.dedent(source), path, rules)
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# The gate: package findings vs the shipped baseline
+# ---------------------------------------------------------------------------
+
+def test_gate_package_is_clean_against_baseline():
+    """Any new R1-R5 finding in das4whales_tpu/ fails tier-1 here."""
+    findings = analysis.analyze_paths([PKG_DIR])
+    syntax = [f for f in findings if f.rule == "E0"]
+    assert not syntax, "\n".join(f.format() for f in syntax)
+    bl = baseline_mod.load(analysis.DEFAULT_BASELINE)
+    new, suppressed = baseline_mod.apply(findings, bl)
+    assert not new, (
+        "daslint findings above baseline (fix, allow[] with a reason, or "
+        "re-baseline deliberately):\n" + "\n".join(f.format() for f in new)
+    )
+    # the ledger is live: it suppresses real, current findings
+    assert suppressed, "baseline no longer matches any finding — regenerate it"
+
+
+def test_gate_baseline_has_no_stale_entries():
+    """Every baselined key still matches a real finding — fixed hazards
+    must leave the ledger so the gate cannot mask their return."""
+    findings = analysis.analyze_paths([PKG_DIR])
+    live = {f.key() for f in findings}
+    bl = baseline_mod.load(analysis.DEFAULT_BASELINE)
+    stale = sorted(set(bl) - live)
+    assert not stale, f"stale baseline entries (remove or regenerate): {stale}"
+
+
+def test_cli_package_green_and_injected_hazard_red(tmp_path):
+    """The acceptance contract, via the real CLI: the package exits 0
+    against the baseline; a scratch file with a jit-in-loop exits 1 with a
+    clickable file:line finding."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, "-m", "das4whales_tpu.analysis", PKG_DIR],
+        capture_output=True, text=True, cwd=REPO_DIR, env=env,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+
+    scratch = tmp_path / "scratch_r2.py"
+    scratch.write_text(textwrap.dedent(
+        """
+        import jax
+
+        def hot(xs):
+            out = []
+            for x in xs:
+                out.append(jax.jit(lambda v: v * 2)(x))
+            return out
+        """
+    ))
+    bad = subprocess.run(
+        [sys.executable, "-m", "das4whales_tpu.analysis", str(scratch)],
+        capture_output=True, text=True, cwd=REPO_DIR, env=env,
+    )
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "scratch_r2.py:7:" in bad.stdout
+    assert "R2[jit-in-loop]" in bad.stdout
+
+
+# ---------------------------------------------------------------------------
+# R1 — host-sync leaks inside jitted functions
+# ---------------------------------------------------------------------------
+
+class TestR1HostSync:
+    def test_float_cast_on_tracer(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x.sum())
+            """
+        )
+        assert codes(fs) == ["host-sync-cast"]
+        assert fs[0].rule == "R1" and fs[0].symbol == "f"
+
+    def test_static_argument_is_exempt(self):
+        fs = run(
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def f(x, n):
+                return x * float(n)
+            """
+        )
+        assert fs == []
+
+    def test_shape_reads_are_metadata_not_syncs(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x / float(x.shape[0])
+            """
+        )
+        assert fs == []
+
+    def test_item_on_derived_value(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                y = x.max()
+                return y.item()
+            """
+        )
+        assert codes(fs) == ["host-sync-item"]
+
+    def test_np_asarray_on_tracer(self):
+        fs = run(
+            """
+            import numpy as np
+            import jax
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x)
+            """
+        )
+        assert codes(fs) == ["host-transfer-np-asarray"]
+
+
+# ---------------------------------------------------------------------------
+# R2 — retrace hazards
+# ---------------------------------------------------------------------------
+
+class TestR2Retrace:
+    def test_jit_in_loop(self):
+        fs = run(
+            """
+            import jax
+
+            def hot(xs):
+                out = []
+                for x in xs:
+                    out.append(jax.jit(lambda v: v + 1)(x))
+                return out
+            """
+        )
+        assert "jit-in-loop" in codes(fs)
+
+    def test_jit_in_function_body(self):
+        fs = run(
+            """
+            import jax
+
+            def apply(x):
+                f = jax.jit(lambda v: v + 1)
+                return f(x)
+            """
+        )
+        assert codes(fs) == ["jit-in-function-body"]
+
+    def test_cached_factory_is_the_blessed_idiom(self):
+        fs = run(
+            """
+            import functools
+            import jax
+
+            @functools.lru_cache(maxsize=None)
+            def make_step(n):
+                return jax.jit(lambda v: v * n)
+            """
+        )
+        assert fs == []
+
+    def test_jitted_def_nested_in_function_body(self):
+        fs = run(
+            """
+            import jax
+
+            def make(cfg):
+                @jax.jit
+                def step(x):
+                    return x + cfg
+                return step
+            """
+        )
+        assert codes(fs) == ["jit-in-function-body"]
+
+    def test_array_valued_static_spec(self):
+        fs = run(
+            """
+            import numpy as np
+            import jax
+
+            def g(x, k):
+                return x
+
+            f = jax.jit(g, static_argnums=np.arange(2))
+            """
+        )
+        assert "array-valued-static" in codes(fs)
+
+    def test_unhashable_static_spec(self):
+        fs = run(
+            """
+            import jax
+
+            def g(x, opts):
+                return x
+
+            f = jax.jit(g, static_argnames={"opts": True})
+            """
+        )
+        assert "unhashable-static" in codes(fs)
+
+    def test_jit_inside_jitted_body(self):
+        """R2 must not go blind inside @jax.jit functions — a jit
+        constructed there is a fresh program per enclosing trace."""
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                g = jax.jit(lambda v: v + 1)
+                return g(x)
+            """
+        )
+        assert "jit-in-function-body" in codes(fs)
+
+    def test_jitted_def_inside_jitted_body(self):
+        fs = run(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                @jax.jit
+                def g(v):
+                    return v + 1
+                return g(x)
+            """
+        )
+        assert "jit-in-function-body" in codes(fs)
+
+    def test_allow_comment_suppresses_on_line(self):
+        fs = run(
+            """
+            import jax
+
+            def apply(x):
+                f = jax.jit(lambda v: v + 1)  # daslint: allow[R2] one-shot
+                return f(x)
+            """
+        )
+        assert fs == []
+
+    def test_ignore_comment_suppresses_from_line_above(self):
+        fs = run(
+            """
+            import jax
+
+            def apply(x):
+                # daslint: ignore
+                f = jax.jit(lambda v: v + 1)
+                return f(x)
+            """
+        )
+        assert fs == []
+
+    def test_trailing_allow_does_not_bleed_to_next_line(self):
+        """A trailing allow licenses only its own line — the unannotated
+        hazard on the next line must still be reported."""
+        fs = run(
+            """
+            import jax
+
+            def apply(x):
+                f = jax.jit(lambda v: v + 1)  # daslint: allow[R2] one-shot
+                g = jax.jit(lambda v: v + 2)
+                return f(x) + g(x)
+            """
+        )
+        assert codes(fs) == ["jit-in-function-body"]
+        assert fs[0].line == 6
+
+
+# ---------------------------------------------------------------------------
+# R3 — float64 drift in device-path packages (+ design allowlist)
+# ---------------------------------------------------------------------------
+
+class TestR3DtypeDrift:
+    SRC = """
+        import numpy as np
+
+        def design():
+            return np.zeros(4, dtype=np.float64)
+        """
+
+    def test_float64_in_ops_package(self):
+        fs = run(self.SRC, path="das4whales_tpu/ops/custom.py")
+        assert codes(fs) == ["float64-host-constant"]
+        assert fs[0].rule == "R3" and fs[0].symbol == "design"
+
+    def test_fk_design_allowlist(self):
+        """Host-side float64 filter design in ops/fk.py is the documented
+        contract — same source, allowlisted path, no finding."""
+        fs = run(self.SRC, path="das4whales_tpu/ops/fk.py")
+        assert fs == []
+
+    def test_out_of_scope_package_unflagged(self):
+        fs = run(self.SRC, path="das4whales_tpu/utils/helpers.py")
+        assert fs == []
+
+    def test_dtype_string_keyword(self):
+        fs = run(
+            """
+            import numpy as np
+
+            def make():
+                return np.ones(8, dtype="float64")
+            """,
+            path="das4whales_tpu/parallel/custom.py",
+        )
+        assert codes(fs) == ["float64-host-constant"]
+
+    def test_float64_inside_jit_body(self):
+        fs = run(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return x + jnp.asarray(1.0, dtype=jnp.float64)
+            """,
+            path="das4whales_tpu/models/custom.py",
+        )
+        assert codes(fs) == ["float64-in-device-path"]
+
+
+# ---------------------------------------------------------------------------
+# R4 — np.* on traced arguments
+# ---------------------------------------------------------------------------
+
+class TestR4NumpyOnTracer:
+    def test_np_call_on_tracer(self):
+        fs = run(
+            """
+            import numpy as np
+            import jax
+
+            @jax.jit
+            def f(x):
+                return np.sum(x * 2)
+            """
+        )
+        assert codes(fs) == ["np-call-on-tracer"]
+        assert fs[0].rule == "R4"
+
+    def test_np_on_host_constant_is_fine(self):
+        fs = run(
+            """
+            import numpy as np
+            import jax
+
+            @jax.jit
+            def f(x):
+                win = np.hanning(128)
+                return x * win
+            """
+        )
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# R5 — donation audit in parallel/ and workflows/
+# ---------------------------------------------------------------------------
+
+class TestR5Donation:
+    def test_missing_donate_in_parallel(self):
+        fs = run(
+            """
+            import jax
+
+            def body(x):
+                return x
+
+            step = jax.jit(body)
+            """,
+            path="das4whales_tpu/parallel/custom.py",
+        )
+        assert codes(fs) == ["jit-missing-donate"]
+        assert fs[0].rule == "R5"
+
+    def test_donating_entry_point_is_clean(self):
+        fs = run(
+            """
+            import jax
+
+            def body(x):
+                return x
+
+            step = jax.jit(body, donate_argnums=(0,))
+            """,
+            path="das4whales_tpu/workflows/custom.py",
+        )
+        assert fs == []
+
+    def test_ops_package_out_of_scope(self):
+        fs = run(
+            """
+            import jax
+
+            def body(x):
+                return x
+
+            step = jax.jit(body)
+            """,
+            path="das4whales_tpu/ops/custom.py",
+        )
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline machinery
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def _findings(self):
+        return run(
+            """
+            import jax
+
+            def a(x):
+                return jax.jit(lambda v: v)(x)
+
+            def b(x):
+                return jax.jit(lambda v: v)(x)
+            """
+        )
+
+    def test_dump_load_apply_roundtrip(self, tmp_path):
+        fs = self._findings()
+        assert len(fs) == 2
+        path = tmp_path / "baseline.toml"
+        path.write_text(baseline_mod.dump(fs))
+        bl = baseline_mod.load(path)
+        new, suppressed = baseline_mod.apply(fs, bl)
+        assert new == [] and len(suppressed) == 2
+
+    def test_count_caps_suppression(self, tmp_path):
+        """Baselining one occurrence does not license a second in the same
+        symbol — the extra (highest-line) finding stays new."""
+        fs = self._findings()
+        path = tmp_path / "baseline.toml"
+        path.write_text(baseline_mod.dump(fs[:1]))
+        bl = baseline_mod.load(path)
+        extra = analysis.Finding(
+            rule=fs[0].rule, code=fs[0].code, path=fs[0].path,
+            line=fs[0].line + 40, col=0, symbol=fs[0].symbol, message="again",
+        )
+        new, suppressed = baseline_mod.apply([fs[0], extra, fs[1]], bl)
+        assert [f.line for f in suppressed] == [fs[0].line]
+        assert extra in new and fs[1] in new
+
+    def test_write_baseline_preserves_reasons(self, tmp_path):
+        fs = self._findings()
+        path = tmp_path / "baseline.toml"
+        key = fs[0].key()
+        path.write_text(baseline_mod.dump(fs, {key: "deliberate one-shot"}))
+        assert baseline_mod.reasons_of(path) == {key: "deliberate one-shot"}
+        # regeneration keeps the reason for the persisting key
+        path.write_text(baseline_mod.dump(fs, baseline_mod.reasons_of(path)))
+        assert 'reason = "deliberate one-shot"' in path.read_text()
+
+    def test_malformed_baseline_is_an_error(self, tmp_path):
+        path = tmp_path / "baseline.toml"
+        path.write_text("[[finding]]\nrule = [oops]\n")
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load(path)
+
+    def test_canonical_path_anchors_at_package(self):
+        assert (analysis.canonical_path("/a/b/das4whales_tpu/ops/fk.py")
+                == "das4whales_tpu/ops/fk.py")
+        assert analysis.canonical_path("scratch.py") == "scratch.py"
+        # a checkout whose directory is itself named das4whales_tpu must
+        # anchor at the package (LAST match), or every baseline key misses
+        assert (analysis.canonical_path(
+            "/home/u/das4whales_tpu/das4whales_tpu/ops/fk.py")
+            == "das4whales_tpu/ops/fk.py")
+
+
+class TestCLI:
+    def test_in_process_main_red_then_baselined_green(self, tmp_path):
+        scratch = tmp_path / "hot.py"
+        scratch.write_text(
+            "import jax\n\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n"
+        )
+        bl = tmp_path / "bl.toml"
+        assert daslint_main([str(scratch), "--baseline", str(bl)]) == 1
+        assert daslint_main([str(scratch), "--baseline", str(bl),
+                             "--write-baseline"]) == 0
+        assert daslint_main([str(scratch), "--baseline", str(bl)]) == 0
+
+    def test_write_baseline_partial_scan_keeps_out_of_scope_entries(
+            self, tmp_path):
+        """Regenerating from a narrowed scan (one file, or a rule subset)
+        must not wipe ledger entries the scan did not cover."""
+        a = tmp_path / "a.py"
+        b = tmp_path / "b.py"
+        for p in (a, b):
+            p.write_text(
+                "import jax\n\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n"
+            )
+        bl = tmp_path / "bl.toml"
+        assert daslint_main([str(a), str(b), "--baseline", str(bl),
+                             "--write-baseline"]) == 0
+        # re-scan only a.py: b.py's entry survives, the full gate stays green
+        assert daslint_main([str(a), "--baseline", str(bl),
+                             "--write-baseline"]) == 0
+        assert daslint_main([str(a), str(b), "--baseline", str(bl)]) == 0
+        # rule-subset re-scan of everything: R2 entries survive an R5-only run
+        assert daslint_main([str(a), str(b), "--rules", "R5",
+                             "--baseline", str(bl), "--write-baseline"]) == 0
+        assert daslint_main([str(a), str(b), "--baseline", str(bl)]) == 0
+
+    def test_rule_subset_and_unknown_rule(self, tmp_path):
+        scratch = tmp_path / "hot.py"
+        scratch.write_text(
+            "import jax\n\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n"
+        )
+        assert daslint_main([str(scratch), "--rules", "R5",
+                             "--no-baseline"]) == 0
+        assert daslint_main([str(scratch), "--rules", "R9"]) == 2
+
+    def test_syntax_error_is_reported_not_swallowed(self, tmp_path):
+        scratch = tmp_path / "broken.py"
+        scratch.write_text("def f(:\n")
+        assert daslint_main([str(scratch), "--no-baseline"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Recompile guard — the runtime half of the gate
+# ---------------------------------------------------------------------------
+
+class TestRecompileGuard:
+    """Each hot entry point: two same-shape invocations, at most one XLA
+    backend compile. Inputs are built (and blocked on) outside the guard so
+    only the entry point's own programs are counted."""
+
+    def _guard2(self, compile_guard, what, fn, *args):
+        with compile_guard.max_compiles(1, what=what):
+            jax.block_until_ready(fn(*args))
+            jax.block_until_ready(fn(*args))
+
+    def test_fk_filter_apply(self, compile_guard, rng):
+        trace = jnp.asarray(rng.standard_normal((16, 64)))
+        mask = jnp.asarray(rng.random((16, 64)) > 0.5, dtype=trace.dtype)
+        jax.block_until_ready((trace, mask))
+        self._guard2(compile_guard, "fk_filter_apply",
+                     fk.fk_filter_apply, trace, mask)
+
+    def test_xcorr(self, compile_guard, rng):
+        x = jnp.asarray(rng.standard_normal(128))
+        y = jnp.asarray(rng.standard_normal(128))
+        jax.block_until_ready((x, y))
+        self._guard2(compile_guard, "shift_xcorr", xcorr.shift_xcorr, x, y)
+
+    def test_spectrogram(self, compile_guard, rng):
+        wave = jnp.asarray(rng.standard_normal(512))
+        jax.block_until_ready(wave)
+        with compile_guard.max_compiles(1, what="spectrogram"):
+            for _ in range(2):
+                p, tt, ff = spectral.spectrogram(wave, fs=100.0, nfft=64)
+                jax.block_until_ready(p)
+
+    def test_gabor_conv(self, compile_guard, rng):
+        up, _down = image.gabor_filt_design(-6.0, ksize=10)
+        img = jnp.asarray(rng.standard_normal((24, 24)))
+        kernel = jnp.asarray(up, dtype=img.dtype)
+        jax.block_until_ready((img, kernel))
+        self._guard2(compile_guard, "gabor filter2d_same",
+                     image.filter2d_same, img, kernel)
+
+    def test_guard_trips_on_shape_churn(self, compile_guard):
+        f = jax.jit(lambda v: v * 2.0)
+        x8 = jnp.ones((8,))
+        x16 = jnp.ones((16,))
+        jax.block_until_ready((x8, x16))
+        with pytest.raises(runtime.RecompileError, match="retracing"):
+            with compile_guard.max_compiles(1, what="shape churn"):
+                jax.block_until_ready(f(x8))
+                jax.block_until_ready(f(x16))
+
+    def test_count_compiles_reports_cold_then_warm(self, compile_guard):
+        f = jax.jit(lambda v: v + 3.0)
+        x = jnp.ones((32,))
+        jax.block_until_ready(x)
+        _, cold = compile_guard.count_compiles(f, x)
+        _, warm = compile_guard.count_compiles(f, x)
+        assert cold >= 1
+        assert warm == 0

@@ -186,7 +186,7 @@ def test_sharded_banded_fk_matches_full(mesh8, rng):
     """Band-limited sharded f-k apply == full sharded apply within the
     taper-tail bound, carrying ~3x less collective volume."""
     import functools
-    from jax import shard_map
+    from das4whales_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
     from das4whales_tpu.parallel.fft import (
         fk_apply_local,

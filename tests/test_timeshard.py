@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from das4whales_tpu.parallel.compat import shard_map
 
 from das4whales_tpu.config import AcquisitionMetadata
 from das4whales_tpu.models.matched_filter import (
