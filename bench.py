@@ -225,36 +225,84 @@ def _replay_banked(banked: dict, suffix: str, errors=None) -> None:
     )
     if errors:
         banked["error"] = "; ".join(errors)
-    print(json.dumps(banked))
+    # Provenance IN the headline, not buried at key 20 of the payload: the
+    # r05 artifact read as a fresh measurement because the 21.65 h age and
+    # the foreign commit sat behind the metric/value pair. The metric
+    # string itself carries the replay status, and the ordered dict puts
+    # banked/banked_age_h/stale_commit right after the headline numbers.
+    stale = bool(banked.get("stale_commit"))
+    banked["metric"] = (
+        f"{banked.get('metric', '')} "
+        f"[REPLAYED BANK: {banked['banked_age_h']}h old"
+        + (f"; STALE COMMIT {banked_commit} != HEAD {head}" if stale else "")
+        + "]"
+    )
+    ordered = {
+        "metric": banked.pop("metric"),
+        "value": banked.pop("value", None),
+        "unit": banked.pop("unit", None),
+        "vs_baseline": banked.pop("vs_baseline", None),
+        "banked": True,
+        "banked_age_h": banked.get("banked_age_h"),
+        "stale_commit": stale,
+    }
+    ordered.update(banked)
+    print(json.dumps(ordered))
 
 
-def _make_block(nx, ns, fs, dx, seed=0):
-    """OOI-scale noise block with a handful of injected fin-call chirps."""
+#: raw interrogator counts -> strain for the synthetic bench blocks: the
+#: bench's narrow-wire (int16) and conditioned (float32) inputs are the
+#: SAME scene through this factor, so both wires detect identical physics
+BENCH_SCALE = 1e-12
+
+
+def _make_block(nx, ns, fs, dx, seed=0, wire="conditioned"):
+    """OOI-scale noise block with a handful of injected fin-call chirps.
+
+    ``wire="raw"`` returns int16 interrogator COUNTS (the narrow wire
+    format — half the float32 bytes over the H2D wire); ``"conditioned"``
+    returns the float32 strain those counts condition to (demean+scale by
+    ``BENCH_SCALE``), i.e. the same scene on the wide wire."""
     rng = np.random.default_rng(seed)
-    block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
+    counts = rng.normal(0.0, 1000.0, size=(nx, ns))
     t = np.arange(0, 0.68, 1 / fs)
     f0, f1 = 28.8, 17.8
     sing = -f1 * 0.68 / (f0 - f1)
-    chirp = (np.cos(2 * np.pi * (-sing * f0) * np.log(np.abs(1 - t / sing))) * np.hanning(len(t))).astype(np.float32)
+    chirp = np.cos(2 * np.pi * (-sing * f0) * np.log(np.abs(1 - t / sing))) * np.hanning(len(t))
     for k in range(6):
         ch = (k + 1) * nx // 8
         onset = int((4 + 8 * k) * fs)
         if onset + len(chirp) < ns:
-            block[ch, onset : onset + len(chirp)] += 5e-9 * chirp
-    return block
+            counts[ch, onset : onset + len(chirp)] += 5000.0 * chirp
+    counts = np.rint(counts).astype(np.int16)
+    if wire == "raw":
+        return counts
+    x = counts.astype(np.float32)
+    x -= x.mean(axis=1, keepdims=True)
+    x *= BENCH_SCALE
+    return x
 
 
 def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
-              channel_tile="auto", channel_pad=None):
+              channel_tile="auto", channel_pad=None, wire=None):
     import jax
     import jax.numpy as jnp
 
     from das4whales_tpu.config import AcquisitionMetadata
     from das4whales_tpu.models.matched_filter import MatchedFilterDetector
 
-    meta = AcquisitionMetadata(fs=fs, dx=dx, nx=nx, ns=ns)
+    # The wire format under measurement: "raw" (default) ships int16
+    # counts and conditions on device (ops/conditioning.py — halves the
+    # H2D bytes that dominated the round-4/5 unattributed wall,
+    # docs/PERF.md); DAS_BENCH_WIRE=conditioned opts back to the
+    # host-conditioned float32 wire.
+    if wire is None:
+        wire = os.environ.get("DAS_BENCH_WIRE", "raw")
+    meta = AcquisitionMetadata(fs=fs, dx=dx, nx=nx, ns=ns,
+                               scale_factor=BENCH_SCALE)
     det = MatchedFilterDetector(
         meta, [0, nx, 1], (nx, ns), peak_block=peak_block, channel_tile=channel_tile,
+        wire=wire,
         # The bench measures the framework's best production-capable
         # configuration: the fused bandpass∘f-k route (the library default
         # since round 4; golden-certified, VALIDATION.md) —
@@ -271,17 +319,30 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
         # paying 4-6 tunnel round trips per call
         keep_correlograms=os.environ.get("DAS_BENCH_KEEP_CORR", "0") == "1",
     )
-    block = _make_block(nx, ns, fs, dx)
+    block = _make_block(nx, ns, fs, dx, wire=wire)
+
     # stage the host->device transfer in channel slabs: one ~1 GB RPC is a
     # suspected trigger of the tunnel wedge (TESTLOG.md), and slab puts cost
-    # nothing on a healthy device
+    # nothing on a healthy device. Timed + synced so the payload ATTRIBUTES
+    # the transfer (stage_wall_s["h2d"]) instead of leaving it in the
+    # unattributed remainder of the wall (docs/PERF.md round-5 table).
     slab = 4096
-    if nx > slab:
-        x = jnp.concatenate(
-            [jax.device_put(block[i : i + slab]) for i in range(0, nx, slab)], axis=0
-        )
-    else:
-        x = jax.device_put(block)
+
+    def put_block():
+        if nx > slab:
+            return jnp.concatenate(
+                [jax.device_put(block[i : i + slab]) for i in range(0, nx, slab)],
+                axis=0,
+            )
+        return jax.device_put(block)
+
+    h2d_best = float("inf")
+    x = None
+    for _ in range(max(1, min(repeats, 2))):  # transfer is ~GB-scale; cap at 2
+        del x
+        t0 = time.perf_counter()
+        x = jax.block_until_ready(put_block())
+        h2d_best = min(h2d_best, time.perf_counter() - t0)
 
     def run():
         res = det(x)
@@ -298,7 +359,10 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
         res = run()
         times.append(time.perf_counter() - t0)
     n_picks = sum(int(v.shape[1]) for v in res.picks.values())
-    stages = bench_stages(det, x, repeats=repeats) if with_stages else None
+    stages = bench_stages(det, x, repeats=repeats) if with_stages else {}
+    # h2d rides in the stage table even on no-stage rungs: the acceptance
+    # contract is that the transfer is ATTRIBUTED, not inferred
+    stages = dict(stages or {}, h2d=round(h2d_best, 4))
     route = det._route()
     if route == "tiled":
         route = f"tiled(tile={det.effective_channel_tile})"
@@ -308,7 +372,12 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
         route += "+1prog"
     if det.fk_pad_rows:
         route += f"+chpad{det.design.fk_channels}"
-    return min(times), n_picks, str(jax.devices()[0]), stages, route, det.pick_mode
+    if wire == "raw":
+        route += "+rawwire"
+    wire_info = {"wire": wire, "wire_bytes": int(block.nbytes),
+                 "wire_dtype": str(block.dtype)}
+    return (min(times), n_picks, str(jax.devices()[0]), stages, route,
+            det.pick_mode, wire_info)
 
 
 def bench_stages(det, x, repeats=3):
@@ -358,7 +427,7 @@ def bench_stages(det, x, repeats=3):
     # substantial constant (the round-4 correlate stage measured 0.28 s
     # against a 6.5 ms roofline bound, i.e. ~0.27 s of pure sync), so the
     # payload carries it for stage-wall interpretation
-    one = jnp.ones((8,), x.dtype)
+    one = jnp.ones((8,), jnp.float32)  # not x.dtype: the raw wire is int16
     stages["sync_overhead"], _ = timed(jax.jit(lambda a: a + 1.0), one)
 
     # the detector's own filter program (covers the staged, fused-bandpass
@@ -372,7 +441,7 @@ def bench_stages(det, x, repeats=3):
         )
         stages["correlate"], (corr_tiles, gmax) = timed(corr_fn, trf)
         thres = 0.5 * float(gmax)
-        thr = jnp.asarray([0.9 * thres] + [thres] * (nT - 1), x.dtype)
+        thr = jnp.asarray([0.9 * thres] + [thres] * (nT - 1), trf.dtype)
         if det.pick_mode == "sparse":
             # time the exact production pattern — THE escalation policy
             # (ops.peaks.picks_with_escalation), including its saturation
@@ -496,12 +565,13 @@ def _run_rung_child(spec: dict) -> int:
         )
         out = {"cpu_wall": cpu_wall, "n_picks": n_picks}
     else:
-        wall, n_picks, device, stages, route, pick_engine = bench_tpu(
+        wall, n_picks, device, stages, route, pick_engine, wire_info = bench_tpu(
             spec["nx"], spec["ns"], spec["fs"], spec["dx"],
             peak_block=spec["peak_block"], **spec["kw"]
         )
         out = {"wall": wall, "n_picks": n_picks, "device": device,
-               "stages": stages, "route": route, "pick_engine": pick_engine}
+               "stages": stages, "route": route, "pick_engine": pick_engine,
+               **wire_info}
     print("RUNG_RESULT:" + json.dumps(out), flush=True)
     return 0
 
@@ -864,6 +934,10 @@ def main():
         "device": device,
         "route": route,
         "pick_engine": result.get("pick_engine"),
+        # wire attribution (narrow-wire ingest): what actually crossed H2D
+        "wire": result.get("wire"),
+        "wire_dtype": result.get("wire_dtype"),
+        "wire_bytes": result.get("wire_bytes"),
         "cpu_ref_rate": round(cpu_rate, 1) if cpu_rate else None,
         "cpu_ref_mode": cpu_ref_mode,
         "cpu_ref_rate_extrapolated": (

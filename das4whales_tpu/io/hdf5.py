@@ -59,9 +59,16 @@ def get_metadata_optasense(filepath: str) -> AcquisitionMetadata:
 @functools.partial(jax.jit, static_argnames=())
 def raw2strain(trace: jnp.ndarray, scale_factor: float) -> jnp.ndarray:
     """Demean each channel and scale raw counts to strain
-    (data_handle.py:157-177) — on device, one fused kernel."""
-    trace = trace - jnp.mean(trace, axis=-1, keepdims=True)
-    return trace * scale_factor
+    (data_handle.py:157-177) — on device, one fused kernel. Delegates to
+    ``ops.conditioning.condition`` so the affine map whose raw/conditioned
+    parity the narrow wire guarantees has exactly ONE definition. Float
+    inputs keep their dtype; integer counts condition to float32 (the
+    scale must never be cast to an int dtype — it would truncate to 0)."""
+    from ..ops import conditioning
+
+    dtype = (trace.dtype if jnp.issubdtype(trace.dtype, jnp.floating)
+             else jnp.float32)
+    return conditioning.condition(trace, scale_factor, dtype=dtype)
 
 
 @dataclass
@@ -78,6 +85,11 @@ class StrainBlock:
     t0_utc: datetime
     metadata: AcquisitionMetadata | None = None
     selection: ChannelSelection | None = None
+    #: "conditioned": ``trace`` is strain (host demean+scale already ran).
+    #: "raw": ``trace`` is stored-dtype interrogator counts — the narrow
+    #: wire format; condition on device with ``ops.conditioning`` using
+    #: ``metadata.scale_factor`` (or hand it to a ``wire="raw"`` detector).
+    wire: str = "conditioned"
 
     def __iter__(self):
         return iter((self.trace, self.tx, self.dist, self.t0_utc))
@@ -91,6 +103,7 @@ def load_das_data(
     dtype=jnp.float32,
     device=None,
     engine: str = "auto",
+    wire: str = "conditioned",
 ) -> StrainBlock:
     """Load a strided channel selection as strain, with time/distance axes.
 
@@ -103,6 +116,11 @@ def load_das_data(
     engine (threaded pread + fused conditioning, see ``io.native``),
     ``"h5py"`` the pure-Python path, ``"auto"`` picks native when the
     dataset layout and dtype allow it.
+
+    ``wire="raw"`` is the NARROW wire format: the stored-dtype counts
+    cross host→device untouched (int16 = half the float32 bytes) and the
+    same demean+scale affine map runs on device (``ops.conditioning``) —
+    the returned block is still strain, only the transfer is narrower.
     """
     if not os.path.exists(filename):
         raise FileNotFoundError(f"File {filename} not found")
@@ -111,13 +129,18 @@ def load_das_data(
 
     if engine not in ("auto", "native", "h5py"):
         raise ValueError(f"unknown engine {engine!r}; expected 'auto', 'native', or 'h5py'")
-    if engine == "native" and dtype != jnp.float32:
+    if wire not in ("conditioned", "raw"):
+        raise ValueError(f"unknown wire {wire!r}; expected 'conditioned' or 'raw'")
+    if engine == "native" and wire == "conditioned" and dtype != jnp.float32:
         raise ValueError("engine='native' produces float32; pass dtype=jnp.float32")
     native_spec = None
     with h5py.File(filename, "r") as fp:
         raw = fp["Acquisition/Raw[0]/RawData"]
         t_us = int(fp["Acquisition/Raw[0]/RawDataTime"][0])
-        if engine in ("auto", "native") and dtype == jnp.float32:
+        # raw wire serves any dtype from the layout (stored-dtype memmap
+        # gather, conditioning casts on device); the conditioned fused C++
+        # pass produces float32 only
+        if engine in ("auto", "native") and (wire == "raw" or dtype == jnp.float32):
             from . import native as native_mod
 
             layout = native_mod.contiguous_layout(raw) if native_mod.available() else None
@@ -130,6 +153,23 @@ def load_das_data(
                 )
         if native_spec is None:
             block = raw[sel.start : sel.stop : sel.step, :]
+
+    if wire == "raw":
+        from ..ops import conditioning
+
+        if native_spec is not None:
+            from . import native as native_mod
+
+            offset, disk_dtype, nx_disk, ns_disk = native_spec
+            block = native_mod.read_strided_raw(
+                filename, offset, disk_dtype, nx_disk, ns_disk,
+                sel.start, min(sel.stop, nx_disk), sel.step,
+            )
+        # narrow wire: put the STORED dtype on device, condition there —
+        # one transfer of the raw count bytes, never the float32 inflation
+        arr = jax.device_put(block, device) if device is not None else jnp.asarray(block)
+        trace = conditioning.condition(arr, meta.scale_factor, dtype=dtype)
+        return assemble_block(trace, meta, sel, t_us)
 
     if native_spec is not None:
         from . import native as native_mod
@@ -153,17 +193,20 @@ def load_das_data(
     return assemble_block(trace, meta, sel, t_us)
 
 
-def assemble_block(trace, metadata, sel: ChannelSelection, t0_us: int) -> StrainBlock:
+def assemble_block(trace, metadata, sel: ChannelSelection, t0_us: int,
+                   wire: str = "conditioned") -> StrainBlock:
     """Build a :class:`StrainBlock` (time/distance axes + UTC start) from a
-    conditioned ``[channel x time]`` array. Shared by the single-file loader
-    above and the multi-file streaming path (io/stream.py) so the axis
-    conventions (data_handle.py:220-228) live in exactly one place."""
+    ``[channel x time]`` array. Shared by the single-file loader above and
+    the multi-file streaming path (io/stream.py) so the axis conventions
+    (data_handle.py:220-228) live in exactly one place. ``wire`` records
+    whether ``trace`` is conditioned strain or raw counts (narrow wire)."""
     meta = as_metadata(metadata)
     nnx, nns = trace.shape
     tx = np.arange(nns) / meta.fs
     dist = (np.arange(nnx) * sel.step + sel.start) * meta.dx
     t0 = datetime.fromtimestamp(t0_us * 1e-6, tz=timezone.utc).replace(tzinfo=None)
-    return StrainBlock(trace=trace, tx=tx, dist=dist, t0_utc=t0, metadata=meta, selection=sel)
+    return StrainBlock(trace=trace, tx=tx, dist=dist, t0_utc=t0, metadata=meta,
+                       selection=sel, wire=wire)
 
 
 def write_optasense(
@@ -174,10 +217,13 @@ def write_optasense(
     gauge_length: float = 51.05,
     n: float = 1.4681,
     t0_us: int = 1_636_000_000_000_000,
+    raw_dtype=np.int32,
 ) -> str:
-    """Write a ``[channel x time]`` int raw block in the OptaSense HDF5
+    """Write a ``[channel x time]`` raw block in the OptaSense HDF5
     schema the reader (and the reference) expects. Used for synthetic
-    fixtures and data export."""
+    fixtures and data export. ``raw_dtype`` sets the stored dtype
+    (int32 default, matching the deployment schema; float32 files exist
+    in the wild and exercise the float narrow-wire path)."""
     raw_data = np.asarray(raw_data)
     nx, ns = raw_data.shape
     with h5py.File(filepath, "w") as fp:
@@ -189,7 +235,7 @@ def write_optasense(
         raw = acq.create_group("Raw[0]")
         raw.attrs["OutputDataRate"] = fs
         raw.attrs["NumberOfLoci"] = nx
-        raw.create_dataset("RawData", data=raw_data.astype(np.int32))
+        raw.create_dataset("RawData", data=raw_data.astype(raw_dtype))
         times = (t0_us + np.arange(ns) * 1e6 / fs).astype(np.int64)
         dt = raw.create_dataset("RawDataTime", data=times)
         dt.attrs["Count"] = ns

@@ -185,6 +185,30 @@ def read_strided(
     return out
 
 
+def read_strided_raw(
+    path: str,
+    offset: int,
+    dtype: np.dtype,
+    nx: int,
+    ns: int,
+    start: int,
+    stop: int,
+    step: int,
+) -> np.ndarray:
+    """Strided channel read of the STORED dtype, no conditioning — the
+    narrow wire format (``io.stream`` ``wire="raw"``): raw interrogator
+    counts cross host→device untouched (int16 stays 2 bytes/sample) and
+    demean/scale runs on device (``ops.conditioning``). Consumes the same
+    ``contiguous_layout`` probe as the fused C++ path but needs only a
+    numpy memmap, so it works even where the engine failed to build."""
+    mm = np.memmap(path, dtype=np.dtype(dtype), mode="r", offset=offset,
+                   shape=(nx, ns))
+    try:
+        return np.ascontiguousarray(mm[start:stop:step])
+    finally:
+        del mm
+
+
 def raw2strain_inplace(block: np.ndarray, scale: float, nthreads: int | None = None) -> np.ndarray:
     """Threaded in-place demean+scale of a float32 [nx x ns] block."""
     lib = get_lib()

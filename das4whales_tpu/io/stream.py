@@ -9,6 +9,20 @@ and conditions ahead, and blocks are handed to JAX as device arrays —
 optionally placed with a NamedSharding so a [file x channel x time] batch
 lands pre-sharded for the multi-chip step (parallel/pipeline.py).
 
+Two transfer optimizations ride on top of the read pipeline:
+
+* ``wire="raw"`` — the NARROW wire format: the stored dtype (int16 TDMS
+  counts, int32/float32 OptaSense) crosses host→device untouched and the
+  demean+scale conditioning runs on device (``ops.conditioning``), fused
+  into the consuming detection program. H2D bytes drop 2× for int16
+  sources; picks are bit-identical (same affine map, device-executed).
+* the **overlap executor** (``overlap_transfers``, default on for
+  device-bound streams) — file k+1's ``jax.device_put`` (pre-sharded via
+  ``NamedSharding`` when given) is dispatched the moment its read
+  completes, while file k's program runs, instead of blocking on the
+  read thread's handoff at yield time. Device memory holds up to
+  ``prefetch + 1`` blocks in flight (vs 2 without overlap).
+
 Unlike the reference's ThreadPoolExecutor fan-out, which loses result
 ordering via ``as_completed`` (detect.py:244-245), both paths here yield
 files strictly in submission order. Metadata probing is also pipelined —
@@ -18,6 +32,8 @@ in campaign length.
 
 from __future__ import annotations
 
+import functools
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Iterator, Sequence
@@ -31,6 +47,8 @@ from ..config import AcquisitionMetadata, ChannelSelection, as_metadata
 from . import native
 from .hdf5 import StrainBlock, assemble_block
 from .interrogators import get_acquisition_parameters
+
+WIRE_FORMATS = ("conditioned", "raw")
 
 
 @dataclass
@@ -85,23 +103,22 @@ def _read_h5py_host(spec: _FileSpec, sel: ChannelSelection) -> np.ndarray:
     return x
 
 
-def _read_tdms_host(spec: _FileSpec, sel: ChannelSelection) -> np.ndarray:
-    """Read + condition a Silixa TDMS file, updating ``spec.t0_us`` from
-    its ``GPSTimeStamp`` property when present (the reference never loads
-    TDMS bulk data at all — its silixa path is metadata-only,
-    data_handle.py:113-154)."""
-    from .interrogators import _natural_key
-    from .tdms import TdmsFile
+def _read_tdms_host(spec: _FileSpec, sel: ChannelSelection,
+                    raw: bool = False) -> np.ndarray:
+    """Read a Silixa TDMS file (conditioning on the host unless ``raw``),
+    updating ``spec.t0_us`` from its ``GPSTimeStamp`` property when present
+    (the reference never loads TDMS bulk data at all — its silixa path is
+    metadata-only, data_handle.py:113-154)."""
+    from .tdms import read_measurement_block
 
-    f = TdmsFile.read(spec.path)
-    channels = f["Measurement"]
-    names = sorted(channels, key=_natural_key)[sel.start : sel.stop : sel.step]
-    x = np.stack([channels[c] for c in names]).astype(np.float32)
-    x -= x.mean(axis=1, keepdims=True)
-    x *= spec.meta.scale_factor
-    t0 = f.properties.get("GPSTimeStamp")
-    if hasattr(t0, "timestamp"):
-        spec.t0_us = int(t0.timestamp() * 1e6)
+    x, t0_us = read_measurement_block(
+        spec.path, sel.start, sel.stop, sel.step, raw=raw
+    )
+    if not raw:
+        x -= x.mean(axis=1, keepdims=True)
+        x *= spec.meta.scale_factor
+    if t0_us is not None:
+        spec.t0_us = t0_us
     return x
 
 
@@ -109,6 +126,30 @@ def _read_host(spec: _FileSpec, sel: ChannelSelection) -> np.ndarray:
     if _is_tdms(spec.path) or spec.meta.interrogator == "silixa":
         return _read_tdms_host(spec, sel)
     return _read_h5py_host(spec, sel)
+
+
+def _read_host_raw(spec: _FileSpec, sel: ChannelSelection,
+                   engine: str = "auto") -> np.ndarray:
+    """Narrow-wire host read: the stored dtype, untouched. Natively-probed
+    layouts go through a numpy memmap (no parse, no copy beyond the
+    strided gather); irregular files fall back to their format reader with
+    conditioning skipped. ``engine`` keeps the conditioned path's
+    contract: ``"h5py"`` forces the format readers even when a layout was
+    probed, ``"native"`` raises on files without one."""
+    if engine != "h5py" and spec.layout is not None:
+        offset, dt, nx, ns = spec.layout
+        return native.read_strided_raw(
+            spec.path, offset, dt, nx, ns, sel.start, min(sel.stop, nx), sel.step
+        )
+    if engine == "native":
+        raise ValueError(
+            f"{spec.path} is not natively readable but the stream started "
+            "on the native engine; pass engine='h5py' for mixed file sets"
+        )
+    if _is_tdms(spec.path) or spec.meta.interrogator == "silixa":
+        return _read_tdms_host(spec, sel, raw=True)
+    with h5py.File(spec.path, "r") as fp:
+        return fp["Acquisition/Raw[0]/RawData"][sel.start : sel.stop : sel.step, :]
 
 
 def stream_strain_blocks(
@@ -122,15 +163,27 @@ def stream_strain_blocks(
     device=None,
     sharding=None,
     as_numpy: bool = False,
+    wire: str = "conditioned",
+    overlap_transfers: bool | None = None,
 ) -> Iterator[StrainBlock]:
-    """Yield conditioned :class:`StrainBlock`\\ s for ``files`` in order,
-    reading ahead ``prefetch`` files while the caller computes.
+    """Yield :class:`StrainBlock`\\ s for ``files`` in order, reading ahead
+    ``prefetch`` files while the caller computes.
 
     ``metadata`` may be None (probed per file), one metadata for all files,
     or a sequence aligned with ``files``. ``sharding``/``device`` place each
     block on arrival (e.g. a per-file NamedSharding over the channel axis).
     ``as_numpy`` keeps traces on the host (for callers that batch several
     files before one placed transfer, e.g. :func:`stream_file_batches`).
+
+    ``wire="raw"`` streams the STORED dtype untouched (narrow wire; see
+    module docstring) — the yielded block's ``.trace`` is raw counts and
+    ``.wire == "raw"``; condition on device (``ops.conditioning`` or a
+    ``wire="raw"`` detector/step).
+
+    ``overlap_transfers`` (default: on whenever blocks are device-bound)
+    dispatches file k+1's ``jax.device_put`` as soon as its read completes,
+    overlapping H2D transfer with compute on file k. Costs up to
+    ``prefetch + 1`` blocks of device memory in flight.
 
     ``engine="auto"`` picks the native path iff the *first* file is natively
     readable; a later file that breaks that assumption raises — pass
@@ -140,8 +193,13 @@ def stream_strain_blocks(
         raise ValueError("prefetch must be >= 1")
     if engine not in ("auto", "native", "h5py"):
         raise ValueError(f"unknown engine {engine!r}; expected 'auto', 'native', or 'h5py'")
+    if wire not in WIRE_FORMATS:
+        raise ValueError(f"unknown wire {wire!r}; expected one of {WIRE_FORMATS}")
     if as_numpy and (sharding is not None or device is not None):
         raise ValueError("as_numpy=True returns host arrays; drop sharding/device")
+    if as_numpy and overlap_transfers:
+        raise ValueError("as_numpy=True never transfers; drop overlap_transfers")
+    overlap = (not as_numpy) if overlap_transfers is None else bool(overlap_transfers)
     files = list(files)
     if not files:
         return
@@ -154,32 +212,20 @@ def stream_strain_blocks(
     if len(metas) != len(files):
         raise ValueError(f"got {len(metas)} metadata entries for {len(files)} files")
 
-    def finish(spec: _FileSpec, host: np.ndarray) -> StrainBlock:
-        if as_numpy:
-            arr = host
-        elif sharding is not None:
-            arr = jax.device_put(host, sharding)
-        elif device is not None:
-            arr = jax.device_put(host, device)
-        else:
-            arr = jnp.asarray(host)
-        return assemble_block(arr, spec.meta, sel, spec.t0_us)
+    def place(host: np.ndarray):
+        if sharding is not None:
+            return jax.device_put(host, sharding)
+        if device is not None:
+            return jax.device_put(host, device)
+        return jnp.asarray(host)
+
+    def finish(spec: _FileSpec, arr) -> StrainBlock:
+        return assemble_block(arr, spec.meta, sel, spec.t0_us, wire=wire)
 
     first = _probe(files[0], interrogator, metas[0])
     use_native = engine in ("auto", "native") and first.layout is not None
     if engine == "native" and not use_native:
         raise ValueError(f"engine='native' but {files[0]} is not natively readable")
-
-    def native_submit(pf, spec: _FileSpec):
-        if spec.layout is None:
-            raise ValueError(
-                f"{spec.path} is not natively readable but the stream started "
-                "on the native engine; pass engine='h5py' for mixed file sets"
-            )
-        offset, dt, nx, ns = spec.layout
-        return pf.submit(spec.path, offset, dt, nx, ns,
-                         sel.start, min(sel.stop, nx), sel.step,
-                         fuse=True, scale=spec.meta.scale_factor)
 
     # probe lazily: spec k is probed right before (native) or inside (h5py)
     # its read task, keeping only `prefetch` probes + reads ahead of the
@@ -194,41 +240,105 @@ def stream_strain_blocks(
             specs[i] = _probe(files[i], interrogator, metas[i])
         return specs[i]
 
-    if use_native:
-        with native.Prefetcher(nworkers=prefetch) as pf:
-            def submit(i):
-                try:
-                    return native_submit(pf, spec_for(i))
-                except Exception as exc:  # noqa: BLE001 — re-raised in order
-                    return ("__probe_error__", exc)
+    if use_native and wire == "conditioned":
+        # fused C++ path: read + demean + scale in one native pass; the
+        # transfer of file k+1 is handed off to a single ordered transfer
+        # thread (overlap) or dispatched at yield time (no overlap)
+        yield from _native_stream(
+            files, sel, specs, spec_for, prefetch, place, finish,
+            as_numpy, overlap,
+        )
+        return
 
-            tickets = {i: submit(i) for i in range(min(prefetch, len(files)))}
-            for i in range(len(files)):
-                ticket = tickets.pop(i)
-                nxt = i + prefetch
-                if nxt < len(files):
-                    tickets[nxt] = submit(nxt)
-                if isinstance(ticket, tuple) and ticket[0] == "__probe_error__":
-                    raise ticket[1]
-                host = pf.wait(ticket)
-                yield finish(specs.pop(i), host)
+    if wire == "raw":
+        reader = functools.partial(_read_host_raw, engine=engine)
     else:
-        def probe_and_read(i):
-            spec = spec_for(i) if i == 0 else _probe(files[i], interrogator, metas[i])
-            return spec, _read_host(spec, sel)
+        reader = _read_host
 
-        with ThreadPoolExecutor(max_workers=prefetch) as ex:
-            futs = {
-                i: ex.submit(probe_and_read, i)
-                for i in range(min(prefetch, len(files)))
-            }
-            for i in range(len(files)):
-                fut = futs.pop(i)
-                nxt = i + prefetch
-                if nxt < len(files):
-                    futs[nxt] = ex.submit(probe_and_read, nxt)
-                spec, host = fut.result()  # strict submission order
-                yield finish(spec, host)
+    def probe_and_read(i):
+        spec = spec_for(i) if i == 0 else _probe(files[i], interrogator, metas[i])
+        host = reader(spec, sel)
+        if overlap and not as_numpy:
+            # dispatch the H2D transfer from the read worker, the moment
+            # the read completes — jax.device_put is async, so the worker
+            # is not pinned and the copy overlaps compute on earlier files
+            return spec, place(host)
+        return spec, host
+
+    with ThreadPoolExecutor(max_workers=prefetch) as ex:
+        futs = {
+            i: ex.submit(probe_and_read, i)
+            for i in range(min(prefetch, len(files)))
+        }
+        for i in range(len(files)):
+            fut = futs.pop(i)
+            nxt = i + prefetch
+            if nxt < len(files):
+                futs[nxt] = ex.submit(probe_and_read, nxt)
+            spec, payload = fut.result()  # strict submission order
+            if as_numpy or overlap:
+                yield finish(spec, payload)
+            else:
+                yield finish(spec, place(payload))
+
+
+def _native_stream(files, sel, specs, spec_for, prefetch, place, finish,
+                   as_numpy, overlap):
+    """The native-engine stream body: C++ prefetcher reads ahead; the
+    wait-and-transfer handoff runs on a dedicated ordered thread when
+    ``overlap`` so file k+1's device_put dispatches during compute on k."""
+    n = len(files)
+
+    with native.Prefetcher(nworkers=prefetch) as pf:
+        def submit(i):
+            try:
+                spec = spec_for(i)
+                if spec.layout is None:
+                    raise ValueError(
+                        f"{spec.path} is not natively readable but the stream "
+                        "started on the native engine; pass engine='h5py' for "
+                        "mixed file sets"
+                    )
+                offset, dt, nx, ns = spec.layout
+                return pf.submit(spec.path, offset, dt, nx, ns,
+                                 sel.start, min(sel.stop, nx), sel.step,
+                                 fuse=True, scale=spec.meta.scale_factor)
+            except Exception as exc:  # noqa: BLE001 — re-raised in order
+                return ("__probe_error__", exc)
+
+        tickets = {i: submit(i) for i in range(min(prefetch, n))}
+        next_read = min(prefetch, n)
+
+        def hand(j):
+            """Wait file j's native read, then dispatch its transfer —
+            probe/read errors re-raise here, surfacing (via the ordered
+            future pop below) at file j's own yield position."""
+            ticket = tickets.pop(j)
+            if isinstance(ticket, tuple) and ticket[0] == "__probe_error__":
+                raise ticket[1]
+            host = pf.wait(ticket)
+            return finish(specs.pop(j), host if as_numpy else place(host))
+
+        if not overlap or as_numpy:
+            for i in range(n):
+                if next_read < n and next_read <= i + prefetch:
+                    tickets[next_read] = submit(next_read)
+                    next_read += 1
+                yield hand(i)
+            return
+
+        with ThreadPoolExecutor(max_workers=1) as tx:
+            handed = 0
+            futs: deque = deque()
+            for i in range(n):
+                while next_read < min(n, i + prefetch + 1):
+                    tickets[next_read] = submit(next_read)
+                    next_read += 1
+                # keep this file + one successor on the transfer thread
+                while handed <= min(n - 1, i + 1):
+                    futs.append(tx.submit(hand, handed))
+                    handed += 1
+                yield futs.popleft().result()
 
 
 def stream_file_batches(
@@ -242,12 +352,15 @@ def stream_file_batches(
     prefetch: int = 2,
     engine: str = "auto",
     tail: str = "pad",
+    wire: str = "conditioned",
 ) -> Iterator[tuple]:
     """Stack consecutive files into ``[file x channel x time]`` batches for
     the sharded multi-chip detection step (parallel/pipeline.py).
 
     Yields ``(batch_array, blocks)``; when ``mesh`` is given the stack is
     placed with the pipeline's input sharding (file x channel).
+    ``wire="raw"`` stacks and transfers the stored dtype (narrow wire) —
+    pair with a ``wire="raw"`` sharded step, which conditions on the mesh.
 
     ``tail`` controls trailing files that do not fill a batch:
     ``"pad"`` (default) zero-pads the final stack to the batch size and
@@ -277,13 +390,13 @@ def stream_file_batches(
             files = files[:n_full]
     return _file_batches_gen(
         list(files), selected_channels, metadata, batch=batch, mesh=mesh,
-        interrogator=interrogator, prefetch=prefetch, engine=engine,
+        interrogator=interrogator, prefetch=prefetch, engine=engine, wire=wire,
     )
 
 
 def _file_batches_gen(
     files, selected_channels, metadata, *, batch, mesh, interrogator,
-    prefetch, engine,
+    prefetch, engine, wire,
 ) -> Iterator[tuple]:
     from ..parallel.pipeline import input_sharding
 
@@ -301,7 +414,7 @@ def _file_batches_gen(
     for blk in stream_strain_blocks(
         files, selected_channels, metadata,
         interrogator=interrogator, prefetch=prefetch, engine=engine,
-        as_numpy=True,
+        as_numpy=True, wire=wire,
     ):
         pending.append(blk)
         if len(pending) == batch:

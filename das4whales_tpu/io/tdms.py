@@ -256,6 +256,31 @@ class TdmsFile:
         return self
 
 
+def read_measurement_block(filepath: str, start: int, stop: int, step: int,
+                           *, raw: bool = False):
+    """Host bulk read of a Silixa file's ``Measurement`` group: the
+    ``[start:stop:step]`` channel selection stacked ``[n_sel x ns]`` in
+    natural name order. ``raw=True`` keeps the STORED dtype (the narrow
+    wire format — int16 counts stay int16 for the host→device transfer,
+    conditioning runs on device via ``ops.conditioning``); ``raw=False``
+    casts to float32 for the host conditioning path. Returns
+    ``(block, t0_us or None)`` with ``t0_us`` from ``GPSTimeStamp`` when
+    present. The ONE TDMS bulk-selection routine — the stream's
+    conditioned and raw readers both come through here, so channel
+    ordering cannot drift between wire formats."""
+    from .interrogators import _natural_key
+
+    f = TdmsFile.read(filepath)
+    channels = f["Measurement"]
+    names = sorted(channels, key=_natural_key)[start:stop:step]
+    stack = np.stack([channels[c] for c in names])
+    if not raw:
+        stack = stack.astype(np.float32)
+    t0 = f.properties.get("GPSTimeStamp")
+    t0_us = int(t0.timestamp() * 1e6) if hasattr(t0, "timestamp") else None
+    return stack, t0_us
+
+
 def contiguous_layout(filepath: str):
     """Native-ingest layout probe: ``(data_offset, dtype, nx, ns, t0_us)``
     when the file is ONE TDMS segment whose ``Measurement`` channels are

@@ -35,6 +35,7 @@ from ..config import (
     FkFilterConfig,
     as_metadata,
 )
+from ..ops import conditioning
 from ..ops import fk as fk_ops
 from ..ops import peaks as peak_ops
 from ..ops import spectral, xcorr
@@ -359,6 +360,7 @@ def reference_threshold_factors(n_templates: int, dtype=None) -> jnp.ndarray:
     static_argnames=(
         "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp",
         "tile", "max_peaks", "capacity", "use_threshold", "pick_method",
+        "condition", "cond_demean",
     ),
 )
 def mf_detect_picks_program(
@@ -379,10 +381,22 @@ def mf_detect_picks_program(
     capacity: int,
     use_threshold: bool,
     pick_method: str = "topk",
+    condition: bool = False,
+    cond_demean: bool = True,
+    cond_scale=1.0,
 ):
-    """The WHOLE detection step as ONE XLA program: bandpass -> f-k filter
+    """The WHOLE detection step as ONE XLA program: [optional narrow-wire
+    conditioning prologue ->] bandpass -> f-k filter
     -> correlate -> in-graph reference threshold (main_mfdetect.py:94-99)
     -> envelope -> sparse prominence picks -> row-major device compaction.
+
+    ``condition=True`` treats ``trace`` as RAW stored-dtype counts off the
+    narrow wire (io/stream.py ``wire="raw"``) and runs the demean+scale
+    conditioning (``ops.conditioning.condition``) as the program's first
+    fused pass — the same affine map the host readers apply, so picks are
+    bit-identical to the conditioned-wire route. The raw input buffer is
+    NOT donated: the adaptive-K policy reruns this program on the same
+    trace when a pick row saturates at K0.
 
     The ``__call__`` route runs the same math but with 4-6 host syncs per
     file (threshold pull, saturation check, compaction count, packed
@@ -405,6 +419,12 @@ def mf_detect_picks_program(
     """
     C = trace.shape[0]
     nT = templates_true.shape[0]
+    if condition:
+        # narrow-wire prologue: raw counts -> strain, fused ahead of the
+        # filter pass (templates carry the compute dtype)
+        trace = conditioning.condition(
+            trace, cond_scale, demean=cond_demean, dtype=templates_true.dtype
+        )
     # THE filter graphs (inlined under this jit): identical construction
     # to the standalone filter programs, so the routes cannot drift
     if staged_bp:
@@ -485,8 +505,19 @@ class MatchedFilterDetector:
         channel_pad: int | str | None = None,
         fused_bandpass: bool = True,
         pick_pack_cap: int = 1 << 18,
+        wire: str = "conditioned",
     ):
         self.metadata = as_metadata(metadata)
+        if wire not in ("conditioned", "raw"):
+            raise ValueError(f"unknown wire {wire!r}; expected 'conditioned' or 'raw'")
+        # wire="raw": inputs are stored-dtype interrogator counts off the
+        # narrow wire (io/stream.py wire="raw"); the demean+scale
+        # conditioning runs ON DEVICE (ops/conditioning.py), fused into
+        # the one-program route / prepended to the staged routes, using
+        # this metadata's scale_factor. Bit-identical picks to the
+        # conditioned wire (same affine map, device-executed).
+        self.wire = wire
+        self._cond_scale = jnp.float32(self.metadata.scale_factor)
         if templates is None:
             templates = {"HF": FIN_HF_NOTE, "LF": FIN_LF_NOTE}
         # resolved name -> CallTemplateConfig mapping (consumed by eval.py's
@@ -587,10 +618,27 @@ class MatchedFilterDetector:
     def fk_pad_rows(self) -> int:
         return self.design.fk_channels - self.design.trace_shape[0]
 
+    def _as_input(self, trace) -> jnp.ndarray:
+        """Raw wire keeps the stored dtype across the transfer; the
+        conditioned wire casts to the compute dtype as before."""
+        if self.wire == "raw":
+            return jnp.asarray(trace)
+        return jnp.asarray(trace, dtype=self._mask_band_dev.dtype)
+
+    def condition_input(self, trace: jnp.ndarray) -> jnp.ndarray:
+        """Narrow-wire prologue for the staged routes: raw counts ->
+        strain on device (no-op on the conditioned wire). The input is
+        not donated — staged callers may hold the block (ops.conditioning
+        has the donating variant for callers that own their buffer)."""
+        if self.wire != "raw":
+            return jnp.asarray(trace, dtype=self._mask_band_dev.dtype)
+        return conditioning.condition_jit(jnp.asarray(trace), self._cond_scale)
+
     def filter_block(self, trace: jnp.ndarray) -> jnp.ndarray:
         # filter-only program: never drags the (discarded) correlate stage
         # into the compiled module — at canonical shape that stage alone is
         # the round-2 OOM
+        trace = self.condition_input(trace)
         if self.fused_bandpass:
             return mf_filter_fused(
                 trace, self._mask_band_dev, self._band_lo, self._band_hi,
@@ -603,7 +651,7 @@ class MatchedFilterDetector:
         )
 
     def __call__(self, trace: jnp.ndarray, threshold: float | None = None, with_snr: bool = False) -> MatchedFilterResult:
-        trace = jnp.asarray(trace, dtype=self._mask_band_dev.dtype)
+        trace = self._as_input(trace)
         if self.pick_mode == "sparse" and not self.keep_correlograms and not with_snr:
             # campaign mode wants exactly the picks — take the one-program
             # route (single dispatch + single fetch; see detect_picks)
@@ -626,7 +674,7 @@ class MatchedFilterDetector:
         (campaign semantics — the reference keeps them only for plotting,
         main_mfdetect.py:84-92; use ``__call__`` for those).
         """
-        trace = jnp.asarray(trace, dtype=self._mask_band_dev.dtype)
+        trace = self._as_input(trace)
         if self.pick_mode != "sparse":
             return self._call_full(trace, threshold=threshold)
         C = trace.shape[0]
@@ -635,7 +683,7 @@ class MatchedFilterDetector:
         cap = int(min(C * self.max_peaks, self.pick_pack_cap))
         use_thr = threshold is not None
         thr_in = jnp.full((nT,), 0.0 if threshold is None else float(threshold),
-                          dtype=trace.dtype)
+                          dtype=self._mask_band_dev.dtype)
         tile = self.effective_channel_tile if self._route() == "tiled" else None
 
         def run(k):
@@ -649,6 +697,8 @@ class MatchedFilterDetector:
                 tile=tile, max_peaks=k, capacity=cap,
                 use_threshold=use_thr,
                 pick_method=peak_ops.escalation_method(k, self.max_peaks),
+                condition=self.wire == "raw",
+                cond_scale=self._cond_scale,
             )
 
         chan, times, cnt, satc, thr = jax.device_get(run(self.pick_k0))
@@ -741,7 +791,10 @@ class MatchedFilterDetector:
             thr_np = thres * np.asarray(reference_threshold_factors(nT))
         else:
             thr_np = np.full((nT,), float(threshold), dtype=np.float32)
-        thr_dev = jnp.asarray(thr_np, dtype=trace.dtype)
+        # compute dtype, NOT trace.dtype: on the raw wire trace is still
+        # stored-dtype counts here (filter_block conditions internally)
+        # and an int16 cast would truncate the thresholds
+        thr_dev = jnp.asarray(thr_np, dtype=self._mask_band_dev.dtype)
 
         correlograms, peak_masks, picks, thr_out, snr = {}, {}, {}, {}, {}
         if self.pick_mode == "sparse":

@@ -1,0 +1,122 @@
+"""On-device conditioning of raw interrogator counts (the narrow wire).
+
+The reference conditions on the host — ``raw2strain`` (data_handle.py:
+157-177) runs numpy demean+scale on the Python thread, so the block that
+crosses host→device is already float32 strain. That makes the wire wide:
+an int16 TDMS file inflates 2× (int32 stays 1×) before it ever reaches
+HBM, and at the canonical OOI shape the conditioned block is ~1 GB of
+host→device traffic per 60 s file — the dominant *unattributed* share of
+the measured on-chip wall (docs/PERF.md stage table). Large-Scale DFT on
+TPUs (arXiv:2002.03260) makes the general argument: keep data
+device-resident and move the minimum over the wire.
+
+This module is the other half of ``io``'s ``wire="raw"`` mode: the
+stored-dtype counts cross the wire untouched and the SAME affine map the
+host readers apply — ``(x.astype(f32) - mean(x, time)) * scale_factor``
+— runs on device, fused into the head of whichever detection program
+consumes the block (``models/matched_filter.py:mf_detect_picks_program``,
+``parallel/pipeline.py:_mf_body``, ``parallel/timeshard.py``). Fused,
+the conditioning costs one extra pass over data the filter stage was
+about to read anyway; the wire shrinks 2× (int16) with bit-identical
+pick output (same map, same order, device reduction).
+
+Functions here are pure jnp and safe to inline under jit/shard_map; the
+jitted wrappers at the bottom serve callers that condition as a
+standalone step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def condition(trace: jnp.ndarray, scale, *, demean: bool = True,
+              dtype=jnp.float32) -> jnp.ndarray:
+    """Raw stored-dtype counts -> strain, on device.
+
+    The exact affine map of the host conditioning path
+    (``io/stream.py:_read_h5py_host``; reference data_handle.py:157-177):
+    cast to ``dtype``, demean each channel along time, multiply by the
+    interrogator scale factor. Pure function — inline it under any jit or
+    shard_map body whose TIME axis is local (per-channel means are then
+    shard-local; a time-sharded layout needs
+    :func:`condition_time_sharded`).
+    """
+    x = trace.astype(dtype)
+    if demean:
+        x = x - jnp.mean(x, axis=-1, keepdims=True)
+    return x * jnp.asarray(scale, dtype)
+
+
+def condition_time_sharded(trace: jnp.ndarray, scale, axis_name: str,
+                           n_time_global: int, *, demean: bool = True,
+                           dtype=jnp.float32) -> jnp.ndarray:
+    """:func:`condition` for a shard_map body whose TIME axis is sharded.
+
+    The per-channel mean spans shards, so it is computed as a ``psum`` of
+    local sums over ``axis_name`` divided by the GLOBAL time length —
+    one scalar-per-channel collective, not a data transpose. Reduction
+    order differs from the single-device mean by float roundoff only.
+
+    ``n_time_global`` smaller than the sharded record length means the
+    tail is divisibility zero-padding: the pad contributes nothing to
+    the sum (raw zeros), and its samples are masked back to exactly 0
+    after the demean — the conditioned wire pads AFTER conditioning, so
+    leaving ``-mean*scale`` in the pad would break raw/conditioned
+    parity through the record-length FFT.
+    """
+    x = trace.astype(dtype)
+    if demean:
+        m = jax.lax.psum(jnp.sum(x, axis=-1, keepdims=True), axis_name)
+        x = x - m / n_time_global
+        local = x.shape[-1]
+        pos = jax.lax.axis_index(axis_name) * local + jnp.arange(local)
+        x = jnp.where(pos < n_time_global, x, jnp.zeros((), dtype))
+    return x * jnp.asarray(scale, dtype)
+
+
+def condition_segmented(trace: jnp.ndarray, scale, seg_ids: jnp.ndarray,
+                        seg_means: jnp.ndarray, *,
+                        dtype=jnp.float32) -> jnp.ndarray:
+    """:func:`condition` for a CONCATENATED multi-file record (the
+    long-record workflow): the conditioned wire demeans each FILE
+    separately before concatenation, so the raw wire must subtract
+    per-file means, not one whole-record mean — files carry different DC
+    count offsets (routine interrogator drift) and a global demean leaves
+    a step at every file boundary whose filtered transient shifts picks.
+
+    ``seg_ids`` maps each (local) time sample to its file's column in
+    ``seg_means`` (``[channel x n_segments]``, float32). The means are
+    computed on the HOST from the raw block with the same numpy
+    reduction the conditioned readers use — element-wise subtract and
+    scale are then the only device ops, so conditioned values are
+    bit-identical to the host route (no reduction-order roundoff at
+    all). Divisibility padding maps to a trailing all-zero mean column:
+    pad samples condition to exactly 0, matching the conditioned wire's
+    pad-after-conditioning zeros. Layout-agnostic along time (slice
+    ``seg_ids`` with the local shard window under shard_map).
+    """
+    x = trace.astype(dtype)
+    x = x - seg_means.astype(dtype)[:, seg_ids]
+    return x * jnp.asarray(scale, dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("demean",))
+def condition_jit(trace: jnp.ndarray, scale, demean: bool = True) -> jnp.ndarray:
+    """Standalone jitted prologue for callers that must KEEP the raw
+    buffer alive (the adaptive-K routes rerun the program on the same
+    input, so the detector cannot donate it)."""
+    return condition(trace, scale, demean=demean)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("demean",))
+def condition_donated(trace: jnp.ndarray, scale, demean: bool = True) -> jnp.ndarray:
+    """:func:`condition_jit` with the raw input buffer DONATED — the
+    narrow-wire block is dead the moment strain exists, so callers that
+    own their buffer (fresh from the ingest stream, no rerun planned)
+    should hand it back to XLA instead of holding both copies in HBM.
+    Donation is a no-op on backends that do not implement it (CPU)."""
+    return condition(trace, scale, demean=demean)

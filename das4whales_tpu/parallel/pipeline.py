@@ -19,6 +19,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .compat import shard_map
 
+from ..ops import conditioning as cond_ops
 from ..ops import peaks as peak_ops
 from ..ops import spectral, xcorr
 from ..ops.filters import _odd_ext
@@ -34,11 +35,12 @@ def _bp_local(trace: jnp.ndarray, gain: jnp.ndarray, padlen: int) -> jnp.ndarray
 
 
 def _mf_body(
-    trace, mask_band, bp_gain, templates_true, template_mu, template_scale, *,
+    trace, mask_band, bp_gain, templates_true, template_mu, template_scale,
+    cond_scale, *,
     band_lo: int, band_hi: int, bp_padlen: int, channel_axis: str,
     relative_threshold: float, hf_factor: float, pick_mode: str, max_peaks: int,
     outputs: str = "full", fused: bool = False, pick_tile: int = 512,
-    pick_method: str = "topk",
+    pick_method: str = "topk", condition: bool = False,
 ):
     """shard_map body. Local shapes: trace [B/Pf, C/Pc, T], mask_band
     [K, Bpad/Pc] (band-limited half-spectrum — the all_to_alls and
@@ -46,6 +48,13 @@ def _mf_body(
     [Fext], templates_true [nT, m] (TRUE length — the memory-lean
     correlate route, ops/xcorr.py:padded_template_stats, halves the
     per-shard FFT temps vs the padded form)."""
+    if condition:
+        # narrow-wire prologue (wire="raw"): raw stored-dtype counts ->
+        # strain, per shard. Time is unsharded here, so the per-channel
+        # demean is shard-local — no collective (ops/conditioning.py)
+        trace = cond_ops.condition(
+            trace, cond_scale, dtype=templates_true.dtype
+        )
     # fused mode: |H(f)|^2 is already folded into mask_band at design
     # time — skip the separate bandpass program (same math and edge
     # contract as the single-chip fused route,
@@ -104,9 +113,20 @@ def make_sharded_mf_step(
     fused_bandpass: bool = True,
     pick_tile: int = 512,
     pick_method: str = "topk",
+    wire: str = "conditioned",
+    scale_factor: float | None = None,
 ):
     """Build the jitted multi-chip detection step for a
     ``[file x channel x time]`` batch.
+
+    ``wire="raw"`` makes the step consume NARROW-WIRE batches
+    (``io.stream.stream_file_batches(wire="raw")``): the stored-dtype
+    counts land pre-sharded on the mesh and the demean+scale conditioning
+    (``ops.conditioning``) runs as the SPMD body's first fused pass using
+    ``scale_factor`` (required then — the design does not carry it). Picks
+    are bit-identical to the conditioned wire; the input batch is not
+    donated because the campaigns' adaptive-K policy reruns the step on
+    the same batch (analysis/baseline.toml R5 entry).
 
     ``pick_tile``/``pick_method`` tune the sparse pick stage exactly like
     the single-chip route (channel tiles via ``lax.map``; see
@@ -146,6 +166,10 @@ def make_sharded_mf_step(
         raise ValueError(f"pick_mode must be 'sparse' or 'dense', got {pick_mode!r}")
     if outputs not in ("full", "picks"):
         raise ValueError(f"outputs must be 'full' or 'picks', got {outputs!r}")
+    if wire not in ("conditioned", "raw"):
+        raise ValueError(f"unknown wire {wire!r}; expected 'conditioned' or 'raw'")
+    if wire == "raw" and scale_factor is None:
+        raise ValueError("wire='raw' needs scale_factor (metadata.scale_factor)")
     nnx, nns = design.trace_shape
     if design.fk_channels != nnx:
         raise ValueError(
@@ -170,6 +194,8 @@ def make_sharded_mf_step(
         xcorr.padded_template_stats_device(design.templates)
     )
 
+    cond_scale = jnp.asarray(0.0 if scale_factor is None else scale_factor,
+                             jnp.float32)
     body = functools.partial(
         _mf_body,
         band_lo=band_lo,
@@ -184,6 +210,7 @@ def make_sharded_mf_step(
         outputs=outputs,
         pick_tile=pick_tile,
         pick_method=pick_method,
+        condition=wire == "raw",
     )
     tfc = P(None, file_axis, channel_axis, None)  # [template, file, channel, *]
     if pick_mode == "sparse":
@@ -203,6 +230,7 @@ def make_sharded_mf_step(
             P(None, None),                      # true-length templates (replicated)
             P(None),                            # template means (replicated)
             P(None),                            # template scales (replicated)
+            P(),                                # conditioning scale (replicated)
         ),
         out_specs=(
             (picks_spec, P(file_axis))                # picks, thresholds
@@ -220,7 +248,8 @@ def make_sharded_mf_step(
 
     @jax.jit  # daslint: allow[R2] one-shot factory: caller holds the step for the run
     def step(trace_batch):
-        return fn(trace_batch, mask_band, bp_gain, templates_true, template_mu, template_scale)
+        return fn(trace_batch, mask_band, bp_gain, templates_true, template_mu,
+                  template_scale, cond_scale)
 
     return step
 

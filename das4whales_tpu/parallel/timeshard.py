@@ -37,6 +37,7 @@ import scipy.signal as sp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .compat import axis_size, shard_map
 
+from ..ops import conditioning as cond_ops
 from ..ops import peaks as peak_ops
 from ..ops import spectral, xcorr
 from ..ops.filters import zero_phase_gain
@@ -201,8 +202,35 @@ def make_sharded_mf_step_time(
     fused_bandpass: bool = True,
     pick_tile: int = 512,
     pick_method: str = "topk",
+    wire: str = "conditioned",
+    scale_factor: float | None = None,
+    cond_time_samples: int | None = None,
+    cond_segments=None,
+    cond_means=None,
 ):
     """Full flagship detection step for a TIME-sharded ``[C, T]`` block.
+
+    ``wire="raw"`` consumes a NARROW-WIRE record (stored-dtype counts,
+    ``io.stream`` ``wire="raw"``): the conditioning prologue runs in the
+    SPMD body using ``scale_factor`` (required then). The time axis is
+    sharded here, so the per-channel demean is a ``psum`` of local sums
+    over the mesh axis (``ops.conditioning.condition_time_sharded``) —
+    one scalar-per-channel collective; reduction order differs from the
+    single-device mean by float roundoff only. ``cond_time_samples``
+    divides the mean by the REAL sample count when the record carries
+    divisibility zero-padding (zeros add nothing to the sum, so this
+    yields the exact mean over real samples; default: the full length).
+
+    For a CONCATENATED multi-file record the conditioned wire demeans
+    each file separately, so the whole-record psum mean is the wrong
+    map: pass ``cond_segments`` (per-file time lengths, in record order)
+    plus ``cond_means`` (``[channel x n_files]`` float32 per-file means,
+    computed on the host from the raw blocks with the same numpy
+    reduction the conditioned readers use). The body then gather-
+    subtracts the exact host means (``ops.conditioning
+    .condition_segmented``) — no device reduction, so conditioned values
+    are bit-identical to the conditioned wire, and divisibility padding
+    (the samples past ``sum(cond_segments)``) conditions to exactly 0.
 
     ``fused_bandpass=True`` folds |H(f)|² into the full f-k mask (the
     time FFT of the pencil transform applies it), dropping the
@@ -241,6 +269,10 @@ def make_sharded_mf_step_time(
         raise ValueError(f"pick_mode must be 'sparse' or 'dense', got {pick_mode!r}")
     if outputs not in ("full", "picks"):
         raise ValueError(f"outputs must be 'full' or 'picks', got {outputs!r}")
+    if wire not in ("conditioned", "raw"):
+        raise ValueError(f"unknown wire {wire!r}; expected 'conditioned' or 'raw'")
+    if wire == "raw" and scale_factor is None:
+        raise ValueError("wire='raw' needs scale_factor (metadata.scale_factor)")
     nnx, nns = design.trace_shape
     if design.fk_channels != nnx:
         raise ValueError(
@@ -276,7 +308,56 @@ def make_sharded_mf_step_time(
     )
     n_templates = design.templates.shape[0]
 
-    def body(x, gain_w, mask_r, tmpl, tmu, tsc):
+    condition = wire == "raw"
+    cond_scale = jnp.asarray(0.0 if scale_factor is None else scale_factor,
+                             jnp.float32)
+    cond_n = int(cond_time_samples or nns)
+    segmented = cond_segments is not None or cond_means is not None
+    seg_operands = ()
+    if segmented:
+        if not condition:
+            raise ValueError("cond_segments/cond_means apply to wire='raw' only")
+        if cond_segments is None or cond_means is None:
+            raise ValueError("cond_segments and cond_means go together")
+        seg_lens = [int(n) for n in cond_segments]
+        n_real = sum(seg_lens)
+        if min(seg_lens, default=0) < 1 or not n_real <= nns:
+            raise ValueError(
+                f"cond_segments {seg_lens} must be positive and sum to at "
+                f"most the record length {nns}"
+            )
+        means = np.asarray(cond_means, np.float32)
+        if means.shape != (nnx, len(seg_lens)):
+            raise ValueError(
+                f"cond_means shape {means.shape} != "
+                f"{(nnx, len(seg_lens))} ([channel x n_segments])"
+            )
+        # sample -> file column; divisibility padding maps to a trailing
+        # all-zero mean column so it conditions to exactly 0
+        seg_ids = np.full(nns, len(seg_lens), np.int32)
+        seg_ids[:n_real] = np.repeat(
+            np.arange(len(seg_lens), dtype=np.int32), seg_lens
+        )
+        seg_operands = (
+            jnp.asarray(seg_ids),
+            jnp.asarray(np.concatenate(
+                [means, np.zeros((nnx, 1), np.float32)], axis=1
+            )),
+        )
+
+    def body(x, gain_w, mask_r, tmpl, tmu, tsc, cscale, *seg):
+        if condition and segmented:
+            # narrow-wire prologue, multi-file record: gather-subtract
+            # the exact per-file host means (ops/conditioning.py)
+            x = cond_ops.condition_segmented(
+                x, cscale, seg[0], seg[1], dtype=tmpl.dtype
+            )
+        elif condition:
+            # narrow-wire prologue: the per-channel mean spans time
+            # shards -> psum of local sums (ops/conditioning.py)
+            x = cond_ops.condition_time_sharded(
+                x, cscale, time_axis, cond_n, dtype=tmpl.dtype
+            )
         bp = (x if fused_bandpass
               else _bp_time_local(x, gain_w, halo=halo, axis_name=time_axis))
         trf = fk_apply_time_local(bp, mask_r, time_axis)           # [C, T/P]
@@ -325,7 +406,11 @@ def make_sharded_mf_step_time(
             P(None, None),        # true-length templates (replicated)
             P(None),              # template means (replicated)
             P(None),              # template scales (replicated)
-        ),
+            P(),                  # conditioning scale (replicated)
+        ) + ((
+            P(time_axis),         # per-sample file/segment ids
+            P(None, None),        # per-file host means (replicated)
+        ) if segmented else ()),
         out_specs=(
             (picks_spec, P())           # picks, threshold
             if outputs == "picks"
@@ -342,7 +427,8 @@ def make_sharded_mf_step_time(
 
     @jax.jit  # daslint: allow[R2] one-shot factory: caller holds the step for the run
     def step(trace):
-        return fn(trace, gain, mask_rows, templates_true, template_mu, template_scale)
+        return fn(trace, gain, mask_rows, templates_true, template_mu,
+                  template_scale, cond_scale, *seg_operands)
 
     return step
 

@@ -188,6 +188,7 @@ def run_campaign(
     interrogator: str = "optasense",
     prefetch: int = 2,
     engine: str = "h5py",
+    wire: str = "conditioned",
     **detector_kwargs,
 ) -> CampaignResult:
     """Detect over ``files``, tolerating per-file failures and resuming
@@ -195,11 +196,22 @@ def run_campaign(
 
     ``detector=None`` builds a ``MatchedFilterDetector`` from the first
     readable file's shape/metadata (extra ``detector_kwargs`` pass
-    through). Returns a :class:`CampaignResult`; durable state lives in
-    ``outdir/manifest.jsonl`` + ``outdir/picks/*.npz``.
+    through). ``wire="raw"`` streams stored-dtype counts (narrow wire)
+    and builds the detector with the matching on-device conditioning
+    prologue — a caller-supplied ``detector`` must have been built with
+    the same ``wire``. Returns a :class:`CampaignResult`; durable state
+    lives in ``outdir/manifest.jsonl`` + ``outdir/picks/*.npz``.
     """
     import jax.numpy as jnp
 
+    det_wire = getattr(detector, "wire", "conditioned")
+    if detector is not None and det_wire != wire:
+        raise ValueError(
+            f"detector was built with wire={det_wire!r} but the "
+            f"campaign streams wire={wire!r}; a conditioned-wire detector "
+            "fed raw counts would treat them as strain (no on-device "
+            "demean/scale) and silently mis-detect"
+        )
     os.makedirs(outdir, exist_ok=True)
     metas = _normalize_metas(metadata, list(files))
     records: List[FileRecord] = []
@@ -214,7 +226,7 @@ def run_campaign(
         stream = stream_strain_blocks(
             pending[i:], selected_channels, pend_metas[i:],
             interrogator=interrogator, prefetch=prefetch, engine=engine,
-            as_numpy=True,
+            as_numpy=True, wire=wire,
         )
         while True:
             path = pending[i] if i < len(pending) else None
@@ -232,7 +244,20 @@ def run_campaign(
                 if detector is None:
                     detector = MatchedFilterDetector(
                         block.metadata, selected_channels, block.trace.shape,
-                        **detector_kwargs,
+                        wire=wire, **detector_kwargs,
+                    )
+                det_meta = getattr(detector, "metadata", None)
+                if (wire == "raw" and det_meta is not None
+                        and block.metadata is not None
+                        and block.metadata.scale_factor != det_meta.scale_factor):
+                    # the raw wire conditions on device with the DETECTOR's
+                    # scale; a file probed with a different factor would get
+                    # the wrong strain silently — fail it per-file instead
+                    raise ValueError(
+                        f"scale_factor {block.metadata.scale_factor!r} != "
+                        f"detector scale {det_meta.scale_factor!r}; wire='raw' "
+                        "conditions with one scale — use wire='conditioned' "
+                        "for heterogeneous file sets"
                     )
                 result = detector(jnp.asarray(block.trace))
                 # any detector family works: the contract is a result with
@@ -389,11 +414,17 @@ def run_campaign_sharded(
     relative_threshold: float = 0.5,
     hf_factor: float = 0.9,
     fused_bandpass: bool = True,
+    wire: str = "conditioned",
 ) -> CampaignResult:
     """Multi-chip campaign: file batches land pre-sharded on the mesh and
     the whole batch detects in ONE program (data-parallel over files,
     channel-parallel within each — ``parallel.pipeline``), with the same
     manifest/resume/picks-artifact contract as :func:`run_campaign`.
+
+    ``wire="raw"`` is the narrow-wire mode: stored-dtype batches land
+    pre-sharded (2× fewer H2D bytes for int16 sources) and the SPMD step
+    conditions on the mesh (``make_sharded_mf_step(wire="raw")``) using
+    the probed ``scale_factor``; picks are bit-identical.
 
     Fault isolation is at PROBE granularity: every pending file is probed
     up front (cheap attribute read for HDF5; full parse for TDMS) and
@@ -425,6 +456,20 @@ def run_campaign_sharded(
     healthy_specs, spec0 = _probe_healthy(
         zip(pending, pend_metas), interrogator, fail
     )
+    if wire == "raw":
+        # the raw wire conditions on the mesh with ONE scale (spec0's); a
+        # file probed with a different factor cannot ride this step — fail
+        # it at probe granularity, like any unprobeable file
+        for p, sp in healthy_specs:
+            if sp.meta.scale_factor != spec0.meta.scale_factor:
+                fail(p, ValueError(
+                    f"scale_factor {sp.meta.scale_factor!r} != campaign "
+                    f"scale {spec0.meta.scale_factor!r}; wire='raw' "
+                    "conditions with one scale — use wire='conditioned' "
+                    "for heterogeneous file sets"
+                ))
+        healthy_specs = [(p, sp) for p, sp in healthy_specs
+                         if sp.meta.scale_factor == spec0.meta.scale_factor]
     healthy = [p for p, _ in healthy_specs]
     healthy_metas = [sp.meta for _, sp in healthy_specs]
     if not healthy:
@@ -440,10 +485,14 @@ def run_campaign_sharded(
     )
     if batch is None:
         batch = max(int(mesh.shape.get("file", 1)), 1)
+    wire_kw = (
+        {"wire": "raw", "scale_factor": spec0.meta.scale_factor}
+        if wire == "raw" else {}
+    )
     step_k0, step_full = _adaptive_sharded_steps(
         make_sharded_mf_step, design, mesh,
         relative_threshold=relative_threshold, hf_factor=hf_factor,
-        fused_bandpass=fused_bandpass,
+        fused_bandpass=fused_bandpass, **wire_kw,
     )
 
     factors = {name: (hf_factor if i == 0 else 1.0)
@@ -452,6 +501,7 @@ def run_campaign_sharded(
     for stack, blocks in stream_file_batches(
         healthy, selected_channels, healthy_metas, batch=batch, mesh=mesh,
         interrogator=interrogator, prefetch=prefetch, engine=engine, tail="pad",
+        wire=wire,
     ):
         t0 = time.perf_counter()
         sp_picks, thres = jax.block_until_ready(step_k0(stack))
@@ -514,9 +564,16 @@ def run_campaign_multiprocess(
     relative_threshold: float = 0.5,
     hf_factor: float = 0.9,
     fused_bandpass: bool = True,
+    wire: str = "conditioned",
 ) -> CampaignResult:
     """Multi-HOST campaign: one SPMD program per batch across all
     processes of the JAX runtime.
+
+    ``wire="raw"`` is rejected here for now: the shard callback's zero
+    fill for failed reads needs the stored dtype known identically on
+    every process *before* any process has read a byte, which the
+    metadata-only probe does not guarantee for irregular files. The
+    single-host campaigns carry the narrow wire.
 
     Every process runs this same call with the same arguments after
     ``parallel.distributed.initialize_from_env()`` formed the runtime
@@ -545,6 +602,11 @@ def run_campaign_multiprocess(
     from ..parallel import distributed
     from ..parallel.pipeline import input_sharding, make_sharded_mf_step
 
+    if wire != "conditioned":
+        raise ValueError(
+            "run_campaign_multiprocess supports wire='conditioned' only "
+            "(raw dtype must be known identically on every process)"
+        )
     is_writer = jax.process_index() == 0
     mesh = distributed.global_mesh()
     batch = int(mesh.shape["file"])

@@ -89,6 +89,7 @@ def detect_long_record(
     family: str = "mf",
     fused_bandpass: bool | None = None,
     family_kwargs: dict | None = None,
+    wire: str = "conditioned",
 ) -> LongRecordResult:
     """Detect calls over a continuous multi-file record.
 
@@ -97,6 +98,15 @@ def detect_long_record(
     The time axis is sharded over ``mesh`` (defaults to all devices on a
     1-D ``(time,)`` mesh); channels stay whole for the flagship family,
     so any channel count works.
+
+    ``wire="raw"`` (flagship family only) streams and concatenates the
+    STORED dtype — the multi-file record crosses host→device as raw
+    counts (2× fewer bytes for int16 sources, and half the host RAM for
+    the concatenated record) and the time-sharded step conditions on
+    device by gather-subtracting the exact per-file host means
+    (``ops.conditioning.condition_segmented`` — the conditioned wire
+    demeans each file separately, so a whole-record demean would be the
+    wrong map when files carry different DC count offsets).
 
     ``family`` selects the detector: ``"mf"`` (flagship matched filter),
     ``"spectro"`` (spectrogram correlation — picks are reported at frame
@@ -110,6 +120,13 @@ def detect_long_record(
     """
     if family not in ("mf", "spectro", "gabor", "learned"):
         raise ValueError(f"unknown family {family!r}")
+    if wire not in ("conditioned", "raw"):
+        raise ValueError(f"unknown wire {wire!r}; expected 'conditioned' or 'raw'")
+    if wire == "raw" and family != "mf":
+        raise ValueError(
+            "wire='raw' is wired into the flagship family only; the "
+            "spectro/gabor/learned front ends consume conditioned strain"
+        )
     fam_kw = dict(family_kwargs or {})
     if family == "mf" and fam_kw:
         raise ValueError(
@@ -152,7 +169,7 @@ def detect_long_record(
 
     blocks = list(stream_strain_blocks(
         files, selected_channels, metadata,
-        interrogator=interrogator, engine=engine, as_numpy=True,
+        interrogator=interrogator, engine=engine, as_numpy=True, wire=wire,
     ))
     meta = as_metadata(blocks[0].metadata)
     record = np.concatenate([b.trace for b in blocks], axis=-1)
@@ -219,11 +236,40 @@ def detect_long_record(
         )
         # campaign-mode outputs: the full-record trf/corr/env arrays never
         # become program outputs (this workflow only consumes picks)
+        cond_kw = {}
+        if wire == "raw":
+            # per-FILE conditioning parameters, host-side: the conditioned
+            # wire demeans each file before concatenation, so the on-device
+            # prologue must subtract the same per-file means (and leave the
+            # divisibility pad exactly 0) — one numpy pass per raw block,
+            # the identical reduction the conditioned readers run, making
+            # raw-wire conditioning bit-identical (ops.conditioning
+            # .condition_segmented)
+            scales = {as_metadata(b.metadata).scale_factor for b in blocks}
+            if len(scales) > 1:
+                raise ValueError(
+                    f"wire='raw' conditions the record with one scale but "
+                    f"the files probed {sorted(scales)}; use "
+                    "wire='conditioned' for heterogeneous file sets"
+                )
+            # dtype=f32 reduces with the same pairwise float32 sum as the
+            # conditioned readers' astype(f32).mean, WITHOUT materializing
+            # a float32 copy of each raw block (that temp would transiently
+            # re-inflate the host RAM the narrow wire halves)
+            cond_kw = dict(
+                scale_factor=meta.scale_factor,
+                cond_segments=[b.trace.shape[-1] for b in blocks],
+                cond_means=np.stack(
+                    [b.trace.mean(axis=1, dtype=np.float32) for b in blocks],
+                    axis=1,
+                ),
+            )
         step = make_sharded_mf_step_time(
             design, mesh, time_axis=time_axis, halo=halo,
             relative_threshold=relative_threshold, hf_factor=hf_factor,
             pick_mode="sparse", max_peaks=max_peaks_per_channel,
             fused_bandpass=fused_bandpass, outputs="picks",
+            wire=wire, **cond_kw,
         )
         sp_picks, thres = jax.block_until_ready(step(xd))
         names = design.template_names

@@ -28,6 +28,11 @@ def main():
         "artifacts", "profile"))
     ap.add_argument("--deadline", type=float,
                     default=float(os.environ.get("DAS_PERF_DEADLINE", 1500.0)))
+    ap.add_argument("--wire", choices=("raw", "conditioned"),
+                    default=os.environ.get("DAS_BENCH_WIRE", "raw"),
+                    help="H2D wire format: 'raw' ships int16 counts and "
+                         "conditions on device (narrow wire, the bench "
+                         "default); 'conditioned' ships float32 strain")
     args = ap.parse_args()
 
     from scripts._wedge_guard import arm_deadline, resolve_backend
@@ -45,16 +50,32 @@ def main():
     import time
 
     nx, ns = (1024, 3000) if args.quick else (22050, 12000)
-    meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=nx, ns=ns)
-    # the bench/campaign configuration: picks-only -> the one-program route
+    meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=nx, ns=ns,
+                               scale_factor=1e-12)
+    # the bench/campaign configuration: picks-only -> the one-program
+    # route; wire="raw" adds the on-device conditioning prologue so the
+    # trace shows the narrow-wire production path
     det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns),
-                                keep_correlograms=False)
+                                keep_correlograms=False, wire=args.wire)
     rng = np.random.default_rng(0)
-    block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
+    if args.wire == "raw":
+        block = rng.normal(0.0, 1000.0, size=(nx, ns))
+        block = np.rint(block).astype(np.int16)
+    else:
+        block = rng.standard_normal((nx, ns)).astype(np.float32) * 1e-9
     slab = 4096
-    x = jnp.concatenate(
-        [jax.device_put(block[i : i + slab]) for i in range(0, nx, slab)], axis=0
-    )
+
+    def put_block():
+        return jnp.concatenate(
+            [jax.device_put(block[i : i + slab]) for i in range(0, nx, slab)],
+            axis=0,
+        )
+
+    t0 = time.perf_counter()
+    x = jax.block_until_ready(put_block())
+    h2d_wall = time.perf_counter() - t0
+    print(f"h2d transfer: {h2d_wall:.3f} s for wire_bytes={block.nbytes} "
+          f"(wire={args.wire}, wire_dtype={block.dtype})", flush=True)
 
     def sync(res):
         if res.trf_fk is not None:
@@ -74,7 +95,8 @@ def main():
     # the multi-dispatch legacy path in a SEPARATE trace dir: diffing the
     # two attributes exactly how much of the round-4 wall was host syncs
     legacy_dir = args.logdir + "_multidispatch"
-    det_legacy = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns))
+    det_legacy = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns),
+                                       wire=args.wire)
     jax.block_until_ready(det_legacy(x).trf_fk)    # compile + warm
     os.makedirs(legacy_dir, exist_ok=True)
     t0 = time.perf_counter()

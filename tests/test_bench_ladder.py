@@ -259,6 +259,12 @@ def test_probe_failure_replays_banked_tpu_line(monkeypatch, tmp_path):
     assert rc == 0
     assert p["banked"] is True
     assert p["shape"] == [22050, 12000] and p["value"] == 1.23e9
+    # provenance IN the headline: metric names the replay + age, and the
+    # banked/age/staleness keys sit right behind the headline numbers
+    assert "REPLAYED BANK" in p["metric"]
+    assert list(p)[:7] == ["metric", "value", "unit", "vs_baseline",
+                           "banked", "banked_age_h", "stale_commit"]
+    assert p["stale_commit"] is False            # no banked_commit recorded
     assert "banked" in p["device"] and "unreachable at report time" in p["device"]
     # the annotation must not overclaim provenance (the bank survives
     # across sessions inside the age cap)
@@ -389,7 +395,7 @@ def test_bank_keeps_best_payload(monkeypatch, tmp_path):
     assert json.load(open(bench.BANK_PATH))["shape"] == [22050, 12000]
 
 
-def test_fallback_stage_breakdown_consistent_with_wall():
+def test_fallback_stage_breakdown_consistent_with_wall(monkeypatch):
     """The graded artifact must be internally consistent (VERDICT r3 weak
     #2: a stage table summing to 10x the headline wall): the stage
     breakdown follows the detector's RESOLVED pick engine — scipy host
@@ -399,6 +405,9 @@ def test_fallback_stage_breakdown_consistent_with_wall():
     import os
     import subprocess as sp
 
+    # the wire assertions below pin the DEFAULT (raw) wire; a shell that
+    # exported the documented opt-out must not fail the suite
+    monkeypatch.delenv("DAS_BENCH_WIRE", raising=False)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = sp.run(
         [sys.executable, bench.__file__, "--quick", "--no-cpu"],
@@ -415,9 +424,16 @@ def test_fallback_stage_breakdown_consistent_with_wall():
     # the v5e roofline predictions ride along for every stage, but the
     # achieved-fraction field is null off-TPU (meaningless on a CPU wall)
     # every COMPUTE stage gets a roofline bound; the sync_overhead row is
-    # a measured dispatch constant with no bandwidth model
-    assert set(p["roofline_pred_ms"]) == set(stages) - {"sync_overhead"}
+    # a measured dispatch constant and h2d a measured wire transfer —
+    # neither has an HBM bandwidth model
+    assert set(p["roofline_pred_ms"]) == set(stages) - {"sync_overhead", "h2d"}
     assert p["roofline_frac"] is None
+    # narrow-wire attribution (ISSUE 2 acceptance): the transfer is an
+    # attributed stage and the payload names what crossed the wire
+    assert stages["h2d"] >= 0.0
+    assert p["wire"] == "raw" and p["wire_dtype"] == "int16"
+    nx, ns = p["shape"]
+    assert p["wire_bytes"] == nx * ns * 2          # ≤ 0.5x the f32 wire
 
 
 def test_truncated_rung_result_line_is_a_rung_failure():
@@ -528,6 +544,9 @@ def test_replay_rederives_vs_baseline_from_measured_wall(monkeypatch, tmp_path):
 
     rc, p = run_scenario(monkeypatch, spawn, probe_ok=False, bank_path=str(bank))
     assert rc == 0 and p["banked"] is True
+    # measured on commit aaaaaaa != HEAD: the staleness must be in the
+    # headline metric itself, not only in a trailing payload key
+    assert p["stale_commit"] is True and "STALE COMMIT" in p["metric"]
     assert p["vs_baseline"] == pytest.approx(226.2 / 4.8559, rel=1e-3)
     assert p["cpu_ref_mode"].startswith("measured-same-shape")
     assert p["vs_baseline_extrapolated"] == 73.32

@@ -1,0 +1,424 @@
+"""Narrow-wire ingest: on-device conditioning parity + transfer accounting.
+
+The exactness contract of the raw wire (ISSUE 2): ``wire="raw"`` ships
+the STORED dtype over host→device and runs the demean+scale affine map on
+device (``ops/conditioning.py``) — picks must be bit-identical to the
+host-conditioned route on every execution path (one-program single-chip,
+channel-sharded SPMD, time-sharded SPMD, campaign, long-record), for both
+int16 TDMS counts and float32/int32 OptaSense HDF5 inputs, while the wire
+carries at most the stored-dtype bytes (0.5× float32 for int16 sources).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from das4whales_tpu.io.hdf5 import write_optasense
+from das4whales_tpu.io.interrogators import get_acquisition_parameters
+from das4whales_tpu.io.stream import stream_file_batches, stream_strain_blocks
+from das4whales_tpu.io.synth import (
+    SyntheticCall,
+    SyntheticScene,
+    write_synthetic_tdms,
+)
+from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+from das4whales_tpu.ops import conditioning
+
+NX, NS = 32, 1200
+SEL = [0, NX, 1]
+
+
+def _scene(seed=0):
+    return SyntheticScene(
+        nx=NX, ns=NS, noise_rms=0.05, seed=seed,
+        calls=[SyntheticCall(t0=2.0, x0_m=NX / 2 * 2.042, amplitude=2.0)],
+    )
+
+
+@pytest.fixture
+def tdms_file(tmp_path):
+    return write_synthetic_tdms(str(tmp_path / "a.tdms"), _scene())
+
+
+@pytest.fixture
+def h5_f32_file(tmp_path, rng):
+    """A float32-RawData OptaSense file (float OOI products exist in the
+    wild): raw wire must still demean+scale on device."""
+    counts = rng.normal(0.0, 1000.0, size=(NX, NS)).astype(np.float32)
+    t = np.arange(0, 0.68, 1 / 200.0)
+    chirp = (np.cos(2 * np.pi * 20.0 * t) * np.hanning(len(t))).astype(np.float32)
+    counts[NX // 2, 400 : 400 + len(chirp)] += 5000.0 * chirp
+    return write_optasense(str(tmp_path / "f32.h5"), counts, fs=200.0, dx=2.0,
+                           raw_dtype=np.float32)
+
+
+def _detector_pair(meta):
+    kw = dict(pick_mode="sparse", keep_correlograms=False)
+    return (
+        MatchedFilterDetector(meta, SEL, (NX, NS), **kw),
+        MatchedFilterDetector(meta, SEL, (NX, NS), wire="raw", **kw),
+    )
+
+
+def _stream_pair(path, wire_dtype, **kw):
+    cond = next(stream_strain_blocks([path], SEL, as_numpy=True, **kw))
+    raw = next(stream_strain_blocks([path], SEL, as_numpy=True, wire="raw", **kw))
+    assert raw.wire == "raw" and cond.wire == "conditioned"
+    assert raw.trace.dtype == wire_dtype
+    return cond, raw
+
+
+def _assert_picks_identical(res_cond, res_raw):
+    assert set(res_cond.picks) == set(res_raw.picks)
+    n_total = 0
+    for name in res_cond.picks:
+        np.testing.assert_array_equal(res_cond.picks[name], res_raw.picks[name])
+        n_total += res_cond.picks[name].shape[1]
+    assert n_total > 0, "parity over an empty pick set proves nothing"
+
+
+def test_condition_matches_host_map(rng):
+    raw = rng.integers(-20000, 20000, size=(8, 64)).astype(np.int16)
+    scale = 3.25e-9
+    host = raw.astype(np.float32)
+    host = (host - host.mean(axis=1, keepdims=True)) * scale
+    dev = np.asarray(conditioning.condition(jnp.asarray(raw), scale))
+    assert dev.dtype == np.float32
+    np.testing.assert_allclose(dev, host, rtol=1e-6, atol=1e-30)
+    # no-demean variant: pure cast+scale
+    nod = np.asarray(conditioning.condition(jnp.asarray(raw), scale, demean=False))
+    np.testing.assert_allclose(nod, raw.astype(np.float32) * scale, rtol=1e-7)
+
+
+@pytest.mark.filterwarnings("ignore:Some donated buffers were not usable")
+def test_condition_jit_and_donated_agree(rng):
+    # CPU backends do not implement donation — the donated variant must
+    # still compute correctly there (the warning is expected noise)
+    raw = jnp.asarray(rng.integers(-100, 100, size=(4, 32)).astype(np.int16))
+    a = np.asarray(conditioning.condition_jit(raw, 1e-9))
+    b = np.asarray(conditioning.condition_donated(jnp.asarray(raw), 1e-9))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_condition_time_sharded_pad_masks_to_zero(rng):
+    """The psum-demean path with ``n_time_global`` < record length (the
+    documented padded-record recipe): pad samples must condition to
+    EXACTLY 0 — the conditioned wire pads after conditioning, and a
+    ``-mean*scale`` tail would leak into the record-length FFT."""
+    from jax.sharding import PartitionSpec as P
+
+    from das4whales_tpu.parallel import make_mesh
+    from das4whales_tpu.parallel.compat import shard_map
+
+    p = len(jax.devices())
+    n_real, scale = 100, 3.25e-9
+    n_pad = p - n_real % p if n_real % p else p   # always a real pad tail
+    raw = rng.integers(-20000, 20000, size=(8, n_real)).astype(np.int16)
+    padded = np.pad(raw, ((0, 0), (0, n_pad)))
+    mesh = make_mesh(shape=(p,), axis_names=("time",))
+    fn = shard_map(
+        lambda x: conditioning.condition_time_sharded(x, scale, "time", n_real),
+        mesh=mesh, in_specs=P(None, "time"), out_specs=P(None, "time"),
+        check_vma=False,
+    )
+    out = np.asarray(fn(jnp.asarray(padded)))
+    assert (out[:, n_real:] == 0.0).all()
+    host = raw.astype(np.float32)
+    host = (host - host.mean(axis=1, keepdims=True)) * scale
+    np.testing.assert_allclose(out[:, :n_real], host, rtol=1e-5, atol=1e-30)
+
+
+def test_condition_segmented_matches_per_file_host_map(rng):
+    """Gather-subtract of host-computed per-file means: bit-identical to
+    per-file host conditioning, pad column conditions to exactly 0."""
+    lens, scale = (60, 40), 1.5e-9
+    raw = rng.integers(-20000, 20000, size=(6, sum(lens) + 4)).astype(np.int32)
+    raw[:, sum(lens):] = 0                              # divisibility pad
+    mu = np.stack(
+        [raw[:, s - n:s].astype(np.float32).mean(axis=1)
+         for s, n in zip(np.cumsum(lens), lens)], axis=1,
+    )
+    seg_ids = np.repeat(np.arange(3, dtype=np.int32), list(lens) + [4])
+    means = np.concatenate([mu, np.zeros((6, 1), np.float32)], axis=1)
+    out = np.asarray(conditioning.condition_segmented(
+        jnp.asarray(raw), scale, jnp.asarray(seg_ids), jnp.asarray(means)
+    ))
+    assert (out[:, sum(lens):] == 0.0).all()
+    host = []
+    for s, n in zip(np.cumsum(lens), lens):
+        x = raw[:, s - n:s].astype(np.float32)
+        x -= x.mean(axis=1, keepdims=True)
+        x *= scale
+        host.append(x)
+    np.testing.assert_array_equal(out[:, :sum(lens)], np.concatenate(host, axis=1))
+
+
+def test_load_das_data_native_engine_raw_wire(h5_f32_file):
+    """An explicit ``engine='native'`` must be honored (or raise) on the
+    raw wire, not silently fall back to h5py — the native layout serves
+    raw reads through the stored-dtype memmap gather."""
+    from das4whales_tpu.io import native
+    from das4whales_tpu.io.hdf5 import load_das_data
+
+    if not native.available():
+        pytest.skip("native ingest engine not built on this image")
+    meta = get_acquisition_parameters(h5_f32_file, "optasense")
+    blk_n = load_das_data(h5_f32_file, SEL, meta, engine="native", wire="raw")
+    blk_h = load_das_data(h5_f32_file, SEL, meta, engine="h5py", wire="raw")
+    np.testing.assert_array_equal(np.asarray(blk_n.trace), np.asarray(blk_h.trace))
+
+
+def test_raw_wire_halves_tdms_transfer_bytes(tdms_file):
+    cond, raw = _stream_pair(tdms_file, np.int16, engine="h5py")
+    assert raw.trace.nbytes * 2 == cond.trace.nbytes
+
+
+def test_tdms_int16_picks_bit_identical(tdms_file):
+    """Acceptance: int16 TDMS raw wire == conditioned wire, pick for pick."""
+    cond, raw = _stream_pair(tdms_file, np.int16, engine="h5py")
+    det_c, det_r = _detector_pair(cond.metadata)
+    _assert_picks_identical(det_c(cond.trace), det_r(raw.trace))
+
+
+def test_hdf5_float32_picks_bit_identical(h5_f32_file):
+    """Acceptance: float32 HDF5 raw wire == conditioned wire — the raw
+    route must still demean+scale even though no dtype cast happens."""
+    meta = get_acquisition_parameters(h5_f32_file, "optasense")
+    cond, raw = _stream_pair(h5_f32_file, np.float32, metadata=meta,
+                             engine="h5py")
+    det_c, det_r = _detector_pair(meta)
+    _assert_picks_identical(det_c(cond.trace), det_r(raw.trace))
+
+
+def test_load_das_data_raw_wire_matches(h5_f32_file):
+    from das4whales_tpu.io.hdf5 import load_das_data
+
+    meta = get_acquisition_parameters(h5_f32_file, "optasense")
+    blk_c = load_das_data(h5_f32_file, SEL, meta, engine="h5py")
+    blk_r = load_das_data(h5_f32_file, SEL, meta, engine="h5py", wire="raw")
+    np.testing.assert_allclose(np.asarray(blk_r.trace), np.asarray(blk_c.trace),
+                               rtol=1e-5, atol=1e-30)
+    with pytest.raises(ValueError, match="wire"):
+        load_das_data(h5_f32_file, SEL, meta, wire="chunky")
+
+
+def test_detector_full_route_parity(tdms_file):
+    """The staged (non-one-program) routes condition via the detector's
+    standalone prologue — same picks, and the result carries the
+    correlograms the campaign mode skips."""
+    cond, raw = _stream_pair(tdms_file, np.int16, engine="h5py")
+    meta = cond.metadata
+    det_c = MatchedFilterDetector(meta, SEL, (NX, NS), pick_mode="sparse")
+    det_r = MatchedFilterDetector(meta, SEL, (NX, NS), pick_mode="sparse",
+                                  wire="raw")
+    rc, rr = det_c(cond.trace), det_r(raw.trace)
+    _assert_picks_identical(rc, rr)
+    for name in rc.correlograms:
+        # float32 roundoff only: the demean reduction runs on device for
+        # the raw wire, so near-zero tail samples differ in the last ulps
+        np.testing.assert_allclose(
+            np.asarray(rc.correlograms[name]), np.asarray(rr.correlograms[name]),
+            rtol=1e-3, atol=2e-5,
+        )
+
+
+def test_sharded_step_raw_wire_parity(tdms_file):
+    from das4whales_tpu.models.matched_filter import design_matched_filter
+    from das4whales_tpu.parallel import make_mesh
+    from das4whales_tpu.parallel.pipeline import make_sharded_mf_step
+
+    meta = get_acquisition_parameters(tdms_file, "silixa")
+    mesh = make_mesh(shape=(2, 4), axis_names=("file", "channel"))
+    design = design_matched_filter((NX, NS), SEL, meta)
+    step_c = make_sharded_mf_step(design, mesh, outputs="picks")
+    step_r = make_sharded_mf_step(design, mesh, outputs="picks", wire="raw",
+                                  scale_factor=meta.scale_factor)
+    files = [tdms_file, tdms_file]
+    (bc, _), = stream_file_batches(files, SEL, batch=2, mesh=mesh)
+    (br, _), = stream_file_batches(files, SEL, batch=2, mesh=mesh, wire="raw")
+    assert br.dtype == jnp.int16 and br.nbytes * 2 == bc.nbytes
+    pc, tc = jax.block_until_ready(step_c(bc))
+    pr, tr = jax.block_until_ready(step_r(br))
+    np.testing.assert_array_equal(np.asarray(pc.selected), np.asarray(pr.selected))
+    np.testing.assert_array_equal(
+        np.asarray(pc.positions)[np.asarray(pc.selected)],
+        np.asarray(pr.positions)[np.asarray(pr.selected)],
+    )
+    np.testing.assert_allclose(np.asarray(tc), np.asarray(tr), rtol=1e-5)
+    with pytest.raises(ValueError, match="scale_factor"):
+        make_sharded_mf_step(design, mesh, wire="raw")
+
+
+def test_timesharded_step_raw_wire_parity(tdms_file):
+    """Time-sharded conditioning demeans via psum across shards — picks
+    must still match the conditioned wire exactly."""
+    from das4whales_tpu.models.matched_filter import design_matched_filter
+    from das4whales_tpu.parallel import make_mesh
+    from das4whales_tpu.parallel.timeshard import (
+        make_sharded_mf_step_time,
+        time_sharding,
+    )
+
+    meta = get_acquisition_parameters(tdms_file, "silixa")
+    cond, raw = _stream_pair(tdms_file, np.int16, engine="h5py")
+    mesh = make_mesh(shape=(8,), axis_names=("time",))
+    design = design_matched_filter((NX, NS), SEL, meta)
+    st_c = make_sharded_mf_step_time(design, mesh, outputs="picks")
+    st_r = make_sharded_mf_step_time(design, mesh, outputs="picks", wire="raw",
+                                     scale_factor=meta.scale_factor)
+    xc = jax.device_put(jnp.asarray(cond.trace), time_sharding(mesh))
+    xr = jax.device_put(jnp.asarray(raw.trace), time_sharding(mesh))
+    pc, tc = jax.block_until_ready(st_c(xc))
+    pr, tr = jax.block_until_ready(st_r(xr))
+    np.testing.assert_array_equal(np.asarray(pc.selected), np.asarray(pr.selected))
+    np.testing.assert_array_equal(
+        np.asarray(pc.positions)[np.asarray(pc.selected)],
+        np.asarray(pr.positions)[np.asarray(pr.selected)],
+    )
+    assert np.asarray(pc.selected).any()
+    np.testing.assert_allclose(float(tc), float(tr), rtol=1e-5)
+
+
+def test_campaign_raw_wire_parity(tmp_path):
+    from das4whales_tpu.workflows.campaign import load_picks, run_campaign
+
+    files = [write_synthetic_tdms(str(tmp_path / f"f{k}.tdms"), _scene(k))
+             for k in range(2)]
+    res_c = run_campaign(files, SEL, str(tmp_path / "cc"),
+                         pick_mode="sparse", keep_correlograms=False)
+    res_r = run_campaign(files, SEL, str(tmp_path / "cr"), wire="raw",
+                         pick_mode="sparse", keep_correlograms=False)
+    assert res_c.n_done == res_r.n_done == 2
+    for a, b in zip(res_c.records, res_r.records):
+        pa, pb = load_picks(a.picks_file), load_picks(b.picks_file)
+        for name in pa:
+            np.testing.assert_array_equal(pa[name], pb[name])
+
+
+def test_longrecord_raw_wire_parity(tmp_path):
+    from das4whales_tpu.workflows.longrecord import detect_long_record
+
+    files = [write_synthetic_tdms(str(tmp_path / f"f{k}.tdms"), _scene(k))
+             for k in range(2)]
+    rc = detect_long_record(files, SEL)
+    rr = detect_long_record(files, SEL, wire="raw")
+    assert set(rc.picks) == set(rr.picks)
+    for name in rc.picks:
+        np.testing.assert_array_equal(rc.picks[name], rr.picks[name])
+    assert sum(p.shape[1] for p in rc.picks.values()) > 0
+    with pytest.raises(ValueError, match="flagship family only"):
+        detect_long_record(files, SEL, wire="raw", family="spectro")
+
+
+def test_longrecord_raw_wire_parity_dc_offsets(tmp_path, rng):
+    """The conditioned wire demeans each FILE separately (the stream's
+    per-file host demean) and zero-pads AFTER conditioning; the raw wire
+    must run the same map — per-file means, pad exactly 0 — not one
+    global whole-record demean. Files with different DC count offsets
+    (routine interrogator drift) and a record length that forces a
+    divisibility pad expose both differences."""
+    from das4whales_tpu.workflows.longrecord import detect_long_record
+
+    ns = 1202                          # 2 files -> 2404 % 8 != 0: real pad
+    fs, dx = 200.0, 2.0
+    t = np.arange(0, 0.68, 1 / fs)
+    chirp = np.cos(2 * np.pi * 20.0 * t) * np.hanning(len(t))
+    files = []
+    for k, dc in enumerate((20000.0, -15000.0)):
+        counts = rng.normal(dc, 1000.0, size=(NX, ns))
+        counts[NX // 2, 300 : 300 + len(chirp)] += 5000.0 * chirp
+        files.append(write_optasense(
+            str(tmp_path / f"dc{k}.h5"), np.rint(counts).astype(np.int32),
+            fs=fs, dx=dx,
+        ))
+    meta = get_acquisition_parameters(files[0], "optasense")
+    rc = detect_long_record(files, SEL, meta, engine="h5py")
+    rr = detect_long_record(files, SEL, meta, engine="h5py", wire="raw")
+    assert set(rc.picks) == set(rr.picks)
+    n_total = 0
+    for name in rc.picks:
+        np.testing.assert_array_equal(rc.picks[name], rr.picks[name])
+        n_total += rc.picks[name].shape[1]
+    assert n_total > 0
+    for name in rc.thresholds:
+        assert rc.thresholds[name] == pytest.approx(rr.thresholds[name], rel=1e-6)
+
+
+def test_tiled_route_raw_wire_parity(tdms_file):
+    """The tiled (memory-lean) route builds its threshold vector on
+    device — on the raw wire that cast must target the COMPUTE dtype,
+    not the int16 input dtype (which int-truncates thresholds: an
+    explicit 0.7 becomes 0 and every noise local max over-picks)."""
+    cond, raw = _stream_pair(tdms_file, np.int16, engine="h5py")
+    meta = cond.metadata
+    # int tile forces "tiled"; keep_correlograms routes through
+    # _call_full -> _call_tiled instead of the one-program route
+    kw = dict(pick_mode="sparse", channel_tile=16, keep_correlograms=True)
+    det_c = MatchedFilterDetector(meta, SEL, (NX, NS), **kw)
+    det_r = MatchedFilterDetector(meta, SEL, (NX, NS), wire="raw", **kw)
+    assert det_c._route() == det_r._route() == "tiled"
+    _assert_picks_identical(det_c(cond.trace), det_r(raw.trace))
+    # sub-integer explicit threshold: int16 truncation would zero it
+    _assert_picks_identical(det_c(cond.trace, threshold=0.7),
+                            det_r(raw.trace, threshold=0.7))
+
+
+def test_multiprocess_campaign_rejects_raw_wire(tmp_path):
+    from das4whales_tpu.workflows.campaign import run_campaign_multiprocess
+
+    with pytest.raises(ValueError, match="conditioned"):
+        run_campaign_multiprocess([], SEL, str(tmp_path), wire="raw")
+
+
+def test_raw_wire_heterogeneous_scale_fails_fast(tmp_path, rng):
+    """The raw wire conditions with ONE scale_factor; a campaign file probed
+    with a different factor must fail (per-file), and a long record must
+    raise — never condition with the wrong scale silently."""
+    from das4whales_tpu.workflows.campaign import run_campaign
+    from das4whales_tpu.workflows.longrecord import detect_long_record
+
+    paths = []
+    for k, gl in enumerate((51.05, 25.0)):   # probe -> different scale_factor
+        counts = rng.integers(-20000, 20000, size=(NX, NS)).astype(np.int32)
+        paths.append(write_optasense(str(tmp_path / f"g{k}.h5"), counts,
+                                     fs=200.0, dx=2.0, gauge_length=gl))
+
+    res = run_campaign(paths, SEL, str(tmp_path / "camp"), wire="raw",
+                       pick_mode="sparse", keep_correlograms=False)
+    assert res.n_done == 1 and res.n_failed == 1
+    failed = [r for r in res.records if r.status == "failed"]
+    assert failed[0].path == paths[1] and "scale" in failed[0].error
+
+    with pytest.raises(ValueError, match="scale"):
+        detect_long_record(paths, SEL, wire="raw")
+
+
+def test_campaign_rejects_wire_mismatched_detector(tmp_path):
+    """A conditioned-wire detector fed the raw stream would silently treat
+    counts as strain — the mismatch must fail fast, both directions."""
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.workflows.campaign import run_campaign
+
+    md = AcquisitionMetadata(fs=200.0, dx=2.0, nx=NX, ns=NS)
+    det_c = MatchedFilterDetector(md, SEL, (NX, NS))
+    with pytest.raises(ValueError, match="wire"):
+        run_campaign([], SEL, str(tmp_path / "a"), detector=det_c, wire="raw")
+    det_r = MatchedFilterDetector(md, SEL, (NX, NS), wire="raw")
+    with pytest.raises(ValueError, match="wire"):
+        run_campaign([], SEL, str(tmp_path / "b"), detector=det_r)
+
+
+def test_wire_validation():
+    meta = get_acquisition_parameters.__module__  # keep import honest
+    assert meta
+    with pytest.raises(ValueError, match="wire"):
+        list(stream_strain_blocks(["x.h5"], SEL, wire="wide"))
+    from das4whales_tpu.config import AcquisitionMetadata
+
+    md = AcquisitionMetadata(fs=200.0, dx=2.0, nx=NX, ns=NS)
+    with pytest.raises(ValueError, match="wire"):
+        MatchedFilterDetector(md, SEL, (NX, NS), wire="wide")

@@ -630,6 +630,43 @@ class TestRecompileGuard:
         self._guard2(compile_guard, "gabor filter2d_same",
                      image.filter2d_same, img, kernel)
 
+    def test_one_program_raw_wire_detect_compiles_once(self, compile_guard, rng):
+        """The conditioning-fused one-program route (narrow wire,
+        models/matched_filter.py:mf_detect_picks_program with
+        condition=True): across two same-shape raw files the warmed
+        entry point may compile NOTHING — the ceiling is one compile
+        total, paid by the warm-up. max_peaks == pick_k0 pins the
+        adaptive-K policy to its single-program branch, so a second
+        compile here is a genuine retrace of the conditioning prologue
+        (e.g. a weak-typed scale or a per-call wrapper)."""
+        from das4whales_tpu.config import AcquisitionMetadata
+        from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+        nx, ns = 16, 512
+        meta = AcquisitionMetadata(fs=200.0, dx=2.0, nx=nx, ns=ns,
+                                   scale_factor=1e-12)
+        det = MatchedFilterDetector(
+            meta, [0, nx, 1], (nx, ns), pick_mode="sparse",
+            keep_correlograms=False, wire="raw", max_peaks=64,
+        )
+
+        def block(seed):
+            r = np.random.default_rng(seed)
+            x = jnp.asarray(r.integers(-1000, 1000, (nx, ns)).astype(np.int16))
+            jax.block_until_ready(x)
+            return x
+
+        a, b, c = block(0), (block(1)), block(2)
+        # warm-up pays the one-and-only compile (plus the tiny helper
+        # fills detect_picks builds alongside the program); after it, two
+        # same-shape files must compile NOTHING — i.e. the route's total
+        # ceiling across same-shape files is the single cold compile
+        _, cold = compile_guard.count_compiles(det.detect_picks, a)
+        assert cold >= 1
+        with compile_guard.max_compiles(0, what="one-program raw-wire warm"):
+            det.detect_picks(b)
+            det.detect_picks(c)
+
     def test_guard_trips_on_shape_churn(self, compile_guard):
         f = jax.jit(lambda v: v * 2.0)
         x8 = jnp.ones((8,))

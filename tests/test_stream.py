@@ -110,6 +110,161 @@ def test_stream_file_batches_sharded(file_set):
     assert not np.asarray(tail_stack[1]).any()  # padded slot is zeros
 
 
+def _truncate(path, keep_fraction=0.4):
+    """Corrupt a file mid-data: metadata parses (probe succeeds), the bulk
+    read fails."""
+    import os
+
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(int(size * keep_fraction))
+    return path
+
+
+@pytest.mark.parametrize("wire", ["conditioned", "raw"])
+@pytest.mark.parametrize("overlap", [False, True])
+def test_midstream_read_failure_surfaces_in_order(file_set, wire, overlap):
+    """A file that errors during prefetch must raise on ITS OWN ordered
+    yield — never wedge the stream, never reorder it, and never steal the
+    position of a healthy earlier file (the campaign runner's per-file
+    fault attribution rides on this)."""
+    paths, raws = file_set
+    meta = get_acquisition_parameters(paths[0], "optasense")
+    _truncate(paths[1])
+    stream = stream_strain_blocks(
+        paths[:4], [0, 32, 1], meta, prefetch=2, engine="h5py", wire=wire,
+        as_numpy=not overlap, overlap_transfers=overlap or None,
+    )
+    first = next(stream)  # file 0 is healthy and must arrive intact
+    got = np.asarray(first.trace)
+    want = raws[0][0:32:1]
+    if wire == "raw":
+        np.testing.assert_array_equal(got, want)
+    else:
+        np.testing.assert_allclose(
+            got, _expected(raws[0], [0, 32, 1], meta.scale_factor),
+            rtol=1e-4, atol=1e-16,
+        )
+    with pytest.raises(Exception):
+        next(stream)  # the corrupt file's OWN position, not a later one
+
+
+def test_midstream_probe_failure_surfaces_in_order(file_set, tmp_path):
+    """A file whose PROBE fails (garbage container) attributes to its own
+    yield position as well — with prefetch already past it."""
+    paths, _ = file_set
+    bad = str(tmp_path / "garbage.h5")
+    with open(bad, "wb") as fh:
+        fh.write(b"not an hdf5 file")
+    files = [paths[0], bad, paths[2]]
+    stream = stream_strain_blocks(files, [0, 32, 1], prefetch=3, engine="h5py")
+    next(stream)
+    with pytest.raises(Exception):
+        next(stream)
+
+
+def test_overlap_transfer_matches_blocking_handoff(file_set):
+    """The overlap executor (device_put of file k+1 dispatched during
+    compute on file k) must be value- and order-transparent."""
+    paths, _ = file_set
+    meta = get_acquisition_parameters(paths[0], "optasense")
+    on = list(stream_strain_blocks(paths, [0, 32, 1], meta,
+                                   overlap_transfers=True))
+    off = list(stream_strain_blocks(paths, [0, 32, 1], meta,
+                                    overlap_transfers=False))
+    assert len(on) == len(off) == len(paths)
+    for a, b in zip(on, off):
+        np.testing.assert_array_equal(np.asarray(a.trace), np.asarray(b.trace))
+
+
+def test_overlap_rejects_as_numpy(file_set):
+    paths, _ = file_set
+    with pytest.raises(ValueError, match="overlap_transfers"):
+        list(stream_strain_blocks(paths, [0, 32, 1], as_numpy=True,
+                                  overlap_transfers=True))
+
+
+@pytest.mark.skipif(not native.available(), reason="native engine unavailable")
+def test_native_overlap_matches_h5py(file_set):
+    """Native engine + overlap executor (the production TPU ingest path):
+    same values, same order as the pure-h5py blocking stream."""
+    paths, _ = file_set
+    meta = get_acquisition_parameters(paths[0], "optasense")
+    nat = list(stream_strain_blocks(paths, [0, 32, 1], meta, engine="native",
+                                    overlap_transfers=True))
+    ref = list(stream_strain_blocks(paths, [0, 32, 1], meta, engine="h5py",
+                                    overlap_transfers=False))
+    for a, b in zip(nat, ref):
+        np.testing.assert_allclose(np.asarray(a.trace), np.asarray(b.trace),
+                                   rtol=1e-4, atol=1e-16)
+
+
+@pytest.mark.skipif(not native.available(), reason="native engine unavailable")
+def test_native_midstream_failure_with_overlap(file_set):
+    """Mid-stream corruption on the native path with the overlap executor:
+    file 0 lands, the corrupt file raises at its own position."""
+    paths, raws = file_set
+    meta = get_acquisition_parameters(paths[0], "optasense")
+    _truncate(paths[1], keep_fraction=0.3)
+    stream = stream_strain_blocks(paths[:3], [0, 32, 1], meta, prefetch=2,
+                                  engine="native", overlap_transfers=True)
+    first = next(stream)
+    np.testing.assert_allclose(
+        np.asarray(first.trace), _expected(raws[0], [0, 32, 1], meta.scale_factor),
+        rtol=1e-4, atol=1e-16,
+    )
+    with pytest.raises(Exception):
+        next(stream)
+
+
+def test_stream_raw_wire_values(file_set):
+    """Raw wire ships the stored int32 counts untouched, in order."""
+    paths, raws = file_set
+    meta = get_acquisition_parameters(paths[0], "optasense")
+    sel = [2, 30, 2]
+    blocks = list(stream_strain_blocks(paths, sel, meta, engine="h5py",
+                                       wire="raw", as_numpy=True))
+    for blk, raw in zip(blocks, raws):
+        assert blk.trace.dtype == np.int32 and blk.wire == "raw"
+        np.testing.assert_array_equal(blk.trace, raw[sel[0]:sel[1]:sel[2]])
+
+
+def test_stream_raw_wire_respects_engine(file_set, monkeypatch, tmp_path):
+    """The raw wire keeps the conditioned path's engine contract:
+    engine='h5py' must NEVER take the native memmap (the documented
+    escape hatch when the layout probe is wrong), and engine='native'
+    raises on a file without a layout instead of silently parsing it."""
+    paths, raws = file_set
+    meta = get_acquisition_parameters(paths[0], "optasense")
+
+    def boom(*a, **k):
+        raise AssertionError("engine='h5py' took the native memmap")
+
+    monkeypatch.setattr(native, "read_strided_raw", boom)
+    blocks = list(stream_strain_blocks(paths[:2], [0, 32, 1], meta,
+                                       engine="h5py", wire="raw", as_numpy=True))
+    np.testing.assert_array_equal(blocks[0].trace, raws[0])
+    monkeypatch.undo()
+
+    # chunked (non-contiguous) layout defeats the native probe -> no
+    # layout for file 1; the native-engine raw stream must raise at its
+    # ordered position, exactly like the conditioned native stream
+    import h5py
+
+    mixed = str(tmp_path / "chunked.h5")
+    dio.write_optasense(mixed, raws[1], fs=200.0, dx=2.0)
+    with h5py.File(mixed, "r+") as fp:
+        data = fp["Acquisition/Raw[0]/RawData"][:]
+        del fp["Acquisition/Raw[0]/RawData"]
+        fp["Acquisition/Raw[0]"].create_dataset(
+            "RawData", data=data, chunks=(8, 100))
+    stream = stream_strain_blocks([paths[0], mixed], [0, 32, 1], meta,
+                                  engine="native", wire="raw", as_numpy=True)
+    np.testing.assert_array_equal(next(stream).trace, raws[0])
+    with pytest.raises(ValueError, match="not natively readable"):
+        next(stream)
+
+
 def test_stream_file_batches_tail_policies(file_set):
     paths, _ = file_set
     meta = get_acquisition_parameters(paths[0], "optasense")
