@@ -13,6 +13,7 @@ scientific defaults that preserve the reference's semantics.
 from __future__ import annotations
 
 import dataclasses
+import os
 from dataclasses import dataclass
 from typing import Mapping
 
@@ -189,3 +190,131 @@ def as_metadata(metadata) -> AcquisitionMetadata:
     if isinstance(metadata, AcquisitionMetadata):
         return metadata
     return AcquisitionMetadata.from_dict(metadata)
+
+
+@dataclass(frozen=True)
+class BatchBucketConfig:
+    """Time-length padding buckets for batched campaigns
+    (``workflows.campaign.run_campaign_batched`` /
+    ``io.stream.stream_batched_slabs``).
+
+    A batched program step serves ONE ``[B, channel, time]`` shape;
+    compiling a program per distinct record length would make a
+    heterogeneous campaign O(#shapes) compiles. Buckets cap that at
+    O(#buckets): each file's time axis is zero-padded up to its bucket's
+    length. ``mode``:
+
+    * ``"exact"`` — no padding; every distinct length is its own bucket
+      (right for campaigns whose files all share one length).
+    * ``"pow2"`` (default) — pad to the next power of two at or above
+      ``min_length``; any mix of record lengths compiles at most
+      ~log2(longest) programs.
+    * ``"fixed"`` — pad to the smallest entry of ``lengths`` that fits; a
+      record longer than every entry raises ``ValueError`` (the batched
+      campaign records it as a per-file failure).
+    """
+
+    mode: str = "pow2"
+    lengths: tuple = ()
+    min_length: int = 1024
+
+    def __post_init__(self):
+        if self.mode not in ("exact", "pow2", "fixed"):
+            raise ValueError(
+                f"unknown bucket mode {self.mode!r}; expected 'exact', "
+                "'pow2' or 'fixed'"
+            )
+        if self.mode == "fixed" and not self.lengths:
+            raise ValueError("mode='fixed' needs explicit bucket lengths")
+
+    def bucket_ns(self, ns: int) -> int:
+        """The padded time length serving a record of ``ns`` samples."""
+        if ns < 1:
+            raise ValueError(f"record length must be >= 1, got {ns}")
+        if self.mode == "exact":
+            return int(ns)
+        if self.mode == "fixed":
+            for length in sorted(self.lengths):
+                if ns <= int(length):
+                    return int(length)
+            raise ValueError(
+                f"record length {ns} exceeds every fixed bucket "
+                f"{tuple(sorted(self.lengths))}"
+            )
+        return max(int(self.min_length), 1 << max(ns - 1, 0).bit_length())
+
+
+def as_bucket_config(bucket) -> BatchBucketConfig:
+    """Accept a :class:`BatchBucketConfig`, a mode string (``"exact"`` /
+    ``"pow2"``), or a sequence of fixed bucket lengths."""
+    if isinstance(bucket, BatchBucketConfig):
+        return bucket
+    if isinstance(bucket, str):
+        return BatchBucketConfig(mode=bucket)
+    return BatchBucketConfig(
+        mode="fixed", lengths=tuple(int(b) for b in bucket)
+    )
+
+
+#: Default on-disk home of the persistent XLA compilation cache (batched
+#: campaigns compile O(#buckets) programs ONCE per machine, not once per
+#: process — docs/TPU_RUNBOOK.md). Override with
+#: ``DAS_COMPILATION_CACHE_DIR`` (or JAX's own
+#: ``JAX_COMPILATION_CACHE_DIR``, which bench.py sets for its rung
+#: children).
+DEFAULT_COMPILATION_CACHE_DIR = os.path.join(
+    "~", ".cache", "das4whales_tpu", "jax_cache"
+)
+
+
+def compilation_cache_dir() -> str:
+    """Resolve the persistent compilation-cache directory (env overrides
+    first, then the default under the user cache home)."""
+    return (
+        os.environ.get("DAS_COMPILATION_CACHE_DIR")
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.expanduser(DEFAULT_COMPILATION_CACHE_DIR)
+    )
+
+
+def enable_persistent_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Wire ``jax``'s persistent compilation cache to an on-disk
+    directory, so a second process (a resumed campaign, the next bench
+    rung, tomorrow's run) loads serialized executables instead of
+    re-compiling — the cross-process complement of the in-process
+    ``compile_guard`` ceiling.
+
+    Also drops the cache's min-compile-time floor to 0 so the small
+    bucket programs of test-scale campaigns persist too (jax's default
+    only caches compiles slower than 1 s). Best-effort and idempotent:
+    returns the active cache directory, or None where this jaxlib lacks
+    persistent-cache support (the caller proceeds uncached).
+    """
+    path = os.path.abspath(cache_dir or compilation_cache_dir())
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        for knob, val in (
+            ("jax_persistent_cache_min_compile_time_secs", 0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(knob, val)
+            except Exception:  # noqa: BLE001 — knob absent on older jax
+                pass
+        return path
+    except Exception:  # noqa: BLE001 — pre-0.4.26 config name
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as cc,
+            )
+
+            cc.initialize_cache(path)
+            return path
+        except Exception:  # noqa: BLE001 — no persistent-cache support
+            return None

@@ -75,11 +75,14 @@ def from_raven_selection_table(
     descriptive ``ValueError`` up front, and rows whose time cells are
     empty/unparseable are skipped (reported via ``skipped``, a list that
     receives ``(line_number, reason)`` tuples) instead of crashing
-    mid-iteration (ADVICE r4)."""
+    mid-iteration (ADVICE r4). When rows are dropped and no ``skipped``
+    list was passed, ONE summary ``warnings.warn`` fires — silent row
+    loss must never pass unnoticed (ADVICE r5)."""
     def norm(s: str) -> str:
         return " ".join(str(s).split()).lower()
 
     groups: Dict[str, list] = {}
+    n_dropped = 0
     with open(path, newline="") as fh:
         reader = csv.DictReader(fh, delimiter="\t")
         headers = {norm(h): h for h in (reader.fieldnames or [])}
@@ -103,11 +106,20 @@ def from_raven_selection_table(
                 end = float((row.get(end_col) if end_col else None) or begin)
                 ch = int(float((row.get(ch_col) if ch_col else None) or 0))
             except (TypeError, ValueError) as e:
+                n_dropped += 1
                 if skipped is not None:
                     skipped.append((lineno, repr(e)))
                 continue
             center = (begin + end) / 2.0
             groups.setdefault(name, []).append((ch, int(round(center * fs))))
+    if n_dropped and skipped is None:
+        import warnings
+
+        warnings.warn(
+            f"{path}: {n_dropped} selection-table row(s) skipped "
+            "(empty/unparseable time or channel cells); pass skipped=[] "
+            "to collect per-row (line_number, reason) details"
+        )
     return {
         name: np.asarray(sorted(v), dtype=np.int64).T.reshape(2, -1)
         for name, v in groups.items()

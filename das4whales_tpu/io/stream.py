@@ -32,6 +32,7 @@ in campaign length.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -424,3 +425,210 @@ def _file_batches_gen(
         stack = np.stack([b.trace for b in pending])
         fill = np.zeros((batch - len(pending),) + stack.shape[1:], stack.dtype)
         yield place(np.concatenate([stack, fill])), tuple(pending)
+
+
+# ---------------------------------------------------------------------------
+# Batched-slab assembly (the single-chip batched campaign's ingest)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BatchSlab:
+    """One assembled ``[B, channel, time]`` batch for the batched
+    detection route (``parallel.batch``).
+
+    ``stack`` is the padded batch (device array, or host numpy with
+    ``as_numpy=True``); trailing file slots past ``n_valid`` are zeros
+    (the program shape is fixed at ``B`` — padded slots produce no
+    recorded output). ``blocks``/``paths``/``n_real`` are aligned with
+    the ``n_valid`` REAL files in stream order; ``index0`` is the first
+    file's index in the file list handed to the assembler (failure
+    attribution and resume bookkeeping). ``bucket_ns`` is the padded time
+    length (``config.BatchBucketConfig``); each file's real samples are
+    ``stack[j, :, :n_real[j]]``.
+    """
+
+    stack: object
+    blocks: tuple
+    paths: tuple
+    index0: int
+    bucket_ns: int
+    n_real: tuple
+
+    @property
+    def n_valid(self) -> int:
+        return len(self.blocks)
+
+
+class SlabReadError(RuntimeError):
+    """A file failed to probe/read/bucket during slab assembly.
+
+    ``index`` is the culprit's position in the file list handed to the
+    assembler and ``path`` its path — raised AFTER any partial slab of
+    already-read earlier files has been yielded, so the campaign records
+    exactly one failure and resumes at ``index + 1``.
+    """
+
+    def __init__(self, path: str, index: int, cause: Exception):
+        super().__init__(f"{path}: {type(cause).__name__}: {cause}")
+        self.path = path
+        self.index = index
+        self.cause = cause
+        self.__cause__ = cause
+
+
+def _assemble_host_slabs(files, selected_channels, metadata, *, batch,
+                         bucket_cfg, interrogator, prefetch, engine, wire):
+    """Host half of the assembler: pull ordered blocks off the read
+    pipeline, group CONSECUTIVE same-bucket files, pad and stack. Slabs
+    come out strictly in file order (a bucket change flushes the current
+    partial slab), so per-file pick order is stable across mixed-bucket
+    campaigns."""
+    pending: list = []
+    n_reals: list = []
+    idx0 = 0
+    cur_key = None  # (channels, bucket_ns, wire dtype)
+
+    def flush():
+        nonlocal pending, n_reals
+        C, b_ns, dt = cur_key
+        stack = np.zeros((batch, C, b_ns), dt)
+        for j, b in enumerate(pending):
+            tr = np.asarray(b.trace)
+            stack[j, :, : tr.shape[1]] = tr
+        slab = BatchSlab(
+            stack=stack, blocks=tuple(pending),
+            paths=tuple(files[idx0 : idx0 + len(pending)]), index0=idx0,
+            bucket_ns=b_ns, n_real=tuple(n_reals),
+        )
+        pending, n_reals = [], []
+        return slab
+
+    stream = stream_strain_blocks(
+        files, selected_channels, metadata, interrogator=interrogator,
+        prefetch=prefetch, engine=engine, as_numpy=True, wire=wire,
+    )
+    for i in range(len(files)):
+        try:
+            blk = next(stream)
+            b_ns = bucket_cfg.bucket_ns(np.asarray(blk.trace).shape[1])
+        except StopIteration:  # defensive: stream ended early
+            break
+        except Exception as exc:  # noqa: BLE001 — per-file isolation
+            # surface the partial slab of healthy earlier files FIRST,
+            # then the attributed error (campaign resumes past file i)
+            if pending:
+                yield flush()
+            raise SlabReadError(files[i], i, exc)
+        tr = np.asarray(blk.trace)
+        key = (tr.shape[0], b_ns, tr.dtype)
+        if pending and key != cur_key:
+            yield flush()
+            idx0 = i
+        elif not pending:
+            idx0 = i
+        cur_key = key
+        pending.append(blk)
+        n_reals.append(tr.shape[1])
+        if len(pending) == batch:
+            yield flush()
+            idx0 = i + 1
+    if pending:
+        yield flush()
+
+
+def stream_batched_slabs(
+    files: Sequence[str],
+    selected_channels,
+    metadata=None,
+    *,
+    batch: int,
+    bucket="pow2",
+    interrogator: str = "optasense",
+    prefetch: int = 2,
+    engine: str = "h5py",
+    wire: str = "conditioned",
+    device=None,
+    sharding=None,
+    as_numpy: bool = False,
+    in_flight: int = 2,
+) -> Iterator[BatchSlab]:
+    """Coalesce the ordered read pipeline into ``[batch, channel, time]``
+    slabs for the batched one-program detection route
+    (``parallel.batch``; driven by
+    ``workflows.campaign.run_campaign_batched``).
+
+    Consecutive files sharing a shape bucket (``bucket``:
+    ``config.BatchBucketConfig`` / mode string / fixed-length sequence)
+    are zero-padded to the bucket length and stacked; a bucket change or
+    the end of the list flushes a PARTIAL slab (``n_valid < batch``,
+    trailing file slots zero). The whole campaign therefore compiles
+    O(#buckets) programs.
+
+    The overlap executor at slab granularity: slab k+1's ``device_put``
+    (via ``sharding``/``device``; plain ``jnp.asarray`` otherwise)
+    dispatches on a transfer thread while the caller computes on slab k,
+    with at most ``in_flight`` slabs in the transfer pipeline (bounded
+    device memory: ``in_flight + 1`` slabs resident worst-case).
+    ``as_numpy=True`` skips placement and yields host stacks.
+
+    A file that fails to probe/read/bucket raises :class:`SlabReadError`
+    carrying its index — after any partial slab of earlier healthy files
+    has been yielded, so the error surfaces at the failing file's own
+    position in the consumption order (the campaign's per-file fault
+    isolation relies on this attribution, exactly like
+    ``stream_strain_blocks``).
+    """
+    if batch < 1:
+        raise ValueError("batch must be >= 1")
+    if in_flight < 1:
+        raise ValueError("in_flight must be >= 1")
+    from ..config import as_bucket_config
+
+    bucket_cfg = as_bucket_config(bucket)
+    gen = _assemble_host_slabs(
+        list(files), selected_channels, metadata, batch=batch,
+        bucket_cfg=bucket_cfg, interrogator=interrogator, prefetch=prefetch,
+        engine=engine, wire=wire,
+    )
+    if as_numpy:
+        if sharding is not None or device is not None:
+            raise ValueError("as_numpy=True returns host stacks; drop sharding/device")
+        yield from gen
+        return
+
+    def place(slab: BatchSlab) -> BatchSlab:
+        if sharding is not None:
+            stack = jax.device_put(slab.stack, sharding)
+        elif device is not None:
+            stack = jax.device_put(slab.stack, device)
+        else:
+            stack = jnp.asarray(slab.stack)
+        return dataclasses.replace(slab, stack=stack)
+
+    error: SlabReadError | None = None
+    with ThreadPoolExecutor(max_workers=1) as tx:
+        futs: deque = deque()
+
+        def pump():
+            nonlocal error
+            while error is None and len(futs) < in_flight:
+                try:
+                    slab = next(gen)
+                except StopIteration:
+                    return
+                except SlabReadError as exc:
+                    error = exc  # surfaces after the queued healthy slabs
+                    return
+                # the device_put dispatch runs on the transfer thread the
+                # moment assembly completes, overlapping H2D with compute
+                # on the previously yielded slab
+                futs.append(tx.submit(place, slab))
+
+        pump()
+        while futs:
+            slab = futs.popleft().result()
+            pump()  # refill BEFORE yielding: next transfer overlaps compute
+            yield slab
+        if error is not None:
+            raise error

@@ -384,6 +384,7 @@ def mf_detect_picks_program(
     condition: bool = False,
     cond_demean: bool = True,
     cond_scale=1.0,
+    cond_n_real=None,
 ):
     """The WHOLE detection step as ONE XLA program: [optional narrow-wire
     conditioning prologue ->] bandpass -> f-k filter
@@ -394,9 +395,14 @@ def mf_detect_picks_program(
     narrow wire (io/stream.py ``wire="raw"``) and runs the demean+scale
     conditioning (``ops.conditioning.condition``) as the program's first
     fused pass — the same affine map the host readers apply, so picks are
-    bit-identical to the conditioned-wire route. The raw input buffer is
-    NOT donated: the adaptive-K policy reruns this program on the same
-    trace when a pick row saturates at K0.
+    bit-identical to the conditioned-wire route. ``cond_n_real`` (a traced
+    scalar) marks a bucket-padded raw record: only the first
+    ``cond_n_real`` time samples are real, the demean spans them alone,
+    and the pad conditions to exactly 0
+    (``ops.conditioning.condition_padded`` — the batched campaign's shape
+    buckets, io/stream.py). The raw input buffer is NOT donated: the
+    adaptive-K policy reruns this program on the same trace when a pick
+    row saturates at K0.
 
     The ``__call__`` route runs the same math but with 4-6 host syncs per
     file (threshold pull, saturation check, compaction count, packed
@@ -421,10 +427,18 @@ def mf_detect_picks_program(
     nT = templates_true.shape[0]
     if condition:
         # narrow-wire prologue: raw counts -> strain, fused ahead of the
-        # filter pass (templates carry the compute dtype)
-        trace = conditioning.condition(
-            trace, cond_scale, demean=cond_demean, dtype=templates_true.dtype
-        )
+        # filter pass (templates carry the compute dtype); a bucket-padded
+        # record demeans over its real samples only
+        if cond_n_real is None:
+            trace = conditioning.condition(
+                trace, cond_scale, demean=cond_demean,
+                dtype=templates_true.dtype
+            )
+        else:
+            trace = conditioning.condition_padded(
+                trace, cond_scale, cond_n_real, demean=cond_demean,
+                dtype=templates_true.dtype
+            )
     # THE filter graphs (inlined under this jit): identical construction
     # to the standalone filter programs, so the routes cannot drift
     if staged_bp:
@@ -651,6 +665,22 @@ class MatchedFilterDetector:
         )
 
     def __call__(self, trace: jnp.ndarray, threshold: float | None = None, with_snr: bool = False) -> MatchedFilterResult:
+        """Detect calls in one ``[channel x time]`` block.
+
+        BEHAVIOR NOTE (round-5 change, documented for external callers):
+        in the campaign configuration — ``pick_mode="sparse"`` with
+        ``keep_correlograms=False`` and no ``with_snr`` — this routes
+        through :meth:`detect_picks` (one XLA program, one packed fetch)
+        and the result carries ``trf_fk=None`` and empty
+        ``correlograms``. Callers that used
+        ``jax.block_until_ready(res.trf_fk)`` as their device sync must
+        migrate: ``res.picks`` is host numpy already (the packed fetch IS
+        the sync), so detection is complete when this returns and no
+        explicit sync is needed. To keep the device-resident ``trf_fk``
+        and correlograms, construct the detector with
+        ``keep_correlograms=True`` (the default) or request
+        ``with_snr=True`` — both preserve the staged route.
+        """
         trace = self._as_input(trace)
         if self.pick_mode == "sparse" and not self.keep_correlograms and not with_snr:
             # campaign mode wants exactly the picks — take the one-program
@@ -659,9 +689,25 @@ class MatchedFilterDetector:
         return self._call_full(trace, threshold=threshold, with_snr=with_snr)
 
     def detect_picks(
-        self, trace: jnp.ndarray, threshold: float | None = None
+        self, trace: jnp.ndarray, threshold: float | None = None,
+        n_real: int | None = None,
     ) -> MatchedFilterResult:
         """Picks-only detection: ONE XLA program, ONE device->host fetch.
+
+        ``n_real`` marks a bucket-padded block (the batched campaign's
+        shape buckets): ``trace`` is ``[C, T_bucket]`` whose real samples
+        are ``[:, :n_real]`` and whose tail is zero pad. On the raw wire
+        the conditioning then demeans over the real samples only
+        (``ops.conditioning.condition_padded``); on the conditioned wire
+        the pad is already post-conditioning zeros and ``n_real`` is a
+        no-op in-program. Picks in the pad region (filter ring-down past
+        the record end) are returned as-is — batch-route parity — and
+        campaign callers trim them (``parallel.batch.trim_picks``). The
+        packed-capacity-overflow fallback to the exact full-transfer
+        route keeps the pad-aware demean: the block is conditioned with
+        ``condition_padded`` up front and the exact route runs it as a
+        conditioned-wire input (matching the conditioned wire's
+        pad-after-conditioning layout up to float reduction order).
 
         Numerics-identical to ``__call__``'s pick output (same filter,
         correlate, threshold policy, peak kernels — the threshold just
@@ -685,6 +731,14 @@ class MatchedFilterDetector:
         thr_in = jnp.full((nT,), 0.0 if threshold is None else float(threshold),
                           dtype=self._mask_band_dev.dtype)
         tile = self.effective_channel_tile if self._route() == "tiled" else None
+        # pad-aware conditioning only when the pad is real: an exact-fit
+        # n_real keeps the plain jnp.mean path (and its compiled program)
+        cond_nr = (
+            jnp.asarray(int(n_real), jnp.int32)
+            if (self.wire == "raw" and n_real is not None
+                and int(n_real) != trace.shape[1])
+            else None
+        )
 
         def run(k):
             return mf_detect_picks_program(
@@ -699,6 +753,7 @@ class MatchedFilterDetector:
                 pick_method=peak_ops.escalation_method(k, self.max_peaks),
                 condition=self.wire == "raw",
                 cond_scale=self._cond_scale,
+                cond_n_real=cond_nr,
             )
 
         chan, times, cnt, satc, thr = jax.device_get(run(self.pick_k0))
@@ -708,6 +763,23 @@ class MatchedFilterDetector:
             chan, times, cnt, satc, thr = jax.device_get(run(self.max_peaks))
         if int(cnt.max(initial=0)) > cap:
             # packed-capacity overflow: the exact full-transfer route
+            if cond_nr is not None:
+                # the pad-aware demean must survive the fallback: plain
+                # whole-record conditioning would bias the mean by
+                # n_real/T and turn the zero pad into a -mean*scale step
+                # that rings through the bucket-length FFT. Condition
+                # here (real samples only, pad stays exactly 0) and hand
+                # the exact route the already-conditioned block through a
+                # conditioned-wire view of this detector.
+                import copy
+
+                cond_trace = conditioning.condition_padded(
+                    trace, self._cond_scale, cond_nr,
+                    dtype=self._mask_band_dev.dtype,
+                )
+                det = copy.copy(self)
+                det.wire = "conditioned"
+                return det._call_full(cond_trace, threshold=threshold)
             return self._call_full(trace, threshold=threshold)
         picks, thr_out = {}, {}
         for i, name in enumerate(names):

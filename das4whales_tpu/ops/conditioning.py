@@ -104,6 +104,36 @@ def condition_segmented(trace: jnp.ndarray, scale, seg_ids: jnp.ndarray,
     return x * jnp.asarray(scale, dtype)
 
 
+def condition_padded(trace: jnp.ndarray, scale, n_real, *,
+                     demean: bool = True, dtype=jnp.float32) -> jnp.ndarray:
+    """:func:`condition` for a time-PADDED record: ``trace`` is
+    ``[..., T_bucket]`` raw counts whose REAL samples are
+    ``[..., :n_real]`` and whose tail is bucket-padding zeros (the batched
+    campaign's shape buckets, ``io.stream.stream_batched_slabs``).
+
+    The per-channel mean spans only the real samples (masked sum divided
+    by ``n_real`` — the pad contributes nothing, it is raw zeros) and pad
+    samples are masked back to exactly 0 after the demean: the
+    conditioned wire pads AFTER conditioning, so leaving ``-mean*scale``
+    in the pad would break raw/conditioned parity through the
+    bucket-length FFT. ``n_real`` may be a traced scalar, so ONE compiled
+    program serves every real length inside a bucket. Reduction order
+    over the padded axis differs from the exact-length ``jnp.mean`` by
+    float roundoff only (same caveat as :func:`condition_time_sharded`);
+    picks are unaffected — a per-channel constant offset is annihilated
+    by the DC-killing bandpass/f-k filters and peak prominence is
+    offset-invariant.
+    """
+    x = trace.astype(dtype)
+    valid = jnp.arange(x.shape[-1]) < n_real
+    if demean:
+        s = jnp.sum(jnp.where(valid, x, jnp.zeros((), dtype)),
+                    axis=-1, keepdims=True)
+        x = x - s / jnp.asarray(n_real, dtype)
+    x = jnp.where(valid, x, jnp.zeros((), dtype))
+    return x * jnp.asarray(scale, dtype)
+
+
 @functools.partial(jax.jit, static_argnames=("demean",))
 def condition_jit(trace: jnp.ndarray, scale, demean: bool = True) -> jnp.ndarray:
     """Standalone jitted prologue for callers that must KEEP the raw

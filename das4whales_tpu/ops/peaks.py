@@ -342,10 +342,12 @@ def find_peaks_sparse(
     selected = valid & (prom >= thr_bc[:, None])
 
     if method == "pack":
-        # slots are position-ascending by construction; report invalid
-        # positions as N (the topk path's convention)
+        # slots are position-ascending by construction; every slot NOT in
+        # `selected` reports position N — the topk path's promise (a
+        # valid-but-unselected candidate, i.e. one that failed the
+        # prominence test, must not leak its position; ADVICE round 5)
         return SparsePicks(
-            jnp.where(valid, pos, N), heights, prom, selected, saturated
+            jnp.where(selected, pos, N), heights, prom, selected, saturated
         )
     # order by position per channel for reference-compatible pick lists
     pos_sorted_key = jnp.where(selected, pos, N)

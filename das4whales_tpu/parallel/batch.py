@@ -1,0 +1,249 @@
+"""Batched campaign execution: B files per program step on one chip.
+
+Every stage of the canonical detect pipeline runs at ~1-2% of its
+roofline on a single 22050x12000 file (BENCH_r05) — one file cannot
+saturate the chip, so the throughput move is the standard
+inference-serving one (dynamic batching + shape bucketing, PAPERS.md):
+stack ``B`` same-shape files into a ``[B, channel, time]`` slab and run
+the WHOLE one-program matched-filter route
+(``models.matched_filter.mf_detect_picks_program``) once per slab,
+amortizing dispatch, host-sync and pick-finalization overhead across the
+batch. The per-file math is the unbatched program over a leading file
+axis — ``jax.vmap`` (cross-file parallelism, the chip-filling
+accelerator mode) or ``jax.lax.map`` (sequential in-program, the CPU
+mode: single-file cache locality, bitwise-identical per-file outputs) —
+so per-file picks are bit-identical to the unbatched route (parity
+pinned by tier-1 tests; under ``vmap``, in-graph thresholds may differ
+in the last ulp from FFT-batch reduction order — picks are invariant to
+that, the threshold and the envelope shift together).
+
+Heterogeneous record lengths ride shape BUCKETS
+(``config.BatchBucketConfig``): each file's time axis is zero-padded to
+its bucket's length and the campaign compiles O(#buckets) programs, not
+O(#shapes); on the raw wire the program demeans over the real samples
+only (``ops.conditioning.condition_padded``, per-file ``n_real`` as a
+traced vector — no per-length retrace).
+
+Input donation: the K0 (pack-method) attempt must keep the slab alive
+for the adaptive-K escalation rerun, so it never donates
+(analysis/baseline.toml R5 entry); the full-capacity escalation program
+is the slab's final consumer and donates it
+(``batched_detect_picks_program_donated``) when the caller owns the
+buffer (``BatchedMatchedFilterDetector(donate=True)``, the campaign
+default — overflow fallback re-reads from the assembler's host blocks,
+never from the donated device slab).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.matched_filter import (
+    MatchedFilterDetector,
+    mf_detect_picks_program,
+)
+from ..ops import peaks as peak_ops
+
+_STATIC = (
+    "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp", "tile",
+    "max_peaks", "capacity", "use_threshold", "pick_method", "condition",
+    "serial",
+)
+
+
+def _batched_body(
+    trace_batch, mask_band, bp_gain, templates_true, mu, scale, thr_in,
+    cond_scale, n_real, *,
+    band_lo: int, band_hi: int, bp_padlen: int, pad_rows: int,
+    staged_bp: bool, tile: int | None, max_peaks: int, capacity: int,
+    use_threshold: bool, pick_method: str, condition: bool,
+    serial: bool = False,
+):
+    """The one-program route over a leading file axis, in ONE program.
+
+    ``trace_batch`` is ``[B, C, T]`` (stored-dtype counts when
+    ``condition``, strain otherwise); ``n_real`` is None (exact-fit
+    bucket) or a ``[B]`` int vector of real time lengths (bucket-padded
+    raw records — conditioned-wire pads are already zeros and need no
+    in-program handling). Returns the per-file program outputs with a
+    leading batch axis: ``(chan [B, nT, capacity], times [B, nT,
+    capacity], count [B, nT], sat_count [B, nT], thr [B, nT])``.
+
+    ``serial`` picks HOW the batch dimension executes inside the program:
+
+    * ``False`` — ``jax.vmap``: cross-file parallelism, every stage sees
+      the full ``[B, ...]`` working set. The accelerator mode: one file
+      runs at ~1-2% of roofline (BENCH_r05), so the batch is what fills
+      the chip.
+    * ``True`` — ``jax.lax.map``: files execute sequentially inside the
+      one program, so the per-file working set (and cache locality)
+      matches the unbatched program exactly and per-file outputs are
+      BITWISE-identical to it; only the dispatch + host-sync +
+      pick-finalization overhead is amortized. The CPU mode — measured
+      1.3-1.4x amortized per-file throughput at [1024 x 3000] where the
+      vmap mode's 4x working set loses to the cache (docs/PERF.md).
+    """
+    def one(tr, nr):
+        return mf_detect_picks_program(
+            tr, mask_band, bp_gain, templates_true, mu, scale, thr_in,
+            band_lo, band_hi, bp_padlen, pad_rows, staged_bp, tile,
+            max_peaks, capacity, use_threshold, pick_method=pick_method,
+            condition=condition, cond_scale=cond_scale, cond_n_real=nr,
+        )
+
+    if n_real is None:
+        if serial:
+            return jax.lax.map(lambda tr: one(tr, None), trace_batch)
+        return jax.vmap(lambda tr: one(tr, None))(trace_batch)
+    if serial:
+        return jax.lax.map(lambda args: one(*args), (trace_batch, n_real))
+    return jax.vmap(one)(trace_batch, n_real)
+
+
+#: The batched one-program detection step (see :func:`_batched_body`).
+#: NOT donated: the K0 attempt of the adaptive-K policy must keep the
+#: slab for the full-capacity rerun (and the bench reuses one stack
+#: across repeats).
+batched_detect_picks_program = jax.jit(_batched_body, static_argnames=_STATIC)
+
+#: Donating variant for the slab's FINAL consumer (the escalation rerun,
+#: or a caller that runs single-shot at full capacity): the narrow-wire
+#: slab is dead the moment picks exist, so hand its HBM back to XLA.
+batched_detect_picks_program_donated = jax.jit(
+    _batched_body, static_argnames=_STATIC, donate_argnums=(0,)
+)
+
+
+def trim_picks(picks: Dict[str, np.ndarray], n_real: int) -> Dict[str, np.ndarray]:
+    """Drop picks in a bucket-padded record's pad region (``time >=
+    n_real``): the pad holds no signal, so anything picked there is
+    filter ring-down past the record end, not a detection. Exact-fit
+    records pass through unchanged."""
+    return {
+        name: pk[:, pk[1] < n_real] if pk.shape[1] else pk
+        for name, pk in picks.items()
+    }
+
+
+class BatchedMatchedFilterDetector:
+    """Batched facade over one :class:`MatchedFilterDetector`: a
+    ``[B, channel, time]`` slab in, per-file picks out, one XLA program
+    and one packed fetch per slab.
+
+    The wrapped detector must be the campaign configuration
+    (``pick_mode="sparse"``; build it at the BUCKET shape). The adaptive-K
+    policy of :meth:`MatchedFilterDetector.detect_picks` is preserved
+    across the batch: a K0 pack-method program first, escalating to the
+    full-capacity topk program only when any file's row saturated —
+    bit-identical (``ops.peaks.picks_with_escalation`` semantics).
+    ``donate=True`` donates the slab to the escalation program (its final
+    consumer); the common no-saturation path cannot donate retroactively,
+    so callers drop their slab reference after :meth:`detect_batch` and
+    the bounded in-flight depth of the assembler caps resident slabs.
+    ``serial=None`` resolves the in-program batch execution mode per
+    backend (``lax.map`` on CPU, ``vmap`` on accelerators — see
+    :func:`_batched_body`); pass a bool to force one.
+    """
+
+    def __init__(self, detector: MatchedFilterDetector, donate: bool = True,
+                 serial: bool | None = None):
+        if detector.pick_mode != "sparse":
+            raise ValueError(
+                f"the batched route needs pick_mode='sparse' (got "
+                f"{detector.pick_mode!r}); build the detector with "
+                "pick_mode='sparse', keep_correlograms=False"
+            )
+        self.det = detector
+        self.donate = bool(donate)
+        if serial is None:
+            serial = jax.default_backend() == "cpu"
+        self.serial = bool(serial)
+
+    def detect_batch(
+        self, stack, n_real=None, n_valid: int | None = None,
+    ) -> List[tuple | None]:
+        """Detect over a ``[B, C, T]`` slab.
+
+        ``n_real`` (sequence of per-file real time lengths) marks
+        bucket-padded files; ``n_valid`` limits the returned entries to
+        the slab's real files (trailing zero file-slots of a partial
+        batch are computed — the program shape is fixed — but never
+        fetched into results). Returns one entry per (valid) file:
+        ``(picks {name: (2, n) int64}, thresholds {name: float})``, or
+        ``None`` when that file's packed-pick capacity overflowed and the
+        caller must fall back to its exact per-file route
+        (:meth:`MatchedFilterDetector.detect_picks` on the host block).
+        """
+        det = self.det
+        C, T = det.design.trace_shape
+        B = int(stack.shape[0])
+        if tuple(stack.shape[1:]) == (C, T):
+            stack = det._as_input(stack)
+        else:
+            raise ValueError(
+                f"slab shape {tuple(stack.shape[1:])} != detector design "
+                f"shape {(C, T)}; one batched detector serves one bucket"
+            )
+        names = det.design.template_names
+        nT = len(names)
+        cap = int(min(C * det.max_peaks, det.pick_pack_cap))
+        thr_in = jnp.zeros((nT,), det._mask_band_dev.dtype)
+        tile = det.effective_channel_tile if det._route() == "tiled" else None
+        nr = None
+        if det.wire == "raw" and n_real is not None:
+            nr_np = np.asarray(n_real, np.int32)
+            if nr_np.ndim != 1 or not 1 <= nr_np.shape[0] <= B:
+                raise ValueError(
+                    f"n_real must be a <= {B}-vector, got {nr_np.shape}"
+                )
+            if nr_np.shape[0] < B:
+                # partial slab: padded file slots are whole-length zeros
+                nr_np = np.concatenate(
+                    [nr_np, np.full(B - nr_np.shape[0], T, np.int32)]
+                )
+            if int(nr_np.min(initial=T)) < T:
+                nr = jnp.asarray(nr_np)
+
+        def run(k, donate_now):
+            fn = (batched_detect_picks_program_donated if donate_now
+                  else batched_detect_picks_program)
+            return fn(
+                stack, det._mask_band_dev, det._gain_dev,
+                det._templates_true, det._template_mu, det._template_scale,
+                thr_in, det._cond_scale, nr,
+                band_lo=det._band_lo, band_hi=det._band_hi,
+                bp_padlen=det.design.bp_padlen, pad_rows=det.fk_pad_rows,
+                staged_bp=not det.fused_bandpass, tile=tile, max_peaks=k,
+                capacity=cap, use_threshold=False,
+                pick_method=peak_ops.escalation_method(k, det.max_peaks),
+                condition=det.wire == "raw", serial=self.serial,
+            )
+
+        chan, times, cnt, satc, thr = jax.device_get(run(det.pick_k0, False))
+        if det.pick_k0 < det.max_peaks and int(satc.sum()):
+            # a row saturated at K0: full-capacity rerun — the slab's last
+            # use, so it is donated when the caller owns the buffer
+            chan, times, cnt, satc, thr = jax.device_get(
+                run(det.max_peaks, self.donate)
+            )
+        del stack  # common path: drop our reference the moment picks exist
+
+        out: List[tuple | None] = []
+        for b in range(B if n_valid is None else int(n_valid)):
+            if int(cnt[b].max(initial=0)) > cap:
+                out.append(None)  # packed overflow: exact per-file fallback
+                continue
+            picks, thr_out = {}, {}
+            for i, name in enumerate(names):
+                k = int(cnt[b, i])
+                picks[name] = np.asarray(
+                    [chan[b, i, :k], times[b, i, :k]], dtype=np.int64
+                )
+                thr_out[name] = float(thr[b, i])
+                det._warn_saturated(name, int(satc[b, i]))
+            out.append((picks, thr_out))
+        return out

@@ -282,6 +282,170 @@ def run_campaign(
     return CampaignResult(outdir=outdir, records=records)
 
 
+def run_campaign_batched(
+    files: Sequence[str],
+    selected_channels,
+    outdir: str,
+    metadata=None,
+    batch: int = 4,
+    bucket="pow2",
+    resume: bool = True,
+    max_failures: int | None = None,
+    interrogator: str = "optasense",
+    prefetch: int = 2,
+    engine: str = "h5py",
+    wire: str = "conditioned",
+    in_flight: int = 2,
+    donate: bool = True,
+    serial: bool | None = None,
+    persistent_cache: bool | str = True,
+    **detector_kwargs,
+) -> CampaignResult:
+    """Single-chip BATCHED campaign: ``batch`` files per program step.
+
+    The throughput route for the "one file cannot saturate the chip"
+    regime (BENCH_r05: every stage at ~1-2% of roofline): the slab
+    assembler (``io.stream.stream_batched_slabs``) coalesces same-bucket
+    files off the overlap executor into one ``[B, channel, time]`` stack,
+    and the batched one-program route (``parallel.batch``) detects the
+    whole slab in ONE dispatch + ONE packed fetch — per-file picks
+    bit-identical to :func:`run_campaign`'s unbatched one-program route.
+    Manifest/resume/picks-artifact contract, per-file fault isolation and
+    ``max_failures`` are exactly :func:`run_campaign`'s.
+
+    Heterogeneous record lengths ride shape buckets (``bucket``:
+    ``config.BatchBucketConfig`` / ``"pow2"`` / ``"exact"`` / fixed
+    lengths) so the campaign compiles O(#buckets) programs; those
+    compiles persist across processes via the on-disk compilation cache
+    (``persistent_cache``: True wires ``config.compilation_cache_dir()``,
+    a str names the directory, False skips — docs/TPU_RUNBOOK.md).
+    ``donate=True`` hands each slab to XLA at its final use
+    (``parallel.batch`` donation contract); ``in_flight`` bounds slabs
+    resident on device; ``serial`` forces the in-program batch execution
+    mode (``True``: ``lax.map``, ``False``: ``vmap``; ``None`` resolves
+    per backend — see ``parallel.batch._batched_body``). ``wire="raw"`` streams stored-dtype counts and
+    conditions on device per bucket (padded records demean over real
+    samples only); like :func:`run_campaign`, a file whose probed
+    ``scale_factor`` differs from its bucket detector's fails per-file.
+    """
+    import jax.numpy as jnp
+
+    from ..config import enable_persistent_compilation_cache
+    from ..io.stream import SlabReadError, stream_batched_slabs
+    from ..parallel.batch import BatchedMatchedFilterDetector, trim_picks
+
+    if persistent_cache:
+        enable_persistent_compilation_cache(
+            persistent_cache if isinstance(persistent_cache, str) else None
+        )
+    os.makedirs(outdir, exist_ok=True)
+    metas = _normalize_metas(metadata, list(files))
+    records: List[FileRecord] = []
+    pending, pend_idx = _split_resume(list(files), outdir, resume, records)
+    pend_metas = [metas[j] for j in pend_idx]
+    fail = _failure_recorder(outdir, records, max_failures)
+
+    dets: Dict[tuple, BatchedMatchedFilterDetector] = {}
+
+    def detector_for(slab) -> BatchedMatchedFilterDetector:
+        C = slab.stack.shape[1]
+        key = (C, slab.bucket_ns, np.dtype(np.asarray(slab.blocks[0].trace).dtype).name)
+        bdet = dets.get(key)
+        if bdet is None:
+            bdet = BatchedMatchedFilterDetector(
+                MatchedFilterDetector(
+                    slab.blocks[0].metadata, selected_channels,
+                    (C, slab.bucket_ns), wire=wire, pick_mode="sparse",
+                    keep_correlograms=False, **detector_kwargs,
+                ),
+                donate=donate, serial=serial,
+            )
+            dets[key] = bdet
+        return bdet
+
+    def handle_slab(slab) -> None:
+        bdet = detector_for(slab)
+        det = bdet.det
+        ok = []
+        for k in range(slab.n_valid):
+            meta_k = slab.blocks[k].metadata
+            if (wire == "raw" and meta_k is not None
+                    and meta_k.scale_factor != det.metadata.scale_factor):
+                # the raw wire conditions with the BUCKET detector's scale
+                # (same per-file guard as run_campaign)
+                fail(slab.paths[k], ValueError(
+                    f"scale_factor {meta_k.scale_factor!r} != detector "
+                    f"scale {det.metadata.scale_factor!r}; wire='raw' "
+                    "conditions with one scale — use wire='conditioned' "
+                    "for heterogeneous file sets"
+                ))
+                ok.append(False)
+            else:
+                ok.append(True)
+        t0 = time.perf_counter()
+        results = bdet.detect_batch(
+            slab.stack, n_real=slab.n_real, n_valid=slab.n_valid
+        )
+        wall = time.perf_counter() - t0
+        for k in range(slab.n_valid):
+            if not ok[k]:
+                continue  # its slot computed with the wrong scale: discard
+            path = slab.paths[k]
+            try:
+                if results[k] is None:
+                    # packed-pick capacity overflow: exact per-file route
+                    # on the assembler's host block (the device slab may
+                    # already be donated — never touch it here)
+                    tr = np.asarray(slab.blocks[k].trace)
+                    padded = np.zeros((tr.shape[0], slab.bucket_ns), tr.dtype)
+                    padded[:, : tr.shape[1]] = tr
+                    res = det.detect_picks(
+                        jnp.asarray(padded), n_real=slab.n_real[k]
+                    )
+                    picks, thresholds = res.picks, res.thresholds
+                else:
+                    picks, thresholds = results[k]
+                picks = trim_picks(picks, slab.n_real[k])
+                _file_record(
+                    outdir, path, picks, thresholds,
+                    round(wall / max(slab.n_valid, 1), 3), records,
+                )
+            except Exception as exc:  # noqa: BLE001 — per-file isolation
+                fail(path, exc)
+
+    i = 0
+    while i < len(pending):
+        slabs = stream_batched_slabs(
+            pending[i:], selected_channels, pend_metas[i:], batch=batch,
+            bucket=bucket, interrogator=interrogator, prefetch=prefetch,
+            engine=engine, wire=wire, in_flight=in_flight,
+        )
+        try:
+            for slab in slabs:
+                try:
+                    handle_slab(slab)
+                except CampaignAborted:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — slab-level guard
+                    # a whole-slab failure (detector build, program error)
+                    # fails each of its files, preserving max_failures —
+                    # except files already dispositioned this run (a
+                    # scale-mismatched file was failed inside handle_slab
+                    # before the slab program ran; double-counting it
+                    # would fire max_failures one file early and write a
+                    # duplicate manifest record)
+                    dispositioned = {r.path for r in records}
+                    for path in slab.paths:
+                        if path not in dispositioned:
+                            fail(path, exc)
+        except SlabReadError as exc:
+            fail(pending[i + exc.index], exc.cause)
+            i = i + exc.index + 1
+            continue
+        i = len(pending)
+    return CampaignResult(outdir=outdir, records=records)
+
+
 # per-(template, file) pack capacity for the sharded campaign's pick
 # transfer; counts above it trigger the exact full-grid fallback
 _PICK_PACK_CAP = 1 << 18

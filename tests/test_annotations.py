@@ -112,3 +112,30 @@ def test_empty_time_cells_skipped_and_reported(tmp_path):
     back = from_raven_selection_table(str(p), 200.0, skipped=skipped)
     np.testing.assert_array_equal(back["SELECTION"], [[0, 0], [500, 900]])
     assert [ln for ln, _ in skipped] == [3, 4]
+
+
+def test_dropped_rows_warn_when_no_skipped_list(tmp_path):
+    """With no ``skipped`` collector, dropped rows must fire ONE summary
+    warning naming the count — silent row loss is not allowed (ADVICE r5)."""
+    import warnings
+
+    p = tmp_path / "raven_gaps_warn.txt"
+    p.write_text(
+        "Selection\tView\tChannel\tBegin Time (s)\tEnd Time (s)\n"
+        "1\tSpectrogram 1\t1\t2.0\t3.0\n"
+        "2\tSpectrogram 1\t1\t\t\n"
+        "3\tSpectrogram 1\t1\tnot-a-number\t9\n"
+    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        back = from_raven_selection_table(str(p), 200.0)
+    msgs = [str(w.message) for w in caught
+            if "row(s) skipped" in str(w.message)]
+    assert len(msgs) == 1 and "2 " in msgs[0]
+    np.testing.assert_array_equal(back["SELECTION"], [[0], [500]])
+
+    # a passed skipped list suppresses the warning (details are collected)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        from_raven_selection_table(str(p), 200.0, skipped=[])
+    assert not [w for w in caught if "row(s) skipped" in str(w.message)]

@@ -239,6 +239,33 @@ def test_pack_method_saturation_keeps_first_k_and_flags(rng):
     np.testing.assert_array_equal(got, all_pk[:8])
 
 
+def test_pack_method_unselected_slots_hold_n(rng):
+    """Pack-mode parity with the topk promise (ADVICE r5): every slot NOT
+    in ``selected`` — including a valid candidate that failed the
+    prominence test — must report position N, not its real index."""
+    import scipy.signal as ssp
+
+    sos = ssp.butter(4, [0.1, 0.3], "bp", output="sos")
+    noise = ssp.sosfiltfilt(sos, rng.standard_normal((4, 700)), axis=-1)
+    x = np.abs(ssp.hilbert(noise, axis=-1))
+    # threshold low enough that candidates pass the height prefilter but
+    # some fail the prominence test -> valid-but-unselected slots exist
+    thr = np.percentile(x, 60) * 0.75
+    res = peaks.find_peaks_sparse(x, thr, max_peaks=256, nb=64, method="pack")
+    pos = np.asarray(res.positions)
+    sel = np.asarray(res.selected)
+    N = x.shape[-1]
+    assert (pos[~sel] == N).all()
+    assert (pos[sel] < N).all()
+    # and the selected positions still match the topk path exactly
+    res_t = peaks.find_peaks_sparse(x, thr, max_peaks=256, nb=64,
+                                    method="topk")
+    np.testing.assert_array_equal(
+        peaks.sparse_to_pick_times(pos, sel),
+        peaks.sparse_to_pick_times(res_t.positions, res_t.selected),
+    )
+
+
 def test_escalation_method_policy():
     assert peaks.escalation_method(64, 256) == "pack"
     assert peaks.escalation_method(256, 256) == "topk"
