@@ -298,6 +298,13 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
     # host-conditioned float32 wire.
     if wire is None:
         wire = os.environ.get("DAS_BENCH_WIRE", "raw")
+    from das4whales_tpu import faults
+
+    # resilience attribution (ISSUE 4): snapshot the process-wide
+    # counters around the measured run so any retry/degradation/
+    # quarantine overhead on the hot path is VISIBLE in the payload next
+    # to the headline (a healthy bench reports zeros — that is the claim)
+    resilience_before = faults.counters()
     meta = AcquisitionMetadata(fs=fs, dx=dx, nx=nx, ns=ns,
                                scale_factor=BENCH_SCALE)
     det = MatchedFilterDetector(
@@ -378,8 +385,13 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
                  "wire_dtype": str(block.dtype)}
     batch_info = _bench_batch(meta, nx, ns, block, wire, peak_block,
                               channel_tile, repeats)
+    delta = faults.counters_delta(resilience_before)
+    resilience = {"retries": delta["retries"],
+                  "degradations": delta["degradations"],
+                  "quarantined": delta["quarantined"],
+                  "timeouts": delta["timeouts"]}
     return (min(times), n_picks, str(jax.devices()[0]), stages, route,
-            det.pick_mode, dict(wire_info, **batch_info))
+            det.pick_mode, dict(wire_info, **batch_info, **resilience))
 
 
 def _bench_batch(meta, nx, ns, block, wire, peak_block, channel_tile,
@@ -1033,6 +1045,13 @@ def main():
         "wire": result.get("wire"),
         "wire_dtype": result.get("wire_dtype"),
         "wire_bytes": result.get("wire_bytes"),
+        # resilience counters accrued DURING the measured run (faults.
+        # counters): a healthy hot path reports zeros; nonzero means the
+        # headline wall includes retry/degradation/quarantine overhead
+        "retries": result.get("retries", 0),
+        "degradations": result.get("degradations", 0),
+        "quarantined": result.get("quarantined", 0),
+        "timeouts": result.get("timeouts", 0),
         "cpu_ref_rate": round(cpu_rate, 1) if cpu_rate else None,
         "cpu_ref_mode": cpu_ref_mode,
         "cpu_ref_rate_extrapolated": (

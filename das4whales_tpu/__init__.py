@@ -12,6 +12,7 @@ per-channel Python loops and ``jax.sharding`` meshes instead of dask chunks.
 __version__ = "0.1.0"
 
 from . import config  # noqa: F401
+from . import faults  # noqa: F401
 from . import io  # noqa: F401
 from . import loc  # noqa: F401
 from . import ops  # noqa: F401

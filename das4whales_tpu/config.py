@@ -256,6 +256,69 @@ def as_bucket_config(bucket) -> BatchBucketConfig:
     )
 
 
+@dataclass(frozen=True)
+class DataHealthConfig:
+    """Quarantine thresholds for the on-device data-health stats
+    (``ops.health``; fused into the detection program by the campaign
+    runners — docs/ROBUSTNESS.md).
+
+    A breaching file is dispositioned ``status="quarantined"`` instead
+    of ``done``-with-garbage-picks. Thresholds compare against the stats
+    of the block AS THE DETECTOR CONSUMES IT — raw interrogator counts
+    on the narrow wire (``clip_abs`` in counts, e.g. 32767 for an int16
+    source), strain on the conditioned wire.
+
+    * ``max_nonfinite`` — maximum tolerated non-finite (NaN/Inf) sample
+      COUNT; the default 0 quarantines any NaN-poisoned record.
+    * ``clip_abs`` — saturation magnitude: samples with ``|x| >=
+      clip_abs`` count as clipped (``None`` disables clip accounting).
+    * ``max_clip_frac`` — maximum tolerated clipped fraction.
+    * ``max_rms`` / ``min_rms`` — RMS sanity window (``None`` disables
+      either side); ``min_rms`` catches dead/zeroed records, ``max_rms``
+      wild-amplitude ones.
+    """
+
+    max_nonfinite: int = 0
+    clip_abs: float | None = None
+    max_clip_frac: float = 0.25
+    max_rms: float | None = None
+    min_rms: float | None = None
+
+    def breach(self, stats: Mapping) -> str | None:
+        """The first threshold ``stats`` (an ``ops.health`` stats dict)
+        breaches, as a human-readable reason — or None when healthy.
+        NaN-valued rms (a NaN-poisoned block) reads as unhealthy for any
+        configured rms bound."""
+        if stats["nonfinite"] > self.max_nonfinite:
+            return (f"nonfinite samples: {stats['nonfinite']} > "
+                    f"max_nonfinite={self.max_nonfinite}")
+        if self.clip_abs is not None and stats["clip_frac"] > self.max_clip_frac:
+            return (f"clipped fraction {stats['clip_frac']:.4g} > "
+                    f"max_clip_frac={self.max_clip_frac} "
+                    f"(|x| >= {self.clip_abs:g})")
+        rms = stats["rms"]
+        if self.max_rms is not None and not rms <= self.max_rms:
+            return f"rms {rms:.4g} above max_rms={self.max_rms:g}"
+        if self.min_rms is not None and not rms >= self.min_rms:
+            return f"rms {rms:.4g} below min_rms={self.min_rms:g}"
+        return None
+
+
+def as_health_config(health) -> DataHealthConfig | None:
+    """Accept a :class:`DataHealthConfig`, ``True``/``None`` (defaults:
+    quarantine on any non-finite sample), or ``False`` (health checks
+    off)."""
+    if isinstance(health, DataHealthConfig):
+        return health
+    if health is None or health is True:
+        return DataHealthConfig()
+    if health is False:
+        return None
+    raise TypeError(
+        f"health must be a DataHealthConfig, bool or None, got {health!r}"
+    )
+
+
 #: Default on-disk home of the persistent XLA compilation cache (batched
 #: campaigns compile O(#buckets) programs ONCE per machine, not once per
 #: process — docs/TPU_RUNBOOK.md). Override with

@@ -1,0 +1,494 @@
+"""Failure taxonomy, classified retry, and deterministic fault injection.
+
+At campaign scale, partial failure is the steady state, not the
+exception (Large-Scale DFT on TPUs, arXiv:2002.03260, makes the same
+point for long TPU runs): a multi-day run WILL see NFS blips, truncated
+files, NaN-poisoned records, and hung readers. The reference package has
+no failure story at all (SURVEY.md §5.3-4) and the campaign layer of
+PRs 1-3 treated every exception identically — a transient read error
+permanently failed a file, a NaN slab was marked ``done`` with garbage
+picks, and a hung reader stalled the run forever. This module gives the
+campaign runners (``workflows.campaign``) the vocabulary to do better:
+
+* :func:`classify_failure` — every exception maps to one of four
+  classes: ``transient`` (retry with backoff), ``corrupt`` (the file is
+  bad; disposition ``failed`` immediately), ``data`` (the content is
+  bad; disposition ``quarantined``), ``fatal`` (abort the campaign).
+* :class:`RetryPolicy` / :class:`RetryState` — config-driven attempt
+  ceilings, exponential backoff with deterministic seeded jitter, and
+  per-class campaign-wide retry budgets.
+* :class:`DeadlineExceeded` — a per-file wall-clock reader deadline
+  (enforced by ``io.stream``'s prefetch threads) that turns a hung
+  reader into ``status="timeout"`` + campaign-continues.
+* :class:`FaultPlan` — a SEEDED fault schedule injected at the reader /
+  transfer / detector boundaries, so the whole resilience contract is
+  provable under fuzzed fault schedules (tests/test_chaos.py), not just
+  asserted.
+* :func:`counters` — process-wide resilience counters (retries,
+  degradations, quarantined, timeouts) that bench.py reports next to
+  the headline metric, so resilience overhead on the hot path is
+  visible rather than silently folded into the wall.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Mapping
+
+import numpy as np
+
+FAULT_CLASSES = ("transient", "corrupt", "data", "fatal")
+
+# ---------------------------------------------------------------------------
+# Failure taxonomy
+# ---------------------------------------------------------------------------
+
+#: OS errnos that name a condition expected to clear on retry (I/O layer
+#: blips: NFS staleness, interrupted syscalls, exhausted transient
+#: resources) — NOT conditions that name a bad file (ENOENT, EISDIR).
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in (
+        "EIO", "EAGAIN", "EBUSY", "EINTR", "ESTALE", "ETIMEDOUT",
+        "ENETDOWN", "ENETUNREACH", "ENETRESET", "ECONNABORTED",
+        "ECONNRESET", "ECONNREFUSED", "EHOSTDOWN", "EHOSTUNREACH",
+        "ENOBUFS", "EREMOTEIO", "EDEADLK",
+    )
+    if hasattr(errno, name)
+)
+
+#: Substrings (lowercased) that mark an error text as transient when the
+#: exception type alone is ambiguous (h5py and the jax runtime both
+#: surface rich conditions as bare OSError/RuntimeError text).
+_TRANSIENT_MARKERS = (
+    "timed out", "timeout", "temporarily unavailable", "stale file handle",
+    "resource busy", "connection reset", "transfer failed", "try again",
+    "unavailable: ", "deadline exceeded",
+)
+
+
+class DataHealthError(RuntimeError):
+    """A block's on-device health stats breached the configured
+    thresholds (``ops.health``): the file read fine but its CONTENT is
+    unusable (NaN-poisoned, ADC-clipped, dead). Classified ``data`` —
+    the campaign dispositions it ``quarantined``, never ``done``."""
+
+    fault_class = "data"
+
+    def __init__(self, reason: str, stats: dict | None = None):
+        super().__init__(reason)
+        self.stats = dict(stats or {})
+
+
+class DeadlineExceeded(TimeoutError):
+    """A file's read exceeded the campaign's per-file wall-clock
+    deadline (``io.stream`` ``read_deadline_s``). The campaign records
+    ``status="timeout"`` and continues; the hung worker thread is
+    abandoned (it cannot be killed) and a fresh stream restarts past the
+    culprit."""
+
+    def __init__(self, path: str, deadline_s: float | None):
+        self.path = path
+        self.deadline_s = float(deadline_s) if deadline_s is not None else None
+        super().__init__(
+            f"{path}: read exceeded the "
+            f"{self.deadline_s if self.deadline_s is not None else '?'}s "
+            "per-file deadline"
+        )
+
+
+class FaultInjected(Exception):
+    """Marker mixin: this exception came from a :class:`FaultPlan`."""
+
+
+class InjectedReadError(FaultInjected, OSError):
+    """Injected transient I/O failure at the reader boundary."""
+
+    fault_class = "transient"
+
+
+class InjectedCorruptFile(FaultInjected, OSError):
+    """Injected truncated/garbage-file failure (persists across
+    attempts, like a real bad file on disk)."""
+
+    fault_class = "corrupt"
+
+
+class InjectedTransferError(FaultInjected, ConnectionError):
+    """Injected host->device transfer failure."""
+
+    fault_class = "transient"
+
+
+class InjectedDetectorError(FaultInjected, RuntimeError):
+    """Injected device-program failure at the detector boundary."""
+
+    fault_class = "transient"
+
+
+class InjectedCrash(FaultInjected, RuntimeError):
+    """Injected fatal mid-run crash (the crash-resume drill)."""
+
+    fault_class = "fatal"
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map an exception to its failure class.
+
+    ``transient`` — expected to clear on retry (I/O blips, transfer
+    failures); ``corrupt`` — the FILE is bad, disposition immediately
+    (the safe default for anything unrecognized: retrying an unknown
+    failure risks an unbounded loop, and pre-taxonomy campaigns failed
+    everything immediately, so unknown==corrupt preserves behavior);
+    ``data`` — the CONTENT is bad, quarantine; ``fatal`` — abort the
+    campaign. An exception may self-classify via a ``fault_class``
+    attribute (the injected fault types above and
+    :class:`DataHealthError` do).
+    """
+    declared = getattr(exc, "fault_class", None)
+    if declared in FAULT_CLASSES:
+        return declared
+    if isinstance(exc, (MemoryError, KeyboardInterrupt, SystemExit)):
+        return "fatal"
+    if isinstance(exc, (FloatingPointError,)):
+        return "data"
+    if isinstance(exc, (ConnectionError, InterruptedError, TimeoutError)):
+        return "transient"
+    if isinstance(exc, OSError):
+        if exc.errno in _TRANSIENT_ERRNOS:
+            return "transient"
+        text = str(exc).lower()
+        if any(m in text for m in _TRANSIENT_MARKERS):
+            return "transient"
+        # h5py surfaces truncated/garbage files as errno-less OSError
+        # ("file signature not found", "truncated file", ...)
+        return "corrupt"
+    return "corrupt"
+
+
+# ---------------------------------------------------------------------------
+# Classified retry with deterministic backoff
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Config-driven retry for transient-class failures.
+
+    ``max_attempts`` is the TOTAL attempts per file (1 = never retry).
+    Backoff for attempt ``a`` (1-based) is
+    ``min(base_delay_s * 2**(a-1), max_delay_s)`` scaled by a
+    DETERMINISTIC seeded jitter in ``[1-jitter, 1+jitter]`` — seeded by
+    ``(seed, key, attempt)``, so a rerun of the same campaign sleeps the
+    same schedule (reproducible walls) while distinct files decorrelate
+    (no thundering herd against a recovering filesystem).
+    ``budgets`` caps the campaign-wide number of RETRIES per class
+    (``None`` = unbounded); once a class's budget is spent, further
+    failures of that class disposition immediately.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    jitter: float = 0.25
+    seed: int = 0
+    retry_classes: tuple = ("transient",)
+    budgets: Mapping[str, int | None] = field(
+        default_factory=lambda: {"transient": None}
+    )
+
+    def delay_s(self, key: str, attempt: int) -> float:
+        base = min(self.base_delay_s * 2 ** max(attempt - 1, 0),
+                   self.max_delay_s)
+        rng = random.Random(f"{self.seed}|{key}|{attempt}")
+        return max(0.0, base * (1.0 + self.jitter * rng.uniform(-1.0, 1.0)))
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        """The campaign default, overridable per deployment:
+        ``DAS_RETRY_MAX_ATTEMPTS`` / ``DAS_RETRY_BASE_DELAY_S`` /
+        ``DAS_RETRY_MAX_DELAY_S`` / ``DAS_RETRY_BUDGET`` (campaign-wide
+        transient retry cap, empty = unbounded)."""
+        budget = os.environ.get("DAS_RETRY_BUDGET", "")
+        return cls(
+            max_attempts=int(os.environ.get("DAS_RETRY_MAX_ATTEMPTS", 3)),
+            base_delay_s=float(os.environ.get("DAS_RETRY_BASE_DELAY_S", 0.05)),
+            max_delay_s=float(os.environ.get("DAS_RETRY_MAX_DELAY_S", 2.0)),
+            budgets={"transient": int(budget) if budget else None},
+        )
+
+
+def as_retry_policy(retry) -> RetryPolicy | None:
+    """Accept a :class:`RetryPolicy`, ``None``/``True`` (the env-driven
+    default), or ``False`` (retries off)."""
+    if isinstance(retry, RetryPolicy):
+        return retry
+    if retry is None or retry is True:
+        return RetryPolicy.from_env()
+    if retry is False:
+        return None
+    raise TypeError(f"retry must be a RetryPolicy, bool or None, got {retry!r}")
+
+
+class RetryState:
+    """One campaign's mutable retry bookkeeping over a
+    :class:`RetryPolicy`: per-file attempt counts and per-class spent
+    budgets."""
+
+    def __init__(self, policy: RetryPolicy | None):
+        self.policy = policy
+        self.attempts: Dict[str, int] = {}
+        self.spent: Dict[str, int] = {}
+
+    def attempt(self, key: str) -> int:
+        """Record one attempt for ``key``; returns the 1-based count."""
+        self.attempts[key] = self.attempts.get(key, 0) + 1
+        return self.attempts[key]
+
+    def n_attempts(self, key: str) -> int:
+        return self.attempts.get(key, 0)
+
+    def should_retry(self, key: str, fclass: str) -> bool:
+        pol = self.policy
+        if pol is None or fclass not in pol.retry_classes:
+            return False
+        if self.attempts.get(key, 0) >= pol.max_attempts:
+            return False
+        budget = pol.budgets.get(fclass) if pol.budgets else None
+        return budget is None or self.spent.get(fclass, 0) < budget
+
+    def backoff(self, key: str, fclass: str, sleep=time.sleep) -> float:
+        """Spend one retry (budget + counter) and sleep the deterministic
+        backoff for ``key``'s next attempt; returns the delay slept."""
+        self.spent[fclass] = self.spent.get(fclass, 0) + 1
+        count("retries")
+        delay = self.policy.delay_s(key, self.attempts.get(key, 1))
+        if delay > 0:
+            sleep(delay)
+        return delay
+
+
+# ---------------------------------------------------------------------------
+# Process-wide resilience counters (reported by bench.py)
+# ---------------------------------------------------------------------------
+
+_counters_lock = threading.Lock()
+_COUNTERS: Dict[str, int] = {
+    "retries": 0, "degradations": 0, "quarantined": 0, "timeouts": 0,
+}
+
+
+def count(name: str, n: int = 1) -> None:
+    """Increment a process-wide resilience counter."""
+    with _counters_lock:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of the process-wide resilience counters."""
+    with _counters_lock:
+        return dict(_COUNTERS)
+
+
+def counters_delta(before: Mapping[str, int]) -> Dict[str, int]:
+    """Counters accrued since a :func:`counters` snapshot."""
+    now = counters()
+    return {k: now.get(k, 0) - before.get(k, 0) for k in now}
+
+
+# ---------------------------------------------------------------------------
+# Deterministic chaos harness
+# ---------------------------------------------------------------------------
+
+#: kind -> (site, exception factory or None for non-raising kinds)
+FAULT_KINDS = ("oserror", "truncated", "transfer", "nan", "hang")
+_KIND_SITE = {
+    "oserror": "read", "truncated": "read", "hang": "read", "nan": "read",
+    "transfer": "transfer", "detect": "detect", "crash": "detect",
+}
+#: kinds whose fault persists across attempts: a bad file stays bad, and
+#: a hung mount stays hung (also keeps the chaos oracle deterministic —
+#: an abandoned prefetch worker past a timeout may consume read-site
+#: hits the consumer never observes)
+_PERSISTENT_KINDS = frozenset({"truncated", "nan", "hang"})
+
+
+@dataclass
+class FaultSpec:
+    """One file's planned fault: ``kind`` at ``site``, failing the first
+    ``n_times`` attempts (persistent kinds fail every attempt)."""
+
+    kind: str
+    site: str
+    n_times: int
+
+
+class FaultPlan:
+    """A seeded, deterministic fault schedule over a campaign.
+
+    For each file the plan draws — seeded by ``(seed, basename)`` only,
+    so the schedule is stable across tmp directories, call order, stream
+    restarts and resume — whether to inject a fault, which ``kind``, and
+    for transient kinds how many attempts fail before the file recovers
+    (``1..max_transient_repeats``; keep it below the retry policy's
+    ``max_attempts`` to model recoverable blips). Kinds:
+
+    * ``"oserror"`` — transient ``EIO`` at the reader.
+    * ``"truncated"`` — persistent corrupt-file error at the reader.
+    * ``"transfer"`` — transient host->device transfer failure.
+    * ``"nan"`` — the read succeeds but the block comes back
+      NaN-poisoned (integer blocks: ADC-saturated) — exercises the
+      on-device health quarantine, not an exception path.
+    * ``"hang"`` — the reader sleeps ``hang_s`` (pair with a stream
+      ``read_deadline_s`` below it to exercise the timeout path).
+    * ``"crash"`` (only via ``crash_after``) — a one-shot FATAL fault at
+      the detector boundary after N successful detects: the mid-run
+      crash of the crash-resume drill.
+
+    Injection sites are the hooks ``io.stream`` and
+    ``workflows.campaign`` call: :meth:`on_read` / :meth:`poison_read`
+    (reader boundary, runs on the prefetch worker), :meth:`on_transfer`
+    (before ``device_put``/``jnp.asarray``), :meth:`on_detect` (before
+    the detection program).
+    """
+
+    def __init__(self, seed: int, rate: float = 0.4,
+                 kinds=FAULT_KINDS, hang_s: float = 0.25,
+                 max_transient_repeats: int = 2,
+                 crash_after: int | None = None):
+        for k in kinds:
+            if k not in _KIND_SITE or k == "crash":
+                raise ValueError(f"unknown fault kind {k!r}")
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.kinds = tuple(kinds)
+        self.hang_s = float(hang_s)
+        self.max_transient_repeats = int(max_transient_repeats)
+        self.crash_after = crash_after
+        self._lock = threading.Lock()
+        self._hits: Dict[tuple, int] = {}   # (site, basename) -> injections
+        self._detect_ok = 0                 # successful detects (crash_after)
+        self._crashed = False
+
+    def spec_for(self, path: str) -> FaultSpec | None:
+        """The (deterministic) fault planned for ``path``, if any."""
+        name = os.path.basename(path)
+        rng = random.Random(f"{self.seed}|{name}")
+        if not self.kinds or rng.random() >= self.rate:
+            return None
+        kind = self.kinds[rng.randrange(len(self.kinds))]
+        n = (10**9 if kind in _PERSISTENT_KINDS
+             else 1 + rng.randrange(self.max_transient_repeats))
+        return FaultSpec(kind=kind, site=_KIND_SITE[kind], n_times=n)
+
+    def _fire(self, site: str, path: str) -> FaultSpec | None:
+        """Consume one planned injection at ``site`` for ``path`` (None
+        when the plan holds no fault there or it is spent)."""
+        spec = self.spec_for(path)
+        if spec is None or spec.site != site:
+            return None
+        key = (site, os.path.basename(path))
+        with self._lock:
+            hits = self._hits.get(key, 0)
+            if hits >= spec.n_times:
+                return None
+            self._hits[key] = hits + 1
+        return spec
+
+    # -- hooks ------------------------------------------------------------
+
+    def on_read(self, path: str) -> None:
+        """Reader boundary (prefetch worker): raise or hang per plan.
+        (``nan`` faults do not raise — they fire in :meth:`poison_read`.)"""
+        if self._peek_nan(path):
+            return
+        spec = self._fire("read", path)
+        if spec is None:
+            return
+        if spec.kind == "hang":
+            time.sleep(self.hang_s)
+        elif spec.kind == "truncated":
+            raise InjectedCorruptFile(
+                f"injected: truncated HDF5 (file signature not found): {path}"
+            )
+        else:
+            raise InjectedReadError(
+                errno.EIO, f"injected: transient I/O error reading {path}"
+            )
+
+    def poison_read(self, path: str, arr: np.ndarray) -> np.ndarray:
+        """Reader boundary, after a successful read: NaN-poison (float)
+        or ADC-saturate (integer) a stripe of the block per plan."""
+        spec = self._fire("read", path) if self._peek_nan(path) else None
+        if spec is None:
+            return arr
+        out = np.array(arr)
+        n_bad = max(1, out.shape[-1] // 8)
+        if np.issubdtype(out.dtype, np.floating):
+            out[..., :n_bad] = np.nan
+        else:
+            out[..., :n_bad] = np.iinfo(out.dtype).max
+        return out
+
+    def _peek_nan(self, path: str) -> bool:
+        spec = self.spec_for(path)
+        return spec is not None and spec.kind == "nan"
+
+    def on_transfer(self, path: str) -> None:
+        """Host->device boundary: raise a transient transfer fault."""
+        if self._fire("transfer", path) is not None:
+            raise InjectedTransferError(
+                f"injected: transfer failed for {path}"
+            )
+
+    def on_detect(self, path: str) -> None:
+        """Detector boundary: the one-shot fatal crash (``crash_after``),
+        then any planned detect-site fault."""
+        with self._lock:
+            if (self.crash_after is not None and not self._crashed
+                    and self._detect_ok >= self.crash_after):
+                self._crashed = True
+                raise InjectedCrash(
+                    f"injected: campaign crashed before detecting {path}"
+                )
+        if self._fire("detect", path) is not None:
+            raise InjectedDetectorError(
+                f"injected: device program failed for {path}"
+            )
+
+    def detect_succeeded(self) -> None:
+        """Campaign bookkeeping for ``crash_after``."""
+        with self._lock:
+            self._detect_ok += 1
+
+    def expected_disposition(self, path: str,
+                             policy: RetryPolicy | None) -> str:
+        """The status this plan predicts for ``path`` under ``policy`` —
+        the chaos fuzz oracle. ``"done"`` when the fault recovers within
+        the retry budget (or there is none), else the fault class's
+        terminal status.
+
+        Preconditions the oracle assumes (assert them in the fuzz, not
+        here): ``"hang"`` needs a stream ``read_deadline_s`` below
+        ``hang_s``; ``"nan"`` needs a health gate that can SEE the
+        poison — the default ``DataHealthConfig`` catches the NaN stripe
+        on float wires, but an integer (raw-wire) block is poisoned by
+        ADC saturation, which only a configured ``clip_abs`` /
+        ``max_clip_frac`` gate flags.
+        """
+        spec = self.spec_for(path)
+        if spec is None:
+            return "done"
+        if spec.kind == "truncated":
+            return "failed"
+        if spec.kind == "nan":
+            return "quarantined"
+        if spec.kind == "hang":
+            return "timeout"
+        max_attempts = policy.max_attempts if policy is not None else 1
+        return "done" if spec.n_times < max_attempts else "failed"

@@ -36,6 +36,7 @@ import dataclasses
 import functools
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from dataclasses import dataclass
 from typing import Iterator, Sequence
 
@@ -166,6 +167,8 @@ def stream_strain_blocks(
     as_numpy: bool = False,
     wire: str = "conditioned",
     overlap_transfers: bool | None = None,
+    read_deadline_s: float | None = None,
+    fault_plan=None,
 ) -> Iterator[StrainBlock]:
     """Yield :class:`StrainBlock`\\ s for ``files`` in order, reading ahead
     ``prefetch`` files while the caller computes.
@@ -189,6 +192,23 @@ def stream_strain_blocks(
     ``engine="auto"`` picks the native path iff the *first* file is natively
     readable; a later file that breaks that assumption raises — pass
     ``engine="h5py"`` for heterogeneous campaigns.
+
+    ``read_deadline_s`` bounds how long the consumer waits on any ONE
+    file's prefetch worker: a hung reader (dead NFS mount, wedged
+    interrogator export) raises ``faults.DeadlineExceeded`` at that
+    file's own yield position instead of stalling the stream forever.
+    The hung worker thread cannot be killed — it is abandoned (its pool
+    is shut down without joining) and keeps its memory until the read
+    returns; the campaign runner records ``status="timeout"`` and
+    restarts a fresh stream past the culprit. Threaded-reader paths only
+    (the default ``engine="h5py"`` campaign configuration; the native
+    C++ prefetcher has no bounded wait).
+
+    ``fault_plan`` (``faults.FaultPlan``) injects the chaos harness's
+    scheduled faults at the reader boundary (``on_read`` /
+    ``poison_read`` on the prefetch worker) and, for device-bound
+    streams, at the transfer boundary (``on_transfer`` before
+    ``device_put``) — None (the default) costs nothing.
     """
     if prefetch < 1:
         raise ValueError("prefetch must be >= 1")
@@ -227,6 +247,14 @@ def stream_strain_blocks(
     use_native = engine in ("auto", "native") and first.layout is not None
     if engine == "native" and not use_native:
         raise ValueError(f"engine='native' but {files[0]} is not natively readable")
+    if use_native and (read_deadline_s is not None or fault_plan is not None):
+        if engine == "native":
+            raise ValueError(
+                "read_deadline_s / fault_plan need the threaded reader; the "
+                "native C++ prefetcher has no bounded wait or injection "
+                "hooks — pass engine='h5py'"
+            )
+        use_native = False  # engine='auto': prefer the resilience contract
 
     # probe lazily: spec k is probed right before (native) or inside (h5py)
     # its read task, keeping only `prefetch` probes + reads ahead of the
@@ -258,15 +286,29 @@ def stream_strain_blocks(
 
     def probe_and_read(i):
         spec = spec_for(i) if i == 0 else _probe(files[i], interrogator, metas[i])
+        if fault_plan is not None:
+            fault_plan.on_read(files[i])        # chaos harness: raise/hang
         host = reader(spec, sel)
+        if fault_plan is not None:
+            host = fault_plan.poison_read(files[i], host)
         if overlap and not as_numpy:
             # dispatch the H2D transfer from the read worker, the moment
             # the read completes — jax.device_put is async, so the worker
             # is not pinned and the copy overlaps compute on earlier files
+            if fault_plan is not None:
+                fault_plan.on_transfer(files[i])
             return spec, place(host)
         return spec, host
 
-    with ThreadPoolExecutor(max_workers=prefetch) as ex:
+    # not a `with` block: when a deadline is configured the pool must
+    # NEVER be joined at teardown — a hung worker may never return, and
+    # __exit__'s shutdown(wait=True) would turn one hung file into a
+    # hung campaign (whether the generator exits via the deadline
+    # itself, another file's error, or consumer abandonment while a
+    # hung read is in flight). Deadline-less streams keep the legacy
+    # draining teardown.
+    ex = ThreadPoolExecutor(max_workers=prefetch)
+    try:
         futs = {
             i: ex.submit(probe_and_read, i)
             for i in range(min(prefetch, len(files)))
@@ -276,11 +318,26 @@ def stream_strain_blocks(
             nxt = i + prefetch
             if nxt < len(files):
                 futs[nxt] = ex.submit(probe_and_read, nxt)
-            spec, payload = fut.result()  # strict submission order
+            try:
+                spec, payload = fut.result(read_deadline_s)  # submission order
+            except FutureTimeout as exc:
+                # on Python >= 3.11 concurrent.futures.TimeoutError IS
+                # builtin TimeoutError, so a TimeoutError raised by the
+                # READER (e.g. OSError ETIMEDOUT) lands here too — that
+                # one is the file's own (transient-class) failure, not a
+                # deadline violation
+                if fut.done() and fut.exception() is exc:
+                    raise
+                from .. import faults
+
+                raise faults.DeadlineExceeded(files[i], read_deadline_s)
             if as_numpy or overlap:
                 yield finish(spec, payload)
             else:
                 yield finish(spec, place(payload))
+    finally:
+        wait = read_deadline_s is None
+        ex.shutdown(wait=wait, cancel_futures=not wait)
 
 
 def _native_stream(files, sel, specs, spec_for, prefetch, place, finish,
@@ -478,7 +535,8 @@ class SlabReadError(RuntimeError):
 
 
 def _assemble_host_slabs(files, selected_channels, metadata, *, batch,
-                         bucket_cfg, interrogator, prefetch, engine, wire):
+                         bucket_cfg, interrogator, prefetch, engine, wire,
+                         read_deadline_s=None, fault_plan=None):
     """Host half of the assembler: pull ordered blocks off the read
     pipeline, group CONSECUTIVE same-bucket files, pad and stack. Slabs
     come out strictly in file order (a bucket change flushes the current
@@ -507,6 +565,7 @@ def _assemble_host_slabs(files, selected_channels, metadata, *, batch,
     stream = stream_strain_blocks(
         files, selected_channels, metadata, interrogator=interrogator,
         prefetch=prefetch, engine=engine, as_numpy=True, wire=wire,
+        read_deadline_s=read_deadline_s, fault_plan=fault_plan,
     )
     for i in range(len(files)):
         try:
@@ -552,6 +611,8 @@ def stream_batched_slabs(
     sharding=None,
     as_numpy: bool = False,
     in_flight: int = 2,
+    read_deadline_s: float | None = None,
+    fault_plan=None,
 ) -> Iterator[BatchSlab]:
     """Coalesce the ordered read pipeline into ``[batch, channel, time]``
     slabs for the batched one-program detection route
@@ -577,7 +638,11 @@ def stream_batched_slabs(
     has been yielded, so the error surfaces at the failing file's own
     position in the consumption order (the campaign's per-file fault
     isolation relies on this attribution, exactly like
-    ``stream_strain_blocks``).
+    ``stream_strain_blocks``). ``read_deadline_s`` / ``fault_plan`` pass
+    through to the underlying stream (see ``stream_strain_blocks``); a
+    deadline violation or injected read fault surfaces wrapped in the
+    same :class:`SlabReadError` attribution (its ``cause`` keeps the
+    original class for the campaign's failure taxonomy).
     """
     if batch < 1:
         raise ValueError("batch must be >= 1")
@@ -589,7 +654,8 @@ def stream_batched_slabs(
     gen = _assemble_host_slabs(
         list(files), selected_channels, metadata, batch=batch,
         bucket_cfg=bucket_cfg, interrogator=interrogator, prefetch=prefetch,
-        engine=engine, wire=wire,
+        engine=engine, wire=wire, read_deadline_s=read_deadline_s,
+        fault_plan=fault_plan,
     )
     if as_numpy:
         if sharding is not None or device is not None:

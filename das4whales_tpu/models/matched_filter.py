@@ -360,7 +360,7 @@ def reference_threshold_factors(n_templates: int, dtype=None) -> jnp.ndarray:
     static_argnames=(
         "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp",
         "tile", "max_peaks", "capacity", "use_threshold", "pick_method",
-        "condition", "cond_demean",
+        "condition", "cond_demean", "with_health",
     ),
 )
 def mf_detect_picks_program(
@@ -385,6 +385,8 @@ def mf_detect_picks_program(
     cond_demean: bool = True,
     cond_scale=1.0,
     cond_n_real=None,
+    with_health: bool = False,
+    health_clip=None,
 ):
     """The WHOLE detection step as ONE XLA program: [optional narrow-wire
     conditioning prologue ->] bandpass -> f-k filter
@@ -422,9 +424,26 @@ def mf_detect_picks_program(
     ``sat_count`` is the number of real channels whose pick slots
     saturated at ``max_peaks`` (caller escalates K, exactly like
     ``ops.peaks.picks_with_escalation``).
+
+    ``with_health=True`` appends the on-device data-health stats
+    (``ops.health.health_stats`` over the INPUT block — raw counts on
+    the narrow wire, strain on the conditioned one; ``cond_n_real``
+    restricts them to a padded record's real samples on either wire) to
+    the return: ``(..., health_counts [2] int32, health_rms f32)``. They
+    ride the program's existing packed fetch — the quarantine gate costs
+    no extra dispatch and no extra device->host round trip
+    (docs/ROBUSTNESS.md). ``health_clip`` is a traced scalar (samples
+    with ``|x| >= health_clip`` count as clipped; None disables).
     """
     C = trace.shape[0]
     nT = templates_true.shape[0]
+    if with_health:
+        from ..ops import health as health_ops
+
+        h_counts, h_rms = health_ops.health_stats(
+            trace, jnp.inf if health_clip is None else health_clip,
+            n_real=cond_n_real,
+        )
     if condition:
         # narrow-wire prologue: raw counts -> strain, fused ahead of the
         # filter pass (templates carry the compute dtype); a bucket-padded
@@ -476,6 +495,8 @@ def mf_detect_picks_program(
         )
         sat = jnp.swapaxes(sp.saturated, 0, 1).reshape(nT, -1)[:, :C]
         sat_count = jnp.sum(sat.astype(jnp.int32), axis=-1)
+    if with_health:
+        return chan, times, cnt, sat_count, thr, h_counts, h_rms
     return chan, times, cnt, sat_count, thr
 
 
@@ -497,6 +518,10 @@ class MatchedFilterResult:
     picks: Dict[str, np.ndarray]          # (2, n_picks) [channel_idx, time_idx]
     thresholds: Dict[str, float]
     snr: Dict[str, jnp.ndarray] = field(default_factory=dict)
+    #: on-device data-health stats (ops.health.stats_to_dict) when the
+    #: caller requested the fused quarantine gate (detect_picks
+    #: with_health=True); empty otherwise
+    health: Dict[str, float] = field(default_factory=dict)
 
 
 class MatchedFilterDetector:
@@ -688,9 +713,17 @@ class MatchedFilterDetector:
             return self.detect_picks(trace, threshold=threshold)
         return self._call_full(trace, threshold=threshold, with_snr=with_snr)
 
+    @property
+    def supports_fused_health(self) -> bool:
+        """True when :meth:`detect_picks` can fuse the data-health stats
+        into the one-program route (``ops.health``) — the campaign uses
+        this to pick fused stats over the host-side fallback."""
+        return self.pick_mode == "sparse"
+
     def detect_picks(
         self, trace: jnp.ndarray, threshold: float | None = None,
-        n_real: int | None = None,
+        n_real: int | None = None, with_health: bool = False,
+        health_clip: float | None = None,
     ) -> MatchedFilterResult:
         """Picks-only detection: ONE XLA program, ONE device->host fetch.
 
@@ -719,10 +752,23 @@ class MatchedFilterDetector:
         preserved. ``trf_fk``/``correlograms`` are not materialized
         (campaign semantics — the reference keeps them only for plotting,
         main_mfdetect.py:84-92; use ``__call__`` for those).
+
+        ``with_health=True`` fuses the data-health stats (``ops.health``)
+        into the same program — they ride the packed fetch (no extra
+        dispatch or round trip) and land in ``result.health``;
+        ``health_clip`` sets the clipped-sample magnitude. The campaign
+        quarantine gate (docs/ROBUSTNESS.md) consumes this.
         """
+        from ..ops import health as health_ops
+
         trace = self._as_input(trace)
         if self.pick_mode != "sparse":
-            return self._call_full(trace, threshold=threshold)
+            res = self._call_full(trace, threshold=threshold)
+            if with_health:  # no fused program here: host-side fallback
+                res.health = health_ops.host_health_stats(
+                    np.asarray(trace), clip_abs=health_clip
+                )
+            return res
         C = trace.shape[0]
         nT = self.design.templates.shape[0]
         names = self.design.template_names
@@ -732,11 +778,14 @@ class MatchedFilterDetector:
                           dtype=self._mask_band_dev.dtype)
         tile = self.effective_channel_tile if self._route() == "tiled" else None
         # pad-aware conditioning only when the pad is real: an exact-fit
-        # n_real keeps the plain jnp.mean path (and its compiled program)
+        # n_real keeps the plain jnp.mean path (and its compiled program).
+        # The health stats mask the pad on EITHER wire (the conditioned
+        # wire's pad is zeros — finite and unclipped — but it would
+        # dilute the rms window).
+        pad_real = n_real is not None and int(n_real) != trace.shape[1]
         cond_nr = (
             jnp.asarray(int(n_real), jnp.int32)
-            if (self.wire == "raw" and n_real is not None
-                and int(n_real) != trace.shape[1])
+            if ((self.wire == "raw" or with_health) and pad_real)
             else None
         )
 
@@ -754,16 +803,33 @@ class MatchedFilterDetector:
                 condition=self.wire == "raw",
                 cond_scale=self._cond_scale,
                 cond_n_real=cond_nr,
+                with_health=with_health,
+                health_clip=(None if health_clip is None
+                             else jnp.float32(health_clip)),
             )
 
-        chan, times, cnt, satc, thr = jax.device_get(run(self.pick_k0))
+        health: Dict[str, float] = {}
+
+        def fetch(k):
+            outs = jax.device_get(run(k))
+            if with_health:
+                *outs, h_counts, h_rms = outs
+                health.update(health_ops.stats_to_dict(
+                    h_counts, h_rms,
+                    C * int(n_real if pad_real else trace.shape[1]),
+                ))
+            return outs
+
+        chan, times, cnt, satc, thr = fetch(self.pick_k0)
         if self.pick_k0 < self.max_peaks and int(satc.sum()):
             # some channel saturated at K0 — rerun at full capacity (exact,
             # same policy as ops.peaks.picks_with_escalation)
-            chan, times, cnt, satc, thr = jax.device_get(run(self.max_peaks))
+            chan, times, cnt, satc, thr = fetch(self.max_peaks)
         if int(cnt.max(initial=0)) > cap:
             # packed-capacity overflow: the exact full-transfer route
-            if cond_nr is not None:
+            # (health was already fetched from the packed attempt — the
+            # fallback reruns only the pick transfer, so attach it)
+            if self.wire == "raw" and cond_nr is not None:
                 # the pad-aware demean must survive the fallback: plain
                 # whole-record conditioning would bias the mean by
                 # n_real/T and turn the zero pad into a -mean*scale step
@@ -779,8 +845,12 @@ class MatchedFilterDetector:
                 )
                 det = copy.copy(self)
                 det.wire = "conditioned"
-                return det._call_full(cond_trace, threshold=threshold)
-            return self._call_full(trace, threshold=threshold)
+                res = det._call_full(cond_trace, threshold=threshold)
+                res.health = health
+                return res
+            res = self._call_full(trace, threshold=threshold)
+            res.health = health
+            return res
         picks, thr_out = {}, {}
         for i, name in enumerate(names):
             k = int(cnt[i])
@@ -791,7 +861,7 @@ class MatchedFilterDetector:
             self._warn_saturated(name, int(satc[i]))
         return MatchedFilterResult(
             trf_fk=None, correlograms={}, peak_masks={}, picks=picks,
-            thresholds=thr_out,
+            thresholds=thr_out, health=health,
         )
 
     def _call_full(self, trace: jnp.ndarray, threshold: float | None = None, with_snr: bool = False) -> MatchedFilterResult:

@@ -1,0 +1,109 @@
+"""On-device data-health statistics (the quarantine gate).
+
+A campaign file can read cleanly and still be garbage: a NaN-poisoned
+slab (failed interrogator write), an ADC-saturated recording, a dead
+span of fiber. Pre-taxonomy campaigns marked those ``done`` with
+meaningless picks. The health stats here are computed IN THE SAME XLA
+program as detection (``models.matched_filter.mf_detect_picks_program
+(with_health=True)`` and the batched route) over data the filter stage
+was about to read anyway, and ride the program's one packed fetch — no
+extra dispatch, no extra device->host round trip. The campaign compares
+them against :class:`das4whales_tpu.config.DataHealthConfig` thresholds
+and dispositions breaching files ``quarantined`` (``workflows.campaign``,
+``das4whales_tpu.faults.DataHealthError``).
+
+Counts, not fractions, cross the wire: at the canonical block size
+(2.6e8 samples) a single NaN yields ``1 - 4e-9``, which float32 rounds
+back to exactly 1.0 — a fraction-typed stat would silently pass the
+default ``max_nonfinite=0`` gate. int32 counts are exact up to 2**31
+samples; the host converts to fractions in float64 for reporting.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+#: Number of scalar slots in the packed health-count vector.
+N_COUNTS = 2
+
+
+def health_stats(x, clip_abs, n_real=None):
+    """Per-block health statistics, pure jnp (inline under any jit).
+
+    ``x`` is the detection program's input block ``[..., C, T]`` — raw
+    stored-dtype counts on the narrow wire, float strain on the
+    conditioned wire; the stats see exactly what detection consumes.
+    ``clip_abs`` (traced scalar) is the saturation magnitude: samples
+    with ``|x| >= clip_abs`` count as clipped (pass ``inf`` to disable —
+    no recompile, it is a traced operand). ``n_real`` (traced scalar or
+    None) restricts the stats to the real samples of a bucket-padded
+    record, so bucket padding can never dilute a breach below threshold.
+
+    Returns ``(counts int32 [..., 2], rms float32 [...])`` with
+    ``counts[..., 0]`` the non-finite sample count, ``counts[..., 1]``
+    the clipped sample count, and ``rms`` the root-mean-square over the
+    real samples (NaN when the block holds a NaN — itself a breach
+    signal, since any rms threshold comparison with NaN reads unhealthy).
+    """
+    xf = x.astype(jnp.float32)
+    finite = jnp.isfinite(xf)
+    # clipping is FINITE saturation (ADC rails); non-finite samples are
+    # already counted by the first slot and must not double-report
+    clipped = (jnp.abs(xf) >= jnp.asarray(clip_abs, jnp.float32)) & finite
+    if n_real is not None:
+        valid = jnp.arange(x.shape[-1]) < n_real
+        n = jnp.asarray(n_real, jnp.float32) * x.shape[-2]
+        finite = finite | ~valid
+        clipped = clipped & valid
+        sq = jnp.where(valid, xf * xf, jnp.zeros((), jnp.float32))
+    else:
+        n = jnp.float32(x.shape[-1] * x.shape[-2])
+        sq = xf * xf
+    counts = jnp.stack(
+        [
+            jnp.sum((~finite).astype(jnp.int32), axis=(-2, -1)),
+            jnp.sum(clipped.astype(jnp.int32), axis=(-2, -1)),
+        ],
+        axis=-1,
+    )
+    rms = jnp.sqrt(jnp.sum(sq, axis=(-2, -1)) / n)
+    return counts, rms
+
+
+def stats_to_dict(counts, rms, n_samples: int) -> dict:
+    """One file's fetched health outputs -> the host-side stats dict the
+    quarantine gate (:meth:`DataHealthConfig.breach`) and the manifest
+    consume. Fractions are derived in float64 from the exact counts."""
+    counts = np.asarray(counts)
+    n = max(int(n_samples), 1)
+    return {
+        "nonfinite": int(counts[0]),
+        "clipped": int(counts[1]),
+        "nonfinite_frac": float(counts[0]) / n,
+        "clip_frac": float(counts[1]) / n,
+        "rms": float(rms),
+        "n_samples": int(n_samples),
+    }
+
+
+def host_health_stats(arr: np.ndarray, clip_abs: float | None = None) -> dict:
+    """Host-side fallback for detector families without the fused
+    program (the campaign's generic-adapter path): same stats, numpy,
+    one pass over the already-host-resident block."""
+    x = np.asarray(arr)
+    xf = x.astype(np.float64, copy=False)
+    nonfinite = int(np.size(x) - np.count_nonzero(np.isfinite(xf)))
+    clipped = (
+        int(np.count_nonzero(np.isfinite(xf) & (np.abs(xf) >= float(clip_abs))))
+        if clip_abs is not None else 0
+    )
+    rms = float(np.sqrt(np.mean(np.square(xf))))
+    return {
+        "nonfinite": nonfinite,
+        "clipped": clipped,
+        "nonfinite_frac": nonfinite / max(x.size, 1),
+        "clip_frac": clipped / max(x.size, 1),
+        "rms": rms,
+        "n_samples": int(x.size),
+    }

@@ -46,12 +46,13 @@ from ..models.matched_filter import (
     MatchedFilterDetector,
     mf_detect_picks_program,
 )
+from ..ops import health as health_ops
 from ..ops import peaks as peak_ops
 
 _STATIC = (
     "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp", "tile",
     "max_peaks", "capacity", "use_threshold", "pick_method", "condition",
-    "serial",
+    "serial", "with_health",
 )
 
 
@@ -61,7 +62,7 @@ def _batched_body(
     band_lo: int, band_hi: int, bp_padlen: int, pad_rows: int,
     staged_bp: bool, tile: int | None, max_peaks: int, capacity: int,
     use_threshold: bool, pick_method: str, condition: bool,
-    serial: bool = False,
+    serial: bool = False, with_health: bool = False, health_clip=None,
 ):
     """The one-program route over a leading file axis, in ONE program.
 
@@ -93,6 +94,7 @@ def _batched_body(
             band_lo, band_hi, bp_padlen, pad_rows, staged_bp, tile,
             max_peaks, capacity, use_threshold, pick_method=pick_method,
             condition=condition, cond_scale=cond_scale, cond_n_real=nr,
+            with_health=with_health, health_clip=health_clip,
         )
 
     if n_real is None:
@@ -165,6 +167,7 @@ class BatchedMatchedFilterDetector:
 
     def detect_batch(
         self, stack, n_real=None, n_valid: int | None = None,
+        with_health: bool = False, health_clip: float | None = None,
     ) -> List[tuple | None]:
         """Detect over a ``[B, C, T]`` slab.
 
@@ -173,10 +176,14 @@ class BatchedMatchedFilterDetector:
         the slab's real files (trailing zero file-slots of a partial
         batch are computed — the program shape is fixed — but never
         fetched into results). Returns one entry per (valid) file:
-        ``(picks {name: (2, n) int64}, thresholds {name: float})``, or
-        ``None`` when that file's packed-pick capacity overflowed and the
-        caller must fall back to its exact per-file route
-        (:meth:`MatchedFilterDetector.detect_picks` on the host block).
+        ``(picks {name: (2, n) int64}, thresholds {name: float})`` —
+        with a third element, the per-file ``ops.health`` stats dict,
+        when ``with_health=True`` (the stats are computed in the same
+        program and ride the same packed fetch; ``health_clip`` is the
+        clip-count magnitude) — or ``None`` when that file's packed-pick
+        capacity overflowed and the caller must fall back to its exact
+        per-file route (:meth:`MatchedFilterDetector.detect_picks` on
+        the host block).
         """
         det = self.det
         C, T = det.design.trace_shape
@@ -194,7 +201,7 @@ class BatchedMatchedFilterDetector:
         thr_in = jnp.zeros((nT,), det._mask_band_dev.dtype)
         tile = det.effective_channel_tile if det._route() == "tiled" else None
         nr = None
-        if det.wire == "raw" and n_real is not None:
+        if (det.wire == "raw" or with_health) and n_real is not None:
             nr_np = np.asarray(n_real, np.int32)
             if nr_np.ndim != 1 or not 1 <= nr_np.shape[0] <= B:
                 raise ValueError(
@@ -221,17 +228,28 @@ class BatchedMatchedFilterDetector:
                 capacity=cap, use_threshold=False,
                 pick_method=peak_ops.escalation_method(k, det.max_peaks),
                 condition=det.wire == "raw", serial=self.serial,
+                with_health=with_health,
+                health_clip=(None if health_clip is None
+                             else jnp.float32(health_clip)),
             )
 
-        chan, times, cnt, satc, thr = jax.device_get(run(det.pick_k0, False))
+        h_counts = h_rms = None
+
+        def fetch(k, donate_now):
+            nonlocal h_counts, h_rms
+            outs = jax.device_get(run(k, donate_now))
+            if with_health:
+                *outs, h_counts, h_rms = outs
+            return outs
+
+        chan, times, cnt, satc, thr = fetch(det.pick_k0, False)
         if det.pick_k0 < det.max_peaks and int(satc.sum()):
             # a row saturated at K0: full-capacity rerun — the slab's last
             # use, so it is donated when the caller owns the buffer
-            chan, times, cnt, satc, thr = jax.device_get(
-                run(det.max_peaks, self.donate)
-            )
+            chan, times, cnt, satc, thr = fetch(det.max_peaks, self.donate)
         del stack  # common path: drop our reference the moment picks exist
 
+        n_reals = None if n_real is None else np.asarray(n_real).tolist()
         out: List[tuple | None] = []
         for b in range(B if n_valid is None else int(n_valid)):
             if int(cnt[b].max(initial=0)) > cap:
@@ -245,5 +263,12 @@ class BatchedMatchedFilterDetector:
                 )
                 thr_out[name] = float(thr[b, i])
                 det._warn_saturated(name, int(satc[b, i]))
-            out.append((picks, thr_out))
+            if with_health:
+                ns_b = int(n_reals[b]) if (n_reals is not None
+                                           and b < len(n_reals)) else T
+                out.append((picks, thr_out, health_ops.stats_to_dict(
+                    h_counts[b], h_rms[b], C * ns_b
+                )))
+            else:
+                out.append((picks, thr_out))
         return out

@@ -14,9 +14,18 @@ runner processes an arbitrary file list with:
   failure and the campaign continues (``max_failures`` bounds the
   tolerance);
 * **durable progress** — every file appends a JSON-lines manifest record
-  (status, pick counts, wall, error) and picks land in per-file ``.npz``
-  artifacts; re-running with ``resume=True`` skips completed files, so a
-  killed campaign continues where it stopped.
+  (status, pick counts, wall, error, attempts) and picks land in
+  per-file ``.npz`` artifacts; re-running with ``resume=True`` skips
+  completed files, so a killed campaign continues where it stopped;
+* **classified failure handling** (``das4whales_tpu.faults``,
+  docs/ROBUSTNESS.md) — transient-class failures (I/O blips, transfer
+  errors) retry with seeded exponential backoff; corrupt-class failures
+  disposition ``failed`` immediately; data-class breaches of the fused
+  on-device health stats (``ops.health``) disposition ``quarantined``
+  instead of silently-``done`` garbage picks; a hung reader becomes
+  ``status="timeout"`` via the per-file read deadline; only fatal-class
+  failures abort the run. The whole contract is provable under the
+  seeded chaos harness (``faults.FaultPlan``, tests/test_chaos.py).
 """
 
 from __future__ import annotations
@@ -29,6 +38,8 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
+from .. import faults
+from ..config import as_health_config
 from ..io.stream import stream_strain_blocks
 from ..models.matched_filter import MatchedFilterDetector
 from ..utils.log import get_logger
@@ -37,19 +48,32 @@ log = get_logger("campaign")
 
 MANIFEST = "manifest.jsonl"
 
+#: statuses that disposition a file for good — resume skips them (a
+#: quarantined file is deterministically unhealthy; re-reading it every
+#: resume would re-derive the same breach). "failed" and "timeout" are
+#: retried by a resume: they may have been transient at campaign scale.
+_SETTLED_STATUSES = ("done", "quarantined")
+
 
 class CampaignAborted(RuntimeError):
     """Raised when failures exceed ``max_failures``."""
+
+    fault_class = "fatal"
 
 
 @dataclass
 class FileRecord:
     path: str
-    status: str                  # "done" | "failed" | "skipped"
+    #: "done" | "failed" | "skipped" | "quarantined" | "timeout"
+    status: str
     n_picks: Dict[str, int] = field(default_factory=dict)
     wall_s: float = 0.0
     error: str = ""
     picks_file: str = ""
+    #: how many attempts this file consumed (retried transients > 1)
+    attempts: int = 1
+    #: data-health stats (ops.health) when the campaign computed them
+    health: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -69,13 +93,25 @@ class CampaignResult:
     def n_skipped(self) -> int:
         return sum(r.status == "skipped" for r in self.records)
 
+    @property
+    def n_quarantined(self) -> int:
+        return sum(r.status == "quarantined" for r in self.records)
+
+    @property
+    def n_timeout(self) -> int:
+        return sum(r.status == "timeout" for r in self.records)
+
 
 def _manifest_path(outdir: str) -> str:
     return os.path.join(outdir, MANIFEST)
 
 
-def _load_done(outdir: str) -> set:
-    done = set()
+def _load_settled(outdir: str) -> set:
+    """Paths whose LAST manifest record settles them (done/quarantined —
+    last-record-wins, so a file that failed then succeeded on a later
+    attempt reads settled, and one whose artifact was superseded by a
+    fresh failure record does not)."""
+    last: Dict[str, str] = {}
     try:
         with open(_manifest_path(outdir)) as fh:
             for line in fh:
@@ -83,11 +119,11 @@ def _load_done(outdir: str) -> set:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # torn final line from a killed run
-                if rec.get("status") == "done":
-                    done.add(rec["path"])
+                if "path" in rec:
+                    last[rec["path"]] = rec.get("status", "")
     except OSError:
         pass
-    return done
+    return {p for p, status in last.items() if status in _SETTLED_STATUSES}
 
 
 def _append_manifest(outdir: str, rec: FileRecord) -> None:
@@ -109,12 +145,40 @@ def _picks_path(outdir: str, path: str) -> str:
 
 def _save_picks(outdir: str, path: str, picks: Dict[str, np.ndarray],
                 thresholds: Dict[str, float]) -> str:
+    """Write one file's picks artifact ATOMICALLY (tmp + ``os.replace``):
+    the manifest's ``done`` record is appended only after this returns,
+    so a crash mid-write can never pair a torn ``.npz`` with a ``done``
+    record — resume re-runs the file instead of trusting the torn
+    artifact."""
     out = _picks_path(outdir, path)
     os.makedirs(os.path.dirname(out), exist_ok=True)
     arrays = {f"picks_{name}": np.asarray(pk) for name, pk in picks.items()}
     arrays["thresholds"] = np.asarray([thresholds[name] for name in picks])
     arrays["template_names"] = np.asarray(list(picks), dtype="U")
-    np.savez(out, **arrays)
+    tmp = f"{out}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **arrays)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, out)
+        # fsync the DIRECTORY too: the rename must be durable before the
+        # manifest's done record is appended, or a power loss could keep
+        # the manifest line while dropping the directory entry — the
+        # exact torn-artifact-under-done-record state this function
+        # exists to prevent. Best-effort: some filesystems refuse
+        # directory fsync.
+        try:
+            dirfd = os.open(os.path.dirname(out), os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        except OSError:
+            pass
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return out
 
 
@@ -140,8 +204,8 @@ def _normalize_metas(metadata, files):
 
 def _split_resume(files, outdir: str, resume: bool, records: List[FileRecord]):
     """Partition ``files`` into (pending, pending_indices), appending
-    'skipped' records for manifest-complete files."""
-    done = _load_done(outdir) if resume else set()
+    'skipped' records for manifest-settled (done/quarantined) files."""
+    done = _load_settled(outdir) if resume else set()
     pending, idx = [], []
     for j, path in enumerate(files):
         if path in done:
@@ -150,31 +214,89 @@ def _split_resume(files, outdir: str, resume: bool, records: List[FileRecord]):
             pending.append(path)
             idx.append(j)
     if records and resume:
-        log.info("resume: %d/%d files already done", len(records), len(files))
+        log.info("resume: %d/%d files already settled", len(records), len(files))
     return pending, idx
 
 
 def _failure_recorder(outdir: str, records: List[FileRecord], max_failures,
                       write: bool = True):
     """Shared per-file failure bookkeeping: manifest record + warning +
-    max_failures enforcement. ``write=False`` keeps the bookkeeping but
-    skips the manifest append (multi-host non-writer processes)."""
+    max_failures enforcement (every non-done disposition — failed,
+    quarantined, timeout — counts toward the tolerance). ``write=False``
+    keeps the bookkeeping but skips the manifest append (multi-host
+    non-writer processes)."""
     state = {"n": 0}
 
-    def fail(path: str, exc: Exception) -> None:
+    def fail(path: str, exc: Exception, status: str = "failed",
+             attempts: int = 1, health=None) -> None:
         state["n"] += 1
-        rec = FileRecord(path=path, status="failed",
-                         error=f"{type(exc).__name__}: {exc}")
+        rec = FileRecord(path=path, status=status,
+                         error=f"{type(exc).__name__}: {exc}",
+                         attempts=max(int(attempts), 1),
+                         health=dict(health or {}))
         records.append(rec)
         if write:
             _append_manifest(outdir, rec)
-        log.warning("file failed (%d so far): %s — %s", state["n"], path, rec.error)
+        log.warning("file %s (%d non-done so far): %s — %s",
+                    status, state["n"], path, rec.error)
         if max_failures is not None and state["n"] > max_failures:
             raise CampaignAborted(
                 f"{state['n']} failures exceed max_failures={max_failures}"
             ) from exc
 
     return fail
+
+
+class _Resilience:
+    """One campaign run's classified-failure machinery: the retry state
+    over a ``faults.RetryPolicy``, the data-health config, and the
+    terminal-disposition recorder (docs/ROBUSTNESS.md)."""
+
+    def __init__(self, outdir, records, max_failures, retry, health,
+                 write: bool = True):
+        self.policy = faults.as_retry_policy(retry)
+        self.state = faults.RetryState(self.policy)
+        self.health_cfg = as_health_config(health)
+        self.fail = _failure_recorder(outdir, records, max_failures,
+                                      write=write)
+
+    def attempt(self, path: str) -> int:
+        return self.state.attempt(path)
+
+    def check_health(self, path: str, stats) -> None:
+        """Raise ``faults.DataHealthError`` (data-class -> quarantine)
+        when ``stats`` breach the configured thresholds."""
+        if self.health_cfg is None or not stats:
+            return
+        reason = self.health_cfg.breach(stats)
+        if reason:
+            raise faults.DataHealthError(reason, stats)
+
+    def dispose(self, path: str, exc: Exception) -> str:
+        """Classify a file's failure and either schedule a retry
+        (returns ``"retry"`` after the deterministic backoff sleep) or
+        record its terminal status (returns ``"next"``). Fatal-class
+        failures re-raise — only they abort the campaign."""
+        n_att = self.state.n_attempts(path)
+        if isinstance(exc, faults.DeadlineExceeded):
+            faults.count("timeouts")
+            self.fail(path, exc, status="timeout", attempts=n_att)
+            return "next"
+        fclass = faults.classify_failure(exc)
+        if fclass == "fatal":
+            raise exc
+        if self.state.should_retry(path, fclass):
+            delay = self.state.backoff(path, fclass)
+            log.warning("%s failure on %s (attempt %d): retrying after "
+                        "%.3fs — %s", fclass, path, n_att, delay, exc)
+            return "retry"
+        if fclass == "data":
+            faults.count("quarantined")
+            self.fail(path, exc, status="quarantined", attempts=n_att,
+                      health=getattr(exc, "stats", None))
+        else:
+            self.fail(path, exc, attempts=n_att)
+        return "next"
 
 
 def run_campaign(
@@ -189,6 +311,10 @@ def run_campaign(
     prefetch: int = 2,
     engine: str = "h5py",
     wire: str = "conditioned",
+    retry=None,
+    health=True,
+    read_deadline_s: float | None = None,
+    fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
     """Detect over ``files``, tolerating per-file failures and resuming
@@ -201,6 +327,18 @@ def run_campaign(
     prologue — a caller-supplied ``detector`` must have been built with
     the same ``wire``. Returns a :class:`CampaignResult`; durable state
     lives in ``outdir/manifest.jsonl`` + ``outdir/picks/*.npz``.
+
+    Resilience knobs (docs/ROBUSTNESS.md): ``retry`` — a
+    ``faults.RetryPolicy`` (None/True: the env-driven default, 3
+    attempts with seeded exponential backoff; False: off) applied to
+    transient-class failures, with attempt counts recorded in the
+    manifest; ``health`` — a ``config.DataHealthConfig`` (None/True: the
+    default, which quarantines any non-finite sample; False: off)
+    checked against the on-device health stats fused into the detection
+    program (``ops.health``; host-computed for detector families without
+    the fused route); ``read_deadline_s`` — per-file reader deadline
+    (``status="timeout"`` instead of a stalled campaign);
+    ``fault_plan`` — a ``faults.FaultPlan`` chaos schedule (testing).
     """
     import jax.numpy as jnp
 
@@ -217,16 +355,85 @@ def run_campaign(
     records: List[FileRecord] = []
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
     pend_metas = [metas[j] for j in pend_idx]
-    fail = _failure_recorder(outdir, records, max_failures)
+    rz = _Resilience(outdir, records, max_failures, retry, health)
+
+    def detect_one(path, block, t0):
+        """One attempt at the transfer+detect+health half of a file
+        (raises on failure; the caller dispositions)."""
+        nonlocal detector
+        if fault_plan is not None:
+            fault_plan.on_transfer(path)
+        if detector is None:
+            detector = MatchedFilterDetector(
+                block.metadata, selected_channels, block.trace.shape,
+                wire=wire, **detector_kwargs,
+            )
+        det_meta = getattr(detector, "metadata", None)
+        if (wire == "raw" and det_meta is not None
+                and block.metadata is not None
+                and block.metadata.scale_factor != det_meta.scale_factor):
+            # the raw wire conditions on device with the DETECTOR's
+            # scale; a file probed with a different factor would get
+            # the wrong strain silently — fail it per-file instead
+            raise ValueError(
+                f"scale_factor {block.metadata.scale_factor!r} != "
+                f"detector scale {det_meta.scale_factor!r}; wire='raw' "
+                "conditions with one scale — use wire='conditioned' "
+                "for heterogeneous file sets"
+            )
+        if fault_plan is not None:
+            fault_plan.on_detect(path)
+        clip = rz.health_cfg.clip_abs if rz.health_cfg is not None else None
+        if (rz.health_cfg is not None
+                and getattr(detector, "supports_fused_health", False)):
+            # the one-program route: health stats computed in the same
+            # dispatch, riding the same packed fetch (ops.health)
+            result = detector.detect_picks(
+                jnp.asarray(block.trace), with_health=True, health_clip=clip
+            )
+            stats = result.health
+        else:
+            result = detector(jnp.asarray(block.trace))
+            # generic detector families: host-side stats on the already-
+            # host-resident block (one numpy pass)
+            stats = (
+                health_ops.host_health_stats(block.trace, clip_abs=clip)
+                if rz.health_cfg is not None else {}
+            )
+        rz.check_health(path, stats)            # -> quarantine on breach
+        if fault_plan is not None:
+            fault_plan.detect_succeeded()
+        # any detector family works: the contract is a result with
+        # .picks {name: (2, n)}; thresholds are optional metadata
+        # (the eval adapters for spectro/gabor don't expose them)
+        thresholds = getattr(result, "thresholds", None) or {
+            name: float("nan") for name in result.picks
+        }
+        rec = FileRecord(
+            path=path, status="done",
+            n_picks={k: int(v.shape[1]) for k, v in result.picks.items()},
+            wall_s=round(time.perf_counter() - t0, 3),
+            picks_file=_save_picks(outdir, path, result.picks, thresholds),
+            attempts=rz.state.n_attempts(path), health=dict(stats or {}),
+        )
+        # manifest BEFORE the in-memory record: this block is retried,
+        # and a transient manifest-append failure must not leave a
+        # phantom record that a successful retry would duplicate
+        _append_manifest(outdir, rec)
+        records.append(rec)
+
+    from ..ops import health as health_ops
 
     i = 0
     while i < len(pending):
         # one stream per contiguous run of healthy files; a failure mid-
-        # stream kills the generator, so restart it after the culprit
+        # stream kills the generator, so restart it after the culprit —
+        # or AT it, when its failure class earned a retry
         stream = stream_strain_blocks(
             pending[i:], selected_channels, pend_metas[i:],
             interrogator=interrogator, prefetch=prefetch, engine=engine,
-            as_numpy=True, wire=wire,
+            as_numpy=True, wire=wire, read_deadline_s=read_deadline_s,
+            fault_plan=fault_plan,
         )
         while True:
             path = pending[i] if i < len(pending) else None
@@ -236,47 +443,19 @@ def run_campaign(
                 i = len(pending)
                 break
             except Exception as exc:  # noqa: BLE001 — per-file isolation
-                fail(path, exc)
-                i += 1
-                break
+                rz.attempt(path)
+                if rz.dispose(path, exc) == "next":
+                    i += 1
+                break  # restart the stream either way
             t0 = time.perf_counter()
-            try:
-                if detector is None:
-                    detector = MatchedFilterDetector(
-                        block.metadata, selected_channels, block.trace.shape,
-                        wire=wire, **detector_kwargs,
-                    )
-                det_meta = getattr(detector, "metadata", None)
-                if (wire == "raw" and det_meta is not None
-                        and block.metadata is not None
-                        and block.metadata.scale_factor != det_meta.scale_factor):
-                    # the raw wire conditions on device with the DETECTOR's
-                    # scale; a file probed with a different factor would get
-                    # the wrong strain silently — fail it per-file instead
-                    raise ValueError(
-                        f"scale_factor {block.metadata.scale_factor!r} != "
-                        f"detector scale {det_meta.scale_factor!r}; wire='raw' "
-                        "conditions with one scale — use wire='conditioned' "
-                        "for heterogeneous file sets"
-                    )
-                result = detector(jnp.asarray(block.trace))
-                # any detector family works: the contract is a result with
-                # .picks {name: (2, n)}; thresholds are optional metadata
-                # (the eval adapters for spectro/gabor don't expose them)
-                thresholds = getattr(result, "thresholds", None) or {
-                    name: float("nan") for name in result.picks
-                }
-                rec = FileRecord(
-                    path=path, status="done",
-                    n_picks={k: int(v.shape[1]) for k, v in result.picks.items()},
-                    wall_s=round(time.perf_counter() - t0, 3),
-                    picks_file=_save_picks(outdir, path, result.picks,
-                                           thresholds),
-                )
-                records.append(rec)
-                _append_manifest(outdir, rec)
-            except Exception as exc:  # noqa: BLE001
-                fail(path, exc)
+            while True:  # transfer+detect attempts (block already read)
+                rz.attempt(path)
+                try:
+                    detect_one(path, block, t0)
+                except Exception as exc:  # noqa: BLE001
+                    if rz.dispose(path, exc) == "retry":
+                        continue
+                break
             i += 1
         del stream
     return CampaignResult(outdir=outdir, records=records)
@@ -299,6 +478,10 @@ def run_campaign_batched(
     donate: bool = True,
     serial: bool | None = None,
     persistent_cache: bool | str = True,
+    retry=None,
+    health=True,
+    read_deadline_s: float | None = None,
+    fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
     """Single-chip BATCHED campaign: ``batch`` files per program step.
@@ -327,6 +510,16 @@ def run_campaign_batched(
     conditions on device per bucket (padded records demean over real
     samples only); like :func:`run_campaign`, a file whose probed
     ``scale_factor`` differs from its bucket detector's fails per-file.
+
+    Resilience (``retry`` / ``health`` / ``read_deadline_s`` /
+    ``fault_plan``): :func:`run_campaign`'s classified contract, plus
+    the batched route's GRACEFUL-DEGRADATION ladder — a whole-slab
+    device failure retries the slab's files through the unbatched
+    one-program route (on the assembler's host blocks) before failing
+    any of them, so one poisoned file costs one file, not a slab
+    (docs/ROBUSTNESS.md). Health stats are fused per file into the
+    batched program (``ops.health``) and breaching files are
+    ``quarantined``.
     """
     import jax.numpy as jnp
 
@@ -343,7 +536,10 @@ def run_campaign_batched(
     records: List[FileRecord] = []
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
     pend_metas = [metas[j] for j in pend_idx]
-    fail = _failure_recorder(outdir, records, max_failures)
+    rz = _Resilience(outdir, records, max_failures, retry, health)
+    fail = rz.fail
+    with_health = rz.health_cfg is not None
+    clip = rz.health_cfg.clip_abs if with_health else None
 
     dets: Dict[tuple, BatchedMatchedFilterDetector] = {}
 
@@ -362,6 +558,20 @@ def run_campaign_batched(
             )
             dets[key] = bdet
         return bdet
+
+    def per_file_fallback(slab, k, det):
+        """The unbatched one-program route on the assembler's host block
+        (the device slab may already be donated — never touch it here):
+        the packed-overflow exact path AND the degradation ladder's
+        second rung."""
+        tr = np.asarray(slab.blocks[k].trace)
+        padded = np.zeros((tr.shape[0], slab.bucket_ns), tr.dtype)
+        padded[:, : tr.shape[1]] = tr
+        res = det.detect_picks(
+            jnp.asarray(padded), n_real=slab.n_real[k],
+            with_health=with_health, health_clip=clip,
+        )
+        return res.picks, res.thresholds, res.health
 
     def handle_slab(slab) -> None:
         bdet = detector_for(slab)
@@ -383,35 +593,81 @@ def run_campaign_batched(
             else:
                 ok.append(True)
         t0 = time.perf_counter()
-        results = bdet.detect_batch(
-            slab.stack, n_real=slab.n_real, n_valid=slab.n_valid
-        )
+        degraded = False
+        results = None
+        try:
+            if fault_plan is not None:
+                # the slab is one transfer and one program: a planned
+                # transfer/detect fault against ANY of its files fails
+                # the slab (and the ladder then isolates the culprit).
+                # The culprit's slab-level firing IS one of its attempts
+                # — count it, so the batched route's retry budget and
+                # terminal disposition match the unbatched route and the
+                # chaos oracle even at n_times == max_attempts
+                for k in range(slab.n_valid):
+                    if ok[k]:
+                        try:
+                            fault_plan.on_transfer(slab.paths[k])
+                            fault_plan.on_detect(slab.paths[k])
+                        except Exception:
+                            rz.attempt(slab.paths[k])
+                            raise
+            results = bdet.detect_batch(
+                slab.stack, n_real=slab.n_real, n_valid=slab.n_valid,
+                with_health=with_health, health_clip=clip,
+            )
+        except Exception as exc:  # noqa: BLE001 — degradation ladder
+            if faults.classify_failure(exc) == "fatal":
+                raise
+            # rung 2 of the ladder: a whole-slab device failure retries
+            # the slab's files through the unbatched one-program route
+            # before failing ANY of them — one poisoned file costs one
+            # file, not a slab
+            faults.count("degradations")
+            log.warning(
+                "batched slab of %d files failed (%s: %s); degrading to "
+                "the unbatched per-file route", slab.n_valid,
+                type(exc).__name__, exc,
+            )
+            degraded = True
         wall = time.perf_counter() - t0
         for k in range(slab.n_valid):
             if not ok[k]:
                 continue  # its slot computed with the wrong scale: discard
             path = slab.paths[k]
-            try:
-                if results[k] is None:
-                    # packed-pick capacity overflow: exact per-file route
-                    # on the assembler's host block (the device slab may
-                    # already be donated — never touch it here)
-                    tr = np.asarray(slab.blocks[k].trace)
-                    padded = np.zeros((tr.shape[0], slab.bucket_ns), tr.dtype)
-                    padded[:, : tr.shape[1]] = tr
-                    res = det.detect_picks(
-                        jnp.asarray(padded), n_real=slab.n_real[k]
+            use_fallback = degraded or results[k] is None
+            while True:
+                rz.attempt(path)
+                try:
+                    if use_fallback:
+                        if fault_plan is not None and degraded:
+                            fault_plan.on_transfer(path)
+                            fault_plan.on_detect(path)
+                        picks, thresholds, stats = per_file_fallback(
+                            slab, k, det
+                        )
+                    else:
+                        entry = results[k]
+                        picks, thresholds = entry[0], entry[1]
+                        stats = entry[2] if with_health else {}
+                    rz.check_health(path, stats)  # -> quarantine on breach
+                    picks = trim_picks(picks, slab.n_real[k])
+                    if fault_plan is not None:
+                        fault_plan.detect_succeeded()
+                    _file_record(
+                        outdir, path, picks, thresholds,
+                        round(wall / max(slab.n_valid, 1), 3), records,
+                        attempts=rz.state.n_attempts(path),
+                        health=dict(stats or {}),
                     )
-                    picks, thresholds = res.picks, res.thresholds
-                else:
-                    picks, thresholds = results[k]
-                picks = trim_picks(picks, slab.n_real[k])
-                _file_record(
-                    outdir, path, picks, thresholds,
-                    round(wall / max(slab.n_valid, 1), 3), records,
-                )
-            except Exception as exc:  # noqa: BLE001 — per-file isolation
-                fail(path, exc)
+                except Exception as exc:  # noqa: BLE001 — per-file isolation
+                    if rz.dispose(path, exc) == "retry":
+                        # rerunning the already-fetched batch entry would
+                        # fail identically — retries go through the
+                        # per-file route
+                        use_fallback = True
+                        continue
+                break
 
     i = 0
     while i < len(pending):
@@ -419,6 +675,7 @@ def run_campaign_batched(
             pending[i:], selected_channels, pend_metas[i:], batch=batch,
             bucket=bucket, interrogator=interrogator, prefetch=prefetch,
             engine=engine, wire=wire, in_flight=in_flight,
+            read_deadline_s=read_deadline_s, fault_plan=fault_plan,
         )
         try:
             for slab in slabs:
@@ -427,20 +684,30 @@ def run_campaign_batched(
                 except CampaignAborted:
                     raise
                 except Exception as exc:  # noqa: BLE001 — slab-level guard
-                    # a whole-slab failure (detector build, program error)
-                    # fails each of its files, preserving max_failures —
-                    # except files already dispositioned this run (a
+                    # a whole-slab failure the ladder could not absorb
+                    # (detector build, fatal-class program error) fails
+                    # each of its files, preserving max_failures — except
+                    # files already dispositioned this run (a
                     # scale-mismatched file was failed inside handle_slab
                     # before the slab program ran; double-counting it
                     # would fire max_failures one file early and write a
                     # duplicate manifest record)
+                    if faults.classify_failure(exc) == "fatal":
+                        raise
                     dispositioned = {r.path for r in records}
                     for path in slab.paths:
                         if path not in dispositioned:
                             fail(path, exc)
         except SlabReadError as exc:
-            fail(pending[i + exc.index], exc.cause)
-            i = i + exc.index + 1
+            # the assembler attributes the culprit's index; classify its
+            # cause — transient earns a retry AT the culprit, timeout /
+            # corrupt / data disposition it and resume past
+            path = pending[i + exc.index]
+            rz.attempt(path)
+            if rz.dispose(path, exc.cause) == "retry":
+                i = i + exc.index
+            else:
+                i = i + exc.index + 1
             continue
         i = len(pending)
     return CampaignResult(outdir=outdir, records=records)
@@ -512,39 +779,51 @@ def _compact_batch_picks(positions, selected, n_samples: int, capacity: int):
 _compact_batch_picks_jit = None
 
 
-def _probe_healthy(pairs, interrogator, fail, expect_shape=None):
+def _probe_healthy(pairs, interrogator, fail, expect_shape=None, rz=None):
     """Probe (path, metadata) pairs; returns ``(healthy [(path, spec)],
     spec0)``. ``expect_shape=(nx, ns)`` routes shape mismatches to
     ``fail`` — in a multi-host campaign a wrong-shape file would
     otherwise raise on only the host that reads it while its peers sit
     in the step's collectives (DCN-timeout deadlock, not a per-file
-    failure)."""
+    failure). ``rz`` (a :class:`_Resilience`) adds the classified
+    contract at probe granularity: transient probe failures retry with
+    backoff, the rest disposition per class."""
     from ..io.stream import _probe
 
     healthy, spec0 = [], None
     for path, meta_j in pairs:
-        try:
-            spec = _probe(path, interrogator, meta_j)
-            shape = (spec.meta.nx, spec.meta.ns)
-            want = expect_shape or (
-                (spec0.meta.nx, spec0.meta.ns) if spec0 is not None else shape
-            )
-            if shape != want:
-                raise ValueError(
-                    f"file shape {shape} != campaign shape {want} "
-                    "(one step serves one shape; run mismatched files "
-                    "in their own campaign)"
+        while True:
+            if rz is not None:
+                rz.attempt(path)
+            try:
+                spec = _probe(path, interrogator, meta_j)
+                shape = (spec.meta.nx, spec.meta.ns)
+                want = expect_shape or (
+                    (spec0.meta.nx, spec0.meta.ns) if spec0 is not None
+                    else shape
                 )
-            if spec0 is None:
-                spec0 = spec
-            healthy.append((path, spec))
-        except Exception as exc:  # noqa: BLE001 — per-file isolation
-            fail(path, exc)
+                if shape != want:
+                    raise ValueError(
+                        f"file shape {shape} != campaign shape {want} "
+                        "(one step serves one shape; run mismatched files "
+                        "in their own campaign)"
+                    )
+                if spec0 is None:
+                    spec0 = spec
+                healthy.append((path, spec))
+            except Exception as exc:  # noqa: BLE001 — per-file isolation
+                if rz is not None:
+                    if rz.dispose(path, exc) == "retry":
+                        continue
+                else:
+                    fail(path, exc)
+            break
     return healthy, spec0
 
 
 def _file_record(outdir, path, picks, thresholds, wall_s, records,
-                 write: bool = True) -> FileRecord:
+                 write: bool = True, attempts: int = 1,
+                 health=None) -> FileRecord:
     """One completed file's bookkeeping — artifact + manifest + record —
     shared by every campaign flavor (``write=False``: multi-host
     non-writer processes compute identical records, write nothing)."""
@@ -556,10 +835,14 @@ def _file_record(outdir, path, picks, thresholds, wall_s, records,
         path=path, status="done",
         n_picks={n: int(p.shape[1]) for n, p in picks.items()},
         wall_s=wall_s, picks_file=picks_file,
+        attempts=max(int(attempts), 1), health=dict(health or {}),
     )
-    records.append(rec)
+    # manifest BEFORE the in-memory record: the batched route retries
+    # this call, and a transient manifest-append failure must not leave
+    # a phantom record that a successful retry would duplicate
     if write:
         _append_manifest(outdir, rec)
+    records.append(rec)
     return rec
 
 
@@ -579,6 +862,7 @@ def run_campaign_sharded(
     hf_factor: float = 0.9,
     fused_bandpass: bool = True,
     wire: str = "conditioned",
+    retry=None,
 ) -> CampaignResult:
     """Multi-chip campaign: file batches land pre-sharded on the mesh and
     the whole batch detects in ONE program (data-parallel over files,
@@ -598,7 +882,11 @@ def run_campaign_sharded(
     Probed metadata feeds the stream, so no file is probed twice.
     ``batch`` defaults to the mesh's file-axis size; ``hf_factor`` is the
     first template's threshold factor, threaded to both the picking step
-    and the recorded artifact thresholds (single source).
+    and the recorded artifact thresholds (single source). ``retry``
+    (``faults.RetryPolicy`` / None / False) applies the classified
+    transient-retry contract at the probe boundary — the sharded step
+    itself runs lockstep collectives, so per-file mid-step retry is
+    structurally impossible here (docs/ROBUSTNESS.md).
     """
     import types
 
@@ -615,10 +903,11 @@ def run_campaign_sharded(
     records: List[FileRecord] = []
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
     pend_metas = [metas[j] for j in pend_idx]
-    fail = _failure_recorder(outdir, records, max_failures)
+    rz = _Resilience(outdir, records, max_failures, retry, health=False)
+    fail = rz.fail
 
     healthy_specs, spec0 = _probe_healthy(
-        zip(pending, pend_metas), interrogator, fail
+        zip(pending, pend_metas), interrogator, fail, rz=rz
     )
     if wire == "raw":
         # the raw wire conditions on the mesh with ONE scale (spec0's); a
@@ -943,10 +1232,14 @@ def summarize_campaign(outdir: str) -> dict:
                 recs.append(json.loads(line))
             except json.JSONDecodeError:
                 continue
-    # keep only each path's LAST record (resume runs append fresh records)
-    latest = {r["path"]: r for r in recs}
+    # keep only each path's LAST record: resume runs and retried files
+    # append fresh records (a file that failed, then succeeded on a
+    # later attempt, counts ONCE — as done), so nothing is double-counted
+    latest = {r["path"]: r for r in recs if "path" in r}
     done = [r for r in latest.values() if r["status"] == "done"]
     failed = [r for r in latest.values() if r["status"] == "failed"]
+    quarantined = [r for r in latest.values() if r["status"] == "quarantined"]
+    timeout = [r for r in latest.values() if r["status"] == "timeout"]
 
     totals: Dict[str, int] = {}
     density = {}                  # name -> [n_files x nx] counts
@@ -967,7 +1260,12 @@ def summarize_campaign(outdir: str) -> dict:
     return {
         "n_done": len(done),
         "n_failed": len(failed),
+        "n_quarantined": len(quarantined),
+        "n_timeout": len(timeout),
+        "total_attempts": sum(int(r.get("attempts", 1)) for r in latest.values()),
         "failed_paths": [r["path"] for r in failed],
+        "quarantined_paths": [r["path"] for r in quarantined],
+        "timeout_paths": [r["path"] for r in timeout],
         "total_picks": totals,
         "files": [{"path": r["path"], "n_picks": r["n_picks"],
                    "wall_s": r["wall_s"]} for r in done],

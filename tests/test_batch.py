@@ -371,9 +371,11 @@ def test_campaign_overflow_falls_back_to_exact_route(tmp_path, wire, bucket):
 
 def test_campaign_slab_failure_does_not_double_fail(tmp_path, monkeypatch):
     """A whole-slab failure after a file already failed per-file inside
-    handle_slab (raw-wire scale mismatch) must not fail that file AGAIN:
-    one manifest record per file, and max_failures counts real failures,
-    not duplicates."""
+    handle_slab (raw-wire scale mismatch) must not disposition that file
+    AGAIN — one manifest record per file — and the degradation ladder
+    (ISSUE 4) must recover the slab's healthy file through the unbatched
+    per-file route instead of failing it with the slab."""
+    from das4whales_tpu import faults
     from das4whales_tpu.parallel import batch as batch_mod
 
     paths = _write_files(tmp_path, [NS] * 2)
@@ -383,26 +385,29 @@ def test_campaign_slab_failure_does_not_double_fail(tmp_path, monkeypatch):
     )
     write_synthetic_file(paths[1], scene)  # mismatched scale_factor
 
-    def boom(self, stack, n_real=None, n_valid=None):
+    def boom(self, stack, n_real=None, n_valid=None, **kw):
         raise RuntimeError("program exploded")
 
     monkeypatch.setattr(
         batch_mod.BatchedMatchedFilterDetector, "detect_batch", boom
     )
     out = str(tmp_path / "camp")
-    # max_failures=2 is the point: double-counting the scale-mismatched
-    # file would make 3 recorded failures and abort the campaign early
+    before = faults.counters()
+    # max_failures=1 is the point: double-counting the scale-mismatched
+    # file would make 2 recorded failures and abort the campaign early
     res = run_campaign_batched(paths, SEL, out, batch=2, bucket="exact",
                                wire="raw", persistent_cache=False,
-                               max_failures=2)
-    assert res.n_done == 0 and res.n_failed == 2
+                               max_failures=1)
+    # the ladder salvages the healthy file through the per-file route
+    assert res.n_done == 1 and res.n_failed == 1
+    assert faults.counters_delta(before)["degradations"] == 1
     by_path = {}
     for r in res.records:
         by_path.setdefault(r.path, []).append(r)
     assert len(by_path[paths[1]]) == 1
     assert "scale_factor" in by_path[paths[1]][0].error
     assert len(by_path[paths[0]]) == 1
-    assert "program exploded" in by_path[paths[0]][0].error
+    assert by_path[paths[0]][0].status == "done"
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,474 @@
+"""Chaos harness: the campaign resilience contract under seeded fault
+schedules (ISSUE 4).
+
+The core invariant, fuzzed over many ``faults.FaultPlan`` seeds: a
+campaign under injected truncated-file / transient-I/O / transfer /
+NaN-slab / hang faults ALWAYS terminates, dispositions every file
+exactly once (status matching the plan's oracle: retried transients end
+``done`` with picks bit-identical to a fault-free run, corrupt files
+``failed``, NaN-poisoned files ``quarantined`` — never ``done`` — and
+hung readers ``timeout``), and a resume after an injected mid-run crash
+completes without re-running settled files.
+
+The ``chaos`` marker's quick subset (50 seeds) rides tier-1; the
+``slow``-marked soak widens the schedule space.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from das4whales_tpu import faults
+from das4whales_tpu.config import DataHealthConfig
+from das4whales_tpu.io.stream import stream_strain_blocks
+from das4whales_tpu.io.synth import (
+    SyntheticCall,
+    SyntheticScene,
+    write_synthetic_file,
+)
+from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+from das4whales_tpu.workflows.campaign import (
+    load_picks,
+    run_campaign,
+    run_campaign_batched,
+    summarize_campaign,
+)
+
+NX, NS = 24, 900
+SEL = [0, NX, 1]
+N_FILES = 4
+
+#: fast-but-real retry policy for injected transients (the plan's
+#: transient faults recover within max_transient_repeats=2 < 3 attempts)
+POLICY = faults.RetryPolicy(max_attempts=3, base_delay_s=0.002,
+                            max_delay_s=0.01, seed=0)
+DEADLINE_S = 0.75   # >> the ms-scale reads of these tiny files
+HANG_S = 8.0        # >> deadline: a hang can never sneak under it
+
+
+
+@pytest.fixture(scope="module")
+def file_set(tmp_path_factory):
+    d = tmp_path_factory.mktemp("chaosdata")
+    paths = []
+    for k in range(N_FILES):
+        scene = SyntheticScene(
+            nx=NX, ns=NS, noise_rms=0.05, seed=k,
+            calls=[SyntheticCall(t0=1.2 + 0.3 * k, x0_m=NX / 2 * 2.042,
+                                 amplitude=2.0)],
+        )
+        p = str(d / f"cf{k}.h5")
+        write_synthetic_file(p, scene)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def detector(file_set):
+    """One campaign-configuration detector shared across every seeded
+    campaign (design-once/detect-many keeps the fuzz cheap: one compile
+    serves all schedules)."""
+    blk = next(stream_strain_blocks(file_set[:1], SEL, as_numpy=True))
+    return MatchedFilterDetector(
+        blk.metadata, SEL, np.asarray(blk.trace).shape,
+        pick_mode="sparse", keep_correlograms=False,
+    )
+
+
+@pytest.fixture(scope="module")
+def fault_free(file_set, detector, tmp_path_factory):
+    """Reference picks from a no-faults campaign (the bit-identical
+    oracle for recovered-transient files)."""
+    out = str(tmp_path_factory.mktemp("ref") / "camp")
+    res = run_campaign(file_set, SEL, out, detector=detector)
+    assert res.n_done == N_FILES
+    return {r.path: load_picks(r.picks_file)
+            for r in res.records if r.status == "done"}
+
+
+def _assert_invariant(res, paths, plan, reference):
+    """The exactly-once disposition invariant + the per-status contracts."""
+    by_path = {}
+    for r in res.records:
+        by_path.setdefault(r.path, []).append(r)
+    assert sorted(by_path) == sorted(paths)
+    for path in paths:
+        recs = by_path[path]
+        assert len(recs) == 1, f"{path} dispositioned {len(recs)} times"
+        rec = recs[0]
+        expected = plan.expected_disposition(path, POLICY)
+        assert rec.status == expected, (
+            f"{os.path.basename(path)}: {rec.status} != oracle {expected} "
+            f"(spec={plan.spec_for(path)})"
+        )
+        if rec.status == "done":
+            # a recovered file's picks are bit-identical to fault-free.
+            # (attempts may legitimately read 1 for a read-site
+            # transient: a prefetch worker of an earlier, abandoned
+            # stream can consume the fault off-ledger — the
+            # deterministic attempts contract is pinned separately by
+            # test_transient_retry_bit_identical_with_bounded_backoff)
+            picks = load_picks(rec.picks_file)
+            for name, ref in reference[path].items():
+                np.testing.assert_array_equal(picks[name], ref)
+        elif rec.status == "quarantined":
+            assert rec.picks_file == ""            # never garbage picks
+            assert rec.health.get("nonfinite", 0) > 0
+        assert rec.attempts <= POLICY.max_attempts
+
+
+def _fuzz_one(seed, files, detector, reference, outdir, batched=False):
+    plan = faults.FaultPlan(seed, rate=0.55, hang_s=HANG_S,
+                            max_transient_repeats=2)
+    kwargs = dict(
+        detector=None, retry=POLICY, read_deadline_s=DEADLINE_S,
+        fault_plan=plan, max_failures=None,
+    )
+    if batched:
+        kwargs.pop("detector")
+        res = run_campaign_batched(files, SEL, outdir, batch=2,
+                                   bucket="exact", persistent_cache=False,
+                                   **kwargs)
+    else:
+        kwargs["detector"] = detector
+        res = run_campaign(files, SEL, outdir, **kwargs)
+    _assert_invariant(res, files, plan, reference)
+    return res
+
+
+@pytest.mark.chaos
+def test_chaos_fuzz_quick(file_set, detector, fault_free, tmp_path):
+    """50 seeded fault schedules through ``run_campaign`` (tier-1 —
+    the acceptance floor of ISSUE 4)."""
+    for seed in range(50):
+        _fuzz_one(seed, file_set, detector, fault_free,
+                  str(tmp_path / f"c{seed}"))
+
+
+@pytest.mark.chaos
+def test_chaos_fuzz_batched(file_set, detector, fault_free, tmp_path):
+    """Seeded fault schedules through the BATCHED campaign: slab
+    assembly, the degradation ladder and the fused health gate under
+    the same exactly-once invariant."""
+    for seed in range(12):
+        _fuzz_one(seed, file_set, detector, fault_free,
+                  str(tmp_path / f"cb{seed}"), batched=True)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_fuzz_soak(file_set, detector, fault_free, tmp_path):
+    """The wide soak (excluded from tier-1 by the slow marker)."""
+    for seed in range(50, 250):
+        _fuzz_one(seed, file_set, detector, fault_free,
+                  str(tmp_path / f"s{seed}"))
+    for seed in range(50, 90):
+        _fuzz_one(seed, file_set, detector, fault_free,
+                  str(tmp_path / f"sb{seed}"), batched=True)
+
+
+# ---------------------------------------------------------------------------
+# Targeted drills for each ladder rung
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_transient_retry_bit_identical_with_bounded_backoff(
+        file_set, detector, fault_free, tmp_path):
+    """Every file transiently fails at the transfer boundary and
+    recovers: the campaign retries with bounded backoff and ends with
+    picks bit-identical to the fault-free run, attempt counts in the
+    manifest, and the retries counter advanced. (The transfer site is
+    the deterministic one for attempt bookkeeping — it fires on the
+    campaign thread, never on a discarded prefetch worker.)"""
+    plan = faults.FaultPlan(1, rate=1.0, kinds=("transfer",),
+                            max_transient_repeats=2)
+    before = faults.counters()
+    out = str(tmp_path / "camp")
+    res = run_campaign(file_set, SEL, out, detector=detector, retry=POLICY,
+                       fault_plan=plan)
+    assert res.n_done == N_FILES and res.n_failed == 0
+    assert faults.counters_delta(before)["retries"] >= N_FILES
+    for rec in res.records:
+        assert 2 <= rec.attempts <= POLICY.max_attempts
+        for name, ref in fault_free[rec.path].items():
+            np.testing.assert_array_equal(load_picks(rec.picks_file)[name],
+                                          ref)
+    # attempts are durable: the manifest carries them
+    with open(os.path.join(out, "manifest.jsonl")) as fh:
+        manifest = [json.loads(x) for x in fh]
+    assert all(r["attempts"] >= 2 for r in manifest)
+
+
+@pytest.mark.chaos
+def test_transient_exhaustion_fails_terminally(file_set, detector, tmp_path):
+    """A transient fault outliving max_attempts dispositions ``failed``
+    (bounded retry, not an infinite loop)."""
+    # transfer-site faults: they fire on the campaign thread (never on a
+    # speculative prefetch worker), so the attempt ledger is exact
+    plan = faults.FaultPlan(2, rate=1.0, kinds=("transfer",),
+                            max_transient_repeats=5)   # > max_attempts
+    res = run_campaign(file_set, SEL, str(tmp_path / "camp"),
+                       detector=detector, retry=POLICY, fault_plan=plan)
+    assert res.n_done == 0 and res.n_failed == N_FILES
+    assert all(r.attempts == POLICY.max_attempts for r in res.records)
+
+
+@pytest.mark.chaos
+def test_nan_poisoned_file_is_quarantined_never_done(
+        file_set, detector, fault_free, tmp_path):
+    """The acceptance drill: a NaN-poisoned record is ``quarantined``
+    (fused on-device stats), its slab-mates stay ``done``, and resume
+    skips the quarantined file instead of re-deriving the breach."""
+    plan = faults.FaultPlan(3, rate=1.0, kinds=("nan",))
+    out = str(tmp_path / "camp")
+    res = run_campaign(file_set, SEL, out, detector=detector,
+                       fault_plan=plan)
+    assert res.n_quarantined == N_FILES and res.n_done == 0
+    for rec in res.records:
+        assert rec.status == "quarantined"
+        assert rec.picks_file == "" and rec.health["nonfinite"] > 0
+        assert "nonfinite" in rec.error
+    # resume: quarantined files are settled — skipped, not re-read
+    res2 = run_campaign(file_set, SEL, out, detector=detector,
+                        fault_plan=plan)
+    assert res2.n_skipped == N_FILES
+
+    # batched flavor: one poisoned file per slab, mates unharmed
+    half = faults.FaultPlan(0, rate=0.0)
+    half.spec_for = lambda p: (
+        faults.FaultSpec("nan", "read", 10**9)
+        if os.path.basename(p) == os.path.basename(file_set[1]) else None
+    )
+    resb = run_campaign_batched(file_set, SEL, str(tmp_path / "campb"),
+                                batch=2, bucket="exact",
+                                persistent_cache=False, fault_plan=half)
+    statuses = {r.path: r.status for r in resb.records}
+    assert statuses[file_set[1]] == "quarantined"
+    done = [p for p in file_set if p != file_set[1]]
+    assert all(statuses[p] == "done" for p in done)
+    for rec in resb.records:
+        if rec.status == "done":
+            for name, ref in fault_free[rec.path].items():
+                np.testing.assert_array_equal(
+                    load_picks(rec.picks_file)[name], ref
+                )
+
+
+@pytest.mark.chaos
+def test_hung_reader_times_out_and_campaign_continues(
+        file_set, detector, tmp_path):
+    """A hung reader becomes ``status="timeout"`` at its own position
+    and every other file still dispositions — no stalled run."""
+    plan = faults.FaultPlan(0, rate=0.0, hang_s=HANG_S)
+    hung = os.path.basename(file_set[1])
+    plan.spec_for = lambda p: (
+        faults.FaultSpec("hang", "read", 10**9)
+        if os.path.basename(p) == hung else None
+    )
+    res = run_campaign(file_set, SEL, str(tmp_path / "camp"),
+                       detector=detector, read_deadline_s=0.75,
+                       fault_plan=plan)
+    statuses = {r.path: r.status for r in res.records}
+    assert statuses[file_set[1]] == "timeout"
+    assert res.n_done == N_FILES - 1 and res.n_timeout == 1
+
+
+@pytest.mark.chaos
+def test_corrupt_beside_hung_reader_does_not_stall(file_set, detector,
+                                                  tmp_path):
+    """Teardown regression: when file k fails (corrupt) while file k+1's
+    prefetched read is HUNG, restarting the stream must not join the
+    hung worker — the campaign finishes in deadline-scale time, not
+    hang-scale."""
+    import time as _time
+
+    plan = faults.FaultPlan(0, rate=0.0, hang_s=HANG_S)
+    kinds = {os.path.basename(file_set[0]): "truncated",
+             os.path.basename(file_set[1]): "hang"}
+
+    def spec_for(p):
+        kind = kinds.get(os.path.basename(p))
+        return faults.FaultSpec(kind, "read", 10**9) if kind else None
+
+    plan.spec_for = spec_for
+    t0 = _time.perf_counter()
+    res = run_campaign(file_set, SEL, str(tmp_path / "camp"),
+                       detector=detector, read_deadline_s=0.75,
+                       fault_plan=plan)
+    wall = _time.perf_counter() - t0
+    statuses = {os.path.basename(r.path): r.status for r in res.records}
+    assert statuses[os.path.basename(file_set[0])] == "failed"
+    assert statuses[os.path.basename(file_set[1])] == "timeout"
+    assert res.n_done == N_FILES - 2
+    assert wall < HANG_S, f"campaign stalled {wall:.1f}s on a hung worker"
+
+
+@pytest.mark.chaos
+def test_degradation_ladder_isolates_detect_fault(file_set, tmp_path):
+    """A device-program fault against one slab file degrades the slab to
+    the unbatched route; the transient culprit retries there and every
+    file ends ``done`` — the ladder turns a slab loss into zero losses."""
+    plan = faults.FaultPlan(4, rate=1.0, kinds=("detect",),
+                            max_transient_repeats=2)
+    before = faults.counters()
+    res = run_campaign_batched(file_set, SEL, str(tmp_path / "camp"),
+                               batch=2, bucket="exact",
+                               persistent_cache=False, retry=POLICY,
+                               fault_plan=plan)
+    assert res.n_done == N_FILES and res.n_failed == 0
+    assert faults.counters_delta(before)["degradations"] >= 1
+
+
+@pytest.mark.chaos
+def test_batched_retry_budget_matches_unbatched_at_boundary(file_set,
+                                                           tmp_path):
+    """A transfer fault with n_times == max_attempts must disposition
+    ``failed`` on BOTH routes: the batched slab-level firing counts as
+    the culprit's first attempt, so the batched route cannot smuggle in
+    an extra attempt the unbatched route (and the oracle) don't have."""
+    plan = faults.FaultPlan(0, rate=0.0)
+    culprit = os.path.basename(file_set[0])
+    plan.spec_for = lambda p: (
+        faults.FaultSpec("transfer", "transfer", POLICY.max_attempts)
+        if os.path.basename(p) == culprit else None
+    )
+    res = run_campaign_batched(file_set, SEL, str(tmp_path / "b"), batch=2,
+                               bucket="exact", persistent_cache=False,
+                               retry=POLICY, fault_plan=plan)
+    by = {os.path.basename(r.path): r for r in res.records}
+    assert by[culprit].status == "failed"
+    assert by[culprit].attempts == POLICY.max_attempts
+    assert res.n_done == N_FILES - 1
+    assert res.records and plan.expected_disposition(
+        file_set[0], POLICY) == "failed"
+
+
+@pytest.mark.chaos
+def test_crash_resume_completes_without_rerunning_done(
+        file_set, detector, tmp_path):
+    """Satellite drill: kill the campaign after N files (injected fatal
+    crash), resume, and the settled files are skipped while the final
+    manifest dispositions everything."""
+    out = str(tmp_path / "camp")
+    crash = faults.FaultPlan(0, rate=0.0, crash_after=2)
+    with pytest.raises(faults.InjectedCrash):
+        run_campaign(file_set, SEL, out, detector=detector,
+                     fault_plan=crash)
+    with open(os.path.join(out, "manifest.jsonl")) as fh:
+        manifest = [json.loads(x) for x in fh]
+    assert sum(r["status"] == "done" for r in manifest) == 2
+
+    # resume with the SAME plan: the crash is one-shot, the run completes
+    res = run_campaign(file_set, SEL, out, detector=detector,
+                       fault_plan=crash)
+    assert res.n_skipped == 2                  # done files not re-run
+    assert res.n_done == N_FILES - 2
+    with open(os.path.join(out, "manifest.jsonl")) as fh:
+        manifest = [json.loads(x) for x in fh]
+    by_path = {}
+    for r in manifest:
+        by_path.setdefault(os.path.basename(r["path"]), []).append(r)
+    assert len(by_path) == N_FILES             # manifest complete
+    assert all(rs[-1]["status"] == "done" for rs in by_path.values())
+    # exactly one record per file across BOTH runs: settled files were
+    # never re-processed
+    assert all(len(rs) == 1 for rs in by_path.values())
+    s = summarize_campaign(out)
+    assert s["n_done"] == N_FILES and s["n_failed"] == 0
+
+
+@pytest.mark.chaos
+def test_fatal_class_aborts_mid_batched_run(file_set, tmp_path):
+    """Only fatal-class failures abort the batched campaign (the crash
+    drill's batched flavor)."""
+    crash = faults.FaultPlan(0, rate=0.0, crash_after=0)
+    with pytest.raises(faults.InjectedCrash):
+        run_campaign_batched(file_set, SEL, str(tmp_path / "camp"),
+                             batch=2, bucket="exact",
+                             persistent_cache=False, fault_plan=crash)
+
+
+# ---------------------------------------------------------------------------
+# Satellites: atomic artifacts, last-record-wins summary, fused-health
+# compile discipline
+# ---------------------------------------------------------------------------
+
+
+def test_save_picks_atomic_no_torn_artifact(file_set, detector, tmp_path,
+                                            monkeypatch):
+    """A crash mid-``_save_picks`` leaves NO artifact and NO ``done``
+    record (tmp + os.replace): resume re-runs the file instead of
+    trusting a torn .npz."""
+    import das4whales_tpu.workflows.campaign as camp
+
+    real_savez = np.savez
+
+    def torn_savez(fh, **arrays):
+        fh.write(b"partial garbage")
+        raise faults.InjectedCrash("power loss mid-write")
+
+    monkeypatch.setattr(np, "savez", torn_savez)
+    out = str(tmp_path / "camp")
+    with pytest.raises(faults.InjectedCrash):
+        run_campaign(file_set[:1], SEL, out, detector=detector)
+    picks_dir = os.path.join(out, "picks")
+    leftovers = os.listdir(picks_dir) if os.path.isdir(picks_dir) else []
+    assert leftovers == []                       # no torn .npz, no tmp
+    assert sum(1 for r in camp._load_settled(out)) == 0
+
+    monkeypatch.setattr(np, "savez", real_savez)
+    res = run_campaign(file_set[:1], SEL, out, detector=detector)
+    assert res.n_done == 1                       # resume re-ran it cleanly
+    assert os.path.exists(res.records[0].picks_file)
+
+
+def test_summarize_last_record_wins_for_retried_file(file_set, detector,
+                                                     tmp_path):
+    """A file with a fail record then a done record (retried across
+    runs) counts ONCE, as done — never double-counted."""
+    out = str(tmp_path / "camp")
+    plan = faults.FaultPlan(0, rate=0.0)
+    plan.spec_for = lambda p: (
+        faults.FaultSpec("truncated", "read", 10**9)
+        if os.path.basename(p) == os.path.basename(file_set[0]) else None
+    )
+    res = run_campaign(file_set, SEL, out, detector=detector,
+                       fault_plan=plan)
+    assert res.n_failed == 1
+    # second run: the fault is gone, the failed file succeeds
+    res2 = run_campaign(file_set, SEL, out, detector=detector)
+    assert res2.n_done == 1 and res2.n_skipped == N_FILES - 1
+    s = summarize_campaign(out)
+    assert s["n_done"] == N_FILES and s["n_failed"] == 0
+    assert s["failed_paths"] == []
+    # the manifest genuinely holds both records — last one wins
+    with open(os.path.join(out, "manifest.jsonl")) as fh:
+        recs = [json.loads(x) for x in fh if json.loads(x)["path"] == file_set[0]]
+    assert [r["status"] for r in recs] == ["failed", "done"]
+
+
+def test_fused_health_no_extra_program(file_set, detector, compile_guard):
+    """The fused health stats ride the detection program: after a warm
+    call, further with_health detections compile NOTHING new (still one
+    program per shape) and picks are unchanged by the gate."""
+    blk = next(stream_strain_blocks(file_set[:1], SEL, as_numpy=True))
+    x = jnp.asarray(blk.trace)
+    plain = detector.detect_picks(x)
+    warm = detector.detect_picks(x, with_health=True)
+    with compile_guard.forbid_recompile(
+        "detect_picks(with_health=True) at a warmed shape"
+    ):
+        res = detector.detect_picks(x, with_health=True)
+    assert res.health["nonfinite"] == 0
+    assert res.health["n_samples"] == NX * NS
+    assert res.health["rms"] > 0
+    for name in plain.picks:
+        np.testing.assert_array_equal(res.picks[name], plain.picks[name])
+        np.testing.assert_array_equal(warm.picks[name], plain.picks[name])

@@ -1,0 +1,262 @@
+"""Failure taxonomy, retry policy, health stats, and the chaos plan
+(das4whales_tpu.faults / ops.health / config.DataHealthConfig) — the
+unit layer under the campaign-level chaos tests (tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+import errno
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from das4whales_tpu import faults
+from das4whales_tpu.config import DataHealthConfig, as_health_config
+from das4whales_tpu.ops import health as health_ops
+
+# ---------------------------------------------------------------------------
+# classify_failure
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "exc, expected",
+    [
+        (OSError(errno.EIO, "I/O error"), "transient"),
+        (OSError(errno.ESTALE, "stale file handle"), "transient"),
+        (TimeoutError("deadline"), "transient"),
+        (ConnectionResetError("peer reset"), "transient"),
+        (InterruptedError(), "transient"),
+        (OSError("Unable to open file (file signature not found)"), "corrupt"),
+        (OSError(errno.ENOENT, "no such file"), "corrupt"),
+        (ValueError("scale_factor mismatch"), "corrupt"),
+        (RuntimeError("anything unknown"), "corrupt"),
+        (KeyError("missing dataset"), "corrupt"),
+        (faults.DataHealthError("nan storm"), "data"),
+        (FloatingPointError(), "data"),
+        (MemoryError(), "fatal"),
+        (faults.InjectedReadError(errno.EIO, "injected"), "transient"),
+        (faults.InjectedCorruptFile("injected"), "corrupt"),
+        (faults.InjectedTransferError("injected"), "transient"),
+        (faults.InjectedDetectorError("injected"), "transient"),
+        (faults.InjectedCrash("injected"), "fatal"),
+    ],
+)
+def test_classify_failure(exc, expected):
+    assert faults.classify_failure(exc) == expected
+
+
+def test_classify_message_markers():
+    # errno-less OSErrors self-describe transience in text only
+    assert faults.classify_failure(OSError("request timed out")) == "transient"
+    assert faults.classify_failure(
+        OSError("resource temporarily unavailable")) == "transient"
+    # an unknown exception can self-classify
+    exc = RuntimeError("custom")
+    exc.fault_class = "data"
+    assert faults.classify_failure(exc) == "data"
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / RetryState
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_deterministic_and_bounded():
+    pol = faults.RetryPolicy(base_delay_s=0.1, max_delay_s=0.5, jitter=0.25,
+                             seed=7)
+    d1 = [pol.delay_s("fileA", a) for a in range(1, 6)]
+    d2 = [pol.delay_s("fileA", a) for a in range(1, 6)]
+    assert d1 == d2                                  # seeded: reproducible
+    assert d1 != [pol.delay_s("fileB", a) for a in range(1, 6)]  # decorrelated
+    for a, d in enumerate(d1, start=1):
+        base = min(0.1 * 2 ** (a - 1), 0.5)
+        assert base * 0.75 <= d <= base * 1.25       # jitter-bounded
+    # exponential up to the cap
+    assert pol.delay_s("fileA", 2) > pol.delay_s("fileA", 1) * 1.2
+
+
+def test_retry_state_attempt_ceiling():
+    st = faults.RetryState(faults.RetryPolicy(max_attempts=3))
+    for _ in range(2):
+        st.attempt("f")
+        assert st.should_retry("f", "transient")
+    st.attempt("f")
+    assert not st.should_retry("f", "transient")     # 3rd attempt was last
+
+
+def test_retry_state_class_and_budget():
+    st = faults.RetryState(faults.RetryPolicy(
+        max_attempts=10, budgets={"transient": 2}, base_delay_s=1e-4,
+        max_delay_s=1e-4,
+    ))
+    st.attempt("f")
+    assert not st.should_retry("f", "corrupt")       # only transient retries
+    assert not st.should_retry("f", "data")
+    sleeps = []
+    for _ in range(2):
+        assert st.should_retry("f", "transient")
+        st.backoff("f", "transient", sleep=sleeps.append)
+    assert not st.should_retry("f", "transient")     # campaign budget spent
+    assert len(sleeps) == 2
+    assert faults.RetryState(None).should_retry("f", "transient") is False
+
+
+def test_as_retry_policy_forms():
+    pol = faults.RetryPolicy(max_attempts=7)
+    assert faults.as_retry_policy(pol) is pol
+    assert faults.as_retry_policy(None).max_attempts >= 1
+    assert faults.as_retry_policy(True).max_attempts >= 1
+    assert faults.as_retry_policy(False) is None
+    with pytest.raises(TypeError):
+        faults.as_retry_policy(3)
+
+
+def test_counters_roundtrip():
+    before = faults.counters()
+    faults.count("retries")
+    faults.count("quarantined", 2)
+    delta = faults.counters_delta(before)
+    assert delta["retries"] == 1 and delta["quarantined"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Health stats (device + host) and thresholds
+# ---------------------------------------------------------------------------
+
+
+def test_health_stats_counts_exact():
+    x = np.zeros((4, 100), np.float32)
+    x[1, :3] = np.nan
+    x[2, 5] = np.inf
+    x[3, :7] = 99.0
+    counts, rms = health_ops.health_stats(jnp.asarray(x), clip_abs=50.0)
+    assert int(counts[0]) == 4                       # 3 NaN + 1 Inf
+    assert int(counts[1]) == 7                       # |x| >= 50
+    assert not np.isfinite(float(rms))               # NaN poisons the rms
+
+
+def test_health_stats_clean_and_clip_disabled():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 64)).astype(np.float32)
+    counts, rms = health_ops.health_stats(jnp.asarray(x), clip_abs=jnp.inf)
+    assert int(counts[0]) == 0 and int(counts[1]) == 0
+    np.testing.assert_allclose(
+        float(rms), np.sqrt(np.mean(x.astype(np.float64) ** 2)), rtol=1e-5
+    )
+
+
+def test_health_stats_n_real_masks_pad():
+    x = np.zeros((2, 100), np.float32)
+    x[:, :50] = 2.0                                  # real half
+    x[:, 50:] = np.nan                               # pad region (poisoned
+    #                                                  here only to prove the
+    #                                                  mask excludes it)
+    counts, rms = health_ops.health_stats(
+        jnp.asarray(x), clip_abs=jnp.inf, n_real=jnp.int32(50)
+    )
+    assert int(counts[0]) == 0                       # pad NaNs not counted
+    np.testing.assert_allclose(float(rms), 2.0, rtol=1e-6)
+
+
+def test_host_health_stats_matches_device():
+    x = np.zeros((4, 50), np.float32)
+    x[0, :5] = np.nan
+    x[1, :4] = 123.0
+    host = health_ops.host_health_stats(x, clip_abs=100.0)
+    counts, rms = health_ops.health_stats(jnp.asarray(x), clip_abs=100.0)
+    dev = health_ops.stats_to_dict(counts, rms, x.size)
+    assert host["nonfinite"] == dev["nonfinite"] == 5
+    assert host["clipped"] == dev["clipped"] == 4
+    assert host["n_samples"] == dev["n_samples"] == x.size
+
+
+def test_health_config_breach_reasons():
+    cfg = DataHealthConfig()                         # default: no NaN at all
+    clean = {"nonfinite": 0, "clip_frac": 0.0, "rms": 1.0}
+    assert cfg.breach(clean) is None
+    assert "nonfinite" in cfg.breach({**clean, "nonfinite": 1})
+    clip_cfg = DataHealthConfig(clip_abs=100.0, max_clip_frac=0.1)
+    assert "clipped" in clip_cfg.breach({**clean, "clip_frac": 0.5})
+    rms_cfg = DataHealthConfig(max_rms=10.0, min_rms=0.01)
+    assert "above" in rms_cfg.breach({**clean, "rms": 11.0})
+    assert "below" in rms_cfg.breach({**clean, "rms": 0.001})
+    # a NaN rms reads unhealthy for ANY configured bound (NaN compares
+    # false both ways; the gate must not let that read healthy)
+    assert rms_cfg.breach({**clean, "rms": float("nan")}) is not None
+    assert as_health_config(False) is None
+    assert as_health_config(None).max_nonfinite == 0
+    assert as_health_config(cfg) is cfg
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan determinism
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_deterministic_across_instances():
+    paths = [f"/a/b/file{k}.h5" for k in range(40)]
+    s1 = [faults.FaultPlan(3, rate=0.5).spec_for(p) for p in paths]
+    s2 = [faults.FaultPlan(3, rate=0.5).spec_for(p) for p in paths]
+    assert [(s.kind, s.n_times) if s else None for s in s1] == \
+           [(s.kind, s.n_times) if s else None for s in s2]
+    # stable across directories (basename-seeded)
+    s3 = [faults.FaultPlan(3, rate=0.5).spec_for(f"/other/{p.split('/')[-1]}")
+          for p in paths]
+    assert [(s.kind,) if s else None for s in s1] == \
+           [(s.kind,) if s else None for s in s3]
+    # different seeds draw different schedules
+    s4 = [faults.FaultPlan(4, rate=0.5).spec_for(p) for p in paths]
+    assert [(s.kind,) if s else None for s in s1] != \
+           [(s.kind,) if s else None for s in s4]
+
+
+def test_fault_plan_transient_recovers_persistent_does_not():
+    plan = faults.FaultPlan(0, rate=1.0, kinds=("oserror",),
+                            max_transient_repeats=2)
+    path = "/x/f.h5"
+    spec = plan.spec_for(path)
+    assert spec.kind == "oserror" and 1 <= spec.n_times <= 2
+    fired = 0
+    for _ in range(spec.n_times):
+        with pytest.raises(faults.InjectedReadError):
+            plan.on_read(path)
+        fired += 1
+    plan.on_read(path)                               # recovered
+    assert fired == spec.n_times
+
+    corrupt = faults.FaultPlan(0, rate=1.0, kinds=("truncated",))
+    for _ in range(5):                               # persists forever
+        with pytest.raises(faults.InjectedCorruptFile):
+            corrupt.on_read(path)
+
+
+def test_fault_plan_poison_by_dtype():
+    plan = faults.FaultPlan(0, rate=1.0, kinds=("nan",))
+    f = plan.poison_read("/x/f.h5", np.zeros((4, 64), np.float32))
+    assert np.isnan(f).any()
+    i = plan.poison_read("/x/g.h5", np.zeros((4, 64), np.int16))
+    assert (i == np.iinfo(np.int16).max).any()       # ints saturate instead
+    clean = faults.FaultPlan(0, rate=0.0)
+    x = np.zeros((4, 64), np.float32)
+    assert clean.poison_read("/x/f.h5", x) is x      # no fault: untouched
+
+
+def test_fault_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        faults.FaultPlan(0, kinds=("meteor",))
+    with pytest.raises(ValueError):
+        faults.FaultPlan(0, kinds=("crash",))        # only via crash_after
+
+
+def test_expected_disposition_oracle():
+    pol = faults.RetryPolicy(max_attempts=3)
+    plan = faults.FaultPlan(11, rate=1.0, max_transient_repeats=2)
+    statuses = {plan.expected_disposition(f"/x/f{k}.h5", pol)
+                for k in range(60)}
+    # rate=1.0 with repeats < max_attempts: every kind resolves to done /
+    # failed(truncated) / quarantined(nan) / timeout(hang)
+    assert statuses <= {"done", "failed", "quarantined", "timeout"}
+    assert "quarantined" in statuses and "timeout" in statuses
