@@ -220,6 +220,11 @@ def _replay_banked(banked: dict, suffix: str, errors=None) -> None:
     if head and banked_commit and head != banked_commit:
         banked["stale_commit"] = True
         suffix += f"; stale-commit (measured on {banked_commit}, HEAD {head})"
+    # structured twin of the "accelerator unreachable at report time"
+    # device-string suffix: a replayed bank means THIS invocation could
+    # not reach the accelerator — downstream parsing reads the flag, not
+    # the prose
+    banked["accelerator_unreachable"] = True
     banked["device"] = (
         f"{banked['device']} [banked {banked['banked_age_h']}h ago; {suffix}]"
     )
@@ -245,6 +250,7 @@ def _replay_banked(banked: dict, suffix: str, errors=None) -> None:
         "banked": True,
         "banked_age_h": banked.get("banked_age_h"),
         "stale_commit": stale,
+        "accelerator_unreachable": banked.pop("accelerator_unreachable"),
     }
     ordered.update(banked)
     print(json.dumps(ordered))
@@ -389,7 +395,10 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
     resilience = {"retries": delta["retries"],
                   "degradations": delta["degradations"],
                   "quarantined": delta["quarantined"],
-                  "timeouts": delta["timeouts"]}
+                  "timeouts": delta["timeouts"],
+                  "downshifts": delta["downshifts"],
+                  "oom_recoveries": delta["oom_recoveries"],
+                  "watchdog_timeouts": delta["watchdog_timeouts"]}
     return (min(times), n_picks, str(jax.devices()[0]), stages, route,
             det.pick_mode, dict(wire_info, **batch_info, **resilience))
 
@@ -1052,6 +1061,17 @@ def main():
         "degradations": result.get("degradations", 0),
         "quarantined": result.get("quarantined", 0),
         "timeouts": result.get("timeouts", 0),
+        "downshifts": result.get("downshifts", 0),
+        "oom_recoveries": result.get("oom_recoveries", 0),
+        "watchdog_timeouts": result.get("watchdog_timeouts", 0),
+        # structured flag for the accelerator-routing outcome: downstream
+        # parsing must not regex the human-readable device string. True
+        # whenever the headline did NOT come from a reachable accelerator
+        # — the probe-failed path (fallback) AND the wedged-mid-rung CPU
+        # degrade (ran_cpu without the caller explicitly asking for CPU)
+        "accelerator_unreachable": bool(
+            fallback or (ran_cpu and not explicit_cpu)
+        ),
         "cpu_ref_rate": round(cpu_rate, 1) if cpu_rate else None,
         "cpu_ref_mode": cpu_ref_mode,
         "cpu_ref_rate_extrapolated": (

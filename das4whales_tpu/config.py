@@ -319,6 +319,46 @@ def as_health_config(health) -> DataHealthConfig | None:
     )
 
 
+#: Default device-memory budget [GiB] for program routing and the AOT
+#: memory preflight when ``DAS_HBM_BUDGET_GB`` is unset: well under a
+#: 16 GiB v5e HBM, leaving room for resident arrays + runtime overhead.
+DEFAULT_HBM_BUDGET_GB = 8.0
+
+
+def hbm_budget_bytes() -> int:
+    """The device-memory budget in bytes (``DAS_HBM_BUDGET_GB`` env, or
+    :data:`DEFAULT_HBM_BUDGET_GB`) — ONE resolver shared by the
+    detector's monolithic-vs-tiled routing
+    (``models.matched_filter.MatchedFilterDetector``) and the batched
+    campaign's AOT memory preflight (``utils.memory``), so the preflight
+    gates against exactly the budget the router uses
+    (docs/TPU_RUNBOOK.md OOM triage)."""
+    return int(
+        float(os.environ.get("DAS_HBM_BUDGET_GB", DEFAULT_HBM_BUDGET_GB))
+        * 2**30
+    )
+
+
+def memory_preflight_default() -> bool:
+    """Whether batched campaigns run the AOT memory preflight when the
+    caller passes ``preflight=None`` (``DAS_MEMORY_PREFLIGHT`` env;
+    default off — the preflight spends one AOT compile per candidate
+    (bucket, B) shape up front to never dispatch a program that cannot
+    fit ``DAS_HBM_BUDGET_GB``)."""
+    return os.environ.get("DAS_MEMORY_PREFLIGHT", "0") not in ("0", "", "false")
+
+
+def dispatch_deadline_default() -> float | None:
+    """Default campaign dispatch-watchdog deadline in seconds
+    (``DAS_DISPATCH_DEADLINE_S`` env; unset/empty = no watchdog). The
+    watchdog bounds how long a campaign waits on any ONE device dispatch
+    (program launch + packed fetch) — a wedged XLA runtime becomes
+    ``status="timeout"`` instead of a stalled run
+    (``faults.call_with_deadline``)."""
+    raw = os.environ.get("DAS_DISPATCH_DEADLINE_S", "")
+    return float(raw) if raw else None
+
+
 #: Default on-disk home of the persistent XLA compilation cache (batched
 #: campaigns compile O(#buckets) programs ONCE per machine, not once per
 #: process — docs/TPU_RUNBOOK.md). Override with

@@ -25,9 +25,18 @@ campaign runners (``workflows.campaign``) the vocabulary to do better:
   provable under fuzzed fault schedules (tests/test_chaos.py), not just
   asserted.
 * :func:`counters` — process-wide resilience counters (retries,
-  degradations, quarantined, timeouts) that bench.py reports next to
-  the headline metric, so resilience overhead on the hot path is
-  visible rather than silently folded into the wall.
+  degradations, quarantined, timeouts, downshifts, oom_recoveries,
+  watchdog_timeouts) that bench.py reports next to the headline metric,
+  so resilience overhead on the hot path is visible rather than
+  silently folded into the wall.
+
+ISSUE 5 adds the RESOURCE class to the taxonomy (device HBM exhaustion:
+XLA ``RESOURCE_EXHAUSTED`` / allocator failures), the downshift-rung
+vocabulary (:data:`DOWNSHIFT_STAGES`, :func:`rung_rank`) consumed by the
+campaign's elastic resource ladder, the dispatch watchdog primitive
+(:func:`call_with_deadline` / :class:`DispatchDeadlineExceeded`), and
+the ``oom`` / ``hang_dispatch`` chaos kinds that exercise every rung
+deterministically (docs/ROBUSTNESS.md "Resource ladder").
 """
 
 from __future__ import annotations
@@ -42,7 +51,7 @@ from typing import Dict, Mapping
 
 import numpy as np
 
-FAULT_CLASSES = ("transient", "corrupt", "data", "fatal")
+FAULT_CLASSES = ("transient", "corrupt", "data", "resource", "fatal")
 
 # ---------------------------------------------------------------------------
 # Failure taxonomy
@@ -71,6 +80,25 @@ _TRANSIENT_MARKERS = (
     "unavailable: ", "deadline exceeded",
 )
 
+#: Substrings (lowercased) that mark a device-side allocation failure —
+#: the XLA runtime surfaces HBM pressure as an ``XlaRuntimeError`` (a
+#: bare RuntimeError on some jaxlibs) whose text carries the
+#: ``RESOURCE_EXHAUSTED`` status or an allocator message. These are the
+#: ``resource`` class: retrying the SAME program would OOM identically,
+#: but a smaller batch / the tiled route / the host would succeed — the
+#: campaign's elastic downshift ladder handles them
+#: (workflows.campaign, docs/ROBUSTNESS.md "Resource ladder").
+_RESOURCE_MARKERS = (
+    "resource_exhausted", "resource exhausted", "out of memory",
+    "failed to allocate", "allocation failure", "allocating",
+    "exceeds the hbm", "hbm space", "exhausts hbm",
+)
+
+#: Exception type names (not importable portably: jaxlib moves them
+#: between modules across versions) whose message should be scanned for
+#: the resource markers.
+_RESOURCE_EXC_NAMES = frozenset({"XlaRuntimeError", "JaxRuntimeError"})
+
 
 class DataHealthError(RuntimeError):
     """A block's on-device health stats breached the configured
@@ -92,14 +120,61 @@ class DeadlineExceeded(TimeoutError):
     abandoned (it cannot be killed) and a fresh stream restarts past the
     culprit."""
 
+    stage = "read"
+
     def __init__(self, path: str, deadline_s: float | None):
         self.path = path
         self.deadline_s = float(deadline_s) if deadline_s is not None else None
         super().__init__(
-            f"{path}: read exceeded the "
+            f"{path}: {self.stage} exceeded the "
             f"{self.deadline_s if self.deadline_s is not None else '?'}s "
-            "per-file deadline"
+            f"per-file {self.stage} deadline"
         )
+
+
+class DispatchDeadlineExceeded(DeadlineExceeded):
+    """A device DISPATCH (program launch / ``block_until_ready`` / the
+    packed fetch) exceeded the campaign's ``dispatch_deadline_s`` — the
+    watchdog's complement to the read deadline: a wedged XLA runtime
+    becomes ``status="timeout"`` + campaign-continues instead of a
+    stalled run. The hung dispatch thread is abandoned, exactly like a
+    hung reader (``call_with_deadline``)."""
+
+    stage = "dispatch"
+
+
+def call_with_deadline(fn, deadline_s: float | None, path: str):
+    """Run ``fn()`` bounded by ``deadline_s`` (None: call inline).
+
+    The dispatch watchdog primitive: ``fn`` runs on a daemon thread and a
+    wall-clock deadline bounds the wait, mirroring the reader deadline in
+    ``io.stream``. On violation raises :class:`DispatchDeadlineExceeded`
+    (the campaign dispositions ``status="timeout"``) and ABANDONS the
+    worker — a hung XLA dispatch cannot be cancelled; its memory returns
+    if/when the runtime ever answers. ``fn``'s own exception (including a
+    ``TimeoutError`` it raised itself) re-raises unchanged.
+    """
+    if deadline_s is None:
+        return fn()
+    from concurrent.futures import ThreadPoolExecutor
+    from concurrent.futures import TimeoutError as _FutTimeout
+
+    ex = ThreadPoolExecutor(max_workers=1)
+    try:
+        fut = ex.submit(fn)
+        try:
+            return fut.result(deadline_s)
+        except _FutTimeout as exc:
+            # py3.11+: concurrent.futures.TimeoutError IS builtin
+            # TimeoutError — distinguish fn's own TimeoutError from the
+            # wait deadline (same guard as io.stream's read deadline)
+            if fut.done() and fut.exception() is exc:
+                raise
+            raise DispatchDeadlineExceeded(path, deadline_s)
+    finally:
+        # NEVER join on teardown: the worker may be wedged in the XLA
+        # runtime forever (the read-deadline teardown lesson, PR 4)
+        ex.shutdown(wait=False, cancel_futures=True)
 
 
 class FaultInjected(Exception):
@@ -131,6 +206,14 @@ class InjectedDetectorError(FaultInjected, RuntimeError):
     fault_class = "transient"
 
 
+class InjectedResourceExhausted(FaultInjected, RuntimeError):
+    """Injected device OOM (``RESOURCE_EXHAUSTED``) at the dispatch
+    boundary — fires while the dispatch rung outranks the file's planned
+    ``ok_rung`` (the chaos model of a shape that fits a smaller batch)."""
+
+    fault_class = "resource"
+
+
 class InjectedCrash(FaultInjected, RuntimeError):
     """Injected fatal mid-run crash (the crash-resume drill)."""
 
@@ -145,9 +228,13 @@ def classify_failure(exc: BaseException) -> str:
     (the safe default for anything unrecognized: retrying an unknown
     failure risks an unbounded loop, and pre-taxonomy campaigns failed
     everything immediately, so unknown==corrupt preserves behavior);
-    ``data`` — the CONTENT is bad, quarantine; ``fatal`` — abort the
-    campaign. An exception may self-classify via a ``fault_class``
-    attribute (the injected fault types above and
+    ``data`` — the CONTENT is bad, quarantine; ``resource`` — the
+    DEVICE ran out of memory for this program shape (XLA
+    ``RESOURCE_EXHAUSTED`` / allocator failures): never retried
+    identically, but recoverable by the elastic downshift ladder
+    (smaller batch, tiled route, host — ``workflows.campaign``);
+    ``fatal`` — abort the campaign. An exception may self-classify via a
+    ``fault_class`` attribute (the injected fault types above and
     :class:`DataHealthError` do).
     """
     declared = getattr(exc, "fault_class", None)
@@ -155,6 +242,15 @@ def classify_failure(exc: BaseException) -> str:
         return declared
     if isinstance(exc, (MemoryError, KeyboardInterrupt, SystemExit)):
         return "fatal"
+    if (isinstance(exc, RuntimeError)
+            or type(exc).__name__ in _RESOURCE_EXC_NAMES):
+        # jaxlib's XlaRuntimeError subclasses RuntimeError on current
+        # jaxlibs (and moved modules across versions — match by name
+        # too); HBM exhaustion used to land in `corrupt` here and burn
+        # the file with no downshift
+        text = str(exc).lower()
+        if any(m in text for m in _RESOURCE_MARKERS):
+            return "resource"
     if isinstance(exc, (FloatingPointError,)):
         return "data"
     if isinstance(exc, (ConnectionError, InterruptedError, TimeoutError)):
@@ -250,6 +346,14 @@ class RetryState:
         self.attempts[key] = self.attempts.get(key, 0) + 1
         return self.attempts[key]
 
+    def unattempt(self, key: str) -> None:
+        """Refund one attempt: a resource-class downshift retry is a
+        ROUTE change, not a retry of the same program — it must not
+        spend the file's transient-retry budget (the ladder is bounded
+        by its rung count, never by ``max_attempts``)."""
+        if self.attempts.get(key, 0) > 0:
+            self.attempts[key] -= 1
+
     def n_attempts(self, key: str) -> int:
         return self.attempts.get(key, 0)
 
@@ -280,6 +384,7 @@ class RetryState:
 _counters_lock = threading.Lock()
 _COUNTERS: Dict[str, int] = {
     "retries": 0, "degradations": 0, "quarantined": 0, "timeouts": 0,
+    "downshifts": 0, "oom_recoveries": 0, "watchdog_timeouts": 0,
 }
 
 
@@ -302,30 +407,69 @@ def counters_delta(before: Mapping[str, int]) -> Dict[str, int]:
 
 
 # ---------------------------------------------------------------------------
+# Elastic downshift rungs (shared vocabulary of the resource ladder)
+# ---------------------------------------------------------------------------
+
+#: The canonical downshift order of the resource ladder
+#: (``workflows.campaign``; docs/ROBUSTNESS.md "Resource ladder"):
+#: batched slabs at shrinking B, then the per-file one-program route,
+#: then the channel-tiled route, then the time-sharded route (multi-chip
+#: only), then the host. A rung is ``(stage, batch)`` — batch is 1 for
+#: every non-batched stage.
+DOWNSHIFT_STAGES = ("batched", "file", "tiled", "timeshard", "host")
+
+
+def rung_rank(rung) -> tuple:
+    """Sort key placing rungs in ladder order: earlier (hungrier) rungs
+    rank lower. Within the ``batched`` stage larger batches come first
+    (``('batched', 8) < ('batched', 4) < ... < ('file', 1)``)."""
+    stage, batch = rung
+    return (DOWNSHIFT_STAGES.index(stage), -int(batch))
+
+
+def rung_label(rung) -> str:
+    """Human/manifest form of a rung: ``"batched:4"`` / ``"tiled"``."""
+    stage, batch = rung
+    return f"{stage}:{int(batch)}" if stage == "batched" else stage
+
+
+# ---------------------------------------------------------------------------
 # Deterministic chaos harness
 # ---------------------------------------------------------------------------
 
 #: kind -> (site, exception factory or None for non-raising kinds)
 FAULT_KINDS = ("oserror", "truncated", "transfer", "nan", "hang")
+#: device resource-pressure kinds (opt into them explicitly — they model
+#: HBM exhaustion and wedged dispatches, exercised by the batched
+#: campaign's downshift ladder + dispatch watchdog)
+DISPATCH_FAULT_KINDS = ("oom", "hang_dispatch")
 _KIND_SITE = {
     "oserror": "read", "truncated": "read", "hang": "read", "nan": "read",
     "transfer": "transfer", "detect": "detect", "crash": "detect",
+    "oom": "dispatch", "hang_dispatch": "dispatch",
 }
 #: kinds whose fault persists across attempts: a bad file stays bad, and
 #: a hung mount stays hung (also keeps the chaos oracle deterministic —
 #: an abandoned prefetch worker past a timeout may consume read-site
 #: hits the consumer never observes)
-_PERSISTENT_KINDS = frozenset({"truncated", "nan", "hang"})
+_PERSISTENT_KINDS = frozenset({"truncated", "nan", "hang",
+                               "oom", "hang_dispatch"})
 
 
 @dataclass
 class FaultSpec:
     """One file's planned fault: ``kind`` at ``site``, failing the first
-    ``n_times`` attempts (persistent kinds fail every attempt)."""
+    ``n_times`` attempts (persistent kinds fail every attempt).
+    ``ok_rung`` applies to ``kind="oom"`` only: the first downshift rung
+    (``(stage, batch)``, see :func:`rung_rank`) at which the dispatch
+    stops OOMing — every hungrier rung raises
+    :class:`InjectedResourceExhausted`, deterministically, however the
+    campaign groups files into slabs."""
 
     kind: str
     site: str
     n_times: int
+    ok_rung: tuple | None = None
 
 
 class FaultPlan:
@@ -346,6 +490,17 @@ class FaultPlan:
       on-device health quarantine, not an exception path.
     * ``"hang"`` — the reader sleeps ``hang_s`` (pair with a stream
       ``read_deadline_s`` below it to exercise the timeout path).
+    * ``"oom"`` — device HBM exhaustion at the dispatch boundary: the
+      dispatch raises ``RESOURCE_EXHAUSTED`` while its downshift rung
+      outranks the file's drawn ``ok_rung`` (``("file", 1)`` or
+      ``("tiled", 1)``), and succeeds from that rung on — the
+      deterministic model of a shape that fits a smaller batch
+      (exercises every rung of the campaign's elastic ladder). Not in
+      the default ``kinds``; opt in via ``kinds=faults
+      .DISPATCH_FAULT_KINDS`` or a mixed tuple.
+    * ``"hang_dispatch"`` — the dispatch wedges for ``hang_s`` (pair
+      with a campaign ``dispatch_deadline_s`` below it to exercise the
+      watchdog timeout path). Not in the default ``kinds``.
     * ``"crash"`` (only via ``crash_after``) — a one-shot FATAL fault at
       the detector boundary after N successful detects: the mid-run
       crash of the crash-resume drill.
@@ -384,7 +539,13 @@ class FaultPlan:
         kind = self.kinds[rng.randrange(len(self.kinds))]
         n = (10**9 if kind in _PERSISTENT_KINDS
              else 1 + rng.randrange(self.max_transient_repeats))
-        return FaultSpec(kind=kind, site=_KIND_SITE[kind], n_times=n)
+        ok_rung = None
+        if kind == "oom":
+            # where the shape starts fitting: the per-file route or one
+            # rung further (the tiled route) — both recover to "done"
+            ok_rung = ("file", 1) if rng.random() < 0.5 else ("tiled", 1)
+        return FaultSpec(kind=kind, site=_KIND_SITE[kind], n_times=n,
+                         ok_rung=ok_rung)
 
     def _fire(self, site: str, path: str) -> FaultSpec | None:
         """Consume one planned injection at ``site`` for ``path`` (None
@@ -446,6 +607,27 @@ class FaultPlan:
                 f"injected: transfer failed for {path}"
             )
 
+    def on_dispatch(self, path: str, rung: tuple = ("file", 1)) -> None:
+        """Device-dispatch boundary (inside the campaign's watchdog
+        wrapper): ``oom`` raises ``RESOURCE_EXHAUSTED`` while ``rung``
+        outranks the file's planned ``ok_rung`` (condition-based, not
+        count-based — deterministic however the campaign slices slabs);
+        ``hang_dispatch`` wedges for ``hang_s`` every time (pair with a
+        ``dispatch_deadline_s`` below it)."""
+        spec = self.spec_for(path)
+        if spec is None or spec.site != "dispatch":
+            return
+        if spec.kind == "hang_dispatch":
+            time.sleep(self.hang_s)
+            return
+        ok = spec.ok_rung or ("file", 1)
+        if rung_rank(rung) < rung_rank(ok):
+            raise InjectedResourceExhausted(
+                f"injected: RESOURCE_EXHAUSTED: out of memory while "
+                f"trying to allocate the {rung_label(rung)} program for "
+                f"{path} (fits from {rung_label(ok)})"
+            )
+
     def on_detect(self, path: str) -> None:
         """Detector boundary: the one-shot fatal crash (``crash_after``),
         then any planned detect-site fault."""
@@ -475,11 +657,16 @@ class FaultPlan:
 
         Preconditions the oracle assumes (assert them in the fuzz, not
         here): ``"hang"`` needs a stream ``read_deadline_s`` below
-        ``hang_s``; ``"nan"`` needs a health gate that can SEE the
-        poison — the default ``DataHealthConfig`` catches the NaN stripe
-        on float wires, but an integer (raw-wire) block is poisoned by
-        ADC saturation, which only a configured ``clip_abs`` /
-        ``max_clip_frac`` gate flags.
+        ``hang_s``; ``"hang_dispatch"`` needs a campaign
+        ``dispatch_deadline_s`` below ``hang_s``; ``"oom"`` needs the
+        downshift ladder (on by default in the campaign runners — the
+        ladder always reaches the plan's ``ok_rung``: unbatched routes
+        start AT the per-file rung, so an ``ok_rung`` at or above it
+        never even fires there); ``"nan"`` needs a health gate that can
+        SEE the poison — the default ``DataHealthConfig`` catches the
+        NaN stripe on float wires, but an integer (raw-wire) block is
+        poisoned by ADC saturation, which only a configured ``clip_abs``
+        / ``max_clip_frac`` gate flags.
         """
         spec = self.spec_for(path)
         if spec is None:
@@ -488,7 +675,9 @@ class FaultPlan:
             return "failed"
         if spec.kind == "nan":
             return "quarantined"
-        if spec.kind == "hang":
+        if spec.kind in ("hang", "hang_dispatch"):
             return "timeout"
+        if spec.kind == "oom":
+            return "done"   # the ladder downshifts to spec.ok_rung
         max_attempts = policy.max_attempts if policy is not None else 1
         return "done" if spec.n_times < max_attempts else "failed"

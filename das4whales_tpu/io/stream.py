@@ -517,6 +517,40 @@ class BatchSlab:
         return len(self.blocks)
 
 
+def subdivide_slab(slab: BatchSlab, batch: int) -> list:
+    """Split one :class:`BatchSlab` into smaller slabs of at most
+    ``batch`` files each, re-assembled from the HOST blocks (the device
+    ``stack`` may already be donated or unfit — never touched here).
+
+    The elastic downshift ladder's re-bucketing primitive
+    (``workflows.campaign.run_campaign_batched``): after a
+    resource-class failure at batch B, the same files retry at B/2, …, 1
+    through stacks rebuilt from the assembler's host blocks. File order,
+    paths, ``n_real`` and ``bucket_ns`` are preserved, so per-file picks
+    are bit-identical at every rung.
+    """
+    if batch < 1:
+        raise ValueError("batch must be >= 1")
+    subs = []
+    for s in range(0, slab.n_valid, batch):
+        group = slab.blocks[s : s + batch]
+        tr0 = np.asarray(group[0].trace)
+        # every sub-slab allocates the FULL rung batch (trailing file
+        # slots zero, like the assembler's partial slabs): one program
+        # per (bucket, batch) shape, not one per remainder size
+        stack = np.zeros((batch, tr0.shape[0], slab.bucket_ns), tr0.dtype)
+        for j, b in enumerate(group):
+            tr = np.asarray(b.trace)
+            stack[j, :, : tr.shape[1]] = tr
+        subs.append(BatchSlab(
+            stack=stack, blocks=tuple(group),
+            paths=slab.paths[s : s + batch], index0=slab.index0 + s,
+            bucket_ns=slab.bucket_ns,
+            n_real=slab.n_real[s : s + batch],
+        ))
+    return subs
+
+
 class SlabReadError(RuntimeError):
     """A file failed to probe/read/bucket during slab assembly.
 

@@ -35,6 +35,7 @@ from ..config import (
     FkFilterConfig,
     as_metadata,
 )
+from ..config import hbm_budget_bytes as _default_hbm_budget_bytes
 from ..ops import conditioning
 from ..ops import fk as fk_ops
 from ..ops import peaks as peak_ops
@@ -601,7 +602,8 @@ class MatchedFilterDetector:
         # fetch at ~2 MB/template of int32)
         self.pick_pack_cap = pick_pack_cap
         if hbm_budget_bytes is None:
-            hbm_budget_bytes = int(float(os.environ.get("DAS_HBM_BUDGET_GB", 8.0)) * 2**30)
+            # one resolver shared with the AOT preflight (config.py)
+            hbm_budget_bytes = _default_hbm_budget_bytes()
         self.hbm_budget_bytes = hbm_budget_bytes
         # NOTE: the full dense mask stays host-side (design.fk_mask) — only
         # the banded half-spectrum crop goes to HBM (~3x smaller; at the
@@ -627,6 +629,54 @@ class MatchedFilterDetector:
         (self._templates_true, self._template_mu, self._template_scale) = (
             xcorr.padded_template_stats_device(self.design.templates)
         )
+
+    def tiled_view(self) -> "MatchedFilterDetector":
+        """A shallow view of this detector with the channel-TILED
+        correlate route forced (``_route() == "tiled"`` regardless of
+        the budget estimate) — the resource ladder's memory-lean
+        per-file rung (``workflows.campaign``; docs/ROBUSTNESS.md
+        "Resource ladder"). Shares the design and device arrays: no
+        re-design, one extra compile per shape at most. Cached — repeated
+        calls return the same view."""
+        import copy
+
+        cached = self.__dict__.get("_tiled_view_cache")
+        if cached is not None:
+            return cached
+        det = copy.copy(self)
+        det.__dict__.pop("_tiled_view_cache", None)
+        det.channel_tile = self.effective_channel_tile
+        self.__dict__["_tiled_view_cache"] = det
+        return det
+
+    def host_view(self) -> "MatchedFilterDetector":
+        """A view of this detector whose device arrays live on the host
+        CPU backend — the resource ladder's LAST rung: when no device
+        rung fits, detection still completes (slowly) on host RAM.
+        Callers must run detection under
+        ``jax.default_device(det.host_device)`` so the program compiles
+        for (and dispatches to) the CPU backend. Raises ``RuntimeError``
+        where jax has no CPU backend. Cached — repeated calls return the
+        same view."""
+        import copy
+
+        cached = self.__dict__.get("_host_view_cache")
+        if cached is not None:
+            return cached
+        cpu = jax.devices("cpu")[0]
+        det = copy.copy(self)
+        det.__dict__.pop("_host_view_cache", None)
+        det.__dict__.pop("_tiled_view_cache", None)
+        det.channel_tile = self.effective_channel_tile  # lean on host too
+        with jax.default_device(cpu):
+            for attr in ("_mask_band_dev", "_gain_dev", "_templates_dev",
+                         "_templates_true", "_template_mu",
+                         "_template_scale", "_cond_scale"):
+                setattr(det, attr,
+                        jnp.asarray(np.asarray(getattr(self, attr))))
+        det.host_device = cpu
+        self.__dict__["_host_view_cache"] = det
+        return det
 
     def monolithic_temp_estimate(self) -> int:
         """Rough byte estimate of the one-program correlate+envelope route's

@@ -171,6 +171,15 @@ class BatchedMatchedFilterDetector:
     ) -> List[tuple | None]:
         """Detect over a ``[B, C, T]`` slab.
 
+        ``B`` is read from the stack, NOT fixed at construction: one
+        facade serves every batch size over its bucket shape, compiling
+        one program per distinct ``B``. The campaign's elastic downshift
+        ladder leans on this (``io.stream.subdivide_slab`` re-buckets a
+        failed slab to B/2, …, and redispatches through the SAME
+        detector — docs/ROBUSTNESS.md "Resource ladder"), and the AOT
+        memory preflight prices the program at any candidate ``B``
+        without dispatching (``utils.memory.batched_program_memory``).
+
         ``n_real`` (sequence of per-file real time lengths) marks
         bucket-padded files; ``n_valid`` limits the returned entries to
         the slab's real files (trailing zero file-slots of a partial

@@ -29,6 +29,7 @@ program stays identical on every device.
 from __future__ import annotations
 
 import functools
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -436,3 +437,106 @@ def make_sharded_mf_step_time(
 def time_sharding(mesh: Mesh, time_axis: str = "time") -> NamedSharding:
     """Input sharding for a ``[channel x time]`` block with time sharded."""
     return NamedSharding(mesh, P(None, time_axis))
+
+
+# ---------------------------------------------------------------------------
+# The resource ladder's time-sharded rung (workflows.campaign)
+# ---------------------------------------------------------------------------
+
+
+def viable_time_mesh_size(trace_shape, n_devices: int) -> int | None:
+    """The largest mesh size ``p >= 2`` that can serve ``trace_shape``
+    time-sharded (the pencil f-k transform needs BOTH axes divisible by
+    ``p``), or None when no multi-device decomposition exists — the
+    campaign's downshift ladder uses this to decide whether a
+    ``timeshard`` rung is available at all."""
+    C, T = trace_shape
+    for p in range(min(int(n_devices), C, T), 1, -1):
+        if C % p == 0 and T % p == 0:
+            return p
+    return None
+
+
+def sparse_time_picks_to_dict(sp_picks, template_names, n_samples=None):
+    """Convert a time-sharded step's ``SparsePicks`` (``[nT, C, K]``
+    global time positions) into the campaign picks dict
+    ``{name: (2, n) [channel, time]}``, row-major (channel-major, time
+    ascending within a channel — the same order the one-program route's
+    device compaction emits). ``n_samples`` drops positions at or past
+    the real record length (divisibility / bucket padding)."""
+    pos = np.asarray(sp_picks.positions)
+    sel = np.asarray(sp_picks.selected).astype(bool)
+    out = {}
+    for i, name in enumerate(template_names):
+        mask = sel[i]
+        if n_samples is not None:
+            mask = mask & (pos[i] < int(n_samples))
+        ch, slot = np.nonzero(mask)
+        t = pos[i][ch, slot]
+        order = np.lexsort((t, ch))
+        out[name] = np.asarray([ch[order], t[order]], dtype=np.int64)
+    return out
+
+
+def detect_picks_time_sharded(det, trace, mesh: Mesh, n_real=None):
+    """One file's picks through the TIME-SHARDED detection step — the
+    resource ladder's multi-chip rung (docs/ROBUSTNESS.md "Resource
+    ladder"): per-device working set shrinks ~1/P, so a shape that OOMs
+    every single-chip route can still run on the mesh before falling to
+    the host.
+
+    ``det`` is the bucket's ``models.matched_filter.MatchedFilterDetector``
+    (its design, wire and threshold policy are reused — one source);
+    ``trace`` a host ``[C, T]`` block (stored-dtype counts on the raw
+    wire); ``n_real`` the real time length of a bucket-padded record.
+    Returns ``(picks, thresholds)`` in the campaign dict convention.
+
+    Numerics caveat (same as the long-record path, module docstring):
+    interior samples match the single-chip routes to float roundoff, but
+    the first/last ``halo`` samples differ in their edge-transient
+    handling — unlike the batched/file/tiled rungs, this rung's picks
+    are detection-equivalent rather than guaranteed bit-identical.
+    """
+    # the compiled step depends on n_real only on the RAW wire (it is
+    # the conditioning prologue's static cond_time_samples); on the
+    # conditioned wire n_real feeds just the host-side pad filter — one
+    # step serves every record length of the bucket (no per-length
+    # recompile at this rung)
+    nr_key = (int(n_real)
+              if (n_real is not None and det.wire == "raw") else None)
+    key = (mesh, nr_key)
+    step = _LADDER_STEPS.setdefault(det, {}).get(key)
+    if step is None:
+        wire_kw = (
+            {"wire": "raw", "scale_factor": det.metadata.scale_factor,
+             "cond_time_samples": None if n_real is None else int(n_real)}
+            if det.wire == "raw" else {}
+        )
+        step = make_sharded_mf_step_time(
+            det.design, mesh, outputs="picks", pick_mode="sparse",
+            max_peaks=det.max_peaks, fused_bandpass=det.fused_bandpass,
+            **wire_kw,
+        )
+        _LADDER_STEPS[det][key] = step
+    x = jax.device_put(np.asarray(trace), time_sharding(mesh))
+    sp_picks, thres = jax.block_until_ready(step(x))
+    picks = sparse_time_picks_to_dict(
+        sp_picks, det.design.template_names, n_samples=n_real
+    )
+    from ..models.matched_filter import reference_threshold_factors
+
+    factors = np.asarray(reference_threshold_factors(
+        len(det.design.template_names)
+    ))
+    thresholds = {
+        name: float(thres) * float(factors[i])
+        for i, name in enumerate(det.design.template_names)
+    }
+    return picks, thresholds
+
+
+#: detector -> {(mesh, n_real): compiled time-sharded ladder step}.
+#: Weak-keyed by the detector (the campaign holds its bucket detectors
+#: for the whole run): steps die with their detector, and a fresh
+#: campaign's fresh detector can never collide with a dead one's entry.
+_LADDER_STEPS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()

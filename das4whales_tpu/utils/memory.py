@@ -1,0 +1,182 @@
+"""AOT memory preflight: compile-time HBM accounting for campaign shapes.
+
+The round-2 bench OOM (tests/test_memory_budget.py) established the
+pattern: ``jit(fn).lower(avals).compile().memory_analysis()`` prices a
+program's device footprint BEFORE the first dispatch. This module turns
+that test-only pattern into campaign machinery — the batched runner
+(``workflows.campaign.run_campaign_batched(preflight=True)``) prices
+every candidate ``(bucket, B)`` batched program against the SAME
+``DAS_HBM_BUDGET_GB`` budget the detector's monolithic-vs-tiled router
+uses (``config.hbm_budget_bytes``), starts each bucket at the largest
+batch that fits, and skips shapes that fit at no rung — so the elastic
+downshift ladder (docs/ROBUSTNESS.md "Resource ladder") becomes the
+recovery path for *surprises*, not the scheduler for *known* overflows.
+
+Caveat (same as tests/test_memory_budget.py): on the CPU backend the
+numbers come from CPU buffer assignment — a lower-bound heuristic for
+the TPU footprint, not a reproduction of it. On a real TPU backend the
+analysis prices the actual TPU executable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+import jax
+
+__all__ = [
+    "MemoryStats",
+    "aot_memory_stats",
+    "batched_program_memory",
+    "max_fitting_batch",
+]
+
+
+@dataclass(frozen=True)
+class MemoryStats:
+    """One compiled program's static device-memory footprint (bytes),
+    from ``compiled.memory_analysis()``. ``peak`` (temps + outputs) is
+    the routing/preflight figure — argument buffers are priced
+    separately because campaign inputs (the slab) are alive regardless
+    of which program consumes them."""
+
+    temp_bytes: int
+    output_bytes: int
+    argument_bytes: int
+    generated_code_bytes: int
+
+    @property
+    def peak(self) -> int:
+        return self.temp_bytes + self.output_bytes
+
+    @property
+    def total(self) -> int:
+        return self.peak + self.argument_bytes + self.generated_code_bytes
+
+    def fits(self, budget_bytes: int) -> bool:
+        return self.peak < int(budget_bytes)
+
+
+def _analysis_int(analysis, name: str) -> int:
+    """Best-effort field read: ``memory_analysis()`` fields vary across
+    jaxlib versions/backends; absent ones read 0."""
+    try:
+        return int(getattr(analysis, name))
+    except (AttributeError, TypeError, ValueError):
+        return 0
+
+
+def aot_memory_stats(fn, *avals, static_kwargs=None) -> MemoryStats | None:
+    """AOT-compile ``fn`` at ``avals`` (``jax.ShapeDtypeStruct``\\ s) and
+    return its :class:`MemoryStats` — or None where this jaxlib/backend
+    does not support ``memory_analysis()`` (callers proceed unpreflighted,
+    trusting the downshift ladder).
+
+    ``fn`` may already be a ``jax.jit`` wrapper (it is lowered as-is) or
+    a plain callable (jitted here with ``static_kwargs`` as
+    ``static_argnames`` values).
+    """
+    try:
+        # AOT pricing only: lowered+compiled for memory_analysis(),
+        # never dispatched — no hot-path compile cache to miss
+        jitted = fn if hasattr(fn, "lower") else jax.jit(  # daslint: allow[R2]
+            fn, static_argnames=tuple(static_kwargs or ())
+        )
+        lowered = jitted.lower(*avals, **(static_kwargs or {}))
+        analysis = lowered.compile().memory_analysis()
+    except Exception:  # noqa: BLE001 — unsupported backend/jaxlib: no gate
+        return None
+    if analysis is None:
+        return None
+    return MemoryStats(
+        temp_bytes=_analysis_int(analysis, "temp_size_in_bytes"),
+        output_bytes=_analysis_int(analysis, "output_size_in_bytes"),
+        argument_bytes=_analysis_int(analysis, "argument_size_in_bytes"),
+        generated_code_bytes=_analysis_int(analysis, "generated_code_size_in_bytes"),
+    )
+
+
+def _aval_of(arr) -> jax.ShapeDtypeStruct:
+    import numpy as np
+
+    a = np.asarray(arr) if not hasattr(arr, "dtype") else arr
+    return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+
+def batched_program_memory(
+    bdet, batch: int, stack_dtype, *, with_health: bool = False,
+    health_clip: float | None = None,
+) -> MemoryStats | None:
+    """Price the batched detection program (``parallel.batch``) for
+    ``bdet`` (a ``BatchedMatchedFilterDetector``) at batch size
+    ``batch`` and wire dtype ``stack_dtype`` — the preflight unit the
+    batched campaign compares against ``config.hbm_budget_bytes()``.
+
+    Prices the FULL-CAPACITY (escalation) variant: the K0 attempt is
+    strictly smaller, so a fitting full program certifies both.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import peaks as peak_ops
+    from ..parallel.batch import _STATIC, _batched_body
+
+    det = bdet.det
+    C, T = det.design.trace_shape
+    nT = det.design.templates.shape[0]
+    cap = int(min(C * det.max_peaks, det.pick_pack_cap))
+    tile = det.effective_channel_tile if det._route() == "tiled" else None
+    compute_dtype = det._mask_band_dev.dtype
+    avals = (
+        jax.ShapeDtypeStruct((int(batch), C, T), np.dtype(stack_dtype)),
+        _aval_of(det._mask_band_dev),
+        _aval_of(det._gain_dev),
+        _aval_of(det._templates_true),
+        _aval_of(det._template_mu),
+        _aval_of(det._template_scale),
+        jax.ShapeDtypeStruct((nT,), compute_dtype),       # thr_in
+        _aval_of(det._cond_scale),
+        jax.ShapeDtypeStruct((int(batch),), jnp.int32),   # n_real
+    )
+    static = dict(
+        band_lo=det._band_lo, band_hi=det._band_hi,
+        bp_padlen=det.design.bp_padlen, pad_rows=det.fk_pad_rows,
+        staged_bp=not det.fused_bandpass, tile=tile,
+        max_peaks=det.max_peaks, capacity=cap, use_threshold=False,
+        pick_method=peak_ops.escalation_method(det.max_peaks,
+                                               det.max_peaks),
+        condition=det.wire == "raw", serial=bdet.serial,
+        with_health=with_health,
+    )
+    kwargs = {k: v for k, v in static.items() if k in _STATIC}
+    if with_health and health_clip is not None:
+        kwargs["health_clip"] = jnp.float32(health_clip)
+    # a dedicated jit wrapper (never dispatched): .lower() on the live
+    # batched_detect_picks_program would be equivalent, but keeping the
+    # preflight's lowering separate means a preflight failure can never
+    # poison the hot path's jit cache
+    return aot_memory_stats(
+        jax.jit(_batched_body, static_argnames=_STATIC),  # daslint: allow[R2] AOT pricing only — see aot_memory_stats
+        *avals,
+        static_kwargs=kwargs,
+    )
+
+
+def max_fitting_batch(
+    price: Callable[[int], MemoryStats | None],
+    candidates: Sequence[int],
+    budget_bytes: int,
+) -> int | None:
+    """The largest batch in ``candidates`` whose priced program fits
+    ``budget_bytes`` (``stats.peak < budget``) — the preflight's rung
+    chooser. Candidates are tried largest-first; a candidate whose
+    pricing is unsupported (None) is treated as fitting (no gate is
+    better than a false one — the downshift ladder still protects the
+    run). Returns None when every candidate is priced AND over budget.
+    """
+    for b in sorted({int(c) for c in candidates}, reverse=True):
+        stats = price(b)
+        if stats is None or stats.fits(budget_bytes):
+            return b
+    return None

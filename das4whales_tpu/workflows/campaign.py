@@ -131,6 +131,16 @@ def _append_manifest(outdir: str, rec: FileRecord) -> None:
         fh.write(json.dumps(rec.__dict__) + "\n")
 
 
+def _append_event(outdir: str, event: Dict) -> None:
+    """Append a non-file EVENT record to the manifest (no ``path`` key,
+    so resume bookkeeping and per-file consumers skip it): the downshift
+    ledger (``event="downshift"``), elastic-mesh rebuilds
+    (``event="mesh_downshift"``) and the end-of-run resilience counters
+    (``event="counters"``) — ``summarize_campaign`` aggregates them."""
+    with open(_manifest_path(outdir), "a") as fh:
+        fh.write(json.dumps(dict(event)) + "\n")
+
+
 def _picks_path(outdir: str, path: str) -> str:
     """Deterministic artifact path for one file's picks (every process of
     a multi-host campaign computes the same name; only process 0 writes)."""
@@ -259,6 +269,24 @@ class _Resilience:
         self.health_cfg = as_health_config(health)
         self.fail = _failure_recorder(outdir, records, max_failures,
                                       write=write)
+        self.outdir = outdir
+        self.write = write
+        # per-CAMPAIGN resource-resilience tallies (the process-wide
+        # faults.counters() aggregate across campaigns; these feed this
+        # run's manifest "counters" event and summarize_campaign)
+        self.tallies: Dict[str, int] = {
+            "downshifts": 0, "oom_recoveries": 0, "watchdog_timeouts": 0,
+        }
+
+    def tally(self, name: str, n: int = 1) -> None:
+        self.tallies[name] = self.tallies.get(name, 0) + n
+        faults.count(name, n)
+
+    def flush_tallies(self) -> None:
+        """Write the end-of-run counters event — only when nonzero, so a
+        healthy campaign's manifest stays pure file records."""
+        if self.write and any(self.tallies.values()):
+            _append_event(self.outdir, {"event": "counters", **self.tallies})
 
     def attempt(self, path: str) -> int:
         return self.state.attempt(path)
@@ -280,6 +308,11 @@ class _Resilience:
         n_att = self.state.n_attempts(path)
         if isinstance(exc, faults.DeadlineExceeded):
             faults.count("timeouts")
+            if isinstance(exc, faults.DispatchDeadlineExceeded):
+                # the dispatch watchdog fired (wedged XLA runtime), not
+                # the reader deadline — attributed separately so an OOM
+                # triage can tell a hung chip from a hung mount
+                self.tally("watchdog_timeouts")
             self.fail(path, exc, status="timeout", attempts=n_att)
             return "next"
         fclass = faults.classify_failure(exc)
@@ -299,6 +332,161 @@ class _Resilience:
         return "next"
 
 
+class _DownshiftLadder:
+    """The elastic resource ladder's sticky bookkeeping
+    (docs/ROBUSTNESS.md "Resource ladder").
+
+    One campaign, one ladder: per bucket key it remembers the WINNING
+    rung — ``("batched", B)`` at shrinking B, then ``("file", 1)`` (the
+    per-file one-program route), ``("tiled", 1)`` (channel-tiled
+    correlate), ``("timeshard", 1)`` (time-sharded over a multi-device
+    mesh, when the shape divides), ``("host", 1)`` (CPU backend). A
+    resource-class failure advances the bucket's rung ONCE and the rung
+    sticks for the rest of the campaign (no per-file thrash); every move
+    lands in the manifest's ``downshift`` ledger.
+    """
+
+    def __init__(self, rz: _Resilience, outdir: str, batch: int = 1,
+                 write: bool = True, timeshard: bool = True):
+        self.rz = rz
+        self.outdir = outdir
+        self.batch = int(batch)
+        self.write = write
+        self.allow_timeshard = timeshard
+        self.sticky: Dict[tuple, tuple] = {}
+
+    def rungs(self, trace_shape=None) -> list:
+        out = []
+        b = self.batch
+        while b > 1:
+            out.append(("batched", b))
+            b //= 2
+        out.append(("file", 1))
+        out.append(("tiled", 1))
+        if self.allow_timeshard and trace_shape is not None:
+            import jax
+
+            from ..parallel.timeshard import viable_time_mesh_size
+
+            if viable_time_mesh_size(trace_shape, len(jax.devices())):
+                out.append(("timeshard", 1))
+        out.append(("host", 1))
+        return out
+
+    def current(self, key) -> tuple:
+        return self.sticky.get(
+            key, ("batched", self.batch) if self.batch > 1 else ("file", 1)
+        )
+
+    def pin(self, key, rung, reason: str) -> None:
+        """Preflight placement: start ``key`` at ``rung`` (no failure
+        occurred — ledgered as a preflight downshift when it moves the
+        bucket off the top rung)."""
+        top = ("batched", self.batch) if self.batch > 1 else ("file", 1)
+        self.sticky[key] = rung
+        if faults.rung_rank(rung) > faults.rung_rank(top):
+            self.rz.tally("downshifts")
+            if self.write:
+                _append_event(self.outdir, {
+                    "event": "downshift", "bucket": key if isinstance(key, str) else list(key),
+                    "from": faults.rung_label(top),
+                    "to": faults.rung_label(rung),
+                    "error": reason, "preflight": True, "sticky": True,
+                })
+            log.info("preflight: bucket %s starts at rung %s (%s)",
+                     key, faults.rung_label(rung), reason)
+
+    def downshift(self, key, rung, exc, trace_shape=None):
+        """Advance ``key``'s sticky rung past ``rung`` after a
+        resource-class failure; returns the new rung, or None when the
+        ladder is exhausted (the failure dispositions per-file)."""
+        nxt = None
+        for cand in self.rungs(trace_shape):
+            if faults.rung_rank(cand) > faults.rung_rank(rung):
+                nxt = cand
+                break
+        if nxt is None:
+            return None
+        self.sticky[key] = nxt
+        self.rz.tally("downshifts")
+        if self.write:
+            _append_event(self.outdir, {
+                "event": "downshift", "bucket": key if isinstance(key, str) else list(key),
+                "from": faults.rung_label(rung),
+                "to": faults.rung_label(nxt),
+                "error": f"{type(exc).__name__}: {exc}", "sticky": True,
+            })
+        log.warning(
+            "resource exhaustion at rung %s (%s: %s); downshifting bucket "
+            "%s to %s (sticky)", faults.rung_label(rung),
+            type(exc).__name__, exc, key, faults.rung_label(nxt),
+        )
+        return nxt
+
+
+def _time_mesh(trace_shape):
+    """The ladder's time-sharded rung mesh for ``trace_shape`` (largest
+    viable decomposition over the local devices), or None."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+    from ..parallel.timeshard import viable_time_mesh_size
+
+    p = viable_time_mesh_size(trace_shape, len(jax.devices()))
+    if p is None:
+        return None
+    return make_mesh(shape=(p,), axis_names=("time",),
+                     devices=jax.devices()[:p])
+
+
+def _detect_file_at_rung(det, rung, trace, *, n_real=None,
+                         with_health=False, clip=None):
+    """One file's ``(picks, thresholds, stats)`` at a non-batched ladder
+    rung. ``det`` must be a ``MatchedFilterDetector`` (the bucket/view
+    base); ``trace`` a HOST block (padded to the detector shape).
+    Raises on failure — including resource exhaustion at this rung,
+    which the caller's ladder absorbs."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import health as health_ops
+
+    stage = rung[0]
+    if stage == "timeshard":
+        from ..parallel.timeshard import detect_picks_time_sharded
+
+        mesh = _time_mesh(np.asarray(trace).shape)
+        if mesh is None:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: no viable time-shard mesh for "
+                f"shape {np.asarray(trace).shape}"  # -> next rung (host)
+            )
+        picks, thresholds = detect_picks_time_sharded(
+            det, trace, mesh, n_real=n_real
+        )
+        stats = (health_ops.host_health_stats(np.asarray(trace),
+                                              clip_abs=clip)
+                 if with_health else {})
+        return picks, thresholds, stats
+
+    if stage == "tiled":
+        det = det.tiled_view()
+    elif stage == "host":
+        det = det.host_view()
+
+    def run(d):
+        res = d.detect_picks(
+            jnp.asarray(trace), n_real=n_real,
+            with_health=with_health, health_clip=clip,
+        )
+        return res.picks, res.thresholds, res.health
+
+    if stage == "host":
+        with jax.default_device(det.host_device):
+            return run(det)
+    return run(det)
+
+
 def run_campaign(
     files: Sequence[str],
     selected_channels,
@@ -314,6 +502,7 @@ def run_campaign(
     retry=None,
     health=True,
     read_deadline_s: float | None = None,
+    dispatch_deadline_s: float | None = None,
     fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
@@ -338,9 +527,25 @@ def run_campaign(
     program (``ops.health``; host-computed for detector families without
     the fused route); ``read_deadline_s`` — per-file reader deadline
     (``status="timeout"`` instead of a stalled campaign);
-    ``fault_plan`` — a ``faults.FaultPlan`` chaos schedule (testing).
+    ``dispatch_deadline_s`` — the dispatch WATCHDOG (None: the
+    ``DAS_DISPATCH_DEADLINE_S`` env default): bounds any one device
+    dispatch+fetch, so a wedged XLA runtime becomes ``status="timeout"``
+    too (``faults.call_with_deadline``); ``fault_plan`` — a
+    ``faults.FaultPlan`` chaos schedule (testing).
+
+    Resource exhaustion (``faults.classify_failure == "resource"``, e.g.
+    an XLA ``RESOURCE_EXHAUSTED``): matched-filter campaigns downshift
+    the route — per-file one-program -> channel-tiled -> time-sharded
+    (multi-device) -> host — with the winning rung STICKY for the rest
+    of the run and ledgered in the manifest (docs/ROBUSTNESS.md
+    "Resource ladder").
     """
     import jax.numpy as jnp
+
+    from ..config import dispatch_deadline_default
+
+    if dispatch_deadline_s is None:
+        dispatch_deadline_s = dispatch_deadline_default()
 
     det_wire = getattr(detector, "wire", "conditioned")
     if detector is not None and det_wire != wire:
@@ -356,10 +561,13 @@ def run_campaign(
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
     pend_metas = [metas[j] for j in pend_idx]
     rz = _Resilience(outdir, records, max_failures, retry, health)
+    ladder = _DownshiftLadder(rz, outdir, batch=1)
+    _BUCKET = "campaign"   # one unbatched campaign = one sticky ladder key
 
     def detect_one(path, block, t0):
         """One attempt at the transfer+detect+health half of a file
-        (raises on failure; the caller dispositions)."""
+        (raises on failure; the caller dispositions). Resource-class
+        dispatch failures downshift the route in place (sticky)."""
         nonlocal detector
         if fault_plan is not None:
             fault_plan.on_transfer(path)
@@ -384,36 +592,64 @@ def run_campaign(
         if fault_plan is not None:
             fault_plan.on_detect(path)
         clip = rz.health_cfg.clip_abs if rz.health_cfg is not None else None
-        if (rz.health_cfg is not None
-                and getattr(detector, "supports_fused_health", False)):
-            # the one-program route: health stats computed in the same
-            # dispatch, riding the same packed fetch (ops.health)
-            result = detector.detect_picks(
-                jnp.asarray(block.trace), with_health=True, health_clip=clip
-            )
-            stats = result.health
-        else:
-            result = detector(jnp.asarray(block.trace))
-            # generic detector families: host-side stats on the already-
-            # host-resident block (one numpy pass)
-            stats = (
-                health_ops.host_health_stats(block.trace, clip_abs=clip)
-                if rz.health_cfg is not None else {}
-            )
+        with_health = rz.health_cfg is not None
+        # the resource ladder serves the matched-filter one-program
+        # family; generic detector families (spectro/gabor adapters)
+        # keep the flat route — their resource failures disposition
+        use_ladder = isinstance(detector, MatchedFilterDetector)
+        fused = with_health and getattr(detector, "supports_fused_health",
+                                        False)
+        recovered = False
+        while True:   # rung loop: resource failures downshift, sticky
+            rung = ladder.current(_BUCKET) if use_ladder else ("file", 1)
+
+            def dispatch():
+                if fault_plan is not None:
+                    fault_plan.on_dispatch(path, rung)
+                if use_ladder and (fused or rung[0] != "file"):
+                    return _detect_file_at_rung(
+                        detector, rung, block.trace,
+                        with_health=with_health, clip=clip,
+                    )
+                result = detector(jnp.asarray(block.trace))
+                # generic detector families: host-side stats on the
+                # already-host-resident block (one numpy pass)
+                stats = (
+                    health_ops.host_health_stats(block.trace, clip_abs=clip)
+                    if with_health else {}
+                )
+                # the contract is a result with .picks {name: (2, n)};
+                # thresholds are optional metadata (the eval adapters
+                # for spectro/gabor don't expose them)
+                thresholds = getattr(result, "thresholds", None) or {
+                    name: float("nan") for name in result.picks
+                }
+                return result.picks, thresholds, stats
+
+            try:
+                # the dispatch watchdog bounds the program launch + fetch
+                picks, thresholds, stats = faults.call_with_deadline(
+                    dispatch, dispatch_deadline_s, path
+                )
+                break
+            except Exception as exc:  # noqa: BLE001 — ladder absorbs resource
+                if (use_ladder
+                        and faults.classify_failure(exc) == "resource"
+                        and ladder.downshift(_BUCKET, rung, exc,
+                                             np.asarray(block.trace).shape)):
+                    recovered = True
+                    continue
+                raise
+        if recovered:
+            rz.tally("oom_recoveries")
         rz.check_health(path, stats)            # -> quarantine on breach
         if fault_plan is not None:
             fault_plan.detect_succeeded()
-        # any detector family works: the contract is a result with
-        # .picks {name: (2, n)}; thresholds are optional metadata
-        # (the eval adapters for spectro/gabor don't expose them)
-        thresholds = getattr(result, "thresholds", None) or {
-            name: float("nan") for name in result.picks
-        }
         rec = FileRecord(
             path=path, status="done",
-            n_picks={k: int(v.shape[1]) for k, v in result.picks.items()},
+            n_picks={k: int(v.shape[1]) for k, v in picks.items()},
             wall_s=round(time.perf_counter() - t0, 3),
-            picks_file=_save_picks(outdir, path, result.picks, thresholds),
+            picks_file=_save_picks(outdir, path, picks, thresholds),
             attempts=rz.state.n_attempts(path), health=dict(stats or {}),
         )
         # manifest BEFORE the in-memory record: this block is retried,
@@ -458,6 +694,7 @@ def run_campaign(
                 break
             i += 1
         del stream
+    rz.flush_tallies()
     return CampaignResult(outdir=outdir, records=records)
 
 
@@ -481,6 +718,8 @@ def run_campaign_batched(
     retry=None,
     health=True,
     read_deadline_s: float | None = None,
+    dispatch_deadline_s: float | None = None,
+    preflight: bool | None = None,
     fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
@@ -520,13 +759,42 @@ def run_campaign_batched(
     (docs/ROBUSTNESS.md). Health stats are fused per file into the
     batched program (``ops.health``) and breaching files are
     ``quarantined``.
+
+    Resource exhaustion rides the ELASTIC DOWNSHIFT LADDER
+    (docs/ROBUSTNESS.md "Resource ladder"): a resource-class device
+    failure (XLA ``RESOURCE_EXHAUSTED``) retries the slab at
+    B -> B/2 -> ... -> 1 (sub-slabs rebuilt from the assembler's host
+    blocks — ``io.stream.subdivide_slab``), then the per-file
+    one-program route, the channel-tiled route, the time-sharded route
+    (multi-device meshes whose shape divides), and finally the host CPU
+    backend. The winning rung is STICKY per bucket for the rest of the
+    campaign (one ``downshift`` ledger event per move in the manifest,
+    no per-file thrash) and per-file picks are bit-identical at every
+    single-chip rung (the batched program's per-file math IS the
+    unbatched program's). ``dispatch_deadline_s`` arms the dispatch
+    WATCHDOG (None: the ``DAS_DISPATCH_DEADLINE_S`` env default): a
+    wedged dispatch/fetch becomes ``status="timeout"``.
+    ``preflight`` (None: the ``DAS_MEMORY_PREFLIGHT`` env default) runs
+    the AOT memory preflight per bucket (``utils.memory``): each bucket
+    starts at the largest batch whose program fits
+    ``DAS_HBM_BUDGET_GB`` — and shapes that fit at no rung are skipped
+    up front instead of dispatched into a certain OOM.
     """
     import jax.numpy as jnp
 
-    from ..config import enable_persistent_compilation_cache
-    from ..io.stream import SlabReadError, stream_batched_slabs
+    from ..config import (
+        dispatch_deadline_default,
+        enable_persistent_compilation_cache,
+        hbm_budget_bytes,
+        memory_preflight_default,
+    )
+    from ..io.stream import SlabReadError, stream_batched_slabs, subdivide_slab
     from ..parallel.batch import BatchedMatchedFilterDetector, trim_picks
 
+    if dispatch_deadline_s is None:
+        dispatch_deadline_s = dispatch_deadline_default()
+    if preflight is None:
+        preflight = memory_preflight_default()
     if persistent_cache:
         enable_persistent_compilation_cache(
             persistent_cache if isinstance(persistent_cache, str) else None
@@ -540,42 +808,165 @@ def run_campaign_batched(
     fail = rz.fail
     with_health = rz.health_cfg is not None
     clip = rz.health_cfg.clip_abs if with_health else None
+    ladder = _DownshiftLadder(rz, outdir, batch=batch)
 
     dets: Dict[tuple, BatchedMatchedFilterDetector] = {}
+    skip_buckets: Dict[tuple, str] = {}   # preflight: nothing fits
+
+    def _bucket_key(slab) -> tuple:
+        return (slab.stack.shape[1], slab.bucket_ns,
+                np.dtype(np.asarray(slab.blocks[0].trace).dtype).name)
+
+    def preflight_bucket(key, bdet, slab) -> None:
+        """AOT memory preflight (utils.memory): start this bucket at the
+        largest (bucket, B) whose program fits DAS_HBM_BUDGET_GB, before
+        its first dispatch — and skip shapes no rung can fit."""
+        from ..utils import memory as memutils
+
+        budget = hbm_budget_bytes()
+        cands, b = [], batch
+        while b >= 1:
+            cands.append(b)
+            b //= 2
+        dt = np.asarray(slab.blocks[0].trace).dtype
+
+        def price(b_):
+            return memutils.batched_program_memory(
+                bdet, b_, dt, with_health=with_health, health_clip=clip
+            )
+
+        best = memutils.max_fitting_batch(price, cands, budget)
+        if best is not None:
+            if best < batch:
+                ladder.pin(
+                    key, ("batched", best) if best > 1 else ("file", 1),
+                    f"preflight: largest fitting batch B={best} under "
+                    f"{budget / 2**30:.2f} GiB",
+                )
+            return
+        # not even B=1 fits the monolithic program: price the tiled one
+        tiled = BatchedMatchedFilterDetector(
+            bdet.det.tiled_view(), donate=False, serial=bdet.serial
+        )
+        tstats = memutils.batched_program_memory(
+            tiled, 1, dt, with_health=with_health, health_clip=clip
+        )
+        if tstats is None or tstats.fits(budget):
+            ladder.pin(key, ("tiled", 1),
+                       "preflight: only the tiled per-file program fits "
+                       f"{budget / 2**30:.2f} GiB")
+            return
+        reason = (
+            f"preflight: no (bucket, B) program shape fits "
+            f"DAS_HBM_BUDGET_GB ({budget / 2**30:.2f} GiB); smallest "
+            f"candidate needs {tstats.peak / 2**30:.2f} GiB — skipped "
+            "before dispatch"
+        )
+        skip_buckets[key] = reason
+        _append_event(outdir, {"event": "preflight_skip",
+                               "bucket": key if isinstance(key, str) else list(key), "reason": reason})
+        log.warning("bucket %s: %s", key, reason)
 
     def detector_for(slab) -> BatchedMatchedFilterDetector:
-        C = slab.stack.shape[1]
-        key = (C, slab.bucket_ns, np.dtype(np.asarray(slab.blocks[0].trace).dtype).name)
+        key = _bucket_key(slab)
         bdet = dets.get(key)
         if bdet is None:
             bdet = BatchedMatchedFilterDetector(
                 MatchedFilterDetector(
                     slab.blocks[0].metadata, selected_channels,
-                    (C, slab.bucket_ns), wire=wire, pick_mode="sparse",
+                    (key[0], slab.bucket_ns), wire=wire, pick_mode="sparse",
                     keep_correlograms=False, **detector_kwargs,
                 ),
                 donate=donate, serial=serial,
             )
             dets[key] = bdet
+            if preflight:
+                preflight_bucket(key, bdet, slab)
         return bdet
 
-    def per_file_fallback(slab, k, det):
-        """The unbatched one-program route on the assembler's host block
+    def dispatched(paths, rung, fn):
+        """One watchdogged device dispatch: the chaos dispatch hook
+        (``FaultPlan.on_dispatch``) fires INSIDE the deadline-bounded
+        callable, exactly like a real wedged/OOMing launch."""
+        def run():
+            if fault_plan is not None:
+                for p in paths:
+                    fault_plan.on_dispatch(p, rung)
+            return fn()
+
+        return faults.call_with_deadline(
+            run, dispatch_deadline_s, paths[0] if paths else "<slab>"
+        )
+
+    def per_file_fallback(slab, k, det, rung=("file", 1)):
+        """The unbatched per-file route on the assembler's host block
         (the device slab may already be donated — never touch it here):
         the packed-overflow exact path AND the degradation ladder's
-        second rung."""
+        second rung. ``rung`` honors a stickier ladder placement (a
+        bucket already downshifted to tiled/host retries there, not at
+        a rung known to OOM)."""
         tr = np.asarray(slab.blocks[k].trace)
         padded = np.zeros((tr.shape[0], slab.bucket_ns), tr.dtype)
         padded[:, : tr.shape[1]] = tr
-        res = det.detect_picks(
-            jnp.asarray(padded), n_real=slab.n_real[k],
-            with_health=with_health, health_clip=clip,
-        )
-        return res.picks, res.thresholds, res.health
+
+        def fn():
+            return _detect_file_at_rung(
+                det, rung, padded, n_real=slab.n_real[k],
+                with_health=with_health, clip=clip,
+            )
+
+        return dispatched([slab.paths[k]], rung, fn)
+
+    def run_rung(slab, rung, bdet, ok):
+        """The whole slab's entries at one ladder rung — aligned with
+        ``range(slab.n_valid)``; raises on the rung's failure (resource
+        -> the caller downshifts)."""
+        det = bdet.det
+        stage, b = rung
+        if stage == "batched":
+            if b >= batch:
+                subs = [slab]
+            else:
+                # re-bucket from the assembler's HOST blocks: the device
+                # stack may be donated/unfit, and sub-slabs at B' reuse
+                # the existing per-(bucket, B') compiled programs
+                subs = subdivide_slab(slab, b)
+            entries = []
+            for sub in subs:
+                def fn(sub=sub):
+                    return bdet.detect_batch(
+                        sub.stack, n_real=sub.n_real, n_valid=sub.n_valid,
+                        with_health=with_health, health_clip=clip,
+                    )
+                entries.extend(
+                    dispatched(list(sub.paths), rung, fn)[: sub.n_valid]
+                )
+            return entries
+        entries = []
+        for k in range(slab.n_valid):
+            if not ok[k]:
+                entries.append(None)   # dispositioned by the scale guard
+                continue
+            tr = np.asarray(slab.blocks[k].trace)
+            padded = np.zeros((tr.shape[0], slab.bucket_ns), tr.dtype)
+            padded[:, : tr.shape[1]] = tr
+
+            def fn(padded=padded, k=k):
+                return _detect_file_at_rung(
+                    det, rung, padded, n_real=slab.n_real[k],
+                    with_health=with_health, clip=clip,
+                )
+            entries.append(dispatched([slab.paths[k]], rung, fn))
+        return entries
 
     def handle_slab(slab) -> None:
         bdet = detector_for(slab)
         det = bdet.det
+        key = _bucket_key(slab)
+        if key in skip_buckets:
+            for k in range(slab.n_valid):
+                fail(slab.paths[k], RuntimeError(skip_buckets[key]))
+            return
         ok = []
         for k in range(slab.n_valid):
             meta_k = slab.blocks[k].metadata
@@ -594,6 +985,7 @@ def run_campaign_batched(
                 ok.append(True)
         t0 = time.perf_counter()
         degraded = False
+        recovered = False
         results = None
         try:
             if fault_plan is not None:
@@ -612,15 +1004,28 @@ def run_campaign_batched(
                         except Exception:
                             rz.attempt(slab.paths[k])
                             raise
-            results = bdet.detect_batch(
-                slab.stack, n_real=slab.n_real, n_valid=slab.n_valid,
-                with_health=with_health, health_clip=clip,
-            )
+            rung = ladder.current(key)
+            shape = (int(slab.stack.shape[1]), slab.bucket_ns)
+            while True:   # the elastic ladder: downshift on resource
+                try:
+                    results = run_rung(slab, rung, bdet, ok)
+                    break
+                except Exception as exc:  # noqa: BLE001
+                    fclass = faults.classify_failure(exc)
+                    if fclass == "fatal":
+                        raise
+                    if fclass == "resource":
+                        nxt = ladder.downshift(key, rung, exc, shape)
+                        if nxt is not None:
+                            rung = nxt
+                            recovered = True
+                            continue
+                    raise   # non-resource / exhausted: degrade per-file
         except Exception as exc:  # noqa: BLE001 — degradation ladder
             if faults.classify_failure(exc) == "fatal":
                 raise
-            # rung 2 of the ladder: a whole-slab device failure retries
-            # the slab's files through the unbatched one-program route
+            # the PR 4 rung: a whole-slab device failure retries the
+            # slab's files through the unbatched one-program route
             # before failing ANY of them — one poisoned file costs one
             # file, not a slab
             faults.count("degradations")
@@ -631,11 +1036,18 @@ def run_campaign_batched(
             )
             degraded = True
         wall = time.perf_counter() - t0
+        shape = (int(slab.stack.shape[1]), slab.bucket_ns)
         for k in range(slab.n_valid):
             if not ok[k]:
                 continue  # its slot computed with the wrong scale: discard
             path = slab.paths[k]
             use_fallback = degraded or results[k] is None
+            # the fallback honors the bucket's sticky ladder placement:
+            # never below the per-file rung, never above a rung the
+            # campaign already saw OOM
+            pf_rung = max(("file", 1), ladder.current(key),
+                          key=faults.rung_rank)
+            file_recovered = recovered
             while True:
                 rz.attempt(path)
                 try:
@@ -644,12 +1056,13 @@ def run_campaign_batched(
                             fault_plan.on_transfer(path)
                             fault_plan.on_detect(path)
                         picks, thresholds, stats = per_file_fallback(
-                            slab, k, det
+                            slab, k, det, rung=pf_rung
                         )
                     else:
                         entry = results[k]
                         picks, thresholds = entry[0], entry[1]
-                        stats = entry[2] if with_health else {}
+                        stats = (entry[2] if with_health
+                                 and len(entry) > 2 else {})
                     rz.check_health(path, stats)  # -> quarantine on breach
                     picks = trim_picks(picks, slab.n_real[k])
                     if fault_plan is not None:
@@ -660,7 +1073,20 @@ def run_campaign_batched(
                         attempts=rz.state.n_attempts(path),
                         health=dict(stats or {}),
                     )
+                    if file_recovered:
+                        rz.tally("oom_recoveries")
                 except Exception as exc:  # noqa: BLE001 — per-file isolation
+                    if (use_fallback
+                            and faults.classify_failure(exc) == "resource"):
+                        # resource exhaustion in the fallback too: keep
+                        # descending the ladder (a route change, not a
+                        # retry — refund the attempt)
+                        nxt = ladder.downshift(key, pf_rung, exc, shape)
+                        if nxt is not None:
+                            rz.state.unattempt(path)
+                            pf_rung = nxt
+                            file_recovered = True
+                            continue
                     if rz.dispose(path, exc) == "retry":
                         # rerunning the already-fetched batch entry would
                         # fail identically — retries go through the
@@ -710,6 +1136,7 @@ def run_campaign_batched(
                 i = i + exc.index + 1
             continue
         i = len(pending)
+    rz.flush_tallies()
     return CampaignResult(outdir=outdir, records=records)
 
 
@@ -863,6 +1290,7 @@ def run_campaign_sharded(
     fused_bandpass: bool = True,
     wire: str = "conditioned",
     retry=None,
+    elastic: bool = True,
 ) -> CampaignResult:
     """Multi-chip campaign: file batches land pre-sharded on the mesh and
     the whole batch detects in ONE program (data-parallel over files,
@@ -887,6 +1315,16 @@ def run_campaign_sharded(
     transient-retry contract at the probe boundary — the sharded step
     itself runs lockstep collectives, so per-file mid-step retry is
     structurally impossible here (docs/ROBUSTNESS.md).
+
+    ``elastic=True`` adds ELASTIC SHARD RECOVERY: when a step fails
+    non-fatally mid-campaign (a chip lost or wedged — XLA surfaces that
+    as a runtime error on the next dispatch), the campaign probes the
+    mesh's devices (:func:`_probe_healthy_devices`), rebuilds the mesh
+    on the largest surviving device count that still divides the channel
+    axis, recompiles the step pair there, and re-runs ONLY the in-flight
+    batch — settled files are never re-processed. Each rebuild lands in
+    the manifest as a ``mesh_downshift`` event (docs/ROBUSTNESS.md
+    "Resource ladder").
     """
     import types
 
@@ -950,12 +1388,8 @@ def run_campaign_sharded(
 
     factors = {name: (hf_factor if i == 0 else 1.0)
                for i, name in enumerate(design.template_names)}
-    consumed = 0  # batches cover `healthy` strictly in order
-    for stack, blocks in stream_file_batches(
-        healthy, selected_channels, healthy_metas, batch=batch, mesh=mesh,
-        interrogator=interrogator, prefetch=prefetch, engine=engine, tail="pad",
-        wire=wire,
-    ):
+
+    def process_batch(stack, blocks, step_k0, step_full, consumed):
         t0 = time.perf_counter()
         sp_picks, thres = jax.block_until_ready(step_k0(stack))
         if int(np.asarray(jnp.sum(sp_picks.saturated))):
@@ -985,8 +1419,14 @@ def run_campaign_sharded(
                 positions=np.asarray(sp_picks.positions),
                 selected=np.asarray(sp_picks.selected),
             )
+        # an elastic re-run replays the whole in-flight batch: files the
+        # aborted first pass already recorded must not gain a duplicate
+        # done record (and artifact) here
+        recorded = {r.path for r in records}
         for k, _block in enumerate(blocks):
             path = healthy[consumed + k]
+            if path in recorded:
+                continue
             if host_picks is None:
                 picks = {
                     name: np.asarray([rows_np[i, k, : cnt[i, k]],
@@ -1002,8 +1442,122 @@ def run_campaign_sharded(
                           for name in design.template_names}
             _file_record(outdir, path, picks, thresholds,
                          round(wall / max(len(blocks), 1), 3), records)
-        consumed += len(blocks)
+
+    consumed = 0  # batches cover `healthy` strictly in order
+    rebuilds = 0
+    while consumed < len(healthy):
+        # one stream per mesh incarnation: after an elastic rebuild the
+        # remaining (unsettled) files re-stream placed for the NEW mesh
+        stream = stream_file_batches(
+            healthy[consumed:], selected_channels, healthy_metas[consumed:],
+            batch=batch, mesh=mesh, interrogator=interrogator,
+            prefetch=prefetch, engine=engine, tail="pad", wire=wire,
+        )
+        rebuilt = False
+        for stack, blocks in stream:
+            try:
+                process_batch(stack, blocks, step_k0, step_full, consumed)
+            except Exception as exc:  # noqa: BLE001 — elastic recovery
+                if not elastic or faults.classify_failure(exc) == "fatal":
+                    raise
+                if rebuilds >= _MAX_MESH_REBUILDS:
+                    log.error("elastic recovery exhausted after %d mesh "
+                              "rebuilds", rebuilds)
+                    raise
+                rebuilds += 1
+                mesh = _rebuild_mesh_after_device_loss(
+                    mesh, design.trace_shape[0], exc, outdir
+                )
+                step_k0, step_full = _adaptive_sharded_steps(
+                    make_sharded_mf_step, design, mesh,
+                    relative_threshold=relative_threshold,
+                    hf_factor=hf_factor, fused_bandpass=fused_bandpass,
+                    **wire_kw,
+                )
+                rebuilt = True
+                del stream  # only the in-flight batch re-runs
+                break
+            consumed += len(blocks)
+        if not rebuilt:
+            break
+    rz.flush_tallies()
     return CampaignResult(outdir=outdir, records=records)
+
+
+#: elastic shard recovery gives up after this many mesh rebuilds in one
+#: campaign — a failure that survives repeated shrinking is not a lost
+#: chip, and re-probing forever would mask it
+_MAX_MESH_REBUILDS = 4
+
+
+#: per-device wall bound on the survivor probe: a WEDGED chip often
+#: neither fails nor answers — without a deadline the probe itself would
+#: stall the recovery it exists to enable (the dispatch-watchdog lesson)
+_DEVICE_PROBE_DEADLINE_S = 30.0
+
+
+def _probe_healthy_devices(devices) -> list:
+    """The devices in ``devices`` that still answer a trivial transfer +
+    compute round trip within :data:`_DEVICE_PROBE_DEADLINE_S` — the
+    elastic campaign's survivor probe. A lost chip raises and a wedged
+    one times out here instead of inside the next lockstep step (the
+    probe worker is abandoned, ``faults.call_with_deadline``).
+    Module-level and deliberately simple so tests (and operators) can
+    monkeypatch the survivor policy."""
+    import jax
+
+    def probe(d):
+        x = jax.device_put(np.ones((8,), np.float32), d)
+        return float(np.asarray(x.sum())) == 8.0
+
+    ok = []
+    for d in devices:
+        try:
+            if faults.call_with_deadline(
+                lambda d=d: probe(d), _DEVICE_PROBE_DEADLINE_S, str(d)
+            ):
+                ok.append(d)
+        except Exception:  # noqa: BLE001 — dead/wedged chip: excluded
+            continue
+    return ok
+
+
+def _rebuild_mesh_after_device_loss(mesh, n_channels: int, exc, outdir):
+    """Rebuild the campaign mesh on the surviving devices after a step
+    failure: probe the old mesh's devices, keep the largest count that
+    divides the channel axis (the sharded step's layout constraint), and
+    ledger the move as a ``mesh_downshift`` manifest event. Raises the
+    original ``exc`` when no survivor configuration exists."""
+    from ..parallel.mesh import make_mesh
+
+    old = list(np.asarray(mesh.devices).ravel())
+    ok = _probe_healthy_devices(old)
+    if len(ok) == len(old):
+        # every device answers: the failure was NOT device loss (a
+        # deterministic program/data error would fail identically on a
+        # rebuilt same-size mesh — at the cost of recompiling both
+        # steps, _MAX_MESH_REBUILDS times). Surface it instead.
+        log.error("all %d mesh devices probe healthy; step failure is "
+                  "not device loss — re-raising", len(old))
+        raise exc
+    n = 0
+    for cand in range(len(ok), 0, -1):
+        if n_channels % cand == 0:
+            n = cand
+            break
+    if n < 1:
+        log.error("no surviving device configuration divides the channel "
+                  "axis (%d survivors of %d)", len(ok), len(old))
+        raise exc
+    new_mesh = make_mesh(shape=(1, n), axis_names=tuple(mesh.axis_names),
+                         devices=ok[:n])
+    _append_event(outdir, {
+        "event": "mesh_downshift", "from_devices": len(old),
+        "to_devices": n, "error": f"{type(exc).__name__}: {exc}",
+    })
+    log.warning("elastic recovery: mesh rebuilt on %d/%d devices after "
+                "%s: %s", n, len(old), type(exc).__name__, exc)
+    return new_mesh
 
 
 def run_campaign_multiprocess(
@@ -1232,6 +1786,16 @@ def summarize_campaign(outdir: str) -> dict:
                 recs.append(json.loads(line))
             except json.JSONDecodeError:
                 continue
+    # non-file EVENT records (no "path"): the downshift ledger, elastic
+    # mesh rebuilds and the end-of-run resilience counters (_append_event)
+    events = [r for r in recs if "path" not in r and "event" in r]
+    downshift_events = [e for e in events if e["event"] == "downshift"]
+    mesh_events = [e for e in events if e["event"] == "mesh_downshift"]
+    counters = {"downshifts": 0, "oom_recoveries": 0, "watchdog_timeouts": 0}
+    for e in events:
+        if e["event"] == "counters":
+            for k in counters:
+                counters[k] += int(e.get(k, 0))
     # keep only each path's LAST record: resume runs and retried files
     # append fresh records (a file that failed, then succeeded on a
     # later attempt, counts ONCE — as done), so nothing is double-counted
@@ -1263,6 +1827,14 @@ def summarize_campaign(outdir: str) -> dict:
         "n_quarantined": len(quarantined),
         "n_timeout": len(timeout),
         "total_attempts": sum(int(r.get("attempts", 1)) for r in latest.values()),
+        # resource-resilience ledger (zeros / empty on a healthy run):
+        # sticky downshift moves, files recovered by the elastic ladder,
+        # dispatch-watchdog timeouts, elastic mesh rebuilds
+        "downshifts": counters["downshifts"],
+        "oom_recoveries": counters["oom_recoveries"],
+        "watchdog_timeouts": counters["watchdog_timeouts"],
+        "downshift_ledger": downshift_events,
+        "mesh_downshifts": mesh_events,
         "failed_paths": [r["path"] for r in failed],
         "quarantined_paths": [r["path"] for r in quarantined],
         "timeout_paths": [r["path"] for r in timeout],

@@ -30,6 +30,7 @@ from das4whales_tpu.io.stream import (
     SlabReadError,
     stream_batched_slabs,
     stream_strain_blocks,
+    subdivide_slab,
 )
 from das4whales_tpu.io.synth import (
     SyntheticCall,
@@ -235,6 +236,40 @@ def test_assembler_trailing_partial_batch(tmp_path):
     assert tail.stack.shape == (2, NX, NS)       # fixed program shape
     assert not np.asarray(tail.stack[1]).any()   # padded slot is zeros
     assert tail.index0 == 4 and tail.paths == (paths[4],)
+
+
+def test_subdivide_slab_rebuilds_rungs_from_host_blocks(tmp_path):
+    """The downshift ladder's re-bucketing primitive: sub-slabs at B/2
+    and 1 preserve file order, paths, n_real and bucket shape, allocate
+    the FULL rung batch (one program per (bucket, B') shape), and their
+    stacks equal the original slab's rows."""
+    paths = _write_files(tmp_path, [900, 700, 800, 600])
+    (slab,) = stream_batched_slabs(
+        paths, SEL, batch=4, bucket="pow2", as_numpy=True,
+    )
+    assert slab.n_valid == 4
+    for b in (2, 1):
+        subs = subdivide_slab(slab, b)
+        assert [s.n_valid for s in subs] == [b] * (4 // b)
+        assert [p for s in subs for p in s.paths] == paths
+        assert [n for s in subs for n in s.n_real] == list(slab.n_real)
+        off = 0
+        for s in subs:
+            assert s.bucket_ns == slab.bucket_ns
+            assert s.stack.shape == (b, NX, slab.bucket_ns)  # full rung B
+            assert s.index0 == slab.index0 + off
+            np.testing.assert_array_equal(
+                np.asarray(s.stack)[: s.n_valid],
+                np.asarray(slab.stack)[off : off + s.n_valid],
+            )
+            off += s.n_valid
+    # a partial sub-slab pads its trailing slots with zeros
+    subs3 = subdivide_slab(slab, 3)
+    assert [s.n_valid for s in subs3] == [3, 1]
+    assert subs3[1].stack.shape[0] == 3
+    assert not np.asarray(subs3[1].stack)[1:].any()
+    with pytest.raises(ValueError):
+        subdivide_slab(slab, 0)
 
 
 def test_assembler_midbatch_failure_attribution(tmp_path):

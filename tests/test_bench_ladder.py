@@ -85,6 +85,11 @@ def test_full_shape_headline_when_everything_succeeds(monkeypatch):
     assert p["shape"] == [22050, 12000]
     assert "error" not in p
     assert p["pick_engine"] == "sparse"
+    # structured reachability + resource-resilience counters (zeros on a
+    # healthy run) ride next to the headline
+    assert p["accelerator_unreachable"] is False
+    for key in ("downshifts", "oom_recoveries", "watchdog_timeouts"):
+        assert p[key] == 0
     # vs_baseline uses the recorded SAME-SHAPE CPU measurement (226.2 s
     # golden, VALIDATION.md; VERDICT r4 next-3), and the redundant subset
     # extrapolation run is SKIPPED so a live tunnel window never idles
@@ -265,6 +270,9 @@ def test_probe_failure_replays_banked_tpu_line(monkeypatch, tmp_path):
     assert list(p)[:7] == ["metric", "value", "unit", "vs_baseline",
                            "banked", "banked_age_h", "stale_commit"]
     assert p["stale_commit"] is False            # no banked_commit recorded
+    # structured twin of the device-string suffix: downstream parsing
+    # must never regex the prose for reachability
+    assert p["accelerator_unreachable"] is True
     assert "banked" in p["device"] and "unreachable at report time" in p["device"]
     # the annotation must not overclaim provenance (the bank survives
     # across sessions inside the age cap)

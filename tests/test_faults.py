@@ -42,10 +42,136 @@ from das4whales_tpu.ops import health as health_ops
         (faults.InjectedTransferError("injected"), "transient"),
         (faults.InjectedDetectorError("injected"), "transient"),
         (faults.InjectedCrash("injected"), "fatal"),
+        (faults.InjectedResourceExhausted("injected: RESOURCE_EXHAUSTED"),
+         "resource"),
     ],
 )
 def test_classify_failure(exc, expected):
     assert faults.classify_failure(exc) == expected
+
+
+#: jaxlib's device-OOM message shapes — these used to land in `corrupt`
+#: and burn the file with no downshift (ISSUE 5 satellite)
+_XLA_OOM_TEXTS = (
+    "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+    "14680064000 bytes.",
+    "Resource exhausted: Failed to allocate request for 13.67GiB "
+    "(14680064000B) on device ordinal 0",
+    "Allocation failure: current bytes allocated exceeds HBM capacity",
+    "XLA:TPU compile permanent error. RESOURCE_EXHAUSTED: Attempting to "
+    "reserve 12.34G at the bottom of memory.",
+)
+
+
+@pytest.mark.parametrize("text", _XLA_OOM_TEXTS)
+def test_classify_xla_oom_is_resource(text):
+    # jaxlib raises XlaRuntimeError (a RuntimeError subclass whose module
+    # moves across versions) — both the subclass and a bare RuntimeError
+    # carrying the message classify `resource`
+    assert faults.classify_failure(RuntimeError(text)) == "resource"
+    XlaRuntimeError = type("XlaRuntimeError", (Exception,), {})
+    assert faults.classify_failure(XlaRuntimeError(text)) == "resource"
+
+
+def test_classify_resource_needs_marker_not_just_runtime_error():
+    # plain runtime failures must stay `corrupt` (never retried/downshifted)
+    assert faults.classify_failure(RuntimeError("device program failed")) == "corrupt"
+    exc = RuntimeError("custom")
+    exc.fault_class = "resource"
+    assert faults.classify_failure(exc) == "resource"
+
+
+# ---------------------------------------------------------------------------
+# Downshift rungs + dispatch faults (the resource ladder's vocabulary)
+# ---------------------------------------------------------------------------
+
+
+def test_rung_rank_orders_the_ladder():
+    ladder = [("batched", 8), ("batched", 4), ("batched", 2), ("file", 1),
+              ("tiled", 1), ("timeshard", 1), ("host", 1)]
+    ranked = sorted(ladder[::-1], key=faults.rung_rank)
+    assert ranked == ladder
+    assert faults.rung_label(("batched", 4)) == "batched:4"
+    assert faults.rung_label(("tiled", 1)) == "tiled"
+
+
+def test_oom_fault_fires_above_ok_rung_only():
+    plan = faults.FaultPlan(0, rate=1.0, kinds=("oom",))
+    path = "/x/f.h5"
+    spec = plan.spec_for(path)
+    assert spec.kind == "oom" and spec.site == "dispatch"
+    assert spec.ok_rung in (("file", 1), ("tiled", 1))
+    hungrier = [r for r in [("batched", 8), ("batched", 2), ("file", 1)]
+                if faults.rung_rank(r) < faults.rung_rank(spec.ok_rung)]
+    for rung in hungrier:
+        with pytest.raises(faults.InjectedResourceExhausted):
+            plan.on_dispatch(path, rung)
+    # at and below ok_rung: fits — and it NEVER spends (condition-based,
+    # deterministic however the campaign slices slabs)
+    for _ in range(3):
+        plan.on_dispatch(path, spec.ok_rung)
+        plan.on_dispatch(path, ("host", 1))
+    with pytest.raises(faults.InjectedResourceExhausted):
+        plan.on_dispatch(path, ("batched", 8))
+
+
+def test_hang_dispatch_sleeps_and_watchdog_classifies_timeout():
+    import time
+
+    plan = faults.FaultPlan(0, rate=1.0, kinds=("hang_dispatch",),
+                            hang_s=0.6)
+    path = "/x/g.h5"
+    assert plan.spec_for(path).site == "dispatch"
+    t0 = time.perf_counter()
+    with pytest.raises(faults.DispatchDeadlineExceeded) as ei:
+        faults.call_with_deadline(
+            lambda: plan.on_dispatch(path), 0.15, path
+        )
+    assert time.perf_counter() - t0 < 0.6       # abandoned, not awaited
+    # the watchdog's violation IS a deadline (timeout disposition), and
+    # distinguishable from the reader deadline for triage
+    assert isinstance(ei.value, faults.DeadlineExceeded)
+    assert ei.value.stage == "dispatch"
+
+
+def test_call_with_deadline_passthrough_and_own_timeout():
+    assert faults.call_with_deadline(lambda: 42, 0.5, "p") == 42
+    assert faults.call_with_deadline(lambda: 42, None, "p") == 42
+
+    def boom():
+        raise TimeoutError("the fn's OWN timeout (e.g. ETIMEDOUT)")
+
+    # fn's own TimeoutError re-raises unchanged — it is the file's
+    # transient-class failure, not a watchdog violation
+    with pytest.raises(TimeoutError) as ei:
+        faults.call_with_deadline(boom, 5.0, "p")
+    assert not isinstance(ei.value, faults.DispatchDeadlineExceeded)
+
+    def raise_oom():
+        raise faults.InjectedResourceExhausted("RESOURCE_EXHAUSTED")
+
+    with pytest.raises(faults.InjectedResourceExhausted):
+        faults.call_with_deadline(raise_oom, 5.0, "p")
+
+
+def test_expected_disposition_dispatch_kinds():
+    pol = faults.RetryPolicy(max_attempts=3)
+    oom = faults.FaultPlan(0, rate=1.0, kinds=("oom",))
+    hang = faults.FaultPlan(0, rate=1.0, kinds=("hang_dispatch",))
+    assert oom.expected_disposition("/x/a.h5", pol) == "done"
+    assert hang.expected_disposition("/x/a.h5", pol) == "timeout"
+
+
+def test_unattempt_refunds_without_underflow():
+    st = faults.RetryState(faults.RetryPolicy(max_attempts=2))
+    st.attempt("f")
+    st.attempt("f")
+    st.unattempt("f")
+    assert st.n_attempts("f") == 1
+    assert st.should_retry("f", "transient")
+    st.unattempt("f")
+    st.unattempt("f")                            # never below zero
+    assert st.n_attempts("f") == 0
 
 
 def test_classify_message_markers():
@@ -120,6 +246,10 @@ def test_counters_roundtrip():
     faults.count("quarantined", 2)
     delta = faults.counters_delta(before)
     assert delta["retries"] == 1 and delta["quarantined"] == 2
+    # the resource-resilience counters ship in every snapshot (bench.py
+    # reports them next to retries/degradations — zeros when healthy)
+    for name in ("downshifts", "oom_recoveries", "watchdog_timeouts"):
+        assert name in before
 
 
 # ---------------------------------------------------------------------------

@@ -174,6 +174,122 @@ def test_sharded_step_per_shard_budget(sharded_canonical, outputs, out_cap_gib):
     assert ma.argument_size_in_bytes < 2 * (4 * c8 * N) / 8
 
 
+# ---------------------------------------------------------------------------
+# Batched-program shapes + the AOT memory preflight (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+#: quick-bench-class batched shapes: B x pow2 buckets (the canonical
+#: shape's batched footprint is the canonical single-file program x B in
+#: temps — pricing it here would dominate tier-1 wall for no extra
+#: coverage; the preflight itself prices the REAL campaign shape at run
+#: time, which is the point)
+PF_C = 256
+PF_BUCKETS = (2048, 4096)
+PF_BATCHES = (2, 4)
+
+
+@pytest.fixture(scope="module")
+def preflight_detectors():
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+
+    dets = {}
+    for bucket in PF_BUCKETS:
+        meta = AcquisitionMetadata(fs=200.0, dx=2.042, nx=PF_C, ns=bucket)
+        dets[bucket] = BatchedMatchedFilterDetector(
+            MatchedFilterDetector(meta, [0, PF_C, 1], (PF_C, bucket),
+                                  pick_mode="sparse",
+                                  keep_correlograms=False),
+            serial=True,
+        )
+    return dets
+
+
+@pytest.fixture(scope="module")
+def preflight_stats(preflight_detectors):
+    from das4whales_tpu.utils import memory as memutils
+
+    stats = {}
+    for bucket, bdet in preflight_detectors.items():
+        for b in (1,) + PF_BATCHES:
+            stats[(bucket, b)] = memutils.batched_program_memory(
+                bdet, b, np.float32, with_health=True
+            )
+    assert all(s is not None for s in stats.values()), (
+        "memory_analysis() unsupported on this backend — the preflight "
+        "would run ungated"
+    )
+    return stats
+
+
+def test_batched_program_memory_scales_with_batch(preflight_stats):
+    """The preflight's AOT estimates must order by batch within a bucket
+    — more files per program step cost more device memory — or the
+    largest-fitting-B search would be meaningless. (Cross-BUCKET
+    ordering is deliberately not asserted: CPU buffer assignment reuses
+    temps aggressively enough that a longer bucket can price below a
+    shorter one at B=1 — the module-docstring lower-bound caveat.)"""
+    for bucket in PF_BUCKETS:
+        peaks = [preflight_stats[(bucket, b)].peak for b in (1,) + PF_BATCHES]
+        assert peaks == sorted(peaks) and peaks[0] < peaks[-1], (bucket, peaks)
+        # program outputs are exactly per-file payloads x B
+        outs = {b: preflight_stats[(bucket, b)].output_bytes
+                for b in (1,) + PF_BATCHES}
+        for b in PF_BATCHES:
+            assert outs[b] == pytest.approx(b * outs[1], rel=0.01)
+
+
+def test_preflight_chooser_matches_budget_bracketing(preflight_stats):
+    """max_fitting_batch picks exactly the batch a brute-force comparison
+    against the budget picks, for budgets bracketing every candidate."""
+    from das4whales_tpu.utils import memory as memutils
+
+    for bucket in PF_BUCKETS:
+        peaks = {b: preflight_stats[(bucket, b)].peak
+                 for b in (1,) + PF_BATCHES}
+
+        def price(b, peaks=peaks, bucket=bucket):
+            return preflight_stats[(bucket, b)]
+
+        cands = sorted(peaks)
+        for budget in [peaks[1] - 1] + [peaks[b] + 1 for b in cands]:
+            want = max((b for b in cands if peaks[b] < budget), default=None)
+            got = memutils.max_fitting_batch(price, cands, budget)
+            assert got == want, (bucket, budget, got, want)
+
+
+def test_preflight_gates_against_the_router_budget(preflight_stats):
+    """One budget, two consumers: the preflight compares against
+    config.hbm_budget_bytes() — the SAME resolver the detector's
+    monolithic-vs-tiled router reads — so a shape the router would
+    accept can never be preflight-skipped (and vice versa)."""
+    from das4whales_tpu.config import hbm_budget_bytes
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    assert hbm_budget_bytes() == 8 * 2**30          # the shipped default
+    det = MatchedFilterDetector(
+        {"fs": 200.0, "dx": 2.042, "nx": PF_C, "ns": PF_BUCKETS[0],
+         "n": 1.4681, "GL": 51.0, "scale_factor": 1.0},
+        [0, PF_C, 1], (PF_C, PF_BUCKETS[0]),
+    )
+    assert det.hbm_budget_bytes == hbm_budget_bytes()
+    # the quick-class batched shapes all fit the default budget — the
+    # shipped configuration never preflight-skips them
+    assert all(s.peak < hbm_budget_bytes()
+               for s in preflight_stats.values())
+
+
+def test_unattempted_unsupported_pricing_means_no_gate():
+    """A backend whose memory_analysis() is unsupported must NOT gate:
+    max_fitting_batch treats unpriceable candidates as fitting (the
+    downshift ladder still protects the run at dispatch time)."""
+    from das4whales_tpu.utils import memory as memutils
+
+    assert memutils.max_fitting_batch(lambda b: None, [4, 2, 1], 1) == 4
+    assert memutils.aot_memory_stats(object()) is None
+
+
 def test_spectro_chunk_rfft_footprint(monkeypatch):
     """The spectro detector's per-chunk program under the rFFT engine must
     stay under ~2.5 GiB of temps at the shipped rFFT default batch — the
