@@ -367,10 +367,19 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
 
     run()  # compile (design reuse means this cost amortizes across files)
     times = []
+    # dispatch-wall attribution (ISSUE 6): count device program launches
+    # and blocking fetches taken INSIDE the measured segment, so the
+    # dispatch/sync wall is a regression-gated number next to
+    # stage_wall_s, not an inference from rooflines. Healthy one-program
+    # route: exactly 1 dispatch + 1 sync per file (an adaptive-K
+    # escalation adds one pair; the staged route reports zeros — its
+    # syncs are uncounted block_until_ready, which is itself the finding)
+    seg_before = faults.counters()
     for _ in range(repeats):
         t0 = time.perf_counter()
         res = run()
         times.append(time.perf_counter() - t0)
+    seg = faults.counters_delta(seg_before)
     n_picks = sum(int(v.shape[1]) for v in res.picks.values())
     stages = bench_stages(det, x, repeats=repeats) if with_stages else {}
     # h2d rides in the stage table even on no-stage rungs: the acceptance
@@ -388,7 +397,11 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
     if wire == "raw":
         route += "+rawwire"
     wire_info = {"wire": wire, "wire_bytes": int(block.nbytes),
-                 "wire_dtype": str(block.dtype)}
+                 "wire_dtype": str(block.dtype),
+                 # per-FILE (per measured call) dispatch/sync counts for
+                 # the single-file segment
+                 "n_dispatches": round(seg.get("dispatches", 0) / repeats, 2),
+                 "n_syncs": round(seg.get("syncs", 0) / repeats, 2)}
     batch_info = _bench_batch(meta, nx, ns, block, wire, peak_block,
                               channel_tile, repeats)
     delta = faults.counters_delta(resilience_before)
@@ -438,21 +451,28 @@ def _bench_batch(meta, nx, ns, block, wire, peak_block, channel_tile,
     )
     bdet = BatchedMatchedFilterDetector(det, donate=False)  # stack reused
 
+    from das4whales_tpu import faults as _faults
+
     def best(fn):
         fn()  # compile + warm
         walls = []
+        before = _faults.counters()
         for _ in range(repeats):
             t0 = time.perf_counter()
             fn()  # one-program routes return host picks: the fetch IS the sync
             walls.append(time.perf_counter() - t0)
-        return min(walls)
+        delta = _faults.counters_delta(before)
+        # per measured call: the batched segment's dispatch/sync budget
+        # (healthy: 1 dispatch + 1 sync per SLAB, however many files ride it)
+        return min(walls), (round(delta.get("dispatches", 0) / repeats, 2),
+                            round(delta.get("syncs", 0) / repeats, 2))
 
     x1 = jax.block_until_ready(jnp.asarray(block))
-    single = best(lambda: det.detect_picks(x1))
+    single, _ = best(lambda: det.detect_picks(x1))
     stack = jax.block_until_ready(
         jnp.asarray(np.broadcast_to(block, (b,) + block.shape))
     )
-    bwall = best(lambda: bdet.detect_batch(stack))
+    bwall, (bdisp, bsync) = best(lambda: bdet.detect_batch(stack))
     return {
         "batch": b,
         "batch_wall_s": round(bwall, 4),
@@ -461,6 +481,8 @@ def _bench_batch(meta, nx, ns, block, wire, peak_block, channel_tile,
         "batch_single_file_wall_s": round(single, 4),
         "batch_single_file_value": round(nx * ns / single, 1),
         "batch_amortization": round(single / (bwall / b), 3),
+        "batch_n_dispatches": bdisp,
+        "batch_n_syncs": bsync,
     }
 
 
@@ -1078,6 +1100,13 @@ def main():
             round(cpu_rate_extrapolated, 1) if cpu_rate_extrapolated else None
         ),
         "stage_wall_s": stages,
+        # dispatch-wall attribution (ISSUE 6): device program launches +
+        # blocking fetches PER MEASURED FILE in the headline segment
+        # (faults.counters "dispatches"/"syncs"; healthy one-program
+        # route = 1.0 + 1.0) — the sync wall as a regression-gated
+        # number next to the stage walls it explains
+        "n_dispatches": result.get("n_dispatches"),
+        "n_syncs": result.get("n_syncs"),
         "roofline_pred_ms": roofline_pred,
         "roofline_frac": roofline_frac,
         # every successful rung's wall, so the in-path A/Bs (exact vs
@@ -1090,7 +1119,8 @@ def main():
     # and ch*samples/s/chip ride next to the single-file headline
     for key in ("batch", "batch_wall_s", "batch_per_file_wall_s",
                 "batch_value", "batch_single_file_wall_s",
-                "batch_single_file_value", "batch_amortization"):
+                "batch_single_file_value", "batch_amortization",
+                "batch_n_dispatches", "batch_n_syncs"):
         if key in result:
             payload[key] = result[key]
     if errors:

@@ -36,6 +36,16 @@ R5  donation audit — jitted entry points in ``parallel/`` and
     ``workflows/`` built without ``donate_argnums``/``donate_argnames``.
     Large-buffer steps that cannot donate (parity paths reuse their
     inputs) are recorded in ``analysis/baseline.toml`` with a reason.
+R6  sync-in-loop — HOST-side device syncs inside a ``for``/``while``
+    body in the device-path packages: ``jax.block_until_ready`` /
+    ``jax.device_get`` calls, ``.item()``, and ``np.asarray``/
+    ``np.array`` applied to a freshly computed call result (the
+    tracer-result heuristic host code admits). One of these per
+    iteration serializes the dispatch pipeline — the per-slab sync wall
+    BENCH_r05 measured at 97-99%% chip idle; the pipelined-dispatch
+    layer (``parallel.dispatch``) exists so hot loops never need one.
+    Intentional sites (a drain point, a scalar decision the host must
+    make) are baselined with a reason.
 
 Suppression: an inline ``# daslint: allow[R2]`` (comma list, or
 ``daslint: ignore`` for all rules) on the finding's line or the line above
@@ -51,7 +61,7 @@ import re
 from pathlib import PurePosixPath
 from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
 
 #: (path suffix, function name or "*") pairs where explicit float64 is the
 #: documented host-side design contract (masks and filter coefficients are
@@ -80,6 +90,14 @@ _R3_SCOPE = frozenset({"ops", "parallel", "models"})
 
 #: Path components scoped for the R5 donation audit.
 _R5_SCOPE = frozenset({"parallel", "workflows"})
+
+#: Path components scoped for the R6 sync-in-loop audit (host drivers of
+#: device programs; viz/analysis/eval host-only code is exempt).
+_R6_SCOPE = frozenset({"ops", "parallel", "models", "workflows", "io"})
+
+#: Host calls that synchronize the device stream when applied to an
+#: in-flight array (R6).
+_R6_SYNC_FUNCS = frozenset({"jax.block_until_ready", "jax.device_get"})
 
 _ALLOW_RE = re.compile(r"daslint:\s*(?:allow\[([A-Za-z0-9,\s]+)\]|ignore)")
 
@@ -353,6 +371,7 @@ class _Analyzer(ast.NodeVisitor):
     visit_AsyncFor = visit_For
 
     def visit_Call(self, node: ast.Call):
+        self._check_sync_in_loop(node)
         kws = _jit_call_info(self.imports, node)
         if kws is not None:
             if self._loop_depth and "R2" in self.rules:
@@ -373,6 +392,42 @@ class _Analyzer(ast.NodeVisitor):
         self.generic_visit(node)
 
     # -- rule bodies -------------------------------------------------------
+
+    def _check_sync_in_loop(self, node: ast.Call):
+        """R6: host-side device syncs inside a for/while body. Runs only
+        outside jit bodies (``visit_Call`` never fires inside them — jit
+        bodies go through ``_walk_jit_body``, where R1 owns sync
+        hazards) and only in the R6-scoped packages."""
+        if ("R6" not in self.rules or not self._loop_depth
+                or not _in_scope(self.path, _R6_SCOPE)):
+            return
+        dotted = self.imports.resolve(node.func) or ""
+        if dotted in _R6_SYNC_FUNCS:
+            self._emit("R6", "sync-in-loop", node,
+                       f"`{dotted}` inside a loop body — one device sync "
+                       "per iteration serializes the dispatch pipeline; "
+                       "dispatch the whole loop's work first (parallel."
+                       "dispatch.PipelinedDispatch) and sync once, or "
+                       "baseline this as an intentional drain point")
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "item" and not node.args
+              and not node.keywords):
+            self._emit("R6", "item-in-loop", node,
+                       "`.item()` inside a loop body — a scalar "
+                       "device→host round trip per iteration; fetch the "
+                       "whole array once outside the loop")
+        elif (dotted in ("numpy.asarray", "numpy.array")
+              and any(isinstance(a, ast.Call) for a in node.args)):
+            # tracer-result heuristic: np.asarray over a FRESH call
+            # result in a loop is the classic fetch-per-iteration shape
+            # (np.asarray over an existing host array is free and common)
+            self._emit("R6", "host-transfer-in-loop", node,
+                       f"`{dotted.replace('numpy', 'np', 1)}` over a "
+                       "freshly computed result inside a loop body — if "
+                       "the callee runs on device this is one "
+                       "device→host transfer (and sync) per iteration; "
+                       "batch the computation or fetch once after the "
+                       "loop")
 
     def _check_static_spec(self, keywords, anchor):
         """R2: static_argnums/static_argnames specs that are themselves

@@ -359,6 +359,29 @@ def dispatch_deadline_default() -> float | None:
     return float(raw) if raw else None
 
 
+#: Default depth of the campaign's software-pipelined dispatch queue.
+DEFAULT_DISPATCH_DEPTH = 2
+
+
+def dispatch_depth_default() -> int:
+    """Depth D of the campaigns' software-pipelined dispatch queue
+    (``DAS_DISPATCH_DEPTH`` env; default
+    :data:`DEFAULT_DISPATCH_DEPTH`). Depth D keeps up to D
+    slabs'/files' detection programs IN FLIGHT (dispatched, packed
+    fetch not yet taken), so H2D, compute and the packed fetch of
+    different slabs overlap instead of serializing on a per-slab sync
+    round trip (``parallel.dispatch``; docs/PERF.md "Pipelined
+    dispatch"). ``<= 1`` disables pipelining — the pre-pipeline
+    synchronous dispatch-then-fetch behavior, also the right setting
+    when device memory cannot hold D slabs plus the transfer pipeline's
+    ``in_flight`` stacks (docs/TPU_RUNBOOK.md)."""
+    raw = os.environ.get("DAS_DISPATCH_DEPTH", "")
+    try:
+        return int(raw) if raw else DEFAULT_DISPATCH_DEPTH
+    except ValueError:
+        return DEFAULT_DISPATCH_DEPTH
+
+
 #: Default on-disk home of the persistent XLA compilation cache (batched
 #: campaigns compile O(#buckets) programs ONCE per machine, not once per
 #: process — docs/TPU_RUNBOOK.md). Override with

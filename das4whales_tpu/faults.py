@@ -385,6 +385,11 @@ _counters_lock = threading.Lock()
 _COUNTERS: Dict[str, int] = {
     "retries": 0, "degradations": 0, "quarantined": 0, "timeouts": 0,
     "downshifts": 0, "oom_recoveries": 0, "watchdog_timeouts": 0,
+    # dispatch-wall attribution (ISSUE 6): device program launches and
+    # blocking host fetches/syncs taken by the detection hot paths —
+    # bench.py reports the per-segment deltas next to stage_wall_s so
+    # the dispatch/sync wall is a regression-gated number
+    "dispatches": 0, "syncs": 0,
 }
 
 

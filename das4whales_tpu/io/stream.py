@@ -664,7 +664,13 @@ def stream_batched_slabs(
     (via ``sharding``/``device``; plain ``jnp.asarray`` otherwise)
     dispatches on a transfer thread while the caller computes on slab k,
     with at most ``in_flight`` slabs in the transfer pipeline (bounded
-    device memory: ``in_flight + 1`` slabs resident worst-case).
+    device memory: ``in_flight + 1`` slabs resident worst-case — plus,
+    when the consumer runs the depth-D pipelined dispatch
+    (``parallel.dispatch``), its up-to-``depth`` dispatched-but-unfetched
+    slabs: the batched campaign raises ``in_flight`` to at least the
+    dispatch depth so the transfer pipeline never starves the dispatch
+    queue, making the combined worst-case residency
+    ``in_flight + depth + 1`` slabs — docs/TPU_RUNBOOK.md).
     ``as_numpy=True`` skips placement and yields host stacks.
 
     A file that fails to probe/read/bucket raises :class:`SlabReadError`

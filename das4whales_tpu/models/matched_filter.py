@@ -277,12 +277,15 @@ def mf_correlate_tiled(
     return corr_tiles, jnp.max(tile_maxes)
 
 
-@functools.partial(jax.jit, static_argnames=("max_peaks", "pick_method"))
+@functools.partial(
+    jax.jit, static_argnames=("max_peaks", "pick_method", "pick_engine")
+)
 def mf_pick_tiled(
     corr_tiles: jnp.ndarray,
     thresholds: jnp.ndarray,
     max_peaks: int,
     pick_method: str = "topk",
+    pick_engine: str = "jnp",
 ):
     """Envelope + sparse prominence picking over channel tiles.
 
@@ -293,9 +296,20 @@ def mf_pick_tiled(
     ``[n_tiles, nT, tile, K]`` arrays (merge with
     ``merge_tiled_picks``). ``pick_method``: see
     ``ops.peaks.find_peaks_sparse`` (the escalating callers pass
-    ``ops.peaks.escalation_method(k, k_full)``)."""
+    ``ops.peaks.escalation_method(k, k_full)``). ``pick_engine``:
+    ``"jnp"`` (the staged fallback/oracle) or ``"pallas"`` (the fused
+    VMEM-resident envelope→threshold→prominence→pack kernel,
+    ``ops.pallas_picks`` — selected by the detector's capability-probed
+    engine resolution; pick outputs bitwise-identical either way)."""
     def per_tile(ct):                                    # [nT, tile, n]
-        env = jnp.abs(spectral.analytic_signal(ct, axis=-1))
+        if pick_engine == "pallas":
+            from ..ops import pallas_picks
+
+            return pallas_picks.analytic_envelope_peaks(
+                ct, thresholds[:, None], max_peaks=max_peaks,
+                method=pick_method,
+            )
+        env = spectral.envelope_sqrt(ct, axis=-1)
         return peak_ops.find_peaks_sparse_batched(
             env, thresholds[:, None], max_peaks=max_peaks, method=pick_method
         )
@@ -308,7 +322,7 @@ def mf_envelope_tiled(corr_tiles: jnp.ndarray) -> jnp.ndarray:
     """Per-tile Hilbert envelopes ``[n_tiles, nT, tile, n]`` (for the
     scipy-host and dense pick engines, which consume the envelope itself)."""
     return jax.lax.map(
-        lambda ct: jnp.abs(spectral.analytic_signal(ct, axis=-1)), corr_tiles
+        lambda ct: spectral.envelope_sqrt(ct, axis=-1), corr_tiles
     )
 
 
@@ -361,7 +375,7 @@ def reference_threshold_factors(n_templates: int, dtype=None) -> jnp.ndarray:
     static_argnames=(
         "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp",
         "tile", "max_peaks", "capacity", "use_threshold", "pick_method",
-        "condition", "cond_demean", "with_health",
+        "condition", "cond_demean", "with_health", "pick_engine",
     ),
 )
 def mf_detect_picks_program(
@@ -388,6 +402,7 @@ def mf_detect_picks_program(
     cond_n_real=None,
     with_health: bool = False,
     health_clip=None,
+    pick_engine: str = "jnp",
 ):
     """The WHOLE detection step as ONE XLA program: [optional narrow-wire
     conditioning prologue ->] bandpass -> f-k filter
@@ -479,10 +494,17 @@ def mf_detect_picks_program(
             trf, templates_true, mu, scale
         )
         thr = resolve_thr(jnp.max(corr))
-        env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
-        sp = peak_ops.find_peaks_sparse_batched(
-            env, thr[:, None], max_peaks=max_peaks, method=pick_method
-        )
+        if pick_engine == "pallas":
+            from ..ops import pallas_picks
+
+            sp = pallas_picks.analytic_envelope_peaks(
+                corr, thr[:, None], max_peaks=max_peaks, method=pick_method
+            )
+        else:
+            env = spectral.envelope_sqrt(corr, axis=-1)
+            sp = peak_ops.find_peaks_sparse_batched(
+                env, thr[:, None], max_peaks=max_peaks, method=pick_method
+            )
         chan, times, cnt = peak_ops.compact_picks_rowmajor(
             sp.positions, sp.selected, capacity
         )
@@ -490,7 +512,7 @@ def mf_detect_picks_program(
     else:
         corr_tiles, gmax = mf_correlate_tiled(trf, templates_true, mu, scale, tile)
         thr = resolve_thr(gmax)
-        sp = mf_pick_tiled(corr_tiles, thr, max_peaks, pick_method)
+        sp = mf_pick_tiled(corr_tiles, thr, max_peaks, pick_method, pick_engine)
         chan, times, cnt = mf_compact_tiled_picks(
             sp.positions, sp.selected, C, capacity
         )
@@ -506,7 +528,7 @@ def mf_envelope_and_threshold(corr: jnp.ndarray):
     """Envelope of the correlograms + the reference's threshold policy:
     ``thres = 0.5 * max(all correlograms)``, first (HF) template picked at
     ``0.9 * thres`` (main_mfdetect.py:94-99)."""
-    env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
+    env = spectral.envelope_sqrt(corr, axis=-1)
     thres = REL_THRESHOLD * jnp.max(corr)
     return env, thres * reference_threshold_factors(corr.shape[0])
 
@@ -523,6 +545,36 @@ class MatchedFilterResult:
     #: caller requested the fused quarantine gate (detect_picks
     #: with_health=True); empty otherwise
     health: Dict[str, float] = field(default_factory=dict)
+
+
+class InFlightResult:
+    """Handle for an asynchronously dispatched detection program.
+
+    The dispatch half (``MatchedFilterDetector.dispatch_picks``,
+    ``parallel.batch.BatchedMatchedFilterDetector.dispatch_batch``)
+    launches the device program and returns one of these immediately;
+    :meth:`resolve` performs the packed fetch — the ONLY device sync —
+    and the host-side assembly. The first successful ``resolve()``
+    caches its result (device references are dropped with the closure),
+    so retry wrappers can call it safely; after a FAILED resolve the
+    handle must be discarded, never re-resolved (the campaign's rung
+    loops do exactly that — a timed-out resolve was abandoned mid-fetch
+    on the watchdog worker and is not safely re-enterable).
+    Dropping an unresolved handle
+    abandons the in-flight computation (its device buffers free when
+    XLA finishes) — the campaign does exactly that when a bucket
+    downshifts between dispatch and resolve.
+    """
+
+    def __init__(self, resolve_fn):
+        self._resolve_fn = resolve_fn
+        self._result = None
+
+    def resolve(self):
+        if self._resolve_fn is not None:
+            self._result = self._resolve_fn()
+            self._resolve_fn = None
+        return self._result
 
 
 class MatchedFilterDetector:
@@ -546,6 +598,7 @@ class MatchedFilterDetector:
         fused_bandpass: bool = True,
         pick_pack_cap: int = 1 << 18,
         wire: str = "conditioned",
+        pick_engine: str | None = None,
     ):
         self.metadata = as_metadata(metadata)
         if wire not in ("conditioned", "raw"):
@@ -577,6 +630,16 @@ class MatchedFilterDetector:
         if pick_mode not in ("sparse", "scipy", "dense"):
             raise ValueError(f"unknown pick_mode {pick_mode!r}")
         self.pick_mode = pick_mode
+        # engine WITHIN the sparse mode: the jnp block-table route, or the
+        # Pallas fused envelope→threshold→prominence→pack kernel
+        # (ops.pallas_picks). None/"auto" resolves via DAS_PICK_ENGINE and
+        # the Mosaic capability probe: the kernel only on a TPU backend
+        # whose toolchain lowers it; the jnp route (fallback and parity
+        # oracle) everywhere else. Pick outputs are bitwise-identical
+        # between engines — the kernel runs the SAME per-row math.
+        from ..ops import pallas_picks
+
+        self.pick_engine = pallas_picks.resolve_engine(pick_engine)
         self.max_peaks = max_peaks
         # adaptive sparse-K: the kernel's top-k + per-candidate block
         # tables scale with the slot capacity K, but real rows hold far
@@ -819,6 +882,40 @@ class MatchedFilterDetector:
                     np.asarray(trace), clip_abs=health_clip
                 )
             return res
+        return self.dispatch_picks(
+            trace, threshold=threshold, n_real=n_real,
+            with_health=with_health, health_clip=health_clip,
+        ).resolve()
+
+    def dispatch_picks(
+        self, trace: jnp.ndarray, threshold: float | None = None,
+        n_real: int | None = None, with_health: bool = False,
+        health_clip: float | None = None,
+    ) -> "InFlightResult":
+        """LAUNCH the one-program detection without fetching: the K0
+        program is dispatched asynchronously and an
+        :class:`InFlightResult` handle returns immediately, so the
+        caller can dispatch the NEXT file's program before this one's
+        packed fetch — the depth-D pipelined campaign dispatch
+        (``parallel.dispatch``, docs/PERF.md "Pipelined dispatch").
+        ``handle.resolve()`` performs the packed fetch (the only device
+        sync), resolves the adaptive-K escalation from the
+        already-fetched K0 payload (``sat_count`` rides the packed
+        fetch — the decision costs no extra round trip), reruns at full
+        capacity only if a row saturated, and assembles the
+        :class:`MatchedFilterResult` exactly as :meth:`detect_picks`
+        (same overflow fallback, same outputs — ``detect_picks`` IS
+        ``dispatch_picks(...).resolve()``). Requires
+        ``pick_mode='sparse'`` (the one-program route)."""
+        from .. import faults
+        from ..ops import health as health_ops
+
+        if self.pick_mode != "sparse":
+            raise ValueError(
+                "dispatch_picks needs pick_mode='sparse' (the one-program "
+                f"route); this detector resolved pick_mode={self.pick_mode!r}"
+            )
+        trace = self._as_input(trace)
         C = trace.shape[0]
         nT = self.design.templates.shape[0]
         names = self.design.template_names
@@ -840,6 +937,7 @@ class MatchedFilterDetector:
         )
 
         def run(k):
+            faults.count("dispatches")
             return mf_detect_picks_program(
                 trace, self._mask_band_dev, self._gain_dev,
                 self._templates_true, self._template_mu, self._template_scale,
@@ -856,12 +954,18 @@ class MatchedFilterDetector:
                 with_health=with_health,
                 health_clip=(None if health_clip is None
                              else jnp.float32(health_clip)),
+                pick_engine=self.pick_engine,
             )
 
+        # the K0 launch: async — errors of the device computation itself
+        # surface at resolve()'s fetch, which is where the campaign's
+        # watchdog/ladder wrap it
+        k0_outs = run(self.pick_k0)
         health: Dict[str, float] = {}
 
-        def fetch(k):
-            outs = jax.device_get(run(k))
+        def fetch_payload(outs):
+            outs = jax.device_get(outs)
+            faults.count("syncs")
             if with_health:
                 *outs, h_counts, h_rms = outs
                 health.update(health_ops.stats_to_dict(
@@ -870,49 +974,56 @@ class MatchedFilterDetector:
                 ))
             return outs
 
-        chan, times, cnt, satc, thr = fetch(self.pick_k0)
-        if self.pick_k0 < self.max_peaks and int(satc.sum()):
-            # some channel saturated at K0 — rerun at full capacity (exact,
-            # same policy as ops.peaks.picks_with_escalation)
-            chan, times, cnt, satc, thr = fetch(self.max_peaks)
-        if int(cnt.max(initial=0)) > cap:
-            # packed-capacity overflow: the exact full-transfer route
-            # (health was already fetched from the packed attempt — the
-            # fallback reruns only the pick transfer, so attach it)
-            if self.wire == "raw" and cond_nr is not None:
-                # the pad-aware demean must survive the fallback: plain
-                # whole-record conditioning would bias the mean by
-                # n_real/T and turn the zero pad into a -mean*scale step
-                # that rings through the bucket-length FFT. Condition
-                # here (real samples only, pad stays exactly 0) and hand
-                # the exact route the already-conditioned block through a
-                # conditioned-wire view of this detector.
-                import copy
-
-                cond_trace = conditioning.condition_padded(
-                    trace, self._cond_scale, cond_nr,
-                    dtype=self._mask_band_dev.dtype,
+        def resolve():
+            chan, times, cnt, satc, thr = fetch_payload(k0_outs)
+            if self.pick_k0 < self.max_peaks and int(satc.sum()):
+                # some channel saturated at K0 — rerun at full capacity
+                # (exact, same policy as ops.peaks.picks_with_escalation);
+                # the escalation DECISION came from the K0 payload already
+                # fetched above — no extra sync round trip
+                chan, times, cnt, satc, thr = fetch_payload(
+                    run(self.max_peaks)
                 )
-                det = copy.copy(self)
-                det.wire = "conditioned"
-                res = det._call_full(cond_trace, threshold=threshold)
+            if int(cnt.max(initial=0)) > cap:
+                # packed-capacity overflow: the exact full-transfer route
+                # (health was already fetched from the packed attempt — the
+                # fallback reruns only the pick transfer, so attach it)
+                if self.wire == "raw" and cond_nr is not None:
+                    # the pad-aware demean must survive the fallback: plain
+                    # whole-record conditioning would bias the mean by
+                    # n_real/T and turn the zero pad into a -mean*scale step
+                    # that rings through the bucket-length FFT. Condition
+                    # here (real samples only, pad stays exactly 0) and hand
+                    # the exact route the already-conditioned block through a
+                    # conditioned-wire view of this detector.
+                    import copy
+
+                    cond_trace = conditioning.condition_padded(
+                        trace, self._cond_scale, cond_nr,
+                        dtype=self._mask_band_dev.dtype,
+                    )
+                    det = copy.copy(self)
+                    det.wire = "conditioned"
+                    res = det._call_full(cond_trace, threshold=threshold)
+                    res.health = health
+                    return res
+                res = self._call_full(trace, threshold=threshold)
                 res.health = health
                 return res
-            res = self._call_full(trace, threshold=threshold)
-            res.health = health
-            return res
-        picks, thr_out = {}, {}
-        for i, name in enumerate(names):
-            k = int(cnt[i])
-            picks[name] = np.asarray(
-                [chan[i, :k], times[i, :k]], dtype=np.int64
+            picks, thr_out = {}, {}
+            for i, name in enumerate(names):
+                k = int(cnt[i])
+                picks[name] = np.asarray(
+                    [chan[i, :k], times[i, :k]], dtype=np.int64
+                )
+                thr_out[name] = float(thr[i])
+                self._warn_saturated(name, int(satc[i]))
+            return MatchedFilterResult(
+                trf_fk=None, correlograms={}, peak_masks={}, picks=picks,
+                thresholds=thr_out, health=health,
             )
-            thr_out[name] = float(thr[i])
-            self._warn_saturated(name, int(satc[i]))
-        return MatchedFilterResult(
-            trf_fk=None, correlograms={}, peak_masks={}, picks=picks,
-            thresholds=thr_out, health=health,
-        )
+
+        return InFlightResult(resolve)
 
     def _call_full(self, trace: jnp.ndarray, threshold: float | None = None, with_snr: bool = False) -> MatchedFilterResult:
         if self._route() == "tiled":

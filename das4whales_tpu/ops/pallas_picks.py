@@ -1,0 +1,262 @@
+"""Pallas fused envelope → threshold → prominence → peak-pack kernel.
+
+BENCH_r05 stage attribution: envelope+peaks runs at ``roofline_frac <=
+0.023`` — the pick stage never saturates the VPU because the jnp route
+materializes the ``[nT, C, T]`` envelope, the candidate block tables and
+the top-k sort as separate HBM-resident HLO stages (each a full
+HBM round trip at the canonical shape). TINA (arXiv:2408.16551) makes
+the general point: non-NN DSP reaches accelerator peak only when a
+stage chain is fused into one resident program instead of staged passes.
+
+This kernel runs the WHOLE post-correlation pick chain per row block in
+one VMEM-resident pass:
+
+* envelope — ``sqrt(re² + im²)`` of the analytic signal
+  (``ops.spectral.envelope_sqrt``; the FFT-based Hilbert transform
+  itself stays outside — it is a global transform and already
+  MXU/FFT-efficient). The ``[rows, T]`` envelope never exists in HBM.
+* threshold + plateau-exact local maxima (``ops.peaks.local_maxima``),
+* exact scipy prominences via the sqrt-decomposition block tables,
+* fixed-capacity slot pack (``"pack"``) or tallest-K (``"topk"``).
+
+The pick math is ``ops.peaks._find_peaks_rows`` — the SAME function the
+jnp route executes — applied to the kernel's VMEM block, so the PICK
+outputs (``positions``/``selected``/``saturated`` — the only fields the
+detection programs consume) are bit-identical to the jnp route; the
+parity matrix in tests/test_pallas_picks.py pins them bitwise and the
+jnp route remains the fallback and the oracle. The internal
+``heights``/``prominences`` floats may differ from the jitted jnp
+route in the final ulp (the surrounding jit may fuse the envelope
+multiply-adds into FMAs; the kernel rounds each op) — they never leave
+the program.
+
+Capability: compiled Mosaic lowering of this kernel needs in-kernel
+gathers (``take_along_axis`` over the block axis), scatter-pack, cummax
+and (for ``"topk"``) ``lax.top_k`` — newer Mosaic toolchains only.
+:func:`lowering_gap` probes the ACTUAL kernel via ``jax.export`` (the
+``test_pallas_tpu_lowering`` pattern) and :func:`resolve_engine` only
+selects the kernel route on a TPU backend whose toolchain lowers it;
+everywhere else the jnp route runs and tier-1 stays green. Off-TPU the
+kernel executes in Pallas interpret mode, so CPU tests exercise the
+identical kernel code path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from . import peaks as peak_ops
+from . import spectral
+
+try:  # TPU-specific memory spaces; absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+#: rows per kernel instance — the Mosaic sublane granule for float32
+#: (pallas_stft's block-shape lesson: keep the second-to-minor dim a
+#: multiple of 8 and never size-1)
+ROWS_PER_BLOCK = 8
+
+
+def _picks_kernel(re_ref, im_ref, thr_ref, pos_ref, h_ref, prom_ref,
+                  sel_ref, sat_ref, *, max_peaks: int, nb: int, method: str):
+    """One ``[rb, T]`` row block: fused envelope → threshold → prominence
+    → slot pack, entirely in VMEM. The pick chain is
+    ``ops.peaks._find_peaks_rows`` verbatim — shared with the jnp route."""
+    re = re_ref[...]
+    im = im_ref[...]
+    env = jnp.sqrt(re * re + im * im)       # == spectral.envelope_sqrt
+    sp = peak_ops._find_peaks_rows(
+        env, thr_ref[...][:, 0], max_peaks, nb, True, method
+    )
+    pos_ref[...] = sp.positions.astype(jnp.int32)
+    h_ref[...] = sp.heights
+    prom_ref[...] = sp.prominences
+    sel_ref[...] = sp.selected
+    sat_ref[...] = sp.saturated[:, None]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_peaks", "nb", "method", "rows_per_block",
+                     "interpret"),
+)
+def _envelope_peaks_impl(re, im, thr, max_peaks, nb, method, rows_per_block,
+                         interpret):
+    rows, T = re.shape
+    rb = rows_per_block
+    r_pad = -(-rows // rb) * rb
+    if r_pad != rows:
+        pad = [(0, r_pad - rows), (0, 0)]
+        re = jnp.pad(re, pad)
+        im = jnp.pad(im, pad)
+        # +inf threshold: the height prefilter admits no candidate on a
+        # padding row (selected all-False, saturated False)
+        thr = jnp.pad(thr, pad, constant_values=jnp.inf)
+    vmem = {} if _VMEM is None else {"memory_space": _VMEM}
+    kernel = functools.partial(_picks_kernel, max_peaks=max_peaks, nb=nb,
+                               method=method)
+    K = max_peaks
+    pos, h, prom, sel, sat = pl.pallas_call(
+        kernel,
+        grid=(r_pad // rb,),
+        in_specs=[
+            pl.BlockSpec((rb, T), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((rb, T), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((rb, 1), lambda i: (i, 0), **vmem),
+        ],
+        out_specs=[
+            pl.BlockSpec((rb, K), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((rb, K), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((rb, K), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((rb, K), lambda i: (i, 0), **vmem),
+            pl.BlockSpec((rb, 1), lambda i: (i, 0), **vmem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((r_pad, K), jnp.int32),
+            jax.ShapeDtypeStruct((r_pad, K), jnp.float32),
+            jax.ShapeDtypeStruct((r_pad, K), jnp.float32),
+            jax.ShapeDtypeStruct((r_pad, K), jnp.bool_),
+            jax.ShapeDtypeStruct((r_pad, 1), jnp.bool_),
+        ],
+        interpret=interpret,
+    )(re, im, thr)
+    return (pos[:rows], h[:rows], prom[:rows], sel[:rows], sat[:rows, 0])
+
+
+def envelope_peaks_sparse(
+    re: jnp.ndarray,
+    im: jnp.ndarray,
+    threshold,
+    max_peaks: int = 256,
+    nb: int = 128,
+    method: str = "topk",
+    interpret: bool | None = None,
+) -> peak_ops.SparsePicks:
+    """Fused envelope+pick over the analytic signal's (re, im) parts.
+
+    ``re``/``im`` are ``[..., T]`` float32 (leading axes flatten into
+    the kernel's row axis and are restored on output); ``threshold``
+    broadcasts to ``re.shape[:-1]``. Returns an
+    ``ops.peaks.SparsePicks`` identical — bitwise, same ops — to
+    ``find_peaks_sparse_batched(sqrt(re²+im²), threshold, ...)``, with
+    the envelope, candidate tables and slot pack never leaving VMEM.
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpret mode
+    elsewhere (CPU tests run the identical kernel).
+    """
+    if re.shape != im.shape:
+        raise ValueError(f"re/im shape mismatch: {re.shape} vs {im.shape}")
+    lead = re.shape[:-1]
+    T = re.shape[-1]
+    rows = int(np.prod(lead)) if lead else 1
+    max_peaks = min(int(max_peaks), T)
+    thr = jnp.broadcast_to(jnp.asarray(threshold, jnp.float32), lead)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    pos, h, prom, sel, sat = _envelope_peaks_impl(
+        re.reshape(rows, T).astype(jnp.float32),
+        im.reshape(rows, T).astype(jnp.float32),
+        thr.reshape(rows, 1),
+        max_peaks, nb, method, ROWS_PER_BLOCK, bool(interpret),
+    )
+    K = pos.shape[-1]
+    return peak_ops.SparsePicks(
+        pos.reshape(lead + (K,)), h.reshape(lead + (K,)),
+        prom.reshape(lead + (K,)), sel.reshape(lead + (K,)),
+        sat.reshape(lead),
+    )
+
+
+def analytic_envelope_peaks(
+    corr: jnp.ndarray,
+    threshold,
+    max_peaks: int = 256,
+    nb: int = 128,
+    method: str = "topk",
+    interpret: bool | None = None,
+) -> peak_ops.SparsePicks:
+    """The detection routes' drop-in for ``envelope_sqrt`` +
+    ``find_peaks_sparse_batched``: Hilbert transform (batched FFT —
+    outside the kernel, it is a global transform) followed by the fused
+    envelope→threshold→prominence→pack kernel. ``corr`` is ``[..., T]``
+    real correlograms; ``threshold`` broadcasts to ``corr.shape[:-1]``."""
+    X = spectral.analytic_signal(corr, axis=-1)
+    return envelope_peaks_sparse(
+        X.real, X.imag, threshold, max_peaks=max_peaks, nb=nb,
+        method=method, interpret=interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Capability probe + engine resolution
+# ---------------------------------------------------------------------------
+
+_PICK_ENGINES = ("jnp", "pallas")
+_gap_cache: dict = {}
+
+
+def lowering_gap(method: str = "pack") -> str | None:
+    """Probe whether THIS toolchain's Mosaic lowers the actual fused
+    pick kernel for a TPU target (the ``test_pallas_tpu_lowering``
+    pattern: ``jax.export`` runs the real lowering pipeline without a
+    chip). Returns the first-line error string naming the gap, or None
+    when the kernel lowers. Cached per method for the process."""
+    if method in _gap_cache:
+        return _gap_cache[method]
+    try:
+        from jax import export as jax_export
+    except ImportError:  # pragma: no cover
+        _gap_cache[method] = "jax.export unavailable"
+        return _gap_cache[method]
+
+    def f(re, im, thr):
+        return _envelope_peaks_impl(re, im, thr, 8, 64, method,
+                                    ROWS_PER_BLOCK, False)
+
+    try:
+        # daslint: allow[R2] one-shot probe: built at most once per method, memoized in _gap_cache
+        jax_export.export(jax.jit(f), platforms=["tpu"])(
+            jnp.zeros((8, 256), jnp.float32), jnp.zeros((8, 256), jnp.float32),
+            jnp.zeros((8, 1), jnp.float32),
+        )
+        gap = None
+    except Exception as exc:  # noqa: BLE001 — any lowering failure gates
+        gap = f"{type(exc).__name__}: {str(exc).splitlines()[0][:160]}"
+    _gap_cache[method] = gap
+    return gap
+
+
+def resolve_engine(requested: str | None = None) -> str:
+    """Resolve the pick engine for the sparse detection routes.
+
+    ``requested`` is ``"jnp"`` / ``"pallas"`` (forced — ``"pallas"``
+    off-TPU runs interpret mode, the tests' parity configuration) /
+    ``"auto"`` / None. ``None`` defers to ``DAS_PICK_ENGINE`` (same
+    values), defaulting to ``"auto"``: the fused Pallas kernel on a TPU
+    backend whose Mosaic lowers it (both pack and topk — the adaptive-K
+    policy needs the pair), the jnp route everywhere else.
+    """
+    req = requested or os.environ.get("DAS_PICK_ENGINE", "") or "auto"
+    if req in _PICK_ENGINES:
+        return req
+    if req != "auto":
+        raise ValueError(
+            f"unknown pick engine {req!r}; expected one of "
+            f"{_PICK_ENGINES + ('auto',)}"
+        )
+    if jax.default_backend() != "tpu":
+        return "jnp"
+    if lowering_gap("pack") is None and lowering_gap("topk") is None:
+        return "pallas"
+    return "jnp"

@@ -66,7 +66,10 @@ def _run_info(x: jnp.ndarray):
     n = x.shape[-1]
     chg = x[..., 1:] != x[..., :-1]
     rising = x[..., 1:] > x[..., :-1]
-    idx1 = jnp.arange(1, n, dtype=jnp.int32)
+    # lax.iota, not jnp.arange: arange materializes a literal constant,
+    # which a Pallas kernel body (ops/pallas_picks.py shares this code)
+    # cannot capture on this jax version; iota is an op, same values
+    idx1 = jax.lax.iota(jnp.int32, n - 1) + 1
     # i=0 starts a run with rising=False (left-edge run: never a peak)
     key_tail = jnp.where(chg, 2 * idx1 + rising.astype(jnp.int32), -1)
     zeros = jnp.zeros(x.shape[:-1] + (1,), jnp.int32)
@@ -84,7 +87,7 @@ def local_maxima(x: jnp.ndarray) -> jnp.ndarray:
     either signal edge are not maxima.
     """
     n = x.shape[-1]
-    idx = jnp.arange(n)
+    idx = jax.lax.iota(jnp.int32, n)
 
     run_start, rising = _run_info(x)
     run_start_r, falling_r = _run_info(jnp.flip(x, axis=-1))
@@ -216,8 +219,8 @@ def _one_sided_base_min_sparse(xb, block_max, block_min, pos, h, nb: int):
     C, B, _ = xb.shape
     bp = pos // nb                      # [C, K] block of the candidate
     tp = pos % nb
-    offs = jnp.arange(nb)               # [nb]
-    blocks = jnp.arange(B)              # [B]
+    offs = jax.lax.iota(jnp.int32, nb)  # [nb]
+    blocks = jax.lax.iota(jnp.int32, B)  # [B]
 
     def block_gather(idx):
         # [C, 1, B, nb] gathered at [C, K, 1, 1] along the block axis
@@ -256,6 +259,82 @@ def _one_sided_base_min_sparse(xb, block_max, block_min, pos, h, nb: int):
 
     other = jnp.minimum(jnp.where(has_blk, min_pb_suffix, big), jnp.minimum(min_mid, min_own_prefix))
     return jnp.where(has_own, min_own, other)
+
+
+def _find_peaks_rows(
+    x: jnp.ndarray,
+    thr_bc: jnp.ndarray,
+    max_peaks: int,
+    nb: int,
+    prefilter_height: bool,
+    method: str,
+) -> SparsePicks:
+    """The per-row core of :func:`find_peaks_sparse`, unjitted.
+
+    ``x`` is ``[C, N]``, ``thr_bc`` a ``[C]`` per-row threshold.
+    Factored out so the Pallas fused pick kernel
+    (``ops.pallas_picks``) can run EXACTLY these operations on its
+    VMEM-resident row block — pick parity between the jnp route and the
+    kernel route is then by construction, not by test luck."""
+    C, N = x.shape
+    thr_bc = jnp.asarray(thr_bc)
+
+    mask = local_maxima(x)
+    if prefilter_height:
+        mask = mask & (x >= thr_bc[:, None])
+    n_cand = jnp.sum(mask, axis=-1)
+    saturated = n_cand > max_peaks
+
+    if method == "pack":
+        idx = jax.lax.iota(jnp.int32, N)
+        cnt = jnp.cumsum(mask, axis=-1)
+        dest = jnp.where(mask, cnt - 1, max_peaks)    # >= K -> dropped
+        rows = jax.lax.iota(jnp.int32, C)[:, None]
+        pos = jnp.full((C, max_peaks), N, jnp.int32).at[
+            rows, dest
+        ].set(jnp.broadcast_to(idx, (C, N)), mode="drop")
+        slot_valid = (
+            jax.lax.iota(jnp.int32, max_peaks)[None, :]
+            < jnp.minimum(n_cand, max_peaks)[:, None]
+        )
+        gpos = jnp.where(slot_valid, pos, 0)
+        heights = jnp.take_along_axis(x, gpos, axis=-1)
+        heights = jnp.where(slot_valid, heights, -jnp.inf)
+        valid = slot_valid
+    elif method == "topk":
+        cand_scores = jnp.where(mask, x, -jnp.inf)
+        heights, pos = jax.lax.top_k(cand_scores, max_peaks)      # [C, K]
+        valid = jnp.isfinite(heights)
+        gpos = pos
+    else:
+        raise ValueError(f"unknown method {method!r}")
+
+    xb, bmax, bmin = _block_stats(x, nb)
+    left_min = _one_sided_base_min_sparse(xb, bmax, bmin, gpos, heights, nb)
+    xf = jnp.flip(x, axis=-1)
+    xbf, bmaxf, bminf = _block_stats(xf, nb)
+    right_min = _one_sided_base_min_sparse(
+        xbf, bmaxf, bminf, (N - 1) - gpos, heights, nb
+    )
+
+    prom = heights - jnp.maximum(left_min, right_min)
+    selected = valid & (prom >= thr_bc[:, None])
+
+    if method == "pack":
+        # slots are position-ascending by construction; every slot NOT in
+        # `selected` reports position N — the topk path's promise (a
+        # valid-but-unselected candidate, i.e. one that failed the
+        # prominence test, must not leak its position; ADVICE round 5)
+        return SparsePicks(
+            jnp.where(selected, pos, N), heights, prom, selected, saturated
+        )
+    # order by position per channel for reference-compatible pick lists
+    pos_sorted_key = jnp.where(selected, pos, N)
+    order = jnp.argsort(pos_sorted_key, axis=-1)
+    take = lambda a: jnp.take_along_axis(a, order, axis=-1)
+    return SparsePicks(
+        take(pos_sorted_key), take(heights), take(prom), take(selected), saturated
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("max_peaks", "nb", "method"))
@@ -299,63 +378,7 @@ def find_peaks_sparse(
     max_peaks = min(max_peaks, N)  # slot count cannot exceed the time axis
     thr = jnp.asarray(threshold)
     thr_bc = jnp.broadcast_to(thr, (C,)) if thr.ndim <= 1 else thr
-
-    mask = local_maxima(x)
-    if prefilter_height:
-        mask = mask & (x >= thr_bc[:, None])
-    n_cand = jnp.sum(mask, axis=-1)
-    saturated = n_cand > max_peaks
-
-    if method == "pack":
-        idx = jnp.arange(N, dtype=jnp.int32)
-        cnt = jnp.cumsum(mask, axis=-1)
-        dest = jnp.where(mask, cnt - 1, max_peaks)    # >= K -> dropped
-        rows = jnp.arange(C, dtype=jnp.int32)[:, None]
-        pos = jnp.full((C, max_peaks), N, jnp.int32).at[
-            rows, dest
-        ].set(jnp.broadcast_to(idx, (C, N)), mode="drop")
-        slot_valid = (
-            jnp.arange(max_peaks)[None, :]
-            < jnp.minimum(n_cand, max_peaks)[:, None]
-        )
-        gpos = jnp.where(slot_valid, pos, 0)
-        heights = jnp.take_along_axis(x, gpos, axis=-1)
-        heights = jnp.where(slot_valid, heights, -jnp.inf)
-        valid = slot_valid
-    elif method == "topk":
-        cand_scores = jnp.where(mask, x, -jnp.inf)
-        heights, pos = jax.lax.top_k(cand_scores, max_peaks)      # [C, K]
-        valid = jnp.isfinite(heights)
-        gpos = pos
-    else:
-        raise ValueError(f"unknown method {method!r}")
-
-    xb, bmax, bmin = _block_stats(x, nb)
-    left_min = _one_sided_base_min_sparse(xb, bmax, bmin, gpos, heights, nb)
-    xf = jnp.flip(x, axis=-1)
-    xbf, bmaxf, bminf = _block_stats(xf, nb)
-    right_min = _one_sided_base_min_sparse(
-        xbf, bmaxf, bminf, (N - 1) - gpos, heights, nb
-    )
-
-    prom = heights - jnp.maximum(left_min, right_min)
-    selected = valid & (prom >= thr_bc[:, None])
-
-    if method == "pack":
-        # slots are position-ascending by construction; every slot NOT in
-        # `selected` reports position N — the topk path's promise (a
-        # valid-but-unselected candidate, i.e. one that failed the
-        # prominence test, must not leak its position; ADVICE round 5)
-        return SparsePicks(
-            jnp.where(selected, pos, N), heights, prom, selected, saturated
-        )
-    # order by position per channel for reference-compatible pick lists
-    pos_sorted_key = jnp.where(selected, pos, N)
-    order = jnp.argsort(pos_sorted_key, axis=-1)
-    take = lambda a: jnp.take_along_axis(a, order, axis=-1)
-    return SparsePicks(
-        take(pos_sorted_key), take(heights), take(prom), take(selected), saturated
-    )
+    return _find_peaks_rows(x, thr_bc, max_peaks, nb, prefilter_height, method)
 
 
 def find_peaks_sparse_batched(

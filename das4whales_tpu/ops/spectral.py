@@ -99,6 +99,22 @@ def envelope(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
     return jnp.abs(analytic_signal(x, axis=axis))
 
 
+def envelope_sqrt(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Hilbert envelope as the explicit ``sqrt(re² + im²)`` magnitude.
+
+    Within ~1 ulp of :func:`envelope` (XLA lowers complex ``abs`` to a
+    scaled hypot whose final rounding can differ per element), but
+    expressed with real elementwise ops only — which is what lets the
+    Pallas fused pick kernel (``ops.pallas_picks``) compute THE SAME
+    envelope inside the kernel, where complex abs does not lower. Every
+    matched-filter detection route uses this form, so per-pick parity
+    across routes (jnp fallback ↔ Pallas kernel, staged ↔ one-program,
+    single-chip ↔ sharded/time-sharded) stays bitwise instead of
+    ulp-close."""
+    X = analytic_signal(x, axis=axis)
+    return jnp.sqrt(X.real * X.real + X.imag * X.imag)
+
+
 @functools.partial(jax.jit, static_argnames=("nfft",))
 def fx_transform(trace: jnp.ndarray, nfft: int) -> jnp.ndarray:
     """Per-channel FFT magnitude in the f-x domain.

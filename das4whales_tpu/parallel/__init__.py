@@ -1,6 +1,6 @@
 """Multi-chip scale-out: meshes, distributed FFT, sharded pipelines."""
 
-from . import batch, distributed, fft, mesh, pipeline, timeshard  # noqa: F401
+from . import batch, dispatch, distributed, fft, mesh, pipeline, timeshard  # noqa: F401
 from .batch import BatchedMatchedFilterDetector  # noqa: F401
 from .mesh import make_mesh, shard_block  # noqa: F401
 from .distributed import global_mesh, initialize_from_env  # noqa: F401

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.matched_filter import (
+    InFlightResult,
     MatchedFilterDetector,
     mf_detect_picks_program,
 )
@@ -52,7 +53,7 @@ from ..ops import peaks as peak_ops
 _STATIC = (
     "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp", "tile",
     "max_peaks", "capacity", "use_threshold", "pick_method", "condition",
-    "serial", "with_health",
+    "serial", "with_health", "pick_engine",
 )
 
 
@@ -63,6 +64,7 @@ def _batched_body(
     staged_bp: bool, tile: int | None, max_peaks: int, capacity: int,
     use_threshold: bool, pick_method: str, condition: bool,
     serial: bool = False, with_health: bool = False, health_clip=None,
+    pick_engine: str = "jnp",
 ):
     """The one-program route over a leading file axis, in ONE program.
 
@@ -95,6 +97,7 @@ def _batched_body(
             max_peaks, capacity, use_threshold, pick_method=pick_method,
             condition=condition, cond_scale=cond_scale, cond_n_real=nr,
             with_health=with_health, health_clip=health_clip,
+            pick_engine=pick_engine,
         )
 
     if n_real is None:
@@ -169,7 +172,9 @@ class BatchedMatchedFilterDetector:
         self, stack, n_real=None, n_valid: int | None = None,
         with_health: bool = False, health_clip: float | None = None,
     ) -> List[tuple | None]:
-        """Detect over a ``[B, C, T]`` slab.
+        """Detect over a ``[B, C, T]`` slab (dispatch + fetch in one
+        call — ``dispatch_batch(...).resolve()``; see
+        :meth:`dispatch_batch` for the pipelined split).
 
         ``B`` is read from the stack, NOT fixed at construction: one
         facade serves every batch size over its bucket shape, compiling
@@ -194,6 +199,36 @@ class BatchedMatchedFilterDetector:
         per-file route (:meth:`MatchedFilterDetector.detect_picks` on
         the host block).
         """
+        return self.dispatch_batch(
+            stack, n_real=n_real, n_valid=n_valid, with_health=with_health,
+            health_clip=health_clip,
+        ).resolve()
+
+    def dispatch_batch(
+        self, stack, n_real=None, n_valid: int | None = None,
+        with_health: bool = False, health_clip: float | None = None,
+    ) -> InFlightResult:
+        """LAUNCH the batched K0 program without fetching.
+
+        The depth-D pipelined campaign dispatch
+        (``workflows.campaign.run_campaign_batched``,
+        ``parallel.dispatch``; docs/PERF.md "Pipelined dispatch"): slab
+        k+1's program dispatches here while slab k's picks are still in
+        flight. ``handle.resolve()`` — the slab's ONLY device sync —
+        fetches the packed K0 payload, resolves the adaptive-K
+        escalation from that ALREADY-FETCHED payload (the per-file
+        ``sat_count`` rides the packed fetch, so the decision costs no
+        extra round trip), reruns at full capacity only when a row
+        saturated (the slab's final consumer — donated when the caller
+        owns the buffer), and assembles :meth:`detect_batch`'s per-file
+        entry list. The handle keeps the slab alive for that potential
+        rerun and drops its reference the moment picks exist; dropping
+        an UNRESOLVED handle abandons the in-flight program (the
+        campaign does that when a bucket downshifts between dispatch
+        and resolve).
+        """
+        from .. import faults
+
         det = self.det
         C, T = det.design.trace_shape
         B = int(stack.shape[0])
@@ -224,11 +259,12 @@ class BatchedMatchedFilterDetector:
             if int(nr_np.min(initial=T)) < T:
                 nr = jnp.asarray(nr_np)
 
-        def run(k, donate_now):
+        def run(k, donate_now, stack_):
             fn = (batched_detect_picks_program_donated if donate_now
                   else batched_detect_picks_program)
+            faults.count("dispatches")
             return fn(
-                stack, det._mask_band_dev, det._gain_dev,
+                stack_, det._mask_band_dev, det._gain_dev,
                 det._templates_true, det._template_mu, det._template_scale,
                 thr_in, det._cond_scale, nr,
                 band_lo=det._band_lo, band_hi=det._band_hi,
@@ -240,44 +276,59 @@ class BatchedMatchedFilterDetector:
                 with_health=with_health,
                 health_clip=(None if health_clip is None
                              else jnp.float32(health_clip)),
+                pick_engine=det.pick_engine,
             )
 
-        h_counts = h_rms = None
+        # the K0 launch: async — device-side failures surface at
+        # resolve()'s fetch (where the campaign's watchdog/ladder wrap it)
+        state = {"stack": stack, "k0": run(det.pick_k0, False, stack)}
+        del stack
 
-        def fetch(k, donate_now):
-            nonlocal h_counts, h_rms
-            outs = jax.device_get(run(k, donate_now))
-            if with_health:
-                *outs, h_counts, h_rms = outs
-            return outs
+        def resolve() -> List[tuple | None]:
+            h_counts = h_rms = None
 
-        chan, times, cnt, satc, thr = fetch(det.pick_k0, False)
-        if det.pick_k0 < det.max_peaks and int(satc.sum()):
-            # a row saturated at K0: full-capacity rerun — the slab's last
-            # use, so it is donated when the caller owns the buffer
-            chan, times, cnt, satc, thr = fetch(det.max_peaks, self.donate)
-        del stack  # common path: drop our reference the moment picks exist
+            def fetch_payload(outs):
+                nonlocal h_counts, h_rms
+                outs = jax.device_get(outs)
+                faults.count("syncs")
+                if with_health:
+                    *outs, h_counts, h_rms = outs
+                return outs
 
-        n_reals = None if n_real is None else np.asarray(n_real).tolist()
-        out: List[tuple | None] = []
-        for b in range(B if n_valid is None else int(n_valid)):
-            if int(cnt[b].max(initial=0)) > cap:
-                out.append(None)  # packed overflow: exact per-file fallback
-                continue
-            picks, thr_out = {}, {}
-            for i, name in enumerate(names):
-                k = int(cnt[b, i])
-                picks[name] = np.asarray(
-                    [chan[b, i, :k], times[b, i, :k]], dtype=np.int64
+            chan, times, cnt, satc, thr = fetch_payload(state.pop("k0"))
+            if det.pick_k0 < det.max_peaks and int(satc.sum()):
+                # a row saturated at K0: full-capacity rerun — the slab's
+                # last use, so it is donated when the caller owns the
+                # buffer. The escalation decision came from the packed K0
+                # payload fetched above: no extra sync round trip.
+                chan, times, cnt, satc, thr = fetch_payload(
+                    run(det.max_peaks, self.donate, state["stack"])
                 )
-                thr_out[name] = float(thr[b, i])
-                det._warn_saturated(name, int(satc[b, i]))
-            if with_health:
-                ns_b = int(n_reals[b]) if (n_reals is not None
-                                           and b < len(n_reals)) else T
-                out.append((picks, thr_out, health_ops.stats_to_dict(
-                    h_counts[b], h_rms[b], C * ns_b
-                )))
-            else:
-                out.append((picks, thr_out))
-        return out
+            # common path: drop the slab reference the moment picks exist
+            state.clear()
+
+            n_reals = None if n_real is None else np.asarray(n_real).tolist()
+            out: List[tuple | None] = []
+            for b in range(B if n_valid is None else int(n_valid)):
+                if int(cnt[b].max(initial=0)) > cap:
+                    out.append(None)  # packed overflow: exact per-file fallback
+                    continue
+                picks, thr_out = {}, {}
+                for i, name in enumerate(names):
+                    k = int(cnt[b, i])
+                    picks[name] = np.asarray(
+                        [chan[b, i, :k], times[b, i, :k]], dtype=np.int64
+                    )
+                    thr_out[name] = float(thr[b, i])
+                    det._warn_saturated(name, int(satc[b, i]))
+                if with_health:
+                    ns_b = int(n_reals[b]) if (n_reals is not None
+                                               and b < len(n_reals)) else T
+                    out.append((picks, thr_out, health_ops.stats_to_dict(
+                        h_counts[b], h_rms[b], C * ns_b
+                    )))
+                else:
+                    out.append((picks, thr_out))
+            return out
+
+        return InFlightResult(resolve)

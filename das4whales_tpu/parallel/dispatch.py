@@ -1,0 +1,130 @@
+"""Depth-D software-pipelined device dispatch (the anti-sync-wall layer).
+
+BENCH_r05's stage attribution says the chip is 97-99% idle on the
+headline shape: the correlate wall is 0.28 s against a 6.5 ms roofline
+bound — almost the whole stage "wall" is the host↔device sync round
+trip that separates one slab's packed fetch from the next slab's
+dispatch. The reference's per-file scripts have the same structure, one
+dependency chain deep; TINA (arXiv:2408.16551) and the Large-Scale
+DFT-on-TPU work (arXiv:2002.03260) both locate the order-of-magnitude
+in keeping the accelerator's queue non-empty — never bouncing to the
+host for control flow between stages.
+
+This module is the small, deterministic piece that fixes it for the
+campaign runners:
+
+* :class:`PipelinedDispatch` — a bounded in-flight queue of dispatched
+  (launched, unfetched) detection programs. The campaign dispatches
+  slab k+1 (and k+2, … up to depth D) BEFORE taking slab k's packed
+  fetch, so while the host finalizes slab k's manifest records the
+  chip is already computing slabs k+1..k+D. One fetch per slab still
+  happens — it is the data dependency — but it now overlaps compute on
+  the successors instead of leaving the chip idle, and the campaign
+  takes no other sync: one ``drain()`` ends the segment.
+* :func:`launch` / :func:`fetch` / :func:`sync` — counted wrappers
+  around dispatch and the two sync primitives, feeding the
+  process-wide ``faults.counters()`` ``dispatches``/``syncs`` tallies
+  that bench.py reports next to ``stage_wall_s`` — the dispatch wall is
+  a regression-gated NUMBER, not an inference from rooflines.
+
+Failure attribution contract (the chaos suite pins it): a token is
+(key, handle) — the key names the originating slab/file. Dispatch-time
+errors never enter the queue (the caller handles them synchronously);
+an in-flight failure surfaces when the campaign resolves that token at
+its own position in the drain order, inside the campaign's existing
+watchdog/ladder/retry wrappers — so depth-D pipelining changes WHEN a
+failure surfaces, never WHERE it is attributed.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Iterator, List, Tuple
+
+import jax
+
+from .. import faults
+from ..config import dispatch_depth_default
+
+
+def launch(fn, *args, **kwargs):
+    """Dispatch a device program asynchronously: call ``fn`` (a jitted
+    step / program launcher), count the dispatch, return its
+    still-in-flight outputs WITHOUT syncing. The caller's eventual
+    fetch of the outputs (``np.asarray`` / packed ``device_get``) is
+    the sync — pair with :func:`fetch` so it is counted."""
+    faults.count("dispatches")
+    return fn(*args, **kwargs)
+
+
+def fetch(tree):
+    """Counted blocking fetch: ``jax.device_get`` on a tree of in-flight
+    device arrays — the ONE sync its dispatch chain pays."""
+    faults.count("syncs")
+    return jax.device_get(tree)
+
+
+def sync(tree):
+    """Counted ``jax.block_until_ready`` (for callers that need the
+    arrays resident on device, not on host)."""
+    faults.count("syncs")
+    return jax.block_until_ready(tree)
+
+
+class PipelinedDispatch:
+    """A bounded queue of in-flight (dispatched, unresolved) tokens.
+
+    ``depth`` is the maximum number of tokens in flight (None: the
+    ``DAS_DISPATCH_DEPTH`` env default, 2). ``depth <= 1`` disables
+    pipelining — :attr:`enabled` is False and callers fall back to
+    their synchronous dispatch-then-fetch path, byte-identical to the
+    pre-pipeline behavior.
+
+    Usage (the campaign pattern)::
+
+        pipe = PipelinedDispatch(depth)
+        for slab in slabs:
+            handle = try_dispatch(slab)          # async launch, or None
+            if handle is None:                   # ineligible: sync path
+                for key, h in pipe.drain():      # FIFO: order preserved
+                    finalize(key, h)
+                finalize_sync(slab)
+                continue
+            for key, h in pipe.submit(slab, handle):
+                finalize(key, h)                 # resolve = the one sync
+        for key, h in pipe.drain():
+            finalize(key, h)
+
+    The queue is FIFO: tokens come back in submission order, so
+    manifest records keep the campaign's file order and a failure
+    surfacing at ``finalize`` is attributed to ITS key, never to the
+    slab that happened to be dispatching when it surfaced.
+    """
+
+    def __init__(self, depth: int | None = None):
+        self.depth = dispatch_depth_default() if depth is None else int(depth)
+        self._q: deque = deque()
+
+    @property
+    def enabled(self) -> bool:
+        return self.depth >= 2
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def submit(self, key: Any, handle: Any) -> List[Tuple[Any, Any]]:
+        """Enqueue a dispatched token; returns the (key, handle) tokens
+        that must be resolved NOW to keep at most ``depth`` in flight
+        (oldest first)."""
+        self._q.append((key, handle))
+        out: List[Tuple[Any, Any]] = []
+        while len(self._q) > self.depth:
+            out.append(self._q.popleft())
+        return out
+
+    def drain(self) -> Iterator[Tuple[Any, Any]]:
+        """Yield every queued token oldest-first (the end-of-segment —
+        or pre-sync-path — flush). Resolving the last token is the
+        segment's single remaining sync."""
+        while self._q:
+            yield self._q.popleft()

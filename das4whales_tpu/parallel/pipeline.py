@@ -65,7 +65,7 @@ def _mf_body(
     corr = xcorr.compute_cross_correlograms_corrected(
         trf_fk, templates_true, template_mu, template_scale
     )
-    env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
+    env = spectral.envelope_sqrt(corr, axis=-1)
 
     # per-file threshold: global max over templates/channels/time of the file
     local_max = jnp.max(corr, axis=(0, 2, 3))                     # [B/Pf]

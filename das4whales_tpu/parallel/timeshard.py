@@ -367,7 +367,7 @@ def make_sharded_mf_step_time(
         # true-length-template correlate (ops/xcorr.py:padded_template_stats)
         # — half the per-shard FFT length of the padded form
         corr = xcorr.compute_cross_correlograms_corrected(y, tmpl, tmu, tsc)
-        env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
+        env = spectral.envelope_sqrt(corr, axis=-1)
         file_max = jax.lax.pmax(jnp.max(corr), time_axis)
         thres = relative_threshold * file_max
         factors = jnp.ones(n_templates).at[0].set(hf_factor)

@@ -503,6 +503,7 @@ def run_campaign(
     health=True,
     read_deadline_s: float | None = None,
     dispatch_deadline_s: float | None = None,
+    dispatch_depth: int | None = None,
     fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
@@ -539,6 +540,16 @@ def run_campaign(
     (multi-device) -> host — with the winning rung STICKY for the rest
     of the run and ledgered in the manifest (docs/ROBUSTNESS.md
     "Resource ladder").
+
+    ``dispatch_depth`` (None: the ``DAS_DISPATCH_DEPTH`` env default,
+    2) arms DEPTH-D PIPELINED DISPATCH on the healthy per-file rung
+    (``parallel.dispatch``, docs/PERF.md "Pipelined dispatch"): file
+    k+1's one-program detection is dispatched before file k's packed
+    fetch, so its compute overlaps file k's host-side bookkeeping.
+    Applies to sparse-engine :class:`MatchedFilterDetector` campaigns
+    with the fused health gate (the default configuration); every other
+    configuration — and any file whose resolve fails — takes the
+    synchronous path with identical attribution and retries.
     """
     import jax.numpy as jnp
 
@@ -564,10 +575,15 @@ def run_campaign(
     ladder = _DownshiftLadder(rz, outdir, batch=1)
     _BUCKET = "campaign"   # one unbatched campaign = one sticky ladder key
 
-    def detect_one(path, block, t0):
+    def detect_one(path, block, t0, inflight=None):
         """One attempt at the transfer+detect+health half of a file
         (raises on failure; the caller dispositions). Resource-class
-        dispatch failures downshift the route in place (sticky)."""
+        dispatch failures downshift the route in place (sticky).
+        ``inflight`` (``models.matched_filter.InFlightResult``) is the
+        depth-D pipeline's pre-dispatched program for this file: the
+        first healthy-rung attempt consumes its packed fetch instead of
+        dispatching fresh; any failure discards it (retries re-dispatch
+        synchronously)."""
         nonlocal detector
         if fault_plan is not None:
             fault_plan.on_transfer(path)
@@ -602,10 +618,20 @@ def run_campaign(
         recovered = False
         while True:   # rung loop: resource failures downshift, sticky
             rung = ladder.current(_BUCKET) if use_ladder else ("file", 1)
+            if inflight is not None and rung != ("file", 1):
+                # the campaign downshifted between this file's dispatch
+                # and its resolve: the in-flight program ran at a rung
+                # now known to exhaust — abandon it
+                inflight = None
 
-            def dispatch():
+            def dispatch(inflight=inflight):
                 if fault_plan is not None:
                     fault_plan.on_dispatch(path, rung)
+                if inflight is not None:
+                    # the pipeline's pre-dispatched program: this is its
+                    # packed fetch (the one sync), inside the watchdog
+                    res = inflight.resolve()
+                    return res.picks, res.thresholds, res.health
                 if use_ladder and (fused or rung[0] != "file"):
                     return _detect_file_at_rung(
                         detector, rung, block.trace,
@@ -633,6 +659,7 @@ def run_campaign(
                 )
                 break
             except Exception as exc:  # noqa: BLE001 — ladder absorbs resource
+                inflight = None   # spent/abandoned: never consume twice
                 if (use_ladder
                         and faults.classify_failure(exc) == "resource"
                         and ladder.downshift(_BUCKET, rung, exc,
@@ -659,6 +686,50 @@ def run_campaign(
         records.append(rec)
 
     from ..ops import health as health_ops
+    from ..parallel.dispatch import PipelinedDispatch
+
+    pipe = PipelinedDispatch(dispatch_depth)
+
+    def try_dispatch_file(path, block):
+        """The pipeline's dispatch phase: launch this file's one-program
+        detection asynchronously when the campaign rides the healthy
+        per-file rung with the fused health gate. None -> the
+        synchronous path (attribution-identical; also taken for the
+        first file, which builds the detector)."""
+        if not pipe.enabled or detector is None or rz.health_cfg is None:
+            return None
+        if not (isinstance(detector, MatchedFilterDetector)
+                and detector.pick_mode == "sparse"
+                and detector.supports_fused_health
+                and ladder.current(_BUCKET) == ("file", 1)):
+            return None
+        det_meta = getattr(detector, "metadata", None)
+        if (wire == "raw" and det_meta is not None
+                and block.metadata is not None
+                and block.metadata.scale_factor != det_meta.scale_factor):
+            return None   # detect_one fails it per-file on the sync path
+        try:
+            return detector.dispatch_picks(
+                block.trace, with_health=True,
+                health_clip=rz.health_cfg.clip_abs,
+            )
+        except Exception:  # noqa: BLE001 — surfaces on the sync path
+            return None
+
+    def finalize_file(path, block, t0, infl) -> None:
+        while True:  # transfer+detect attempts (block already read)
+            rz.attempt(path)
+            try:
+                detect_one(path, block, t0, inflight=infl)
+            except Exception as exc:  # noqa: BLE001
+                infl = None   # retries re-dispatch synchronously
+                if rz.dispose(path, exc) == "retry":
+                    continue
+            break
+
+    def drain_pipe() -> None:
+        for tok, queued in pipe.drain():
+            finalize_file(*tok, queued)
 
     i = 0
     while i < len(pending):
@@ -679,21 +750,25 @@ def run_campaign(
                 i = len(pending)
                 break
             except Exception as exc:  # noqa: BLE001 — per-file isolation
+                # queued in-flight files are earlier, healthy reads:
+                # finalize them first so their records precede the
+                # culprit's in the manifest
+                drain_pipe()
                 rz.attempt(path)
                 if rz.dispose(path, exc) == "next":
                     i += 1
                 break  # restart the stream either way
             t0 = time.perf_counter()
-            while True:  # transfer+detect attempts (block already read)
-                rz.attempt(path)
-                try:
-                    detect_one(path, block, t0)
-                except Exception as exc:  # noqa: BLE001
-                    if rz.dispose(path, exc) == "retry":
-                        continue
-                break
+            infl = try_dispatch_file(path, block)
+            if infl is None:
+                drain_pipe()
+                finalize_file(path, block, t0, None)
+            else:
+                for tok, queued in pipe.submit((path, block, t0), infl):
+                    finalize_file(*tok, queued)
             i += 1
         del stream
+    drain_pipe()   # end of segment: the one remaining sync
     rz.flush_tallies()
     return CampaignResult(outdir=outdir, records=records)
 
@@ -720,6 +795,7 @@ def run_campaign_batched(
     read_deadline_s: float | None = None,
     dispatch_deadline_s: float | None = None,
     preflight: bool | None = None,
+    dispatch_depth: int | None = None,
     fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
@@ -779,6 +855,24 @@ def run_campaign_batched(
     starts at the largest batch whose program fits
     ``DAS_HBM_BUDGET_GB`` — and shapes that fit at no rung are skipped
     up front instead of dispatched into a certain OOM.
+
+    ``dispatch_depth`` (None: the ``DAS_DISPATCH_DEPTH`` env default,
+    2) arms DEPTH-D PIPELINED DISPATCH (``parallel.dispatch``,
+    docs/PERF.md "Pipelined dispatch"): while a bucket rides its top
+    (healthy) rung, slab k+1's K0 program is dispatched BEFORE slab k's
+    packed fetch is taken, so H2D, compute and fetch of different slabs
+    overlap and the campaign takes one sync per slab that itself
+    overlaps the successors' compute — no idle dispatch wall between
+    slabs. The adaptive-K escalation is decided from the already-fetched
+    K0 payload (``sat_count`` rides the packed fetch). Every resilience
+    contract is unchanged: an in-flight failure surfaces when ITS slab
+    resolves — in file order, inside the same watchdog/ladder/degrade
+    wrappers — so manifest attribution, the chaos oracle and the sticky
+    downshift ledger are byte-identical to ``dispatch_depth=1``
+    (synchronous, the pre-pipeline behavior; also the fallback whenever
+    a bucket leaves its top rung). Device memory holds up to
+    ``dispatch_depth`` slabs' programs in flight on top of the transfer
+    pipeline's ``in_flight`` stacks.
     """
     import jax.numpy as jnp
 
@@ -917,14 +1011,23 @@ def run_campaign_batched(
 
         return dispatched([slab.paths[k]], rung, fn)
 
-    def run_rung(slab, rung, bdet, ok):
+    def run_rung(slab, rung, bdet, ok, inflight=None):
         """The whole slab's entries at one ladder rung — aligned with
         ``range(slab.n_valid)``; raises on the rung's failure (resource
-        -> the caller downshifts)."""
+        -> the caller downshifts). ``inflight`` (an
+        ``InFlightResult`` from the depth-D pipeline's dispatch phase)
+        short-circuits the top batched rung: the program is already
+        running — the watchdogged call here is its packed fetch, with
+        the chaos dispatch hooks firing inside the deadline exactly
+        like a fresh dispatch (an async launch's failure also surfaces
+        at the fetch)."""
         det = bdet.det
         stage, b = rung
         if stage == "batched":
             if b >= batch:
+                if inflight is not None:
+                    return dispatched(list(slab.paths), rung,
+                                      inflight.resolve)
                 subs = [slab]
             else:
                 # re-bucket from the assembler's HOST blocks: the device
@@ -959,7 +1062,7 @@ def run_campaign_batched(
             entries.append(dispatched([slab.paths[k]], rung, fn))
         return entries
 
-    def handle_slab(slab) -> None:
+    def handle_slab(slab, inflight=None) -> None:
         bdet = detector_for(slab)
         det = bdet.det
         key = _bucket_key(slab)
@@ -1005,12 +1108,24 @@ def run_campaign_batched(
                             rz.attempt(slab.paths[k])
                             raise
             rung = ladder.current(key)
+            if inflight is not None and rung != ("batched", batch):
+                # the bucket downshifted between this slab's dispatch and
+                # its resolve (an earlier in-flight slab OOMed): the
+                # pre-dispatched program ran at a rung now known to
+                # exhaust — discard the handle (abandoning the in-flight
+                # work) and run at the sticky rung instead
+                inflight = None
             shape = (int(slab.stack.shape[1]), slab.bucket_ns)
             while True:   # the elastic ladder: downshift on resource
                 try:
-                    results = run_rung(slab, rung, bdet, ok)
+                    results = run_rung(slab, rung, bdet, ok,
+                                       inflight=inflight)
                     break
                 except Exception as exc:  # noqa: BLE001
+                    # never reuse a handle past a failure: a timed-out
+                    # resolve was abandoned mid-fetch on the watchdog
+                    # worker, and a failed one is spent
+                    inflight = None
                     fclass = faults.classify_failure(exc)
                     if fclass == "fatal":
                         raise
@@ -1095,39 +1210,98 @@ def run_campaign_batched(
                         continue
                 break
 
+    from ..parallel.dispatch import PipelinedDispatch
+
+    pipe = PipelinedDispatch(dispatch_depth)
+
+    def try_dispatch(slab):
+        """The pipeline's dispatch phase: launch the slab's K0 program
+        asynchronously when the bucket rides its healthy top rung.
+        Returns None (-> the synchronous path) for downshifted or
+        skipped buckets, batch=1 campaigns (their top rung is the
+        per-file route), and dispatch-time failures — which the sync
+        path then re-raises at this slab's own turn, keeping
+        attribution identical to the unpipelined campaign."""
+        if not pipe.enabled or batch < 2:
+            return None
+        try:
+            # everything here can fail (detector build, preflight,
+            # tracing): any failure routes the slab to the synchronous
+            # path, where handle_slab re-raises it under the same
+            # per-file guards as the unpipelined campaign
+            bdet = detector_for(slab)
+            key = _bucket_key(slab)
+            if (key in skip_buckets
+                    or ladder.current(key) != ("batched", batch)):
+                return None
+            return bdet.dispatch_batch(
+                slab.stack, n_real=slab.n_real, n_valid=slab.n_valid,
+                with_health=with_health, health_clip=clip,
+            )
+        except CampaignAborted:
+            raise
+        except Exception:  # noqa: BLE001 — surfaces on the sync path
+            return None
+
+    def finalize(slab, inflight) -> None:
+        try:
+            handle_slab(slab, inflight)
+        except CampaignAborted:
+            raise
+        except Exception as exc:  # noqa: BLE001 — slab-level guard
+            # a whole-slab failure the ladder could not absorb
+            # (detector build, fatal-class program error) fails
+            # each of its files, preserving max_failures — except
+            # files already dispositioned this run (a
+            # scale-mismatched file was failed inside handle_slab
+            # before the slab program ran; double-counting it
+            # would fire max_failures one file early and write a
+            # duplicate manifest record)
+            if faults.classify_failure(exc) == "fatal":
+                raise
+            dispositioned = {r.path for r in records}
+            for path in slab.paths:
+                if path not in dispositioned:
+                    fail(path, exc)
+
+    def drain_pipe() -> None:
+        for queued_slab, queued_infl in pipe.drain():
+            finalize(queued_slab, queued_infl)
+
+    # the transfer pipeline must keep at least `depth` slabs moving or
+    # the dispatch pipeline starves waiting on H2D (io.stream documents
+    # the combined residency bound: in_flight + depth + 1 slabs)
+    stream_in_flight = max(in_flight, pipe.depth) if pipe.enabled else in_flight
+
     i = 0
     while i < len(pending):
         slabs = stream_batched_slabs(
             pending[i:], selected_channels, pend_metas[i:], batch=batch,
             bucket=bucket, interrogator=interrogator, prefetch=prefetch,
-            engine=engine, wire=wire, in_flight=in_flight,
+            engine=engine, wire=wire, in_flight=stream_in_flight,
             read_deadline_s=read_deadline_s, fault_plan=fault_plan,
         )
         try:
             for slab in slabs:
-                try:
-                    handle_slab(slab)
-                except CampaignAborted:
-                    raise
-                except Exception as exc:  # noqa: BLE001 — slab-level guard
-                    # a whole-slab failure the ladder could not absorb
-                    # (detector build, fatal-class program error) fails
-                    # each of its files, preserving max_failures — except
-                    # files already dispositioned this run (a
-                    # scale-mismatched file was failed inside handle_slab
-                    # before the slab program ran; double-counting it
-                    # would fire max_failures one file early and write a
-                    # duplicate manifest record)
-                    if faults.classify_failure(exc) == "fatal":
-                        raise
-                    dispositioned = {r.path for r in records}
-                    for path in slab.paths:
-                        if path not in dispositioned:
-                            fail(path, exc)
+                infl = try_dispatch(slab)
+                if infl is None:
+                    # ineligible slab: flush the queue (FIFO — manifest
+                    # order is file order) and run it synchronously
+                    drain_pipe()
+                    finalize(slab, None)
+                else:
+                    for tok in pipe.submit(slab, infl):
+                        finalize(*tok)
+            # end of segment: resolving the queued tail is the segment's
+            # one remaining sync — no per-slab block_until_ready anywhere
+            drain_pipe()
         except SlabReadError as exc:
             # the assembler attributes the culprit's index; classify its
             # cause — transient earns a retry AT the culprit, timeout /
-            # corrupt / data disposition it and resume past
+            # corrupt / data disposition it and resume past. Queued
+            # in-flight slabs hold earlier (healthy) files: finalize them
+            # first so their records precede the culprit's
+            drain_pipe()
             path = pending[i + exc.index]
             rz.attempt(path)
             if rz.dispose(path, exc.cause) == "retry":
@@ -1389,27 +1563,38 @@ def run_campaign_sharded(
     factors = {name: (hf_factor if i == 0 else 1.0)
                for i, name in enumerate(design.template_names)}
 
+    from ..parallel import dispatch as dispatch_mod
+
     def process_batch(stack, blocks, step_k0, step_full, consumed):
         t0 = time.perf_counter()
-        sp_picks, thres = jax.block_until_ready(step_k0(stack))
-        if int(np.asarray(jnp.sum(sp_picks.saturated))):
+        # ASYNC dispatch (no block_until_ready wall): the one-scalar
+        # saturation fetch below is the escalation decision's only sync,
+        # and the packed pick fetch further down is the batch's data
+        # sync — dropping the per-batch block_until_ready lets the next
+        # batch's H2D (the stream's transfer thread) overlap this
+        # batch's compute (ISSUE 6; docs/PERF.md "Pipelined dispatch")
+        sp_picks, thres = dispatch_mod.launch(step_k0, stack)
+        if int(dispatch_mod.fetch(jnp.sum(sp_picks.saturated))):
             # a row saturated at K0: rerun at full capacity (same
             # escalation contract as ops.peaks.picks_with_escalation)
-            sp_picks, thres = jax.block_until_ready(step_full(stack))
-        wall = time.perf_counter() - t0
-        thres_np = np.asarray(thres)
+            sp_picks, thres = dispatch_mod.launch(step_full, stack)
         # pack picks on the mesh before they cross to the host (same
         # boundary-crossing reduction as the single-chip detector's
         # device-side compaction, models/matched_filter.py): only
         # O(actual picks) ints transfer instead of the [nT, B, C, K]
         # slot grid. Overflow (count > cap) falls back to the exact
-        # full-grid transfer — never silent truncation.
+        # full-grid transfer — never silent truncation. The pack
+        # dispatches BEFORE the thres fetch: fetching the scalar first
+        # would serialize the pack behind a host round trip — the exact
+        # gap this route exists to remove.
         nT, B, Cr, K = sp_picks.positions.shape
         cap = min(Cr * K, _PICK_PACK_CAP)
         rows_d, times_d, cnt_d = _compact_batch_picks(
             sp_picks.positions, sp_picks.selected, spec0.meta.ns, cap
         )
+        thres_np = dispatch_mod.fetch(thres)
         host_picks = None
+        faults.count("syncs")   # compacted_to_host's np.asarray fetch
         packed = compacted_to_host(rows_d, times_d, cnt_d, cap)
         if packed is not None:
             rows_np, times_np, cnt = packed
@@ -1419,6 +1604,10 @@ def run_campaign_sharded(
                 positions=np.asarray(sp_picks.positions),
                 selected=np.asarray(sp_picks.selected),
             )
+        # the packed fetch above was the batch's data sync: the wall now
+        # covers dispatch+compute+fetch, like the old block_until_ready
+        # placement, without having serialized the next batch behind it
+        wall = time.perf_counter() - t0
         # an elastic re-run replays the whole in-flight batch: files the
         # aborted first pass already recorded must not gain a duplicate
         # done record (and artifact) here
@@ -1702,21 +1891,29 @@ def run_campaign_multiprocess(
             return np.stack(rows)
 
         x = jax.make_array_from_callback((batch, C, ns), sharding, _shard)
-        sp_picks, thres = jax.block_until_ready(step_k0(x))
-        # replicated scalar -> the same escalation decision on every
-        # process (no extra collective round)
-        if int(np.asarray(jnp.sum(sp_picks.saturated))):
-            sp_picks, thres = jax.block_until_ready(step_full(x))
-        wall = time.perf_counter() - t0
-        thres_np = np.asarray(
-            multihost_utils.process_allgather(thres, tiled=True)
-        ).reshape(batch)
+        from ..parallel import dispatch as dispatch_mod
 
+        # async dispatch: the replicated saturation scalar fetched below
+        # is the escalation decision's only sync (same decision on every
+        # process, no extra collective round); the pick allgathers are
+        # the batch's data sync — no per-batch block_until_ready wall
+        sp_picks, thres = dispatch_mod.launch(step_k0, x)
+        if int(dispatch_mod.fetch(jnp.sum(sp_picks.saturated))):
+            sp_picks, thres = dispatch_mod.launch(step_full, x)
+        wall = time.perf_counter() - t0
+
+        # the device-side pack dispatches BEFORE the thres allgather:
+        # gathering the scalar first would serialize the pack behind a
+        # full collective round trip on every process
         nT, _, Cr, K = sp_picks.positions.shape
         cap = min(Cr * K, _PICK_PACK_CAP)
         rows_d, times_d, cnt_d = _compact_batch_picks(
             sp_picks.positions, sp_picks.selected, ns, cap
         )
+        faults.count("syncs")   # the allgather is this batch's sync point
+        thres_np = np.asarray(
+            multihost_utils.process_allgather(thres, tiled=True)
+        ).reshape(batch)
         # counts first (nT*B ints), then DEVICE-slice to the pow2 max
         # before the cross-host gather — only actual picks ride DCN, the
         # same trick compacted_to_host plays for the device->host hop

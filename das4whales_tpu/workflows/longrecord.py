@@ -25,10 +25,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults
 from ..config import as_metadata
 from ..io.stream import stream_strain_blocks
 from ..models.matched_filter import design_matched_filter
 from ..ops import peaks as peak_ops
+from ..parallel import dispatch as dispatch_mod
 from ..parallel.mesh import make_mesh
 from ..parallel.timeshard import make_sharded_mf_step_time, time_sharding
 from ..utils.log import get_logger
@@ -212,7 +214,12 @@ def detect_long_record(
         cmesh = make_mesh(shape=(p,), axis_names=("channel",),
                           devices=np.asarray(mesh.devices).reshape(-1))
         score_fn, put = _learned.make_sharded_inference(params_l, cfg_l, cmesh)
-        scores = np.asarray(jax.block_until_ready(score_fn(put(record))))
+        # pipelined-dispatch discipline (parallel.dispatch): launch the
+        # step asynchronously; the counted fetch below IS the sync — no
+        # block_until_ready double round trip
+        scores = np.asarray(dispatch_mod.fetch(
+            dispatch_mod.launch(score_fn, put(record))
+        ))
         det = _learned.LearnedDetector(params_l, cfg_l, threshold=thr_l)
         res = det.picks_from_scores(scores)
         pk = res.picks[det.name]
@@ -271,10 +278,19 @@ def detect_long_record(
             fused_bandpass=fused_bandpass, outputs="picks",
             wire=wire, **cond_kw,
         )
-        sp_picks, thres = jax.block_until_ready(step(xd))
+        # async dispatch (parallel.dispatch): the device-side pick pack
+        # below is dispatched back-to-back with the step — the old
+        # per-step block_until_ready serialized the pack behind a full
+        # host round trip for nothing (ISSUE 6 sync-in-loop burn-down).
+        # thr_map is DEFERRED: float(thres) blocks on the step, so
+        # fetching it here would serialize the pack dispatch just as
+        # block_until_ready did
+        sp_picks, thres = dispatch_mod.launch(step, xd)
         names = design.template_names
-        thr_map = {name: float(thres) * (hf_factor if i == 0 else 1.0)
-                   for i, name in enumerate(names)}
+        thr_map_fn = lambda: {
+            name: float(thres) * (hf_factor if i == 0 else 1.0)
+            for i, name in enumerate(names)
+        }
         pos_scale = 1
     else:
         # shared front end (the spectro/gabor workflows' prologue):
@@ -316,7 +332,7 @@ def detect_long_record(
                 max_peaks=max_peaks_per_channel, time_axis=time_axis,
                 **fam_kw,
             )
-            sp_picks = jax.block_until_ready(step(trf_dev))
+            sp_picks = dispatch_mod.launch(step, trf_dev)
             # echo the threshold the factory actually used (its own
             # signature default is the single source)
             import inspect
@@ -325,7 +341,7 @@ def detect_long_record(
                 make_sharded_spectro_step_time
             ).parameters["threshold"].default
             thr = float(fam_kw.get("threshold", factory_default))
-            thr_map = {name: thr for name in names}
+            thr_map_fn = lambda: {name: thr for name in names}  # host value
             pos_scale = nhop                   # frame index -> sample index
         else:
             from ..parallel.gabor import make_sharded_gabor_step_time
@@ -341,21 +357,30 @@ def detect_long_record(
                 n_channels=nnx, outputs="picks",
                 **fam_kw,
             )
-            sp_picks, thres = jax.block_until_ready(step(trf_dev))
-            thr_map = {name: float(thres) * (hf_factor if name == "HF" else 1.0)
-                       for name in names}
+            sp_picks, thres = dispatch_mod.launch(step, trf_dev)
+            # deferred (fetched after the pick pack is dispatched)
+            thr_map_fn = lambda: {
+                name: float(thres) * (hf_factor if name == "HF" else 1.0)
+                for name in names
+            }
             pos_scale = 1
 
     picks, times_s, thr_out = {}, {}, {}
-    saturated = np.asarray(sp_picks.saturated)
     # drop picks inside the divisibility padding (padded zeros cannot
     # raise the pmax threshold, but the envelope can ring there); the
-    # mask runs on raw (pre-scale) positions inside the device pack
+    # mask runs on raw (pre-scale) positions inside the device pack.
+    # The pack dispatches FIRST — before any fetch of the step's
+    # outputs — so it runs back-to-back with the step; only then do the
+    # saturated/threshold fetches block (on a step that the pack is
+    # already queued behind)
     ns_eff = (n_samples - 1) // pos_scale + 1
     cap = min(int(np.prod(sp_picks.positions.shape[-2:])), _PICK_PACK_CAP)
-    rows_d, times_d, cnt_d = _pack_record_picks(
-        sp_picks.positions, sp_picks.selected, ns_eff, cap
+    rows_d, times_d, cnt_d = dispatch_mod.launch(
+        _pack_record_picks, sp_picks.positions, sp_picks.selected, ns_eff, cap
     )
+    saturated = dispatch_mod.fetch(sp_picks.saturated)
+    thr_map = thr_map_fn()   # scalar transfer; the step already finished
+    faults.count("syncs")   # compacted_to_host's np.asarray is the sync
     packed = peak_ops.compacted_to_host(rows_d, times_d, cnt_d, cap)
     if packed is not None:
         rows_np, times_np, cnt = packed

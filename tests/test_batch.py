@@ -423,8 +423,13 @@ def test_campaign_slab_failure_does_not_double_fail(tmp_path, monkeypatch):
     def boom(self, stack, n_real=None, n_valid=None, **kw):
         raise RuntimeError("program exploded")
 
+    # dispatch_batch is the layer BOTH campaign paths share: the depth-D
+    # pipeline's async launch (whose dispatch-time failure routes the
+    # slab to the synchronous path) and the synchronous detect_batch
+    # (== dispatch_batch().resolve()) — so the injected whole-slab
+    # failure fires however the campaign routes the slab
     monkeypatch.setattr(
-        batch_mod.BatchedMatchedFilterDetector, "detect_batch", boom
+        batch_mod.BatchedMatchedFilterDetector, "dispatch_batch", boom
     )
     out = str(tmp_path / "camp")
     before = faults.counters()

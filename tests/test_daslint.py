@@ -685,3 +685,135 @@ class TestRecompileGuard:
         _, warm = compile_guard.count_compiles(f, x)
         assert cold >= 1
         assert warm == 0
+
+
+# ---------------------------------------------------------------------------
+# R6 — host-side device syncs inside loop bodies (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+class TestR6SyncInLoop:
+    PATH = "das4whales_tpu/workflows/scratch.py"
+
+    def test_block_until_ready_in_loop_flagged(self):
+        f = run(
+            """
+            import jax
+
+            def campaign(slabs, step):
+                out = []
+                for slab in slabs:
+                    out.append(jax.block_until_ready(step(slab)))
+                return out
+            """,
+            path=self.PATH,
+        )
+        assert codes(f) == ["sync-in-loop"]
+
+    def test_device_get_and_item_in_loop_flagged(self):
+        f = run(
+            """
+            import jax
+
+            def drain(handles, thr):
+                for h in handles:
+                    x = jax.device_get(h)
+                    if thr.item() > 0:
+                        yield x
+            """,
+            path=self.PATH,
+        )
+        assert sorted(codes(f)) == ["item-in-loop", "sync-in-loop"]
+
+    def test_np_asarray_of_call_result_in_loop_flagged(self):
+        f = run(
+            """
+            import numpy as np
+
+            def fetch_each(blocks, step):
+                return [np.asarray(step(b)) for b in blocks]
+
+            def fetch_loop(blocks, step):
+                out = []
+                for b in blocks:
+                    out.append(np.asarray(step(b)))
+                return out
+            """,
+            path=self.PATH,
+        )
+        # statement loops only (comprehensions are not For nodes)
+        assert codes(f) == ["host-transfer-in-loop"]
+
+    def test_np_asarray_of_host_array_not_flagged(self):
+        f = run(
+            """
+            import numpy as np
+
+            def stack(blocks):
+                out = []
+                for b in blocks:
+                    out.append(np.asarray(b))      # existing array: free
+                    out.append(np.asarray([1, 2]))  # literal: free
+                return out
+            """,
+            path=self.PATH,
+        )
+        assert f == []
+
+    def test_sync_outside_loop_not_flagged(self):
+        f = run(
+            """
+            import jax
+
+            def once(step, x):
+                return jax.block_until_ready(step(x))
+            """,
+            path=self.PATH,
+        )
+        assert f == []
+
+    def test_out_of_scope_package_not_flagged(self):
+        f = run(
+            """
+            import jax
+
+            def plot_all(figs, step):
+                for fg in figs:
+                    jax.block_until_ready(step(fg))
+            """,
+            path="das4whales_tpu/viz/scratch.py",
+        )
+        assert f == []
+
+    def test_jit_bodies_stay_r1_territory(self):
+        # inside a jitted function a sync is R1's finding, not R6's —
+        # no double report
+        f = run(
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                out = 0.0
+                for _ in range(3):
+                    out = out + float(np.asarray(x).sum())
+                return out
+            """,
+            path=self.PATH,
+        )
+        assert "sync-in-loop" not in codes(f)
+        assert any(c in ("host-transfer-np-asarray", "host-sync-cast")
+                   for c in codes(f))
+
+    def test_inline_allow_suppresses(self):
+        f = run(
+            """
+            import jax
+
+            def drain(handles):
+                for h in handles:
+                    jax.block_until_ready(h)  # daslint: allow[R6]
+            """,
+            path=self.PATH,
+        )
+        assert f == []

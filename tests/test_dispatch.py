@@ -1,0 +1,255 @@
+"""Depth-D pipelined campaign dispatch (ISSUE 6).
+
+The contract pinned here: with the default depth-2 pipeline, campaign
+picks/manifests are BIT-IDENTICAL to the synchronous (depth<=1) path;
+the pipeline compiles each (bucket, B) program exactly once
+(``compile_guard``); an in-flight failure is attributed to its
+originating file at drain time; and the ``PipelinedDispatch`` queue
+itself preserves FIFO order and the depth bound.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from das4whales_tpu import faults
+from das4whales_tpu.io.stream import stream_strain_blocks
+from das4whales_tpu.io.synth import (
+    SyntheticCall,
+    SyntheticScene,
+    write_synthetic_file,
+)
+from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+from das4whales_tpu.parallel.dispatch import PipelinedDispatch
+from das4whales_tpu.workflows.campaign import (
+    load_picks,
+    run_campaign,
+    run_campaign_batched,
+)
+
+NX = 24
+NS = 900
+SEL = [0, NX, 1]
+
+
+def _write_files(tmp_path, lengths, stem="f"):
+    paths = []
+    for k, ns in enumerate(lengths):
+        scene = SyntheticScene(
+            nx=NX, ns=ns, noise_rms=0.05, seed=k,
+            calls=[SyntheticCall(t0=1.2 + 0.3 * k, x0_m=NX / 2 * 2.042,
+                                 amplitude=2.0)],
+        )
+        p = str(tmp_path / f"{stem}{k}.h5")
+        write_synthetic_file(p, scene)
+        paths.append(p)
+    return paths
+
+
+def _campaign_picks(res):
+    out = {}
+    for r in res.records:
+        assert r.status == "done", (r.path, r.status, r.error)
+        out[r.path] = load_picks(r.picks_file)
+    return out
+
+
+def _assert_campaigns_identical(res_a, res_b):
+    picks_a, picks_b = _campaign_picks(res_a), _campaign_picks(res_b)
+    assert set(map(_stem, picks_a)) == set(map(_stem, picks_b))
+    by_stem_b = {_stem(p): v for p, v in picks_b.items()}
+    total = 0
+    for p, pk in picks_a.items():
+        pk_b = by_stem_b[_stem(p)]
+        assert set(pk) == set(pk_b)
+        for name in pk:
+            np.testing.assert_array_equal(pk[name], pk_b[name])
+            total += pk[name].shape[1]
+    assert total > 0, "parity over an empty pick set proves nothing"
+
+
+def _stem(p):
+    import os
+
+    return os.path.basename(p)
+
+
+# ---------------------------------------------------------------------------
+# The queue itself
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_queue_fifo_and_depth_bound():
+    pipe = PipelinedDispatch(2)
+    assert pipe.enabled
+    drained = []
+    for k in range(5):
+        drained += pipe.submit(k, f"h{k}")
+        assert len(pipe) <= 2
+    drained += list(pipe.drain())
+    assert [k for k, _ in drained] == list(range(5))      # FIFO
+    assert [h for _, h in drained] == [f"h{k}" for k in range(5)]
+    assert len(pipe) == 0
+
+
+def test_pipeline_queue_disabled_below_two():
+    for depth in (0, 1):
+        pipe = PipelinedDispatch(depth)
+        assert not pipe.enabled
+    # env default resolution
+    pipe = PipelinedDispatch(None)
+    assert pipe.depth >= 1
+
+
+def test_pipeline_env_default(monkeypatch):
+    monkeypatch.setenv("DAS_DISPATCH_DEPTH", "4")
+    assert PipelinedDispatch(None).depth == 4
+    monkeypatch.setenv("DAS_DISPATCH_DEPTH", "bogus")
+    assert PipelinedDispatch(None).depth == 2
+
+
+# ---------------------------------------------------------------------------
+# Batched campaign: pipelined == synchronous, bit-identical
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("wire", ["conditioned", "raw"])
+def test_batched_campaign_depth2_matches_sync(tmp_path, wire):
+    paths = _write_files(tmp_path, [NS] * 5)   # 2 full slabs + partial
+    res_sync = run_campaign_batched(
+        paths, SEL, str(tmp_path / "sync"), batch=2, bucket="pow2",
+        wire=wire, persistent_cache=False, dispatch_depth=1,
+    )
+    res_pipe = run_campaign_batched(
+        paths, SEL, str(tmp_path / "pipe"), batch=2, bucket="pow2",
+        wire=wire, persistent_cache=False, dispatch_depth=2,
+    )
+    assert res_sync.n_done == res_pipe.n_done == 5
+    _assert_campaigns_identical(res_sync, res_pipe)
+
+
+def test_unbatched_campaign_depth2_matches_sync(tmp_path):
+    paths = _write_files(tmp_path, [NS] * 4)
+    blk = next(stream_strain_blocks(paths[:1], SEL, as_numpy=True))
+    det = MatchedFilterDetector(
+        blk.metadata, SEL, np.asarray(blk.trace).shape,
+        pick_mode="sparse", keep_correlograms=False,
+    )
+    res_sync = run_campaign(paths, SEL, str(tmp_path / "sync"),
+                            detector=det, dispatch_depth=1)
+    res_pipe = run_campaign(paths, SEL, str(tmp_path / "pipe"),
+                            detector=det, dispatch_depth=2)
+    assert res_sync.n_done == res_pipe.n_done == 4
+    _assert_campaigns_identical(res_sync, res_pipe)
+
+
+def test_depth2_counts_dispatches_and_syncs(tmp_path):
+    """The dispatch-wall counters: a healthy 2-slab batched campaign at
+    depth 2 takes exactly one dispatch + one sync per slab."""
+    paths = _write_files(tmp_path, [NS] * 4)
+    before = faults.counters()
+    res = run_campaign_batched(paths, SEL, str(tmp_path / "c"), batch=2,
+                               bucket="pow2", persistent_cache=False,
+                               dispatch_depth=2)
+    delta = faults.counters_delta(before)
+    assert res.n_done == 4
+    assert delta["dispatches"] == 2      # one K0 launch per slab
+    assert delta["syncs"] == 2           # one packed fetch per slab
+
+
+# ---------------------------------------------------------------------------
+# Compile discipline: pipelining must not add programs
+# ---------------------------------------------------------------------------
+
+
+def test_depth2_pipeline_compiles_once_per_bucket_B(tmp_path, compile_guard):
+    """Depth-D pipelining still compiles each (bucket, B) program exactly
+    once: after a warm campaign, a second pipelined campaign over fresh
+    same-shape files triggers zero XLA compiles."""
+    paths = _write_files(tmp_path, [NS] * 6)
+    run_campaign_batched(paths, SEL, str(tmp_path / "warm"), batch=2,
+                         bucket="pow2", persistent_cache=False,
+                         dispatch_depth=2)
+    fresh = _write_files(tmp_path, [NS] * 4, stem="g")
+    with compile_guard.forbid_recompile(
+        "depth-2 pipelined run_campaign_batched at a warmed (bucket, B)"
+    ):
+        res = run_campaign_batched(fresh, SEL, str(tmp_path / "again"),
+                                   batch=2, bucket="pow2",
+                                   persistent_cache=False, dispatch_depth=2)
+    assert res.n_done == 4
+
+
+# ---------------------------------------------------------------------------
+# In-flight failure attribution
+# ---------------------------------------------------------------------------
+
+
+def test_inflight_failure_attributes_to_its_own_slab(tmp_path, monkeypatch):
+    """A failure surfacing at RESOLVE time (the in-flight program's
+    fetch) lands on the originating slab's files — not on the slab that
+    was dispatching when it surfaced — and the healthy neighbours still
+    complete via the per-file degradation ladder."""
+    from das4whales_tpu.parallel import batch as batch_mod
+
+    paths = _write_files(tmp_path, [NS] * 6)
+    poisoned = {_stem(paths[2]), _stem(paths[3])}   # slab 2 of 3
+
+    real_dispatch = batch_mod.BatchedMatchedFilterDetector.dispatch_batch
+
+    def failing_dispatch(self, stack, n_real=None, n_valid=None, **kw):
+        handle = real_dispatch(self, stack, n_real=n_real,
+                               n_valid=n_valid, **kw)
+        if n_valid == 2:
+            # identify the slab by its paths via the campaign's stream
+            # order: poison resolve for the slab holding files 2-3
+            idx = failing_dispatch.count
+            failing_dispatch.count += 1
+            if idx == 1:
+                def boom():
+                    raise RuntimeError("injected: in-flight fetch failed")
+                from das4whales_tpu.models.matched_filter import (
+                    InFlightResult,
+                )
+                return InFlightResult(boom)
+        return handle
+
+    failing_dispatch.count = 0
+    monkeypatch.setattr(batch_mod.BatchedMatchedFilterDetector,
+                        "dispatch_batch", failing_dispatch)
+    res = run_campaign_batched(paths, SEL, str(tmp_path / "c"), batch=2,
+                               bucket="pow2", persistent_cache=False,
+                               dispatch_depth=2, retry=False)
+    by_path = {r.path: r for r in res.records}
+    # every file completes: the poisoned slab's resolve failure degrades
+    # to the per-file route (transient class -> slab degradation ladder)
+    assert res.n_done == 6, [(r.path, r.status, r.error)
+                             for r in res.records]
+    # and the degradation was charged to the poisoned slab's files only
+    assert faults.counters()["degradations"] >= 1
+    for p in paths:
+        assert by_path[p].status == "done"
+
+
+def test_slab_read_error_drains_pipeline_first(tmp_path):
+    """A mid-campaign reader failure surfaces AFTER the queued healthy
+    slabs resolve: their records precede the culprit's in the manifest
+    and nothing is lost."""
+    paths = _write_files(tmp_path, [NS] * 5)
+    with open(paths[3], "wb") as fh:        # truncate file 3 to garbage
+        fh.write(b"not an hdf5 file")
+    res = run_campaign_batched(paths, SEL, str(tmp_path / "c"), batch=2,
+                               bucket="pow2", persistent_cache=False,
+                               dispatch_depth=2, retry=False)
+    by_path = {r.path: r for r in res.records}
+    assert by_path[paths[3]].status == "failed"
+    healthy = [p for i, p in enumerate(paths) if i != 3]
+    for p in healthy:
+        assert by_path[p].status == "done", (p, by_path[p])
+    # manifest order: the queued healthy slab's records precede the
+    # culprit's failure record
+    order = [r.path for r in res.records]
+    assert order.index(paths[2]) < order.index(paths[3])

@@ -1,0 +1,215 @@
+"""Pallas fused pick kernel (ISSUE 6): parity matrix vs the jnp route.
+
+The contract pinned here: the fused envelope→threshold→prominence→pack
+kernel (``ops.pallas_picks``) produces PICK outputs — positions,
+selected, saturated, and therefore everything the detection programs
+emit — bit-identical to the jnp route (``ops.peaks`` over
+``spectral.envelope_sqrt``) for both slot methods (pack/topk), at the
+kernel level, the one-program level (``mf_detect_picks_program
+pick_engine="pallas"``, monolithic and channel-tiled), the batched
+route, and on bucket-padded ``n_real`` records. On this CPU image the
+kernel runs in Pallas INTERPRET mode — the identical kernel code path a
+TPU backend compiles; the compiled Mosaic lowering is probed by
+tests/test_pallas_tpu_lowering.py (green-or-skipped per image).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from das4whales_tpu.io.stream import stream_strain_blocks
+from das4whales_tpu.io.synth import (
+    SyntheticCall,
+    SyntheticScene,
+    write_synthetic_file,
+)
+from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+from das4whales_tpu.ops import pallas_picks, peaks, spectral
+
+NX = 24
+NS = 900
+SEL = [0, NX, 1]
+
+
+def _corr_like(shape, seed=0, scale=2.0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=shape).astype(np.float32)) * scale
+
+
+def _assert_picks_identical(sp_k, sp_j):
+    """positions/selected/saturated are THE pick outputs — bitwise.
+    heights/prominences are kernel-internal floats (the surrounding jit
+    may FMA-fuse the envelope arithmetic) — ulp-close, never consumed."""
+    np.testing.assert_array_equal(np.asarray(sp_k.positions),
+                                  np.asarray(sp_j.positions))
+    np.testing.assert_array_equal(np.asarray(sp_k.selected),
+                                  np.asarray(sp_j.selected))
+    np.testing.assert_array_equal(np.asarray(sp_k.saturated),
+                                  np.asarray(sp_j.saturated))
+    np.testing.assert_allclose(np.asarray(sp_k.heights),
+                               np.asarray(sp_j.heights), rtol=1e-6)
+    assert int(np.asarray(sp_j.selected).sum()) > 0, \
+        "parity over an empty pick set proves nothing"
+
+
+@pytest.mark.parametrize("method", ["pack", "topk"])
+@pytest.mark.parametrize("shape", [(3, 10, 777), (2, 8, 512), (1, 3, 1000)])
+def test_kernel_matches_jnp_route(method, shape):
+    corr = _corr_like(shape, seed=shape[-1])
+    thr = jnp.asarray(
+        np.linspace(0.8, 1.2, shape[0]), np.float32
+    )[:, None]
+    sp_k = pallas_picks.analytic_envelope_peaks(
+        corr, thr, max_peaks=32, method=method
+    )
+    env = spectral.envelope_sqrt(corr, axis=-1)
+    sp_j = peaks.find_peaks_sparse_batched(env, thr, max_peaks=32,
+                                           method=method)
+    _assert_picks_identical(sp_k, sp_j)
+
+
+@pytest.mark.parametrize("method", ["pack", "topk"])
+def test_kernel_row_padding_and_saturation(method):
+    # 5 rows (not a multiple of the 8-row block): exercises the padding
+    # rows, and a low threshold saturates K=4 so the saturated flag path
+    # is compared too
+    corr = _corr_like((5, 1203), seed=7)
+    sp_k = pallas_picks.analytic_envelope_peaks(corr, 0.05, max_peaks=4,
+                                                method=method)
+    env = spectral.envelope_sqrt(corr, axis=-1)
+    sp_j = peaks.find_peaks_sparse_batched(env, 0.05, max_peaks=4,
+                                           method=method)
+    assert bool(np.asarray(sp_j.saturated).any())
+    _assert_picks_identical(sp_k, sp_j)
+
+
+def test_engine_resolution(monkeypatch):
+    assert pallas_picks.resolve_engine("jnp") == "jnp"
+    assert pallas_picks.resolve_engine("pallas") == "pallas"
+    # auto on a CPU backend: always the jnp route (no probe involved)
+    assert pallas_picks.resolve_engine("auto") == "jnp"
+    assert pallas_picks.resolve_engine(None) == "jnp"
+    monkeypatch.setenv("DAS_PICK_ENGINE", "pallas")
+    assert pallas_picks.resolve_engine(None) == "pallas"
+    monkeypatch.setenv("DAS_PICK_ENGINE", "bogus")
+    with pytest.raises(ValueError):
+        pallas_picks.resolve_engine(None)
+
+
+# ---------------------------------------------------------------------------
+# Program-level parity: the one-program route with pick_engine="pallas"
+# ---------------------------------------------------------------------------
+
+
+def _scene_file(tmp_path, ns=NS, seed=0):
+    scene = SyntheticScene(
+        nx=NX, ns=ns, noise_rms=0.05, seed=seed,
+        calls=[SyntheticCall(t0=1.2, x0_m=NX / 2 * 2.042, amplitude=2.0)],
+    )
+    p = str(tmp_path / f"scene{seed}.h5")
+    write_synthetic_file(p, scene)
+    return p
+
+
+def _detector(meta, shape, wire="conditioned", **kw):
+    return MatchedFilterDetector(
+        meta, SEL, shape, wire=wire, pick_mode="sparse",
+        keep_correlograms=False, **kw,
+    )
+
+
+def _read(path, wire="conditioned"):
+    return next(stream_strain_blocks([path], SEL, as_numpy=True, wire=wire))
+
+
+@pytest.mark.parametrize("channel_tile", [None, 8])
+def test_program_parity_jnp_vs_pallas(tmp_path, channel_tile):
+    """mf_detect_picks_program picks are bit-identical between engines,
+    on the monolithic AND channel-tiled branches."""
+    blk = _read(_scene_file(tmp_path))
+    tr = jnp.asarray(blk.trace)
+    det_j = _detector(blk.metadata, tr.shape, channel_tile=channel_tile,
+                      pick_engine="jnp")
+    det_p = _detector(blk.metadata, tr.shape, channel_tile=channel_tile,
+                      pick_engine="pallas")
+    assert det_j.pick_engine == "jnp" and det_p.pick_engine == "pallas"
+    res_j = det_j.detect_picks(tr)
+    res_p = det_p.detect_picks(tr)
+    assert set(res_j.picks) == set(res_p.picks)
+    total = 0
+    for name in res_j.picks:
+        np.testing.assert_array_equal(res_j.picks[name], res_p.picks[name])
+        assert res_j.thresholds[name] == res_p.thresholds[name]
+        total += res_j.picks[name].shape[1]
+    assert total > 0
+
+
+def test_program_parity_padded_n_real(tmp_path):
+    """Bucket-padded records (the batched campaign's shape buckets) ride
+    the kernel identically: raw wire, pad demeaned over real samples."""
+    blk = _read(_scene_file(tmp_path, ns=NS), wire="raw")
+    tr = np.asarray(blk.trace)
+    b_ns = 1024                        # pow2 bucket for ns=900
+    padded = np.zeros((tr.shape[0], b_ns), tr.dtype)
+    padded[:, : tr.shape[1]] = tr
+    results = {}
+    for engine in ("jnp", "pallas"):
+        det = _detector(blk.metadata, (tr.shape[0], b_ns), wire="raw",
+                        pick_engine=engine)
+        results[engine] = det.detect_picks(
+            jnp.asarray(padded), n_real=tr.shape[1], with_health=True
+        )
+    total = 0
+    for name in results["jnp"].picks:
+        np.testing.assert_array_equal(results["jnp"].picks[name],
+                                      results["pallas"].picks[name])
+        total += results["jnp"].picks[name].shape[1]
+    assert total > 0
+    # the fused health stats ride both engines' packed fetch identically
+    assert results["jnp"].health == results["pallas"].health
+
+
+def test_batched_route_parity_pallas(tmp_path):
+    """The batched [B, C, T] program with the kernel engine equals the
+    jnp-engine batched route per file, bit-identical."""
+    from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+
+    blocks = [np.asarray(_read(_scene_file(tmp_path, seed=s)).trace)
+              for s in range(3)]
+    meta = _read(_scene_file(tmp_path, seed=0)).metadata
+    stack = jnp.asarray(np.stack(blocks))
+    entries = {}
+    for engine in ("jnp", "pallas"):
+        det = _detector(meta, blocks[0].shape, pick_engine=engine)
+        bdet = BatchedMatchedFilterDetector(det, donate=False)
+        entries[engine] = bdet.detect_batch(stack)
+    total = 0
+    for e_j, e_p in zip(entries["jnp"], entries["pallas"]):
+        assert set(e_j[0]) == set(e_p[0])
+        for name in e_j[0]:
+            np.testing.assert_array_equal(e_j[0][name], e_p[0][name])
+            assert e_j[1][name] == e_p[1][name]
+            total += e_j[0][name].shape[1]
+    assert total > 0
+
+
+def test_adaptive_k_escalation_parity(tmp_path):
+    """A saturating K0 forces the pack→topk escalation rerun: both
+    engines escalate identically and agree bitwise after it."""
+    blk = _read(_scene_file(tmp_path))
+    tr = jnp.asarray(blk.trace)
+    results = {}
+    for engine in ("jnp", "pallas"):
+        det = _detector(blk.metadata, tr.shape, pick_engine=engine)
+        det.pick_k0 = 1                 # everything saturates at K0=1
+        results[engine] = det.detect_picks(tr, threshold=0.001)
+    total = 0
+    for name in results["jnp"].picks:
+        np.testing.assert_array_equal(results["jnp"].picks[name],
+                                      results["pallas"].picks[name])
+        total += results["jnp"].picks[name].shape[1]
+    assert total > 0
