@@ -353,23 +353,24 @@ def main(argv=None) -> int:
             if meta0 is None:
                 print("campaign: no file in the list is probeable; nothing to do")
                 return 3
-            from das4whales_tpu.config import ChannelSelection
-            from das4whales_tpu.models.matched_filter import MatchedFilterDetector
-
-            csel = ChannelSelection.from_list(sel)
-            shape = (csel.n_channels(meta0.nx), meta0.ns)
-            mf = MatchedFilterDetector(meta0, sel, shape,
-                                        fused_bandpass=args.fused)
+            # the family builders wire the shared prefilter + adapter;
+            # workflows.planner maps the result to its DetectorProgram so
+            # the campaign applies the full resilience stack (ladder,
+            # watchdog, health gate) to this family too
             if args.family == "spectro":
-                from das4whales_tpu.eval import SpectroEvalAdapter
-                from das4whales_tpu.models.spectro import SpectroCorrDetector
+                from das4whales_tpu.workflows.spectrodetect import (
+                    campaign_detector,
+                )
 
-                detector = SpectroEvalAdapter(mf, SpectroCorrDetector(meta0))
+                detector = campaign_detector(meta0, sel,
+                                             fused_bandpass=args.fused)
             else:
-                from das4whales_tpu.eval import GaborEvalAdapter
-                from das4whales_tpu.models.gabor import GaborDetector
+                from das4whales_tpu.workflows.gabordetect import (
+                    campaign_detector,
+                )
 
-                detector = GaborEvalAdapter(mf, GaborDetector(meta0, sel))
+                detector = campaign_detector(meta0, sel,
+                                             fused_bandpass=args.fused)
         try:
             if args.multihost:
                 if detector is not None:

@@ -270,6 +270,11 @@ def amplitude_sweep(
 @dataclass
 class _EvalResult:
     picks: Dict[str, np.ndarray]
+    #: per-template effective thresholds when the family exposes them;
+    #: None means ABSENT (the campaign records NaN placeholders —
+    #: workflows.planner.thresholds_for documents the absent-vs-empty
+    #: distinction)
+    thresholds: Dict[str, float] | None = None
 
 
 class SpectroEvalAdapter:
@@ -311,7 +316,10 @@ class SpectroEvalAdapter:
             pk = np.asarray(pk)
             t_samples = np.round(pk[1] * (fs / spectro_fs)).astype(int)
             out[name] = np.asarray([pk[0], t_samples])
-        return _EvalResult(picks=out)
+        # the family's absolute correlogram threshold (one knob serves
+        # every kernel — main_spectrodetect.py:118-121)
+        thr = float(self.det.threshold if threshold is None else threshold)
+        return _EvalResult(picks=out, thresholds={name: thr for name in out})
 
 
 def sharded_picks_to_dict(
@@ -356,7 +364,10 @@ class GaborEvalAdapter:
     def __call__(self, block, threshold: float | None = None):
         filt = getattr(self.prefilter, "filter_block", self.prefilter)
         out = self.det(filt(block), threshold=threshold)
-        return _EvalResult(picks={k: np.asarray(v) for k, v in out["picks"].items()})
+        return _EvalResult(
+            picks={k: np.asarray(v) for k, v in out["picks"].items()},
+            thresholds=out.get("thresholds"),
+        )
 
 
 def threshold_sweep(

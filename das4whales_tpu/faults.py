@@ -416,11 +416,15 @@ def counters_delta(before: Mapping[str, int]) -> Dict[str, int]:
 # ---------------------------------------------------------------------------
 
 #: The canonical downshift order of the resource ladder
-#: (``workflows.campaign``; docs/ROBUSTNESS.md "Resource ladder"):
-#: batched slabs at shrinking B, then the per-file one-program route,
-#: then the channel-tiled route, then the time-sharded route (multi-chip
-#: only), then the host. A rung is ``(stage, batch)`` — batch is 1 for
-#: every non-batched stage.
+#: (``workflows.planner``; docs/ROBUSTNESS.md "Resource ladder"):
+#: batched slabs at shrinking B, then the per-file route, then the
+#: family's tiled (memory-lean) view, then the time-sharded route
+#: (multi-chip only), then the host. A rung is ``(stage, batch)`` —
+#: batch is 1 for every non-batched stage. Each detector family
+#: declares the SUBSET of stages its math supports
+#: (``planner.DetectorProgram.stages``); every family's ladder starts
+#: at ``file`` and ends at ``host``, so the order here totally orders
+#: any family's rungs.
 DOWNSHIFT_STAGES = ("batched", "file", "tiled", "timeshard", "host")
 
 
@@ -664,10 +668,14 @@ class FaultPlan:
         here): ``"hang"`` needs a stream ``read_deadline_s`` below
         ``hang_s``; ``"hang_dispatch"`` needs a campaign
         ``dispatch_deadline_s`` below ``hang_s``; ``"oom"`` needs the
-        downshift ladder (on by default in the campaign runners — the
-        ladder always reaches the plan's ``ok_rung``: unbatched routes
-        start AT the per-file rung, so an ``ok_rung`` at or above it
-        never even fires there); ``"nan"`` needs a health gate that can
+        downshift ladder (on by default in the campaign runners for
+        EVERY detector family — ``workflows.planner``; the ladder
+        always reaches a rung at or past the plan's ``ok_rung``:
+        unbatched routes start AT the per-file rung, so an ``ok_rung``
+        at or above it never even fires there, and a family lacking the
+        ``tiled`` stage recovers at its next declared rung — the host —
+        which outranks every drawable ``ok_rung``); ``"nan"`` needs a
+        health gate that can
         SEE the poison — the default ``DataHealthConfig`` catches the
         NaN stripe on float wires, but an integer (raw-wire) block is
         poisoned by ADC saturation, which only a configured ``clip_abs``

@@ -152,9 +152,11 @@ class GaborDetector:
         else:
             thres = float(threshold)
         picks = {}
+        thresholds = {}
         for name, corr in correlograms.items():
             hf_discount = 0.9 if (name == "HF" and threshold is None) else 1.0
             thr = thres * hf_discount  # HF picked at 0.9*thres (relative policy)
+            thresholds[name] = float(thr)
             env = jnp.abs(spectral.analytic_signal(corr, axis=-1))
             # adaptive K with exact escalation on saturation (ops.peaks)
             pos, _, _, sel, saturated = peak_ops.picks_with_escalation(
@@ -174,4 +176,8 @@ class GaborDetector:
             "correlograms": correlograms,
             "picks": picks,
             "threshold": thres,
+            # per-note effective thresholds (the HF 0.9x discount
+            # applied) — the campaign picks artifact records these
+            # (eval.GaborEvalAdapter threads them through)
+            "thresholds": thresholds,
         }

@@ -366,18 +366,53 @@ class LearnedDetector:
     peak per envelope lobe)."""
 
     def __init__(self, params, cfg: LearnedConfig, threshold: float = 0.5,
-                 name: str = "CALL"):
+                 name: str = "CALL", row_chunk: int | None = None):
         self.params = params
         self.cfg = cfg
         self.threshold = threshold
         self.name = name
+        # classifier window rows per scoring program (None: the whole
+        # [C * n_win] batch in one program) — the planner ladder's
+        # memory-lean knob for this family
+        self.row_chunk = row_chunk
+
+    def tiled_view(self) -> "LearnedDetector":
+        """A shallow view scoring the classifier in bounded window-row
+        chunks — the planner ladder's memory-lean rung for this family
+        (``workflows.planner.LearnedProgram``): caps the CNN's
+        activation memory; scores are per-window, so picks are
+        bit-identical to the one-program sweep. Cached — repeated calls
+        return the same view."""
+        from ..utils.views import cached_shallow_view
+
+        base = self.row_chunk or 8192
+
+        def mutate(det):
+            # never LARGER than the chunk that just OOMed, and strictly
+            # smaller whenever the 256-row floor allows (at the floor
+            # the view is a no-op and the ladder falls through to host)
+            det.row_chunk = min(base, max(256, base // 2))
+
+        return cached_shallow_view(self, "_tiled_view_cache", mutate)
 
     def __call__(self, block, threshold: float | None = None) -> LearnedResult:
         win, centers = window_features(block, self.cfg)
-        scores = np.asarray(
-            _score_windows(self.params, win.reshape(-1, *win.shape[-2:]),
-                           self.cfg.compute_dtype)
-        ).reshape(win.shape[0], win.shape[1])
+        flat = win.reshape(-1, *win.shape[-2:])
+        if self.row_chunk is not None and flat.shape[0] > self.row_chunk:
+            # bounded-activation sweep (tiled_view): at most two program
+            # shapes compile — the full chunk and the remainder
+            parts = [
+                np.asarray(_score_windows(self.params,
+                                          flat[i : i + self.row_chunk],
+                                          self.cfg.compute_dtype))
+                for i in range(0, flat.shape[0], self.row_chunk)
+            ]
+            scores = np.concatenate(parts, axis=0)
+        else:
+            scores = np.asarray(
+                _score_windows(self.params, flat, self.cfg.compute_dtype)
+            )
+        scores = scores.reshape(win.shape[0], win.shape[1])
         return self.picks_from_scores(scores, threshold=threshold)
 
     def picks_from_scores(self, scores: np.ndarray,

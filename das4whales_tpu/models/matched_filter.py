@@ -701,16 +701,12 @@ class MatchedFilterDetector:
         "Resource ladder"). Shares the design and device arrays: no
         re-design, one extra compile per shape at most. Cached — repeated
         calls return the same view."""
-        import copy
+        from ..utils.views import cached_shallow_view
 
-        cached = self.__dict__.get("_tiled_view_cache")
-        if cached is not None:
-            return cached
-        det = copy.copy(self)
-        det.__dict__.pop("_tiled_view_cache", None)
-        det.channel_tile = self.effective_channel_tile
-        self.__dict__["_tiled_view_cache"] = det
-        return det
+        def mutate(det):
+            det.channel_tile = self.effective_channel_tile
+
+        return cached_shallow_view(self, "_tiled_view_cache", mutate)
 
     def host_view(self) -> "MatchedFilterDetector":
         """A view of this detector whose device arrays live on the host
@@ -721,25 +717,21 @@ class MatchedFilterDetector:
         for (and dispatches to) the CPU backend. Raises ``RuntimeError``
         where jax has no CPU backend. Cached — repeated calls return the
         same view."""
-        import copy
+        from ..utils.views import cached_shallow_view
 
-        cached = self.__dict__.get("_host_view_cache")
-        if cached is not None:
-            return cached
         cpu = jax.devices("cpu")[0]
-        det = copy.copy(self)
-        det.__dict__.pop("_host_view_cache", None)
-        det.__dict__.pop("_tiled_view_cache", None)
-        det.channel_tile = self.effective_channel_tile  # lean on host too
-        with jax.default_device(cpu):
-            for attr in ("_mask_band_dev", "_gain_dev", "_templates_dev",
-                         "_templates_true", "_template_mu",
-                         "_template_scale", "_cond_scale"):
-                setattr(det, attr,
-                        jnp.asarray(np.asarray(getattr(self, attr))))
-        det.host_device = cpu
-        self.__dict__["_host_view_cache"] = det
-        return det
+
+        def mutate(det):
+            det.channel_tile = self.effective_channel_tile  # lean on host too
+            with jax.default_device(cpu):
+                for attr in ("_mask_band_dev", "_gain_dev", "_templates_dev",
+                             "_templates_true", "_template_mu",
+                             "_template_scale", "_cond_scale"):
+                    setattr(det, attr,
+                            jnp.asarray(np.asarray(getattr(self, attr))))
+            det.host_device = cpu
+
+        return cached_shallow_view(self, "_host_view_cache", mutate)
 
     def monolithic_temp_estimate(self) -> int:
         """Rough byte estimate of the one-program correlate+envelope route's

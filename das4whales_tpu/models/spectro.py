@@ -228,6 +228,7 @@ class SpectroCorrDetector:
         overlap_pct: float = 0.95,
         threshold: float = 14.0,
         max_peaks: int = 256,
+        batch_channels: int | None = None,
     ):
         self.metadata = as_metadata(metadata)
         self.flims = flims
@@ -236,13 +237,40 @@ class SpectroCorrDetector:
         self.overlap_pct = overlap_pct
         self.threshold = threshold
         self.max_peaks = max_peaks
+        # channel-chunk size of the spectrogram sweep (None: the
+        # engine-aware default — compute_cross_correlogram_spectrocorr)
+        self.batch_channels = batch_channels
+
+    def tiled_view(self) -> "SpectroCorrDetector":
+        """A shallow view sweeping the spectrogram in smaller channel
+        chunks — the planner ladder's memory-lean rung for this family
+        (``workflows.planner.SpectroProgram``): the live STFT frame
+        temps shrink proportionally, and every stage is per-channel
+        math, so picks are bit-identical to the untiled sweep. Cached —
+        repeated calls return the same view."""
+        from ..utils.views import cached_shallow_view
+
+        base = self.batch_channels or (
+            PALLAS_DEFAULT_BATCH
+            if spectral.resolve_stft_engine() == "pallas"
+            else RFFT_DEFAULT_BATCH
+        )
+
+        def mutate(det):
+            # never LARGER than the chunk that just OOMed, and strictly
+            # smaller whenever the 16-channel floor allows (at the floor
+            # the view is a no-op and the ladder falls through to host)
+            det.batch_channels = min(base, max(16, base // 8))
+
+        return cached_shallow_view(self, "_tiled_view_cache", mutate)
 
     def __call__(self, trf_fk: jnp.ndarray):
         fs = self.metadata.fs
         correlograms, picks = {}, {}
         for name, ker in self.kernels.items():
             corr = compute_cross_correlogram_spectrocorr(
-                trf_fk, fs, self.flims, ker, self.win_size, self.overlap_pct
+                trf_fk, fs, self.flims, ker, self.win_size, self.overlap_pct,
+                batch_channels=self.batch_channels,
             )
             correlograms[name] = corr
             # correlograms are half-wave rectified (nonnegative), so the

@@ -154,6 +154,10 @@ class BatchedMatchedFilterDetector:
     :func:`_batched_body`); pass a bool to force one.
     """
 
+    #: detector-family label stamped on campaign records
+    #: (workflows.planner; the batched slab route is MF-only today)
+    family = "mf"
+
     def __init__(self, detector: MatchedFilterDetector, donate: bool = True,
                  serial: bool | None = None):
         if detector.pick_mode != "sparse":

@@ -47,6 +47,37 @@ from .. import faults
 from ..config import dispatch_depth_default
 
 
+def resolve_watchdogged(fn, paths, rung, deadline_s, fault_plan=None):
+    """One watchdogged device dispatch/resolve, shared by every campaign
+    flavor and every detector family (``workflows.planner``): the chaos
+    harness's dispatch hook (``faults.FaultPlan.on_dispatch``) fires for
+    each of ``paths`` INSIDE the deadline-bounded callable — exactly
+    where a real wedged or OOMing launch surfaces — and the whole call
+    is bounded by ``deadline_s`` (``faults.call_with_deadline``; None
+    runs inline). Raises ``fn``'s own failure, the injected fault, or
+    ``faults.DispatchDeadlineExceeded`` on a wedge — every escaping
+    exception is annotated with the rung it failed at
+    (``campaign_rung``), so a terminal failure record can name the
+    executing route (``FileRecord.rung``)."""
+
+    def run():
+        if fault_plan is not None:
+            for p in paths:
+                fault_plan.on_dispatch(p, rung)
+        return fn()
+
+    try:
+        return faults.call_with_deadline(
+            run, deadline_s, paths[0] if paths else "<dispatch>"
+        )
+    except Exception as exc:
+        try:
+            exc.campaign_rung = faults.rung_label(rung)
+        except Exception:  # noqa: BLE001 — slots/frozen exc: skip the tag
+            pass
+        raise
+
+
 def launch(fn, *args, **kwargs):
     """Dispatch a device program asynchronously: call ``fn`` (a jitted
     step / program launcher), count the dispatch, return its

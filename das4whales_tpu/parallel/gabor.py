@@ -15,6 +15,13 @@ sharding would need kilochannel halos. Two layouts avoid that:
   reproduce the pipeline's global couplings, and the channel-row halo
   (the two-stage Gabor receptive field) makes interior channels exactly
   single-chip.
+
+The same channel coupling is why the resilient route planner
+(``workflows.planner.GaborProgram``) declares NO tiled rung for this
+family: a chunked sweep would change detection at tile seams, so the
+campaign ladder degrades the gabor family straight from the per-file
+rung to the host backend (docs/ROBUSTNESS.md "Family x guarantee
+coverage").
 """
 
 from __future__ import annotations

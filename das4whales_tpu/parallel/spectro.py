@@ -12,6 +12,13 @@ every output sharded like its input.
 Within each shard, channels stream through ``lax.map`` tiles so the
 overlapped STFT frame tensor (~1.8 MB/channel at the detector's 95%
 overlap under the rFFT engine) never materializes for the whole shard.
+
+Note on the resilient route planner (``workflows.planner``): these
+sharded steps take the UNFILTERED block and normalize internally, so
+they are standalone detectors — NOT drop-in ladder rungs for the
+campaign's prefiltered spectro adapter. The spectro family's ladder is
+per-file -> channel-chunk-tiled (``SpectroCorrDetector.tiled_view``) ->
+host (docs/ROBUSTNESS.md "Family x guarantee coverage").
 """
 
 from __future__ import annotations

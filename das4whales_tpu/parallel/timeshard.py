@@ -440,7 +440,7 @@ def time_sharding(mesh: Mesh, time_axis: str = "time") -> NamedSharding:
 
 
 # ---------------------------------------------------------------------------
-# The resource ladder's time-sharded rung (workflows.campaign)
+# The resource ladder's time-sharded rung (workflows.planner)
 # ---------------------------------------------------------------------------
 
 
@@ -448,13 +448,26 @@ def viable_time_mesh_size(trace_shape, n_devices: int) -> int | None:
     """The largest mesh size ``p >= 2`` that can serve ``trace_shape``
     time-sharded (the pencil f-k transform needs BOTH axes divisible by
     ``p``), or None when no multi-device decomposition exists — the
-    campaign's downshift ladder uses this to decide whether a
+    planner's downshift ladder uses this to decide whether a
     ``timeshard`` rung is available at all."""
     C, T = trace_shape
     for p in range(min(int(n_devices), C, T), 1, -1):
         if C % p == 0 and T % p == 0:
             return p
     return None
+
+
+def ladder_time_mesh(trace_shape):
+    """The ladder's time-sharded rung mesh for ``trace_shape`` (largest
+    viable decomposition over the local devices), or None — consumed by
+    ``workflows.planner.MatchedFilterProgram``."""
+    from .mesh import make_mesh
+
+    p = viable_time_mesh_size(trace_shape, len(jax.devices()))
+    if p is None:
+        return None
+    return make_mesh(shape=(p,), axis_names=("time",),
+                     devices=jax.devices()[:p])
 
 
 def sparse_time_picks_to_dict(sp_picks, template_names, n_samples=None):

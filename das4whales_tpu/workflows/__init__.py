@@ -3,6 +3,7 @@ points, SURVEY.md §2.2) — each is a ``main(url=None, outdir=None, ...)``
 callable that runs offline on a synthetic OOI-like scene when no URL/file
 is given."""
 
-from . import bathynoise, common, fkcomp, gabordetect, longrecord, mfdetect, plots, spectrodetect  # noqa: F401
+from . import bathynoise, common, fkcomp, gabordetect, longrecord, mfdetect, planner, plots, spectrodetect  # noqa: F401
 from .common import acquire, default_scene  # noqa: F401
 from .longrecord import detect_long_record  # noqa: F401
+from .planner import DetectorProgram, RoutePlanner, program_for  # noqa: F401

@@ -74,6 +74,15 @@ class FileRecord:
     attempts: int = 1
     #: data-health stats (ops.health) when the campaign computed them
     health: Dict[str, float] = field(default_factory=dict)
+    #: detector family that processed the file (workflows.planner:
+    #: "mf" | "spectro" | "gabor" | "learned" | "generic"; "" on
+    #: records from pre-planner manifests)
+    family: str = ""
+    #: the route rung that actually executed (faults.rung_label —
+    #: "batched:4" / "file" / "tiled" / "timeshard" / "host"; also
+    #: "sharded" / "multihost" for the SPMD campaigns) — with
+    #: ``family`` this makes the downshift ledger auditable per family
+    rung: str = ""
 
 
 @dataclass
@@ -163,7 +172,13 @@ def _save_picks(outdir: str, path: str, picks: Dict[str, np.ndarray],
     out = _picks_path(outdir, path)
     os.makedirs(os.path.dirname(out), exist_ok=True)
     arrays = {f"picks_{name}": np.asarray(pk) for name, pk in picks.items()}
-    arrays["thresholds"] = np.asarray([thresholds[name] for name in picks])
+    # a family may legitimately expose thresholds for only SOME templates
+    # (or none: an empty-but-present dict) — record NaN for the missing
+    # names instead of crashing the artifact writer (workflows.planner
+    # ``thresholds_for`` documents the absent-vs-empty distinction)
+    arrays["thresholds"] = np.asarray(
+        [float(thresholds.get(name, float("nan"))) for name in picks]
+    )
     arrays["template_names"] = np.asarray(list(picks), dtype="U")
     tmp = f"{out}.tmp-{os.getpid()}"
     try:
@@ -229,21 +244,24 @@ def _split_resume(files, outdir: str, resume: bool, records: List[FileRecord]):
 
 
 def _failure_recorder(outdir: str, records: List[FileRecord], max_failures,
-                      write: bool = True):
+                      write: bool = True, family: str = ""):
     """Shared per-file failure bookkeeping: manifest record + warning +
     max_failures enforcement (every non-done disposition — failed,
     quarantined, timeout — counts toward the tolerance). ``write=False``
     keeps the bookkeeping but skips the manifest append (multi-host
-    non-writer processes)."""
+    non-writer processes). ``family`` is the default family label
+    stamped on failure records (per-call override wins)."""
     state = {"n": 0}
 
     def fail(path: str, exc: Exception, status: str = "failed",
-             attempts: int = 1, health=None) -> None:
+             attempts: int = 1, health=None, family=family,
+             rung: str = "") -> None:
         state["n"] += 1
         rec = FileRecord(path=path, status=status,
                          error=f"{type(exc).__name__}: {exc}",
                          attempts=max(int(attempts), 1),
-                         health=dict(health or {}))
+                         health=dict(health or {}),
+                         family=family, rung=rung)
         records.append(rec)
         if write:
             _append_manifest(outdir, rec)
@@ -267,8 +285,11 @@ class _Resilience:
         self.policy = faults.as_retry_policy(retry)
         self.state = faults.RetryState(self.policy)
         self.health_cfg = as_health_config(health)
-        self.fail = _failure_recorder(outdir, records, max_failures,
-                                      write=write)
+        #: family label stamped on this run's failure records — set once
+        #: the campaign resolves its DetectorProgram (workflows.planner)
+        self.family = ""
+        self._fail = _failure_recorder(outdir, records, max_failures,
+                                       write=write)
         self.outdir = outdir
         self.write = write
         # per-CAMPAIGN resource-resilience tallies (the process-wide
@@ -277,6 +298,11 @@ class _Resilience:
         self.tallies: Dict[str, int] = {
             "downshifts": 0, "oom_recoveries": 0, "watchdog_timeouts": 0,
         }
+
+    def fail(self, path: str, exc: Exception, status: str = "failed",
+             attempts: int = 1, health=None, rung: str = "") -> None:
+        self._fail(path, exc, status=status, attempts=attempts,
+                   health=health, family=self.family, rung=rung)
 
     def tally(self, name: str, n: int = 1) -> None:
         self.tallies[name] = self.tallies.get(name, 0) + n
@@ -291,21 +317,29 @@ class _Resilience:
     def attempt(self, path: str) -> int:
         return self.state.attempt(path)
 
-    def check_health(self, path: str, stats) -> None:
+    def check_health(self, path: str, stats, rung: str = "") -> None:
         """Raise ``faults.DataHealthError`` (data-class -> quarantine)
-        when ``stats`` breach the configured thresholds."""
+        when ``stats`` breach the configured thresholds. ``rung`` labels
+        the route that computed the stats so the quarantine record can
+        name it (``FileRecord.rung``)."""
         if self.health_cfg is None or not stats:
             return
         reason = self.health_cfg.breach(stats)
         if reason:
-            raise faults.DataHealthError(reason, stats)
+            exc = faults.DataHealthError(reason, stats)
+            exc.campaign_rung = rung
+            raise exc
 
     def dispose(self, path: str, exc: Exception) -> str:
         """Classify a file's failure and either schedule a retry
         (returns ``"retry"`` after the deterministic backoff sleep) or
         record its terminal status (returns ``"next"``). Fatal-class
-        failures re-raise — only they abort the campaign."""
+        failures re-raise — only they abort the campaign. Terminal
+        records carry the rung the failure surfaced at when the
+        dispatch layer annotated it (``campaign_rung`` —
+        ``parallel.dispatch.resolve_watchdogged``)."""
         n_att = self.state.n_attempts(path)
+        rung = getattr(exc, "campaign_rung", "")
         if isinstance(exc, faults.DeadlineExceeded):
             faults.count("timeouts")
             if isinstance(exc, faults.DispatchDeadlineExceeded):
@@ -313,7 +347,7 @@ class _Resilience:
                 # the reader deadline — attributed separately so an OOM
                 # triage can tell a hung chip from a hung mount
                 self.tally("watchdog_timeouts")
-            self.fail(path, exc, status="timeout", attempts=n_att)
+            self.fail(path, exc, status="timeout", attempts=n_att, rung=rung)
             return "next"
         fclass = faults.classify_failure(exc)
         if fclass == "fatal":
@@ -326,165 +360,22 @@ class _Resilience:
         if fclass == "data":
             faults.count("quarantined")
             self.fail(path, exc, status="quarantined", attempts=n_att,
-                      health=getattr(exc, "stats", None))
+                      health=getattr(exc, "stats", None), rung=rung)
         else:
-            self.fail(path, exc, attempts=n_att)
+            self.fail(path, exc, attempts=n_att, rung=rung)
         return "next"
 
 
-class _DownshiftLadder:
-    """The elastic resource ladder's sticky bookkeeping
-    (docs/ROBUSTNESS.md "Resource ladder").
-
-    One campaign, one ladder: per bucket key it remembers the WINNING
-    rung — ``("batched", B)`` at shrinking B, then ``("file", 1)`` (the
-    per-file one-program route), ``("tiled", 1)`` (channel-tiled
-    correlate), ``("timeshard", 1)`` (time-sharded over a multi-device
-    mesh, when the shape divides), ``("host", 1)`` (CPU backend). A
-    resource-class failure advances the bucket's rung ONCE and the rung
-    sticks for the rest of the campaign (no per-file thrash); every move
-    lands in the manifest's ``downshift`` ledger.
-    """
-
-    def __init__(self, rz: _Resilience, outdir: str, batch: int = 1,
-                 write: bool = True, timeshard: bool = True):
-        self.rz = rz
-        self.outdir = outdir
-        self.batch = int(batch)
-        self.write = write
-        self.allow_timeshard = timeshard
-        self.sticky: Dict[tuple, tuple] = {}
-
-    def rungs(self, trace_shape=None) -> list:
-        out = []
-        b = self.batch
-        while b > 1:
-            out.append(("batched", b))
-            b //= 2
-        out.append(("file", 1))
-        out.append(("tiled", 1))
-        if self.allow_timeshard and trace_shape is not None:
-            import jax
-
-            from ..parallel.timeshard import viable_time_mesh_size
-
-            if viable_time_mesh_size(trace_shape, len(jax.devices())):
-                out.append(("timeshard", 1))
-        out.append(("host", 1))
-        return out
-
-    def current(self, key) -> tuple:
-        return self.sticky.get(
-            key, ("batched", self.batch) if self.batch > 1 else ("file", 1)
-        )
-
-    def pin(self, key, rung, reason: str) -> None:
-        """Preflight placement: start ``key`` at ``rung`` (no failure
-        occurred — ledgered as a preflight downshift when it moves the
-        bucket off the top rung)."""
-        top = ("batched", self.batch) if self.batch > 1 else ("file", 1)
-        self.sticky[key] = rung
-        if faults.rung_rank(rung) > faults.rung_rank(top):
-            self.rz.tally("downshifts")
-            if self.write:
-                _append_event(self.outdir, {
-                    "event": "downshift", "bucket": key if isinstance(key, str) else list(key),
-                    "from": faults.rung_label(top),
-                    "to": faults.rung_label(rung),
-                    "error": reason, "preflight": True, "sticky": True,
-                })
-            log.info("preflight: bucket %s starts at rung %s (%s)",
-                     key, faults.rung_label(rung), reason)
-
-    def downshift(self, key, rung, exc, trace_shape=None):
-        """Advance ``key``'s sticky rung past ``rung`` after a
-        resource-class failure; returns the new rung, or None when the
-        ladder is exhausted (the failure dispositions per-file)."""
-        nxt = None
-        for cand in self.rungs(trace_shape):
-            if faults.rung_rank(cand) > faults.rung_rank(rung):
-                nxt = cand
-                break
-        if nxt is None:
-            return None
-        self.sticky[key] = nxt
-        self.rz.tally("downshifts")
-        if self.write:
-            _append_event(self.outdir, {
-                "event": "downshift", "bucket": key if isinstance(key, str) else list(key),
-                "from": faults.rung_label(rung),
-                "to": faults.rung_label(nxt),
-                "error": f"{type(exc).__name__}: {exc}", "sticky": True,
-            })
-        log.warning(
-            "resource exhaustion at rung %s (%s: %s); downshifting bucket "
-            "%s to %s (sticky)", faults.rung_label(rung),
-            type(exc).__name__, exc, key, faults.rung_label(nxt),
-        )
-        return nxt
-
-
-def _time_mesh(trace_shape):
-    """The ladder's time-sharded rung mesh for ``trace_shape`` (largest
-    viable decomposition over the local devices), or None."""
-    import jax
-
-    from ..parallel.mesh import make_mesh
-    from ..parallel.timeshard import viable_time_mesh_size
-
-    p = viable_time_mesh_size(trace_shape, len(jax.devices()))
-    if p is None:
-        return None
-    return make_mesh(shape=(p,), axis_names=("time",),
-                     devices=jax.devices()[:p])
-
-
-def _detect_file_at_rung(det, rung, trace, *, n_real=None,
-                         with_health=False, clip=None):
-    """One file's ``(picks, thresholds, stats)`` at a non-batched ladder
-    rung. ``det`` must be a ``MatchedFilterDetector`` (the bucket/view
-    base); ``trace`` a HOST block (padded to the detector shape).
-    Raises on failure — including resource exhaustion at this rung,
-    which the caller's ladder absorbs."""
-    import jax
-    import jax.numpy as jnp
-
-    from ..ops import health as health_ops
-
-    stage = rung[0]
-    if stage == "timeshard":
-        from ..parallel.timeshard import detect_picks_time_sharded
-
-        mesh = _time_mesh(np.asarray(trace).shape)
-        if mesh is None:
-            raise RuntimeError(
-                "RESOURCE_EXHAUSTED: no viable time-shard mesh for "
-                f"shape {np.asarray(trace).shape}"  # -> next rung (host)
-            )
-        picks, thresholds = detect_picks_time_sharded(
-            det, trace, mesh, n_real=n_real
-        )
-        stats = (health_ops.host_health_stats(np.asarray(trace),
-                                              clip_abs=clip)
-                 if with_health else {})
-        return picks, thresholds, stats
-
-    if stage == "tiled":
-        det = det.tiled_view()
-    elif stage == "host":
-        det = det.host_view()
-
-    def run(d):
-        res = d.detect_picks(
-            jnp.asarray(trace), n_real=n_real,
-            with_health=with_health, health_clip=clip,
-        )
-        return res.picks, res.thresholds, res.health
-
-    if stage == "host":
-        with jax.default_device(det.host_device):
-            return run(det)
-    return run(det)
+# The elastic downshift ladder, the per-family DetectorProgram contract
+# and the routed executor now live in workflows/planner.py (family-
+# agnostic: every detector family inherits the ladder, watchdog, health
+# gate and chaos dispatch hook — not just the matched filter).
+from .planner import (  # noqa: E402
+    DownshiftLadder,
+    MatchedFilterProgram,
+    RoutePlanner,
+    program_for,
+)
 
 
 def run_campaign(
@@ -535,24 +426,26 @@ def run_campaign(
     ``faults.FaultPlan`` chaos schedule (testing).
 
     Resource exhaustion (``faults.classify_failure == "resource"``, e.g.
-    an XLA ``RESOURCE_EXHAUSTED``): matched-filter campaigns downshift
-    the route — per-file one-program -> channel-tiled -> time-sharded
-    (multi-device) -> host — with the winning rung STICKY for the rest
-    of the run and ledgered in the manifest (docs/ROBUSTNESS.md
-    "Resource ladder").
+    an XLA ``RESOURCE_EXHAUSTED``): EVERY detector family downshifts the
+    route through the family-agnostic planner (``workflows.planner``) —
+    per-file -> the family's declared leaner rungs (channel-tiled /
+    time-sharded where the math supports them) -> host — with the
+    winning rung STICKY for the rest of the run and ledgered in the
+    manifest with the family label (docs/ROBUSTNESS.md "Resource
+    ladder" + "Family x guarantee coverage"). The executed family and
+    rung land on every ``FileRecord``.
 
     ``dispatch_depth`` (None: the ``DAS_DISPATCH_DEPTH`` env default,
     2) arms DEPTH-D PIPELINED DISPATCH on the healthy per-file rung
     (``parallel.dispatch``, docs/PERF.md "Pipelined dispatch"): file
     k+1's one-program detection is dispatched before file k's packed
     fetch, so its compute overlaps file k's host-side bookkeeping.
-    Applies to sparse-engine :class:`MatchedFilterDetector` campaigns
-    with the fused health gate (the default configuration); every other
-    configuration — and any file whose resolve fails — takes the
-    synchronous path with identical attribution and retries.
+    Applies to families whose program declares async dispatch + fused
+    health (``DetectorProgram.supports_dispatch`` — the sparse-engine
+    matched filter today); every other configuration — and any file
+    whose resolve fails — takes the synchronous path with identical
+    attribution and retries.
     """
-    import jax.numpy as jnp
-
     from ..config import dispatch_deadline_default
 
     if dispatch_deadline_s is None:
@@ -572,19 +465,31 @@ def run_campaign(
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
     pend_metas = [metas[j] for j in pend_idx]
     rz = _Resilience(outdir, records, max_failures, retry, health)
-    ladder = _DownshiftLadder(rz, outdir, batch=1)
+    # resolve the family program up front when the detector is known, so
+    # even a file that fails BEFORE the first successful detect carries
+    # the right family in its record (the per-family audit must not
+    # split a planner-era campaign across "" and the real family)
+    route: RoutePlanner | None = None
+    if detector is not None:
+        route = RoutePlanner(
+            rz, outdir, program_for(detector),
+            dispatch_deadline_s=dispatch_deadline_s, fault_plan=fault_plan,
+        )
+        rz.family = route.program.family
+    else:
+        rz.family = "mf"   # detector=None builds a MatchedFilterDetector
     _BUCKET = "campaign"   # one unbatched campaign = one sticky ladder key
 
     def detect_one(path, block, t0, inflight=None):
         """One attempt at the transfer+detect+health half of a file
         (raises on failure; the caller dispositions). Resource-class
-        dispatch failures downshift the route in place (sticky).
-        ``inflight`` (``models.matched_filter.InFlightResult``) is the
-        depth-D pipeline's pre-dispatched program for this file: the
-        first healthy-rung attempt consumes its packed fetch instead of
+        dispatch failures downshift the family's route in place
+        (sticky — ``workflows.planner``). ``inflight`` is the depth-D
+        pipeline's pre-dispatched program for this file: the first
+        healthy-rung attempt consumes its packed fetch instead of
         dispatching fresh; any failure discards it (retries re-dispatch
         synchronously)."""
-        nonlocal detector
+        nonlocal detector, route
         if fault_plan is not None:
             fault_plan.on_transfer(path)
         if detector is None:
@@ -592,6 +497,13 @@ def run_campaign(
                 block.metadata, selected_channels, block.trace.shape,
                 wire=wire, **detector_kwargs,
             )
+        if route is None:
+            route = RoutePlanner(
+                rz, outdir, program_for(detector),
+                dispatch_deadline_s=dispatch_deadline_s,
+                fault_plan=fault_plan,
+            )
+            rz.family = route.program.family
         det_meta = getattr(detector, "metadata", None)
         if (wire == "raw" and det_meta is not None
                 and block.metadata is not None
@@ -609,67 +521,16 @@ def run_campaign(
             fault_plan.on_detect(path)
         clip = rz.health_cfg.clip_abs if rz.health_cfg is not None else None
         with_health = rz.health_cfg is not None
-        # the resource ladder serves the matched-filter one-program
-        # family; generic detector families (spectro/gabor adapters)
-        # keep the flat route — their resource failures disposition
-        use_ladder = isinstance(detector, MatchedFilterDetector)
-        fused = with_health and getattr(detector, "supports_fused_health",
-                                        False)
-        recovered = False
-        while True:   # rung loop: resource failures downshift, sticky
-            rung = ladder.current(_BUCKET) if use_ladder else ("file", 1)
-            if inflight is not None and rung != ("file", 1):
-                # the campaign downshifted between this file's dispatch
-                # and its resolve: the in-flight program ran at a rung
-                # now known to exhaust — abandon it
-                inflight = None
-
-            def dispatch(inflight=inflight):
-                if fault_plan is not None:
-                    fault_plan.on_dispatch(path, rung)
-                if inflight is not None:
-                    # the pipeline's pre-dispatched program: this is its
-                    # packed fetch (the one sync), inside the watchdog
-                    res = inflight.resolve()
-                    return res.picks, res.thresholds, res.health
-                if use_ladder and (fused or rung[0] != "file"):
-                    return _detect_file_at_rung(
-                        detector, rung, block.trace,
-                        with_health=with_health, clip=clip,
-                    )
-                result = detector(jnp.asarray(block.trace))
-                # generic detector families: host-side stats on the
-                # already-host-resident block (one numpy pass)
-                stats = (
-                    health_ops.host_health_stats(block.trace, clip_abs=clip)
-                    if with_health else {}
-                )
-                # the contract is a result with .picks {name: (2, n)};
-                # thresholds are optional metadata (the eval adapters
-                # for spectro/gabor don't expose them)
-                thresholds = getattr(result, "thresholds", None) or {
-                    name: float("nan") for name in result.picks
-                }
-                return result.picks, thresholds, stats
-
-            try:
-                # the dispatch watchdog bounds the program launch + fetch
-                picks, thresholds, stats = faults.call_with_deadline(
-                    dispatch, dispatch_deadline_s, path
-                )
-                break
-            except Exception as exc:  # noqa: BLE001 — ladder absorbs resource
-                inflight = None   # spent/abandoned: never consume twice
-                if (use_ladder
-                        and faults.classify_failure(exc) == "resource"
-                        and ladder.downshift(_BUCKET, rung, exc,
-                                             np.asarray(block.trace).shape)):
-                    recovered = True
-                    continue
-                raise
-        if recovered:
-            rz.tally("oom_recoveries")
-        rz.check_health(path, stats)            # -> quarantine on breach
+        # the family-agnostic rung loop: the planner resolves the file at
+        # the sticky rung inside the watchdog (chaos on_dispatch fires
+        # inside the deadline), downshifting on resource-class failures —
+        # EVERY family, not just the matched filter
+        picks, thresholds, stats, rung = route.run_file(
+            path, block.trace, with_health=with_health, clip=clip,
+            inflight=inflight, key=_BUCKET,
+        )
+        # -> quarantine on breach (record names the executing rung)
+        rz.check_health(path, stats, rung=faults.rung_label(rung))
         if fault_plan is not None:
             fault_plan.detect_succeeded()
         rec = FileRecord(
@@ -678,6 +539,7 @@ def run_campaign(
             wall_s=round(time.perf_counter() - t0, 3),
             picks_file=_save_picks(outdir, path, picks, thresholds),
             attempts=rz.state.n_attempts(path), health=dict(stats or {}),
+            family=route.program.family, rung=faults.rung_label(rung),
         )
         # manifest BEFORE the in-memory record: this block is retried,
         # and a transient manifest-append failure must not leave a
@@ -685,23 +547,21 @@ def run_campaign(
         _append_manifest(outdir, rec)
         records.append(rec)
 
-    from ..ops import health as health_ops
     from ..parallel.dispatch import PipelinedDispatch
 
     pipe = PipelinedDispatch(dispatch_depth)
 
     def try_dispatch_file(path, block):
-        """The pipeline's dispatch phase: launch this file's one-program
-        detection asynchronously when the campaign rides the healthy
-        per-file rung with the fused health gate. None -> the
-        synchronous path (attribution-identical; also taken for the
-        first file, which builds the detector)."""
-        if not pipe.enabled or detector is None or rz.health_cfg is None:
+        """The pipeline's dispatch phase: launch this file's program
+        asynchronously when the family supports async dispatch + fused
+        health and the campaign rides the healthy per-file rung. None ->
+        the synchronous path (attribution-identical; also taken for the
+        first file, which builds the detector and its program)."""
+        if not pipe.enabled or route is None or rz.health_cfg is None:
             return None
-        if not (isinstance(detector, MatchedFilterDetector)
-                and detector.pick_mode == "sparse"
-                and detector.supports_fused_health
-                and ladder.current(_BUCKET) == ("file", 1)):
+        if not (route.program.supports_dispatch
+                and route.program.supports_fused_health
+                and route.current(_BUCKET) == ("file", 1)):
             return None
         det_meta = getattr(detector, "metadata", None)
         if (wire == "raw" and det_meta is not None
@@ -709,9 +569,8 @@ def run_campaign(
                 and block.metadata.scale_factor != det_meta.scale_factor):
             return None   # detect_one fails it per-file on the sync path
         try:
-            return detector.dispatch_picks(
-                block.trace, with_health=True,
-                health_clip=rz.health_cfg.clip_abs,
+            return route.program.dispatch(
+                block.trace, with_health=True, clip=rz.health_cfg.clip_abs,
             )
         except Exception:  # noqa: BLE001 — surfaces on the sync path
             return None
@@ -874,8 +733,6 @@ def run_campaign_batched(
     ``dispatch_depth`` slabs' programs in flight on top of the transfer
     pipeline's ``in_flight`` stacks.
     """
-    import jax.numpy as jnp
-
     from ..config import (
         dispatch_deadline_default,
         enable_persistent_compilation_cache,
@@ -884,6 +741,7 @@ def run_campaign_batched(
     )
     from ..io.stream import SlabReadError, stream_batched_slabs, subdivide_slab
     from ..parallel.batch import BatchedMatchedFilterDetector, trim_picks
+    from ..parallel.dispatch import PipelinedDispatch, resolve_watchdogged
 
     if dispatch_deadline_s is None:
         dispatch_deadline_s = dispatch_deadline_default()
@@ -899,12 +757,14 @@ def run_campaign_batched(
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
     pend_metas = [metas[j] for j in pend_idx]
     rz = _Resilience(outdir, records, max_failures, retry, health)
+    rz.family = "mf"   # the batched slab route is the MF family's
     fail = rz.fail
     with_health = rz.health_cfg is not None
     clip = rz.health_cfg.clip_abs if with_health else None
-    ladder = _DownshiftLadder(rz, outdir, batch=batch)
+    ladder = DownshiftLadder(rz, outdir, batch=batch, family="mf")
 
     dets: Dict[tuple, BatchedMatchedFilterDetector] = {}
+    progs: Dict[tuple, MatchedFilterProgram] = {}   # per-file-rung programs
     skip_buckets: Dict[tuple, str] = {}   # preflight: nothing fits
 
     def _bucket_key(slab) -> tuple:
@@ -974,6 +834,7 @@ def run_campaign_batched(
                 donate=donate, serial=serial,
             )
             dets[key] = bdet
+            progs[key] = MatchedFilterProgram(bdet.det)
             if preflight:
                 preflight_bucket(key, bdet, slab)
         return bdet
@@ -981,18 +842,13 @@ def run_campaign_batched(
     def dispatched(paths, rung, fn):
         """One watchdogged device dispatch: the chaos dispatch hook
         (``FaultPlan.on_dispatch``) fires INSIDE the deadline-bounded
-        callable, exactly like a real wedged/OOMing launch."""
-        def run():
-            if fault_plan is not None:
-                for p in paths:
-                    fault_plan.on_dispatch(p, rung)
-            return fn()
+        callable, exactly like a real wedged/OOMing launch
+        (``parallel.dispatch.resolve_watchdogged`` — shared with the
+        planner's per-file executor)."""
+        return resolve_watchdogged(fn, paths, rung, dispatch_deadline_s,
+                                   fault_plan)
 
-        return faults.call_with_deadline(
-            run, dispatch_deadline_s, paths[0] if paths else "<slab>"
-        )
-
-    def per_file_fallback(slab, k, det, rung=("file", 1)):
+    def per_file_fallback(slab, k, prog, rung=("file", 1)):
         """The unbatched per-file route on the assembler's host block
         (the device slab may already be donated — never touch it here):
         the packed-overflow exact path AND the degradation ladder's
@@ -1004,8 +860,8 @@ def run_campaign_batched(
         padded[:, : tr.shape[1]] = tr
 
         def fn():
-            return _detect_file_at_rung(
-                det, rung, padded, n_real=slab.n_real[k],
+            return prog.detect(
+                rung, padded, n_real=slab.n_real[k],
                 with_health=with_health, clip=clip,
             )
 
@@ -1021,7 +877,7 @@ def run_campaign_batched(
         the chaos dispatch hooks firing inside the deadline exactly
         like a fresh dispatch (an async launch's failure also surfaces
         at the fetch)."""
-        det = bdet.det
+        prog = progs[_bucket_key(slab)]
         stage, b = rung
         if stage == "batched":
             if b >= batch:
@@ -1055,8 +911,8 @@ def run_campaign_batched(
             padded[:, : tr.shape[1]] = tr
 
             def fn(padded=padded, k=k):
-                return _detect_file_at_rung(
-                    det, rung, padded, n_real=slab.n_real[k],
+                return prog.detect(
+                    rung, padded, n_real=slab.n_real[k],
                     with_health=with_health, clip=clip,
                 )
             entries.append(dispatched([slab.paths[k]], rung, fn))
@@ -1171,14 +1027,17 @@ def run_campaign_batched(
                             fault_plan.on_transfer(path)
                             fault_plan.on_detect(path)
                         picks, thresholds, stats = per_file_fallback(
-                            slab, k, det, rung=pf_rung
+                            slab, k, progs[key], rung=pf_rung
                         )
+                        exec_rung = pf_rung
                     else:
                         entry = results[k]
                         picks, thresholds = entry[0], entry[1]
                         stats = (entry[2] if with_health
                                  and len(entry) > 2 else {})
-                    rz.check_health(path, stats)  # -> quarantine on breach
+                        exec_rung = rung
+                    rz.check_health(path, stats,  # -> quarantine on breach
+                                    rung=faults.rung_label(exec_rung))
                     picks = trim_picks(picks, slab.n_real[k])
                     if fault_plan is not None:
                         fault_plan.detect_succeeded()
@@ -1187,6 +1046,8 @@ def run_campaign_batched(
                         round(wall / max(slab.n_valid, 1), 3), records,
                         attempts=rz.state.n_attempts(path),
                         health=dict(stats or {}),
+                        family=bdet.family,
+                        rung=faults.rung_label(exec_rung),
                     )
                     if file_recovered:
                         rz.tally("oom_recoveries")
@@ -1209,8 +1070,6 @@ def run_campaign_batched(
                         use_fallback = True
                         continue
                 break
-
-    from ..parallel.dispatch import PipelinedDispatch
 
     pipe = PipelinedDispatch(dispatch_depth)
 
@@ -1424,10 +1283,12 @@ def _probe_healthy(pairs, interrogator, fail, expect_shape=None, rz=None):
 
 def _file_record(outdir, path, picks, thresholds, wall_s, records,
                  write: bool = True, attempts: int = 1,
-                 health=None) -> FileRecord:
+                 health=None, family: str = "", rung: str = "") -> FileRecord:
     """One completed file's bookkeeping — artifact + manifest + record —
     shared by every campaign flavor (``write=False``: multi-host
-    non-writer processes compute identical records, write nothing)."""
+    non-writer processes compute identical records, write nothing).
+    ``family``/``rung`` stamp the detector family and the route rung
+    that actually executed (the per-family audit trail)."""
     if write:
         picks_file = _save_picks(outdir, path, picks, thresholds)
     else:
@@ -1437,6 +1298,7 @@ def _file_record(outdir, path, picks, thresholds, wall_s, records,
         n_picks={n: int(p.shape[1]) for n, p in picks.items()},
         wall_s=wall_s, picks_file=picks_file,
         attempts=max(int(attempts), 1), health=dict(health or {}),
+        family=family, rung=rung,
     )
     # manifest BEFORE the in-memory record: the batched route retries
     # this call, and a transient manifest-append failure must not leave
@@ -1516,6 +1378,7 @@ def run_campaign_sharded(
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
     pend_metas = [metas[j] for j in pend_idx]
     rz = _Resilience(outdir, records, max_failures, retry, health=False)
+    rz.family = "mf"   # the sharded SPMD step is the MF family's
     fail = rz.fail
 
     healthy_specs, spec0 = _probe_healthy(
@@ -1630,7 +1493,8 @@ def run_campaign_sharded(
             thresholds = {name: float(thres_np[k]) * factors[name]
                           for name in design.template_names}
             _file_record(outdir, path, picks, thresholds,
-                         round(wall / max(len(blocks), 1), 3), records)
+                         round(wall / max(len(blocks), 1), 3), records,
+                         family="mf", rung="sharded")
 
     consumed = 0  # batches cover `healthy` strictly in order
     rebuilds = 0
@@ -1812,7 +1676,8 @@ def run_campaign_multiprocess(
     records: List[FileRecord] = []
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
     pend_metas = [metas[j] for j in pend_idx]
-    fail = _failure_recorder(outdir, records, max_failures, write=is_writer)
+    fail = _failure_recorder(outdir, records, max_failures, write=is_writer,
+                             family="mf")
 
     healthy_specs, spec0 = _probe_healthy(
         zip(pending, pend_metas), interrogator, fail
@@ -1964,7 +1829,7 @@ def run_campaign_multiprocess(
                           for name in design.template_names}
             _file_record(outdir, path, picks, thresholds,
                          round(wall / max(n_real, 1), 3), records,
-                         write=is_writer)
+                         write=is_writer, family="mf", rung="multihost")
     # writer must finish artifacts before any process reads them
     multihost_utils.sync_global_devices("das4whales-campaign-end")
     return CampaignResult(outdir=outdir, records=records)
@@ -1997,6 +1862,19 @@ def summarize_campaign(outdir: str) -> dict:
     # append fresh records (a file that failed, then succeeded on a
     # later attempt, counts ONCE — as done), so nothing is double-counted
     latest = {r["path"]: r for r in recs if "path" in r}
+    # per-family / per-rung audit (workflows.planner): every record
+    # carries the detector family and the route rung that executed it,
+    # so a downshift ledger is attributable per family ("" groups
+    # records from pre-planner manifests)
+    by_family: Dict[str, Dict[str, int]] = {}
+    for r in latest.values():
+        fam = by_family.setdefault(r.get("family", ""), {})
+        fam[r["status"]] = fam.get(r["status"], 0) + 1
+    rungs: Dict[str, int] = {}
+    for r in latest.values():
+        if r["status"] == "done":
+            label = r.get("rung", "") or "?"
+            rungs[label] = rungs.get(label, 0) + 1
     done = [r for r in latest.values() if r["status"] == "done"]
     failed = [r for r in latest.values() if r["status"] == "failed"]
     quarantined = [r for r in latest.values() if r["status"] == "quarantined"]
@@ -2032,12 +1910,18 @@ def summarize_campaign(outdir: str) -> dict:
         "watchdog_timeouts": counters["watchdog_timeouts"],
         "downshift_ledger": downshift_events,
         "mesh_downshifts": mesh_events,
+        # status counts per detector family + done counts per executed
+        # rung — the family-resilience audit (docs/ROBUSTNESS.md
+        # "Family x guarantee coverage")
+        "by_family": by_family,
+        "rungs": rungs,
         "failed_paths": [r["path"] for r in failed],
         "quarantined_paths": [r["path"] for r in quarantined],
         "timeout_paths": [r["path"] for r in timeout],
         "total_picks": totals,
         "files": [{"path": r["path"], "n_picks": r["n_picks"],
-                   "wall_s": r["wall_s"]} for r in done],
+                   "wall_s": r["wall_s"], "family": r.get("family", ""),
+                   "rung": r.get("rung", "")} for r in done],
         "density": density,
     }
 

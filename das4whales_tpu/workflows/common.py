@@ -79,6 +79,28 @@ def acquire(
     return block, meta, selected_channels
 
 
+def mf_prefilter(metadata, selected_channels, trace_shape=None, *,
+                 fused_bandpass: bool = True):
+    """The bandpass + f-k front end every signal-processing family
+    shares (the identical head of main_mfdetect / main_spectrodetect /
+    main_gabordetect): a :class:`MatchedFilterDetector` whose
+    ``filter_block`` is the prefilter. One builder so the spectro and
+    gabor campaign detectors (``spectrodetect.campaign_detector`` /
+    ``gabordetect.campaign_detector``) cannot diverge from the flagship's
+    filter design. ``trace_shape=None`` derives the post-selection shape
+    from the metadata."""
+    from ..config import ChannelSelection
+    from ..models.matched_filter import MatchedFilterDetector
+
+    meta = as_metadata(metadata)
+    if trace_shape is None:
+        sel = ChannelSelection.from_list(list(selected_channels))
+        trace_shape = (sel.n_channels(meta.nx), meta.ns)
+    return MatchedFilterDetector(meta, list(selected_channels),
+                                 tuple(trace_shape),
+                                 fused_bandpass=fused_bandpass)
+
+
 def maybe_savefig(fig, outdir: str | None, name: str) -> str | None:
     if fig is None or outdir is None:
         return None

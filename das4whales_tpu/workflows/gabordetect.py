@@ -8,7 +8,27 @@ import numpy as np
 
 from ..models.gabor import GaborDetector
 from ..models.matched_filter import MatchedFilterDetector
-from .common import acquire, maybe_savefig
+from .common import acquire, maybe_savefig, mf_prefilter
+
+
+def campaign_detector(metadata, selected_channels, trace_shape=None, *,
+                      fused_bandpass: bool = True, **gabor_kwargs):
+    """The Gabor/image family wired for the resilient campaign runner:
+    the shared bandpass + f-k prefilter (``common.mf_prefilter``)
+    feeding a :class:`GaborDetector`, wrapped in the eval adapter the
+    route planner maps to the ``"gabor"`` :class:`DetectorProgram`
+    (``workflows.planner``) — the family's ladder is per-file -> host
+    (the oriented Gabor pair couples ~kilochannel image rows, so no
+    tiled rung), with the same retry/health/watchdog/chaos coverage as
+    every other family."""
+    from ..eval import GaborEvalAdapter
+
+    mf = mf_prefilter(metadata, selected_channels, trace_shape,
+                      fused_bandpass=fused_bandpass)
+    return GaborEvalAdapter(
+        mf, GaborDetector(mf.metadata, list(selected_channels),
+                          **gabor_kwargs),
+    )
 
 
 def main(url: str | None = None, outdir: str | None = None, show: bool = False,

@@ -1,0 +1,473 @@
+"""Family-agnostic resilient route planner (the executor every detector
+family inherits).
+
+PRs 4-6 grew a resilience stack for detection campaigns — classified
+retry, on-device health quarantine, an elastic OOM downshift ladder, a
+dispatch watchdog, pipelined dispatch — but it was gated on
+``isinstance(detector, MatchedFilterDetector)`` inside
+``workflows/campaign.py``: spectro, gabor and learned campaigns rode a
+flat route where a single device OOM permanently failed a file that a
+leaner route (or the host backend) would have processed. This module
+extracts the route planner into a family-agnostic contract:
+
+* :class:`DetectorProgram` — the per-family adapter: capability flags
+  (supported ladder stages, fused vs host health stats, async dispatch)
+  plus ``detect(rung, trace)``, the family's program at one ladder rung.
+* :class:`DownshiftLadder` — the sticky per-bucket rung bookkeeping of
+  the elastic resource ladder (moved from ``workflows.campaign``),
+  now filtered to the family's declared stages.
+* :class:`RoutePlanner` — the routed executor: resolves each file
+  through the family program at the bucket's sticky rung, bounds every
+  dispatch with the watchdog (``faults.call_with_deadline``), fires the
+  chaos harness's ``on_dispatch(path, rung)`` hook INSIDE the deadline,
+  and absorbs resource-class failures by descending the ladder.
+* :func:`program_for` — the family registry: maps any campaign detector
+  (``MatchedFilterDetector``, the spectro/gabor eval adapters,
+  ``LearnedDetector``, or any callable returning ``.picks``) to its
+  :class:`DetectorProgram`.
+
+Every family's ladder starts at the per-file rung and ends at the host
+rung, so a resource-class failure is always recoverable somewhere; the
+family declares which intermediate rungs (tiled / time-sharded) its
+math supports. Matched-filter campaigns ride the same planner with
+picks pinned bit-identical to the pre-planner behavior (the chaos and
+parity suites gate this). Coverage matrix: docs/ROBUSTNESS.md
+"Family x guarantee coverage".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .. import faults
+from ..utils.log import get_logger
+
+log = get_logger("planner")
+
+
+def _append_event(outdir: str, event: Dict) -> None:
+    from .campaign import _append_event as _ev
+
+    _ev(outdir, event)
+
+
+def thresholds_for(result, picks) -> Dict[str, float]:
+    """Per-template thresholds for the picks artifact, from a detector
+    result. Distinguishes an ABSENT ``thresholds`` attribute
+    (missing/None — the family exposes no threshold metadata; every
+    template records NaN) from a PRESENT mapping, which is trusted
+    as-is even when empty or partial (missing names record NaN at save
+    time). The old ``getattr(...) or {...}`` fallback conflated the
+    two: an empty-but-present dict is falsy and was silently replaced,
+    while a partial dict crashed the artifact writer."""
+    thresholds = getattr(result, "thresholds", None)
+    if thresholds is None:
+        return {name: float("nan") for name in picks}
+    return dict(thresholds)
+
+
+class DetectorProgram:
+    """One detector family's executor contract.
+
+    Subclasses declare the capability flags and implement
+    :meth:`detect`; the campaign runners never inspect the detector
+    type again — the program IS the family:
+
+    * ``family`` — the manifest/ledger label (``FileRecord.family``).
+    * ``stages`` — the ladder stages this family's math supports, in
+      ladder order. Must include ``"file"`` (the entry rung) and should
+      include ``"host"`` (the rung of last resort — detection on the
+      CPU backend completes where no device rung fits).
+    * ``supports_fused_health`` — the family fuses ``ops.health`` stats
+      into its detection program (stats ride the program's own fetch);
+      otherwise the planner computes host-side stats on the
+      already-host-resident block (same values, one numpy pass).
+    * ``supports_dispatch`` — :meth:`dispatch` can launch the program
+      asynchronously (the depth-D pipelined campaign dispatch).
+    * ``supports_batched`` — a batched (B files per program) builder
+      exists (``run_campaign_batched``; matched filter only today).
+    """
+
+    family = "generic"
+    stages: Tuple[str, ...] = ("file", "host")
+    supports_fused_health = False
+    supports_dispatch = False
+    supports_batched = False
+
+    def __init__(self, detector):
+        self.det = detector
+
+    # -- the per-rung program ---------------------------------------------
+
+    def _det_at(self, stage: str):
+        """The detector view serving ``stage`` — families with a
+        memory-lean ``tiled`` view override this; the default serves
+        the same detector at every stage."""
+        return self.det
+
+    def detect(self, rung, trace, *, n_real=None, with_health: bool = False,
+               clip=None):
+        """One HOST block's ``(picks, thresholds, stats)`` at ``rung``.
+        Raises on failure — including resource exhaustion at this rung,
+        which the caller's ladder absorbs. The default implementation
+        runs the generic ``det(block) -> .picks`` contract through
+        :meth:`_det_at`, with the ``host`` rung pinned to the CPU
+        backend; families with their own per-rung programs (the matched
+        filter) override the whole method."""
+        import jax
+
+        det = self._det_at(rung[0])
+        if rung[0] == "host":
+            with jax.default_device(jax.devices("cpu")[0]):
+                return self._call_detector(det, trace,
+                                           with_health=with_health, clip=clip)
+        return self._call_detector(det, trace, with_health=with_health,
+                                   clip=clip)
+
+    def dispatch(self, trace, *, with_health: bool = False, clip=None):
+        """Launch the per-file program asynchronously (an
+        ``InFlightResult``-style handle whose ``resolve()`` is the one
+        sync), or None when the family has no async route."""
+        return None
+
+    # -- shared helpers ----------------------------------------------------
+
+    def _host_stats(self, trace, with_health: bool, clip) -> Dict[str, float]:
+        if not with_health:
+            return {}
+        from ..ops import health as health_ops
+
+        return health_ops.host_health_stats(np.asarray(trace), clip_abs=clip)
+
+    def _call_detector(self, det, trace, *, with_health: bool, clip):
+        """The generic per-file program: ``det(block)`` -> ``.picks``
+        (+ optional ``.thresholds``), host-side health stats."""
+        import jax.numpy as jnp
+
+        result = det(jnp.asarray(trace))
+        stats = self._host_stats(trace, with_health, clip)
+        return result.picks, thresholds_for(result, result.picks), stats
+
+
+class GenericProgram(DetectorProgram):
+    """Any callable returning ``.picks`` — the flat route of PRs 4-6,
+    now with the host rung (and therefore OOM recovery) for free."""
+
+
+class MatchedFilterProgram(DetectorProgram):
+    """The flagship family: every rung of the ladder, fused health on
+    the sparse one-program route, async dispatch for the depth-D
+    pipeline, and the batched slab route (``run_campaign_batched``)."""
+
+    family = "mf"
+    stages = ("file", "tiled", "timeshard", "host")
+    supports_batched = True
+
+    def __init__(self, detector):
+        super().__init__(detector)
+        self.supports_fused_health = bool(
+            getattr(detector, "supports_fused_health", False)
+        )
+        self.supports_dispatch = getattr(detector, "pick_mode", "") == "sparse"
+
+    def dispatch(self, trace, *, with_health=False, clip=None):
+        if not self.supports_dispatch:
+            return None
+        return self.det.dispatch_picks(trace, with_health=with_health,
+                                       health_clip=clip)
+
+    def detect(self, rung, trace, *, n_real=None, with_health=False,
+               clip=None):
+        import jax
+        import jax.numpy as jnp
+
+        det = self.det
+        stage = rung[0]
+        if stage == "timeshard":
+            from ..parallel.timeshard import (
+                detect_picks_time_sharded,
+                ladder_time_mesh,
+            )
+
+            mesh = ladder_time_mesh(np.asarray(trace).shape)
+            if mesh is None:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: no viable time-shard mesh for "
+                    f"shape {np.asarray(trace).shape}"  # -> next rung (host)
+                )
+            picks, thresholds = detect_picks_time_sharded(
+                det, trace, mesh, n_real=n_real
+            )
+            return picks, thresholds, self._host_stats(trace, with_health,
+                                                       clip)
+
+        if stage == "tiled":
+            det = det.tiled_view()
+        elif stage == "host":
+            det = det.host_view()
+
+        def run(d):
+            res = d.detect_picks(
+                jnp.asarray(trace), n_real=n_real,
+                with_health=with_health, health_clip=clip,
+            )
+            return res.picks, res.thresholds, res.health
+
+        if stage == "host":
+            with jax.default_device(det.host_device):
+                return run(det)
+        return run(det)
+
+
+class SpectroProgram(DetectorProgram):
+    """Spectrogram-correlation family (``eval.SpectroEvalAdapter``):
+    per-file, channel-chunk-tiled (smaller spectrogram sweep chunks —
+    ``models.spectro.SpectroCorrDetector.tiled_view``) and host rungs.
+    Every stage is per-channel math, so the tiled rung's picks are
+    bit-identical to the per-file rung's."""
+
+    family = "spectro"
+    stages = ("file", "tiled", "host")
+
+    def _det_at(self, stage):
+        if stage != "tiled":
+            return self.det
+        import copy
+
+        adapter = copy.copy(self.det)
+        adapter.det = self.det.det.tiled_view()
+        return adapter
+
+
+class GaborProgram(DetectorProgram):
+    """Gabor/image family (``eval.GaborEvalAdapter``): per-file and host
+    rungs only — the oriented Gabor pair couples ~1000 channels of the
+    t-x image, so a channel-tiled rung would change the detection math
+    at tile seams (``parallel/gabor.py`` documents the halo cost)."""
+
+    family = "gabor"
+    stages = ("file", "host")
+
+
+class LearnedProgram(DetectorProgram):
+    """Learned CNN family (``models.learned.LearnedDetector``):
+    per-file, window-row-chunked tiled
+    (``LearnedDetector.tiled_view`` — caps the classifier's activation
+    memory) and host rungs."""
+
+    family = "learned"
+    stages = ("file", "tiled", "host")
+
+    def _det_at(self, stage):
+        return self.det.tiled_view() if stage == "tiled" else self.det
+
+
+def program_for(detector) -> DetectorProgram:
+    """The family registry: any campaign detector -> its
+    :class:`DetectorProgram`. A detector already wrapped in a program
+    passes through; unknown detector types get the
+    :class:`GenericProgram` flat contract (per-file + host rungs, host
+    health stats) — which is strictly MORE resilient than the
+    pre-planner generic path (no ladder at all)."""
+    if isinstance(detector, DetectorProgram):
+        return detector
+    from ..models.learned import LearnedDetector
+    from ..models.matched_filter import MatchedFilterDetector
+
+    if isinstance(detector, MatchedFilterDetector):
+        return MatchedFilterProgram(detector)
+    if isinstance(detector, LearnedDetector):
+        return LearnedProgram(detector)
+    from ..eval import GaborEvalAdapter, SpectroEvalAdapter
+
+    if isinstance(detector, SpectroEvalAdapter):
+        return SpectroProgram(detector)
+    if isinstance(detector, GaborEvalAdapter):
+        return GaborProgram(detector)
+    return GenericProgram(detector)
+
+
+class DownshiftLadder:
+    """The elastic resource ladder's sticky bookkeeping
+    (docs/ROBUSTNESS.md "Resource ladder").
+
+    One campaign, one ladder: per bucket key it remembers the WINNING
+    rung — ``("batched", B)`` at shrinking B, then ``("file", 1)`` (the
+    per-file route), ``("tiled", 1)`` (the family's memory-lean view),
+    ``("timeshard", 1)`` (time-sharded over a multi-device mesh, when
+    the family supports it and the shape divides), ``("host", 1)`` (CPU
+    backend). ``stages`` filters the ladder to the family's declared
+    support (``DetectorProgram.stages``); ``family`` labels the
+    manifest's ``downshift`` ledger events so downshifts are auditable
+    per family. A resource-class failure advances the bucket's rung
+    ONCE and the rung sticks for the rest of the campaign (no per-file
+    thrash); every move lands in the manifest's ``downshift`` ledger.
+    """
+
+    def __init__(self, rz, outdir: str, batch: int = 1,
+                 write: bool = True, timeshard: bool = True,
+                 stages=faults.DOWNSHIFT_STAGES, family: str = ""):
+        self.rz = rz
+        self.outdir = outdir
+        self.batch = int(batch)
+        self.write = write
+        self.allow_timeshard = timeshard
+        self.stages = tuple(stages)
+        self.family = family
+        self.sticky: Dict[tuple, tuple] = {}
+
+    def rungs(self, trace_shape=None) -> list:
+        out = []
+        if "batched" in self.stages:
+            b = self.batch
+            while b > 1:
+                out.append(("batched", b))
+                b //= 2
+        out.append(("file", 1))
+        if "tiled" in self.stages:
+            out.append(("tiled", 1))
+        if ("timeshard" in self.stages and self.allow_timeshard
+                and trace_shape is not None):
+            import jax
+
+            from ..parallel.timeshard import viable_time_mesh_size
+
+            if viable_time_mesh_size(trace_shape, len(jax.devices())):
+                out.append(("timeshard", 1))
+        if "host" in self.stages:
+            out.append(("host", 1))
+        return out
+
+    def current(self, key) -> tuple:
+        return self.sticky.get(
+            key, ("batched", self.batch) if self.batch > 1 else ("file", 1)
+        )
+
+    def pin(self, key, rung, reason: str) -> None:
+        """Preflight placement: start ``key`` at ``rung`` (no failure
+        occurred — ledgered as a preflight downshift when it moves the
+        bucket off the top rung)."""
+        top = ("batched", self.batch) if self.batch > 1 else ("file", 1)
+        self.sticky[key] = rung
+        if faults.rung_rank(rung) > faults.rung_rank(top):
+            self.rz.tally("downshifts")
+            if self.write:
+                _append_event(self.outdir, {
+                    "event": "downshift",
+                    "bucket": key if isinstance(key, str) else list(key),
+                    "family": self.family,
+                    "from": faults.rung_label(top),
+                    "to": faults.rung_label(rung),
+                    "error": reason, "preflight": True, "sticky": True,
+                })
+            log.info("preflight: bucket %s starts at rung %s (%s)",
+                     key, faults.rung_label(rung), reason)
+
+    def downshift(self, key, rung, exc, trace_shape=None):
+        """Advance ``key``'s sticky rung past ``rung`` after a
+        resource-class failure; returns the new rung, or None when the
+        ladder is exhausted (the failure dispositions per-file)."""
+        nxt = None
+        for cand in self.rungs(trace_shape):
+            if faults.rung_rank(cand) > faults.rung_rank(rung):
+                nxt = cand
+                break
+        if nxt is None:
+            return None
+        self.sticky[key] = nxt
+        self.rz.tally("downshifts")
+        if self.write:
+            _append_event(self.outdir, {
+                "event": "downshift",
+                "bucket": key if isinstance(key, str) else list(key),
+                "family": self.family,
+                "from": faults.rung_label(rung),
+                "to": faults.rung_label(nxt),
+                "error": f"{type(exc).__name__}: {exc}", "sticky": True,
+            })
+        log.warning(
+            "resource exhaustion at rung %s (%s: %s); downshifting bucket "
+            "%s to %s (sticky)", faults.rung_label(rung),
+            type(exc).__name__, exc, key, faults.rung_label(nxt),
+        )
+        return nxt
+
+
+class RoutePlanner:
+    """One campaign's routed, degradable, watchdogged executor over a
+    family :class:`DetectorProgram`.
+
+    ``run_file`` resolves one file at the bucket's sticky rung: the
+    family program (or a pre-dispatched in-flight handle at the top
+    rung) runs inside the dispatch watchdog with the chaos harness's
+    ``on_dispatch(path, rung)`` hook firing inside the deadline —
+    exactly where a real wedged/OOMing launch surfaces. Resource-class
+    failures descend the family's ladder (sticky, ledgered); everything
+    else re-raises for the campaign's classified disposition.
+    """
+
+    def __init__(self, rz, outdir: str, program: DetectorProgram, *,
+                 write: bool = True, timeshard: bool = True,
+                 dispatch_deadline_s: float | None = None, fault_plan=None):
+        self.rz = rz
+        self.program = program
+        self.fault_plan = fault_plan
+        self.deadline_s = dispatch_deadline_s
+        self.top = ("file", 1)
+        self.ladder = DownshiftLadder(
+            rz, outdir, batch=1, write=write, timeshard=timeshard,
+            stages=program.stages, family=program.family,
+        )
+
+    def current(self, key: str = "campaign") -> tuple:
+        return self.ladder.current(key)
+
+    def run_file(self, path: str, trace, *, n_real=None,
+                 with_health: bool = False, clip=None, inflight=None,
+                 key: str = "campaign"):
+        """One file's ``(picks, thresholds, stats, rung)`` through the
+        rung loop. ``inflight`` is the depth-D pipeline's pre-dispatched
+        handle for this file: consumed only while the bucket still rides
+        the top rung (a downshift between dispatch and resolve abandons
+        it); any failure discards it — a handle is never resolved
+        twice."""
+        from ..parallel import dispatch as dispatch_mod
+
+        recovered = False
+        shape = np.asarray(trace).shape
+        while True:   # rung loop: resource failures downshift, sticky
+            rung = self.ladder.current(key)
+            if inflight is not None and rung != self.top:
+                # the campaign downshifted between this file's dispatch
+                # and its resolve: the in-flight program ran at a rung
+                # now known to exhaust — abandon it
+                inflight = None
+
+            def fn(inflight=inflight, rung=rung):
+                if inflight is not None:
+                    # the pipeline's pre-dispatched program: this is its
+                    # packed fetch (the one sync), inside the watchdog
+                    res = inflight.resolve()
+                    return res.picks, res.thresholds, res.health
+                return self.program.detect(
+                    rung, trace, n_real=n_real,
+                    with_health=with_health, clip=clip,
+                )
+
+            try:
+                picks, thresholds, stats = dispatch_mod.resolve_watchdogged(
+                    fn, [path], rung, self.deadline_s, self.fault_plan
+                )
+                break
+            except Exception as exc:  # noqa: BLE001 — ladder absorbs resource
+                inflight = None   # spent/abandoned: never consume twice
+                if (faults.classify_failure(exc) == "resource"
+                        and self.ladder.downshift(key, rung, exc, shape)):
+                    recovered = True
+                    continue
+                raise
+        if recovered:
+            self.rz.tally("oom_recoveries")
+        return picks, thresholds, stats, rung

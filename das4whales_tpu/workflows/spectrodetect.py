@@ -9,7 +9,28 @@ import numpy as np
 
 from ..models.matched_filter import MatchedFilterDetector
 from ..models.spectro import SpectroCorrDetector
-from .common import acquire, maybe_savefig
+from .common import acquire, maybe_savefig, mf_prefilter
+
+
+def campaign_detector(metadata, selected_channels, trace_shape=None, *,
+                      threshold: float = 14.0, fused_bandpass: bool = True,
+                      **spectro_kwargs):
+    """The spectro family wired for the resilient campaign runner: the
+    shared bandpass + f-k prefilter (``common.mf_prefilter``) feeding a
+    :class:`SpectroCorrDetector`, wrapped in the eval adapter the route
+    planner maps to the ``"spectro"`` :class:`DetectorProgram`
+    (``workflows.planner``) — so a spectro campaign inherits the whole
+    resilience stack: retry taxonomy, health quarantine, the downshift
+    ladder (per-file -> channel-chunk-tiled -> host), the dispatch
+    watchdog and chaos coverage."""
+    from ..eval import SpectroEvalAdapter
+
+    mf = mf_prefilter(metadata, selected_channels, trace_shape,
+                      fused_bandpass=fused_bandpass)
+    return SpectroEvalAdapter(
+        mf, SpectroCorrDetector(mf.metadata, threshold=threshold,
+                                **spectro_kwargs),
+    )
 
 
 def main(url: str | None = None, outdir: str | None = None, show: bool = False,

@@ -121,6 +121,7 @@ def test_sharded_campaign_matches_contract(file_set, tmp_path):
     assert res.n_done == 2 and res.n_failed == 1
     for rec in res.records:
         if rec.status == "done":
+            assert (rec.family, rec.rung) == ("mf", "sharded")
             picks = load_picks(rec.picks_file)
             assert NX // 2 in picks["HF"][0]  # injected call found under sharding
     # resume skips everything done
@@ -146,9 +147,18 @@ def test_campaign_with_spectro_adapter(file_set, tmp_path):
     res = run_campaign(file_set, SEL, out, detector=adapter)
     assert res.n_done == 2 and res.n_failed == 1
     for rec in res.records:
+        # the family/rung audit fields (workflows.planner) stamp every
+        # record, failures included
+        assert rec.family == "spectro"
         if rec.status == "done":
+            assert rec.rung == "file"
             picks = load_picks(rec.picks_file)
             assert set(picks) == {"HF", "LF"}
+            # the spectro family's absolute threshold rides the artifact
+            # (it used to be a NaN placeholder)
+            with np.load(rec.picks_file) as z:
+                assert all(v == adapter.det.threshold
+                           for v in z["thresholds"])
 
 
 def test_metadata_sequence_form(file_set, tmp_path):

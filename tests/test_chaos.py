@@ -496,12 +496,15 @@ def test_oom_downshift_sticky_bit_identical_and_compile_pinned(
         for name, ref in fault_free[rec.path].items():
             np.testing.assert_array_equal(load_picks(rec.picks_file)[name],
                                           ref)
+    # the audit fields stamp the executing route: every file of the
+    # downshifted campaign ran (and records) the per-file rung
+    assert all((r.family, r.rung) == ("mf", "file") for r in res.records)
     s = summarize_campaign(out)
     # one downshift serves BOTH slabs: the rung is sticky per bucket
     assert s["downshifts"] == 1 and len(s["downshift_ledger"]) == 1
     ev = s["downshift_ledger"][0]
     assert ev["from"] == "batched:2" and ev["to"] == "file"
-    assert ev["sticky"] is True
+    assert ev["sticky"] is True and ev["family"] == "mf"
     assert s["oom_recoveries"] >= 2            # the faulted slab's files
     # compile discipline: every rung program is warm now — a rerun of the
     # same faulted campaign compiles NOTHING (one compile per (bucket, B)
@@ -685,7 +688,11 @@ def test_summary_resource_counters_zero_on_healthy_run(file_set, tmp_path):
     res = run_campaign_batched(file_set, SEL, out, batch=2, bucket="exact",
                                persistent_cache=False)
     assert res.n_done == N_FILES
+    # healthy top-rung records: the batched rung label, MF family
+    assert all((r.family, r.rung) == ("mf", "batched:2")
+               for r in res.records)
     s = summarize_campaign(out)
+    assert s["rungs"] == {"batched:2": N_FILES}
     assert s["downshifts"] == 0
     assert s["oom_recoveries"] == 0
     assert s["watchdog_timeouts"] == 0

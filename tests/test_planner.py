@@ -1,0 +1,451 @@
+"""Family-agnostic resilient route planner (workflows/planner.py).
+
+ISSUE 7's acceptance contract: EVERY detector family — not just the
+matched filter — inherits the downshift ladder, the dispatch watchdog,
+the health gate and the chaos harness's dispatch coverage. These tests
+drive the spectro, gabor and learned families through the same seeded
+``oom`` / ``hang_dispatch`` schedules the MF chaos suite runs
+(tests/test_chaos.py), asserting oracle dispositions, ZERO failed
+records on recovery, picks bit-identical to fault-free at the
+single-chip rungs, and sticky per-family ``downshift`` ledger events.
+Plus the satellite regressions: the absent-vs-empty thresholds
+distinction and the family/rung audit fields on every record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from das4whales_tpu import faults
+from das4whales_tpu.io.interrogators import get_acquisition_parameters
+from das4whales_tpu.io.synth import (
+    SyntheticCall,
+    SyntheticScene,
+    write_synthetic_file,
+)
+from das4whales_tpu.workflows import planner
+from das4whales_tpu.workflows.campaign import (
+    load_picks,
+    run_campaign,
+    summarize_campaign,
+)
+
+NX, NS = 24, 900
+SEL = [0, NX, 1]
+N_FILES = 4
+
+POLICY = faults.RetryPolicy(max_attempts=3, base_delay_s=0.002,
+                            max_delay_s=0.01, seed=0)
+HANG_S = 8.0
+
+
+@pytest.fixture(scope="module")
+def file_set(tmp_path_factory):
+    d = tmp_path_factory.mktemp("plannerdata")
+    paths = []
+    for k in range(N_FILES):
+        scene = SyntheticScene(
+            nx=NX, ns=NS, noise_rms=0.05, seed=k,
+            calls=[SyntheticCall(t0=1.2 + 0.3 * k, x0_m=NX / 2 * 2.042,
+                                 amplitude=2.0)],
+        )
+        p = str(d / f"pf{k}.h5")
+        write_synthetic_file(p, scene)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def meta0(file_set):
+    return get_acquisition_parameters(file_set[0], "optasense")
+
+
+@pytest.fixture(scope="module")
+def spectro_detector(meta0):
+    from das4whales_tpu.workflows.spectrodetect import campaign_detector
+
+    return campaign_detector(meta0, SEL)
+
+
+@pytest.fixture(scope="module")
+def gabor_detector(meta0):
+    from das4whales_tpu.workflows.gabordetect import campaign_detector
+
+    return campaign_detector(meta0, SEL)
+
+
+def _reference_picks(files, detector, outdir):
+    res = run_campaign(files, SEL, outdir, detector=detector)
+    assert res.n_done == len(files), [r.error for r in res.records]
+    return {r.path: load_picks(r.picks_file) for r in res.records}
+
+
+@pytest.fixture(scope="module")
+def spectro_ref(file_set, spectro_detector, tmp_path_factory):
+    return _reference_picks(file_set, spectro_detector,
+                            str(tmp_path_factory.mktemp("spref") / "c"))
+
+
+@pytest.fixture(scope="module")
+def gabor_ref(file_set, gabor_detector, tmp_path_factory):
+    return _reference_picks(file_set, gabor_detector,
+                            str(tmp_path_factory.mktemp("garef") / "c"))
+
+
+def _oom_plan(ok_rung, only=None):
+    plan = faults.FaultPlan(0, rate=0.0)
+    plan.spec_for = lambda p: (
+        faults.FaultSpec("oom", "dispatch", 10**9, ok_rung=ok_rung)
+        if only is None or os.path.basename(p) == only else None
+    )
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# The contract: program resolution and capability declarations
+# ---------------------------------------------------------------------------
+
+
+def test_program_for_resolves_every_family(meta0, spectro_detector,
+                                           gabor_detector):
+    from das4whales_tpu.models import learned
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    mf = MatchedFilterDetector(meta0, SEL, (NX, NS))
+    prog = planner.program_for(mf)
+    assert prog.family == "mf"
+    assert prog.stages == ("file", "tiled", "timeshard", "host")
+    assert prog.supports_batched
+
+    sp = planner.program_for(spectro_detector)
+    assert sp.family == "spectro"
+    assert sp.stages == ("file", "tiled", "host")
+
+    ga = planner.program_for(gabor_detector)
+    assert ga.family == "gabor"
+    assert ga.stages == ("file", "host")   # image ops couple channels
+
+    params, _, _ = learned.init_train_state(learned.LearnedConfig(), seed=0)
+    le = planner.program_for(learned.LearnedDetector(params,
+                                                     learned.LearnedConfig()))
+    assert le.family == "learned"
+    assert le.stages == ("file", "tiled", "host")
+
+    class Custom:
+        def __call__(self, block):
+            raise NotImplementedError
+
+    ge = planner.program_for(Custom())
+    assert ge.family == "generic"
+    assert ge.stages == ("file", "host")
+
+    # every family's ladder starts at the per-file rung and ends at host
+    for p in (prog, sp, ga, le, ge):
+        assert p.stages[0] == "file" and p.stages[-1] == "host"
+        # idempotent: wrapping a program returns it unchanged
+        assert planner.program_for(p) is p
+
+
+def test_ladder_rungs_filtered_to_family_stages(tmp_path):
+    class _RZ:
+        def tally(self, *a, **k):
+            pass
+
+    ladder = planner.DownshiftLadder(_RZ(), str(tmp_path), batch=1,
+                                     write=False, stages=("file", "host"),
+                                     family="gabor")
+    assert ladder.rungs((NX, NS)) == [("file", 1), ("host", 1)]
+    full = planner.DownshiftLadder(_RZ(), str(tmp_path), batch=4,
+                                   write=False)
+    rungs = full.rungs()
+    assert rungs[:3] == [("batched", 4), ("batched", 2), ("file", 1)]
+    assert rungs[-1] == ("host", 1)
+
+
+# ---------------------------------------------------------------------------
+# Seeded chaos schedules through the spectro and gabor families
+# (the tier-1 quick-subset extension of ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def _family_oom_fuzz(seed, files, detector, reference, outdir, family,
+                     tiled_bitwise=True):
+    """One seeded ``oom`` schedule through ``run_campaign`` with a
+    non-MF family: oracle dispositions, zero failed records, picks
+    bit-identical to fault-free (every recovery rung here runs the
+    same math on the same CPU backend), sticky family-labelled ledger."""
+    plan = faults.FaultPlan(seed, rate=0.8, kinds=("oom",))
+    res = run_campaign(files, SEL, outdir, detector=detector, retry=POLICY,
+                       fault_plan=plan)
+    assert res.n_failed == 0 and res.n_done == len(files)
+    for rec in res.records:
+        assert rec.status == plan.expected_disposition(rec.path, POLICY)
+        assert rec.family == family
+        picks = load_picks(rec.picks_file)
+        for name, ref in reference[rec.path].items():
+            np.testing.assert_array_equal(picks[name], ref)
+    s = summarize_campaign(outdir)
+    # only an ok_rung that outranks the per-file entry rung fires at all
+    fired = [p for p in files
+             if (sp := plan.spec_for(p)) is not None
+             and faults.rung_rank(sp.ok_rung) > faults.rung_rank(("file", 1))]
+    if fired:
+        assert s["downshifts"] >= 1 and s["oom_recoveries"] >= 1
+        for ev in s["downshift_ledger"]:
+            assert ev["family"] == family and ev["sticky"] is True
+    else:
+        assert s["downshifts"] == 0 and s["downshift_ledger"] == []
+    assert s["by_family"].get(family, {}).get("done") == len(files)
+    return s
+
+
+@pytest.mark.chaos
+def test_chaos_fuzz_oom_spectro(file_set, spectro_detector, spectro_ref,
+                                tmp_path):
+    """Seeded ``oom`` schedules through the SPECTRO family: the ladder
+    recovers every file at the channel-chunk-tiled rung (per-channel
+    math — picks bit-identical)."""
+    for seed in range(3):
+        _family_oom_fuzz(seed, file_set, spectro_detector, spectro_ref,
+                         str(tmp_path / f"o{seed}"), "spectro")
+
+
+@pytest.mark.chaos
+def test_chaos_fuzz_oom_gabor(file_set, gabor_detector, gabor_ref, tmp_path):
+    """Seeded ``oom`` schedules through the GABOR family: no tiled
+    stage, so a firing fault recovers at the host rung (same backend
+    under tier-1 — picks bit-identical)."""
+    for seed in range(3):
+        s = _family_oom_fuzz(seed, file_set, gabor_detector, gabor_ref,
+                             str(tmp_path / f"o{seed}"), "gabor")
+        for ev in s["downshift_ledger"]:
+            assert ev["to"] == "host"   # gabor ladder: file -> host
+
+
+@pytest.mark.chaos
+def test_spectro_sticky_downshift_rung_recorded(file_set, spectro_detector,
+                                                spectro_ref, tmp_path):
+    """The acceptance drill for a non-MF family: every file OOMs above
+    the tiled rung -> ONE sticky downshift serves the whole campaign,
+    every record executes (and records) the tiled rung, picks
+    bit-identical to fault-free."""
+    out = str(tmp_path / "camp")
+    res = run_campaign(file_set, SEL, out, detector=spectro_detector,
+                       fault_plan=_oom_plan(("tiled", 1)))
+    assert res.n_done == N_FILES and res.n_failed == 0
+    assert all(r.rung == "tiled" and r.family == "spectro"
+               for r in res.records)
+    for rec in res.records:
+        for name, ref in spectro_ref[rec.path].items():
+            np.testing.assert_array_equal(load_picks(rec.picks_file)[name],
+                                          ref)
+    s = summarize_campaign(out)
+    assert s["downshifts"] == 1 and len(s["downshift_ledger"]) == 1
+    ev = s["downshift_ledger"][0]
+    assert (ev["from"], ev["to"], ev["family"]) == ("file", "tiled",
+                                                    "spectro")
+    assert s["oom_recoveries"] >= 1
+    assert s["rungs"] == {"tiled": N_FILES}
+
+
+@pytest.mark.chaos
+def test_learned_family_recovers_at_tiled_rung(file_set, tmp_path):
+    """The learned family (untrained CNN — plumbing, not physics):
+    OOM above tiled recovers at the row-chunked rung with picks
+    bit-identical to its own fault-free run."""
+    from das4whales_tpu.models import learned
+
+    params, _, _ = learned.init_train_state(learned.LearnedConfig(), seed=0)
+    det = learned.LearnedDetector(params, learned.LearnedConfig(),
+                                  threshold=0.5)
+    ref = _reference_picks(file_set, det, str(tmp_path / "ref"))
+    out = str(tmp_path / "camp")
+    res = run_campaign(file_set, SEL, out, detector=det,
+                       fault_plan=_oom_plan(("tiled", 1)))
+    assert res.n_done == N_FILES and res.n_failed == 0
+    assert all(r.family == "learned" and r.rung == "tiled"
+               for r in res.records)
+    for rec in res.records:
+        for name, refpk in ref[rec.path].items():
+            np.testing.assert_array_equal(load_picks(rec.picks_file)[name],
+                                          refpk)
+
+
+@pytest.mark.chaos
+def test_mf_family_rides_same_planner(file_set, tmp_path):
+    """The matched filter migrates onto the shared planner: an OOM
+    above tiled downshifts file -> tiled with picks bit-identical (the
+    wider MF parity/chaos matrix lives in tests/test_chaos.py)."""
+    from das4whales_tpu.io.stream import stream_strain_blocks
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    blk = next(stream_strain_blocks(file_set[:1], SEL, as_numpy=True))
+    det = MatchedFilterDetector(blk.metadata, SEL,
+                                np.asarray(blk.trace).shape,
+                                pick_mode="sparse", keep_correlograms=False)
+    ref = _reference_picks(file_set, det, str(tmp_path / "ref"))
+    out = str(tmp_path / "camp")
+    res = run_campaign(file_set, SEL, out, detector=det,
+                       fault_plan=_oom_plan(("tiled", 1)))
+    assert res.n_done == N_FILES and res.n_failed == 0
+    assert all(r.family == "mf" and r.rung == "tiled" for r in res.records)
+    for rec in res.records:
+        for name, refpk in ref[rec.path].items():
+            np.testing.assert_array_equal(load_picks(rec.picks_file)[name],
+                                          refpk)
+    s = summarize_campaign(out)
+    assert s["downshift_ledger"][0]["family"] == "mf"
+
+
+@pytest.mark.chaos
+def test_watchdog_covers_generic_family(file_set, spectro_detector,
+                                        spectro_ref, tmp_path):
+    """A wedged dispatch against one file of a SPECTRO campaign: the
+    watchdog dispositions it ``timeout`` at deadline scale (the hook
+    fires inside the deadline for every family), the rest stay done."""
+    import time as _time
+
+    culprit = os.path.basename(file_set[1])
+    plan = faults.FaultPlan(0, rate=0.0, hang_s=HANG_S)
+    plan.spec_for = lambda p: (
+        faults.FaultSpec("hang_dispatch", "dispatch", 10**9)
+        if os.path.basename(p) == culprit else None
+    )
+    # warm the spectro program first so the deadline bounds DISPATCH
+    # time, not a cold XLA compile (the MF chaos suite's discipline)
+    assert spectro_ref
+    t0 = _time.perf_counter()
+    res = run_campaign(file_set, SEL, str(tmp_path / "camp"),
+                       detector=spectro_detector, dispatch_deadline_s=1.5,
+                       fault_plan=plan)
+    wall = _time.perf_counter() - t0
+    st = {os.path.basename(r.path): r for r in res.records}
+    assert st[culprit].status == "timeout"
+    assert st[culprit].family == "spectro"
+    # the failure record names the rung the wedge surfaced at (the
+    # dispatch layer annotates escaping exceptions with campaign_rung)
+    assert st[culprit].rung == "file"
+    assert res.n_done == N_FILES - 1 and res.n_timeout == 1
+    assert wall < HANG_S, f"campaign stalled {wall:.1f}s on a wedge"
+    assert summarize_campaign(str(tmp_path / "camp"))["watchdog_timeouts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Satellites: thresholds absent-vs-empty, family/rung audit fields
+# ---------------------------------------------------------------------------
+
+
+class _FakeResult:
+    def __init__(self, picks, thresholds=None, with_attr=True):
+        self.picks = picks
+        if with_attr:
+            self.thresholds = thresholds
+
+
+class _FakeDetector:
+    """Minimal generic-family detector: two templates, configurable
+    thresholds exposure."""
+
+    def __init__(self, thresholds=None, with_attr=True):
+        self._thresholds = thresholds
+        self._with_attr = with_attr
+
+    def __call__(self, block):
+        picks = {"HF": np.zeros((2, 1), np.int64),
+                 "LF": np.asarray([[1], [5]], np.int64)}
+        return _FakeResult(picks, self._thresholds, self._with_attr)
+
+
+def test_thresholds_absent_vs_empty_vs_partial(file_set, tmp_path):
+    """The satellite regression: an ABSENT thresholds attribute records
+    NaN placeholders; an EMPTY-but-present dict is NOT silently
+    replaced (it records NaN per missing name at save time, same
+    artifact shape); a PARTIAL dict keeps its provided values instead
+    of crashing the artifact writer (the pre-fix KeyError failed the
+    file after a successful detection)."""
+    cases = {
+        "absent": _FakeDetector(with_attr=False),
+        "none": _FakeDetector(thresholds=None),
+        "empty": _FakeDetector(thresholds={}),
+        "partial": _FakeDetector(thresholds={"HF": 7.5}),
+        "full": _FakeDetector(thresholds={"HF": 7.5, "LF": 3.25}),
+    }
+    for label, det in cases.items():
+        out = str(tmp_path / label)
+        res = run_campaign(file_set[:1], SEL, out, detector=det)
+        assert res.n_done == 1, (label, res.records[0].error)
+    for label, want in [
+        ("absent", {"HF": np.nan, "LF": np.nan}),
+        ("none", {"HF": np.nan, "LF": np.nan}),
+        ("empty", {"HF": np.nan, "LF": np.nan}),
+        ("partial", {"HF": 7.5, "LF": np.nan}),
+        ("full", {"HF": 7.5, "LF": 3.25}),
+    ]:
+        out = str(tmp_path / label)
+        rec = [json.loads(x) for x in
+               open(os.path.join(out, "manifest.jsonl"))][0]
+        with np.load(rec["picks_file"]) as z:
+            got = {str(n): float(v)
+                   for n, v in zip(z["template_names"], z["thresholds"])}
+        for name, v in want.items():
+            if np.isnan(v):
+                assert np.isnan(got[name]), (label, name, got)
+            else:
+                assert got[name] == v, (label, name, got)
+
+
+def test_thresholds_for_distinguishes_absent_from_empty():
+    picks = {"HF": np.zeros((2, 0)), "LF": np.zeros((2, 0))}
+    absent = planner.thresholds_for(_FakeResult(picks, with_attr=False),
+                                    picks)
+    assert set(absent) == {"HF", "LF"}
+    assert all(np.isnan(v) for v in absent.values())
+    # present-but-empty passes through UNREPLACED (the old `or` fallback
+    # fabricated NaN entries here, erasing the distinction)
+    assert planner.thresholds_for(_FakeResult(picks, thresholds={}),
+                                  picks) == {}
+    partial = planner.thresholds_for(
+        _FakeResult(picks, thresholds={"HF": 7.5}), picks
+    )
+    assert partial == {"HF": 7.5}
+
+
+def test_family_and_rung_on_every_record(file_set, spectro_detector,
+                                         tmp_path):
+    """Satellite: manifest records carry the detector family and the
+    executing rung — failure records included — so per-family downshift
+    ledgers are auditable."""
+    corrupt = str(tmp_path / "corrupt.h5")
+    with open(corrupt, "wb") as fh:
+        fh.write(b"not an hdf5 file")
+    out = str(tmp_path / "camp")
+    res = run_campaign(file_set[:2] + [corrupt], SEL, out,
+                       detector=spectro_detector)
+    by = {os.path.basename(r.path): r for r in res.records}
+    assert by["pf0.h5"].status == "done"
+    assert by["pf0.h5"].family == "spectro" and by["pf0.h5"].rung == "file"
+    assert by["corrupt.h5"].status == "failed"
+    assert by["corrupt.h5"].family == "spectro"   # the campaign's family
+    with open(os.path.join(out, "manifest.jsonl")) as fh:
+        recs = [json.loads(x) for x in fh if "path" in json.loads(x)]
+    assert all("family" in r and "rung" in r for r in recs)
+    s = summarize_campaign(out)
+    assert s["by_family"]["spectro"]["done"] == 2
+    assert s["by_family"]["spectro"]["failed"] == 1
+    assert s["rungs"] == {"file": 2}
+    assert all(f["family"] == "spectro" for f in s["files"])
+
+
+def test_spectro_tiled_view_shallow_and_cached(spectro_detector):
+    det = spectro_detector.det
+    tiled = det.tiled_view()
+    assert tiled is det.tiled_view()        # cached
+    assert tiled is not det
+    assert tiled.batch_channels is not None
+    assert (det.batch_channels is None
+            or tiled.batch_channels < det.batch_channels)
+    assert tiled.kernels is det.kernels     # shallow: shared design
