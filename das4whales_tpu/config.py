@@ -382,6 +382,63 @@ def dispatch_depth_default() -> int:
         return DEFAULT_DISPATCH_DEPTH
 
 
+def mf_engine_default() -> str:
+    """Default matched-filter CORRELATE engine when the caller passes
+    ``mf_engine=None`` (``DAS_MF_ENGINE`` env): ``"fft"`` (the rFFT
+    product route, VPU), ``"matmul"`` (banded-Toeplitz matmul on the
+    MXU, f32 accumulation — ``ops.mxu``), ``"matmul-bf16"`` (bf16
+    inputs with f32 accumulation, eligible only behind the precision
+    gate) or ``"auto"`` (default): per-shape A/B calibration — measured
+    once, cached like the compile cache — picks the fastest engine on a
+    TPU backend, and the FFT route everywhere else
+    (docs/PERF.md "MXU matmul routes")."""
+    return os.environ.get("DAS_MF_ENGINE", "") or "auto"
+
+
+def fk_engine_default() -> str:
+    """Default f-k APPLY engine when the caller passes
+    ``fk_engine=None`` (``DAS_FK_ENGINE`` env): ``"fft"`` (channel-axis
+    FFT pair), ``"matmul"`` (channel-axis DFT-matrix matmul fused with
+    the mask — the Large-Scale-DFT-on-TPUs recast, ``ops.mxu``) or
+    ``"auto"`` (default): the matmul route only on a TPU backend, below
+    the :func:`fk_matmul_max_channels` threshold, and only where the
+    per-shape A/B calibration says it wins."""
+    return os.environ.get("DAS_FK_ENGINE", "") or "auto"
+
+
+#: Default channel-count ceiling for the auto-routed DFT-matmul f-k
+#: apply: the O(C^2) DFT matrix must stay small next to HBM (2 C^2 f32
+#: bytes) and the matmul FLOPs must beat the O(C log C) FFT at MXU
+#: rates. 4096 keeps the matrix pair at 128 MiB.
+DEFAULT_FK_MATMUL_MAX_CHANNELS = 4096
+
+
+def fk_matmul_max_channels() -> int:
+    """Channel-count eligibility ceiling of the ``auto``-routed
+    DFT-matmul f-k apply (``DAS_FK_MATMUL_MAX_CHANNELS`` env; default
+    :data:`DEFAULT_FK_MATMUL_MAX_CHANNELS`). Above it ``auto`` keeps
+    the FFT route and records why; an explicit ``fk_engine="matmul"``
+    overrides (the caller owns the O(C^2) matrix memory)."""
+    raw = os.environ.get("DAS_FK_MATMUL_MAX_CHANNELS", "")
+    try:
+        return int(raw) if raw else DEFAULT_FK_MATMUL_MAX_CHANNELS
+    except ValueError:
+        return DEFAULT_FK_MATMUL_MAX_CHANNELS
+
+
+def calibration_cache_path() -> str:
+    """On-disk home of the per-shape engine A/B calibration table and
+    the bf16 precision-gate verdicts (``ops.mxu.CalibrationTable``) —
+    measured once per (backend, shape), persisted like the compilation
+    cache so the next process (a resumed campaign, tomorrow's bench)
+    routes without re-measuring. ``DAS_CALIBRATION_CACHE`` overrides;
+    the default lives next to the compile cache under the user cache
+    home."""
+    return os.environ.get("DAS_CALIBRATION_CACHE") or os.path.expanduser(
+        os.path.join("~", ".cache", "das4whales_tpu", "mxu_calibration.json")
+    )
+
+
 #: Default on-disk home of the persistent XLA compilation cache (batched
 #: campaigns compile O(#buckets) programs ONCE per machine, not once per
 #: process — docs/TPU_RUNBOOK.md). Override with

@@ -38,6 +38,7 @@ from ..config import (
 from ..config import hbm_budget_bytes as _default_hbm_budget_bytes
 from ..ops import conditioning
 from ..ops import fk as fk_ops
+from ..ops import mxu
 from ..ops import peaks as peak_ops
 from ..ops import spectral, xcorr
 from ..ops.filters import zero_phase_gain
@@ -176,13 +177,17 @@ def mf_filter_and_correlate(
     return trf_fk, corr
 
 
-@functools.partial(jax.jit, static_argnames=("band_lo", "band_hi", "pad_rows"))
+@functools.partial(
+    jax.jit, static_argnames=("band_lo", "band_hi", "pad_rows", "fk_engine")
+)
 def mf_filter_fused(
     trace: jnp.ndarray,
     fused_mask_band: jnp.ndarray,
     band_lo: int,
     band_hi: int,
     pad_rows: int = 0,
+    fk_engine: str = "fft",
+    fk_dft=None,
 ) -> jnp.ndarray:
     """Bandpass ∘ f-k filter as ONE banded spectral multiply.
 
@@ -196,14 +201,21 @@ def mf_filter_fused(
     Butterworth-8 impulse response — <=1e-3 relative beyond ~1 s from
     either edge, ~1e-4 beyond ~3 s (tests/test_fused_bandpass.py); picks
     of interior calls are identical. The reference tapers file edges
-    anyway (dsp.py:705-722)."""
+    anyway (dsp.py:705-722).
+
+    ``fk_engine="matmul"`` routes the channel-axis transform pair through
+    the MXU DFT-matrix matmul (``ops.mxu.fk_apply_dft_matmul``;
+    ``fk_dft`` is the detector's ``(wr, wi)`` device pair)."""
     x = jnp.pad(trace, ((0, pad_rows), (0, 0))) if pad_rows else trace
-    out = fk_ops.fk_filter_apply_rfft_banded(x, fused_mask_band, band_lo, band_hi)
+    out = mxu.fk_apply_body(x, fused_mask_band, band_lo, band_hi,
+                            fk_engine, fk_dft)
     return out[: trace.shape[0]] if pad_rows else out
 
 
 @functools.partial(
-    jax.jit, static_argnames=("band_lo", "band_hi", "bp_padlen", "pad_rows")
+    jax.jit,
+    static_argnames=("band_lo", "band_hi", "bp_padlen", "pad_rows",
+                     "fk_engine"),
 )
 def mf_filter_only(
     trace: jnp.ndarray,
@@ -213,13 +225,16 @@ def mf_filter_only(
     band_hi: int,
     bp_padlen: int,
     pad_rows: int = 0,
+    fk_engine: str = "fft",
+    fk_dft=None,
 ) -> jnp.ndarray:
     """Bandpass + band-limited f-k filter WITHOUT the correlate stage — the
     first program of both detection routes. Kept separate from
     ``mf_filter_and_correlate`` so the correlate temps never share a live
     range with the 2-D f-k spectrum; uses the banded applier
     (``ops.fk.banded_mask_half``) so the channel-axis FFT pair runs only on
-    the mask's in-band frequency columns.
+    the mask's in-band frequency columns — or the MXU DFT-matmul applier
+    when ``fk_engine="matmul"`` (``ops.mxu``).
 
     ``pad_rows`` appends that many virtual silent channels before the f-k
     transform (mask must be designed at the padded count — see
@@ -230,17 +245,19 @@ def mf_filter_only(
     tr_bp = _fft_zero_phase_jit(trace, bp_gain, bp_padlen)
     if pad_rows:
         tr_bp = jnp.pad(tr_bp, ((0, pad_rows), (0, 0)))
-    out = fk_ops.fk_filter_apply_rfft_banded(tr_bp, fk_mask_band, band_lo, band_hi)
+    out = mxu.fk_apply_body(tr_bp, fk_mask_band, band_lo, band_hi,
+                            fk_engine, fk_dft)
     return out[: trace.shape[0]] if pad_rows else out
 
 
-@functools.partial(jax.jit, static_argnames=("tile",))
+@functools.partial(jax.jit, static_argnames=("tile", "mf_engine"))
 def mf_correlate_tiled(
     trf_fk: jnp.ndarray,
     templates_true: jnp.ndarray,
     mu: jnp.ndarray,
     scale,
     tile: int,
+    mf_engine: str = "fft",
 ):
     """Cross-correlograms over channel tiles: the HBM-fitting correlate.
 
@@ -256,7 +273,10 @@ def mf_correlate_tiled(
     Returns ``(corr_tiles [n_tiles, nT, tile, n], gmax)`` where ``gmax`` is
     the global correlogram max over REAL channels only (zero-padding rows
     are excluded so the reference's ``thres = 0.5 * max`` is unchanged,
-    main_mfdetect.py:94).
+    main_mfdetect.py:94). ``mf_engine`` picks the per-tile correlate
+    transform: the rFFT product or the MXU banded-Toeplitz matmul
+    (``ops.mxu.correlograms_body`` — identical normalization/correction
+    math either way).
     """
     C, n = trf_fk.shape
     n_tiles = -(-C // tile)
@@ -267,8 +287,8 @@ def mf_correlate_tiled(
 
     def per_tile(args):
         x, v = args                                      # [tile, n], [tile]
-        corr = xcorr.compute_cross_correlograms_corrected(
-            x, templates_true, mu, scale
+        corr = mxu.correlograms_body(
+            x, templates_true, mu, scale, mf_engine
         )
         tmax = jnp.max(jnp.where(v[None, :, None], corr, neg_inf))
         return corr, tmax
@@ -376,6 +396,7 @@ def reference_threshold_factors(n_templates: int, dtype=None) -> jnp.ndarray:
         "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp",
         "tile", "max_peaks", "capacity", "use_threshold", "pick_method",
         "condition", "cond_demean", "with_health", "pick_engine",
+        "mf_engine", "fk_engine",
     ),
 )
 def mf_detect_picks_program(
@@ -403,6 +424,9 @@ def mf_detect_picks_program(
     with_health: bool = False,
     health_clip=None,
     pick_engine: str = "jnp",
+    mf_engine: str = "fft",
+    fk_engine: str = "fft",
+    fk_dft=None,
 ):
     """The WHOLE detection step as ONE XLA program: [optional narrow-wire
     conditioning prologue ->] bandpass -> f-k filter
@@ -450,6 +474,14 @@ def mf_detect_picks_program(
     no extra dispatch and no extra device->host round trip
     (docs/ROBUSTNESS.md). ``health_clip`` is a traced scalar (samples
     with ``|x| >= health_clip`` count as clipped; None disables).
+
+    ``mf_engine``/``fk_engine`` pick the correlate and f-k transform
+    engines (``"fft"`` or the MXU matmul recasts — ``ops.mxu``; the
+    detector resolves them per shape via the router/calibration table
+    and passes its ``(wr, wi)`` DFT pair as ``fk_dft`` on the matmul
+    f-k route). Normalization, thresholds and pick kernels are shared
+    code across engines, so picks are bit-identical wherever the
+    router selects a matmul route (tests/test_mxu.py).
     """
     C = trace.shape[0]
     nT = templates_true.shape[0]
@@ -478,9 +510,10 @@ def mf_detect_picks_program(
     # to the standalone filter programs, so the routes cannot drift
     if staged_bp:
         trf = mf_filter_only(trace, mask_band, bp_gain, band_lo, band_hi,
-                             bp_padlen, pad_rows)
+                             bp_padlen, pad_rows, fk_engine, fk_dft)
     else:
-        trf = mf_filter_fused(trace, mask_band, band_lo, band_hi, pad_rows)
+        trf = mf_filter_fused(trace, mask_band, band_lo, band_hi, pad_rows,
+                              fk_engine, fk_dft)
 
     def resolve_thr(gmax):
         if use_threshold:
@@ -490,9 +523,7 @@ def mf_detect_picks_program(
         )
 
     if tile is None:
-        corr = xcorr.compute_cross_correlograms_corrected(
-            trf, templates_true, mu, scale
-        )
+        corr = mxu.correlograms_body(trf, templates_true, mu, scale, mf_engine)
         thr = resolve_thr(jnp.max(corr))
         if pick_engine == "pallas":
             from ..ops import pallas_picks
@@ -510,7 +541,9 @@ def mf_detect_picks_program(
         )
         sat_count = jnp.sum(sp.saturated.astype(jnp.int32), axis=-1)
     else:
-        corr_tiles, gmax = mf_correlate_tiled(trf, templates_true, mu, scale, tile)
+        corr_tiles, gmax = mf_correlate_tiled(
+            trf, templates_true, mu, scale, tile, mf_engine
+        )
         thr = resolve_thr(gmax)
         sp = mf_pick_tiled(corr_tiles, thr, max_peaks, pick_method, pick_engine)
         chan, times, cnt = mf_compact_tiled_picks(
@@ -599,6 +632,8 @@ class MatchedFilterDetector:
         pick_pack_cap: int = 1 << 18,
         wire: str = "conditioned",
         pick_engine: str | None = None,
+        mf_engine: str | None = None,
+        fk_engine: str | None = None,
     ):
         self.metadata = as_metadata(metadata)
         if wire not in ("conditioned", "raw"):
@@ -689,9 +724,35 @@ class MatchedFilterDetector:
         self._mask_band_dev = jnp.asarray(mask_band)
         self._gain_dev = jnp.asarray(self.design.bp_gain)
         self._templates_dev = jnp.asarray(self.design.templates)
-        (self._templates_true, self._template_mu, self._template_scale) = (
-            xcorr.padded_template_stats_device(self.design.templates)
+        # ONE host decomposition; the device triple is its placement
+        # (padded_template_stats is the single implementation for both)
+        t_true, t_mu, t_scale = xcorr.padded_template_stats(
+            self.design.templates
         )
+        (self._templates_true, self._template_mu, self._template_scale) = (
+            jnp.asarray(t_true), jnp.asarray(t_mu), jnp.asarray(t_scale)
+        )
+        # MXU matmul routes (ops/mxu.py): resolve the correlate and f-k
+        # engines per shape — forced values pass through, "auto" consults
+        # the per-shape A/B calibration table (measured once, persisted
+        # like the compile cache) and the bf16 precision gate. The
+        # requested values are kept so rung views (host_view) can
+        # re-resolve for their backend instead of inheriting a TPU
+        # routing decision.
+        self._mf_engine_requested = mf_engine
+        self._fk_engine_requested = fk_engine
+        self.mf_engine, self.mf_engine_reason = mxu.resolve_mf_engine(
+            mf_engine, self.design.trace_shape, t_true, t_mu, t_scale
+        )
+        self.fk_engine, self.fk_engine_reason = mxu.resolve_fk_engine(
+            fk_engine, self.design.fk_channels, self.design.trace_shape[1],
+            self._band_hi - self._band_lo,
+        )
+        if self.fk_engine == "matmul":
+            wr, wi = mxu.dft_matrices(self.design.fk_channels)
+            self._fk_dft_dev = (jnp.asarray(wr), jnp.asarray(wi))
+        else:
+            self._fk_dft_dev = None
 
     def tiled_view(self) -> "MatchedFilterDetector":
         """A shallow view of this detector with the channel-TILED
@@ -729,6 +790,28 @@ class MatchedFilterDetector:
                              "_template_scale", "_cond_scale"):
                     setattr(det, attr,
                             jnp.asarray(np.asarray(getattr(self, attr))))
+                # engine routing is per backend: an "auto" decision made
+                # for the TPU must not drag MXU matmul routes onto the
+                # CPU rung — re-resolve for this backend (forced engines
+                # stay forced; the CPU resolver keeps them verbatim)
+                from ..ops import mxu as _mxu
+
+                det.mf_engine, det.mf_engine_reason = _mxu.resolve_mf_engine(
+                    self._mf_engine_requested, self.design.trace_shape,
+                    np.asarray(self._templates_true),
+                    np.asarray(self._template_mu),
+                    np.asarray(self._template_scale), backend="cpu",
+                )
+                det.fk_engine, det.fk_engine_reason = _mxu.resolve_fk_engine(
+                    self._fk_engine_requested, self.design.fk_channels,
+                    self.design.trace_shape[1],
+                    self._band_hi - self._band_lo, backend="cpu",
+                )
+                if det.fk_engine == "matmul":
+                    wr, wi = _mxu.dft_matrices(self.design.fk_channels)
+                    det._fk_dft_dev = (jnp.asarray(wr), jnp.asarray(wi))
+                else:
+                    det._fk_dft_dev = None
             det.host_device = cpu
 
         return cached_shallow_view(self, "_host_view_cache", mutate)
@@ -786,12 +869,14 @@ class MatchedFilterDetector:
         if self.fused_bandpass:
             return mf_filter_fused(
                 trace, self._mask_band_dev, self._band_lo, self._band_hi,
-                pad_rows=self.fk_pad_rows,
+                pad_rows=self.fk_pad_rows, fk_engine=self.fk_engine,
+                fk_dft=self._fk_dft_dev,
             )
         return mf_filter_only(
             trace, self._mask_band_dev, self._gain_dev,
             self._band_lo, self._band_hi, self.design.bp_padlen,
-            pad_rows=self.fk_pad_rows,
+            pad_rows=self.fk_pad_rows, fk_engine=self.fk_engine,
+            fk_dft=self._fk_dft_dev,
         )
 
     def __call__(self, trace: jnp.ndarray, threshold: float | None = None, with_snr: bool = False) -> MatchedFilterResult:
@@ -947,6 +1032,9 @@ class MatchedFilterDetector:
                 health_clip=(None if health_clip is None
                              else jnp.float32(health_clip)),
                 pick_engine=self.pick_engine,
+                mf_engine=self.mf_engine,
+                fk_engine=self.fk_engine,
+                fk_dft=self._fk_dft_dev,
             )
 
         # the K0 launch: async — errors of the device computation itself
@@ -1077,7 +1165,8 @@ class MatchedFilterDetector:
 
         trf_fk = self.filter_block(trace)
         corr_tiles, gmax = mf_correlate_tiled(
-            trf_fk, self._templates_true, self._template_mu, self._template_scale, tile
+            trf_fk, self._templates_true, self._template_mu,
+            self._template_scale, tile, self.mf_engine
         )
         # reference threshold policy (main_mfdetect.py:94-99) via the
         # shared constants/factors

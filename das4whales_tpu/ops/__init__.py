@@ -1,3 +1,3 @@
 """Device-side DSP kernels (jit/vmap-first)."""
 
-from . import chunked, conditioning, fk, filters, health, image, peaks, spectral, xcorr  # noqa: F401
+from . import chunked, conditioning, fk, filters, health, image, mxu, peaks, spectral, xcorr  # noqa: F401

@@ -1,0 +1,645 @@
+"""MXU matmul execution routes for the FLOP-heavy matched-filter stages.
+
+Bench r5 put every FFT-based stage at 0.7-2.3% of the chip's peak: the
+rFFT correlate and the f-k apply run on the TPU's VPU and never touch the
+MXU — the systolic matmul unit that holds ~98 TFLOP/s f32 (~197 bf16) of
+the chip's advertised peak. Two recasts fix that, following TINA
+(arxiv 2408.16551: non-NN DSP as NN-accelerator matmuls) and Large-Scale
+DFT on TPUs (arxiv 2002.03260: the DFT itself as a matmul):
+
+* **Correlation as a banded-Toeplitz matmul** — the whale-call templates
+  are ~140-160 taps against 12k-sample records, so the positive-lag raw
+  correlation ``raw[t, c, k] = sum_j xn[c, k+j] y[t, j]`` is a
+  ``[channel, frames, tap] @ [tap, template]`` contraction. It is
+  expressed here as ``lax.conv_general_dilated`` (XLA's im2col matmul —
+  on TPU it lowers straight onto the MXU) with f32 accumulation
+  (``preferred_element_type``), optionally with bf16 inputs behind the
+  precision gate. The normalization prologue and padded-template
+  correction epilogue are the SAME code the FFT engine runs
+  (``ops.xcorr.normalized_block_and_suffix`` / ``corrected_from_raw``),
+  so the engines can only differ in the raw correlation's rounding.
+
+* **f-k apply as a DFT-matrix matmul** — the channel-axis FFT pair of the
+  banded applier (``ops.fk.fk_filter_apply_rfft_banded``) becomes two
+  complex matmuls against the precomputed ``[C, C]`` DFT matrix, fused
+  with the mask multiply between them. O(C^2) matmul beats O(C log C)
+  FFT on the MXU below a channel-count threshold
+  (``config.fk_matmul_max_channels``); the time-axis rFFT/irFFT stays an
+  FFT (12k samples is far past the crossover).
+
+The **engine router** (:func:`resolve_mf_engine` /
+:func:`resolve_fk_engine`; ``DAS_MF_ENGINE`` / ``DAS_FK_ENGINE`` =
+``fft`` / ``matmul`` / ``auto``) decides per shape. ``auto`` consults a
+per-shape A/B **calibration table** — measured once on the live backend,
+persisted to disk like the compilation cache
+(``config.calibration_cache_path``) — and the bf16 **precision gate**:
+the bf16 route is eligible ONLY when its picks are bit-identical to the
+f32 FFT route on a fixed-seed calibration record; otherwise the gate
+records why in the table and the router falls back to f32
+(docs/PRECISION.md "bf16 eligibility").
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import os
+import time
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import config
+from . import fk as fk_ops
+from . import peaks as peak_ops
+from . import spectral, xcorr
+
+#: Matched-filter correlate engines (resolved static values; the router's
+#: external vocabulary adds "auto").
+MF_ENGINES = ("fft", "matmul", "matmul-bf16")
+
+#: f-k apply engines. The DFT-matmul stays f32: the mask multiply sits
+#: between two C-length transforms whose bf16 rounding would compound,
+#: and the stage is HBM-bound long before the MXU is (docs/PRECISION.md).
+FK_ENGINES = ("fft", "matmul")
+
+
+# ---------------------------------------------------------------------------
+# Correlation as a banded-Toeplitz (im2col) matmul
+# ---------------------------------------------------------------------------
+
+
+def correlate_taps(xn: jnp.ndarray, templates_true: jnp.ndarray,
+                   bf16: bool = False) -> jnp.ndarray:
+    """Positive-lag raw correlation ``sum_j xn[..., k+j] * y[t, j]`` as an
+    MXU contraction: ``conv_general_dilated`` in the ML (no-flip)
+    convention IS the ``[frames, tap] @ [tap, template]`` im2col matmul,
+    right-padded ``m - 1`` so every lag ``k in [0, n)`` is produced
+    exactly as the FFT route's truncated linear correlation. ``xn`` is
+    ``[..., n]`` with arbitrary leading axes; returns ``[nT, ..., n]``
+    in f32 accumulation (bf16 inputs only when ``bf16`` — the precision
+    gate's domain)."""
+    n = xn.shape[-1]
+    nT, m = templates_true.shape
+    lead = xn.shape[:-1]
+    lhs = xn.reshape((-1, 1, n))                    # [batch, feat=1, time]
+    rhs = templates_true[:, None, :]                # [out=nT, in=1, tap]
+    if bf16:
+        lhs = lhs.astype(jnp.bfloat16)
+        rhs = rhs.astype(jnp.bfloat16)
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding=[(0, m - 1)],
+        dimension_numbers=("NCH", "OIH", "NCH"),
+        preferred_element_type=jnp.float32,
+    )                                               # [batch, nT, n]
+    return jnp.moveaxis(out, 1, 0).reshape((nT,) + lead + (n,))
+
+
+def _matmul_correlograms_body(data, templates_true, mu, scale, bf16: bool):
+    """The corrected-correlogram math of
+    ``xcorr.compute_cross_correlograms_corrected`` with the raw
+    correlation on the MXU: identical normalization prologue and
+    padded-template correction epilogue (shared ``ops.xcorr`` helpers),
+    only the transform differs."""
+    xn, suffix = xcorr.normalized_block_and_suffix(data)
+    raw = correlate_taps(xn, templates_true, bf16=bf16)
+    return xcorr.corrected_from_raw(raw, suffix, mu, scale, data.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bf16",))
+def compute_cross_correlograms_matmul(
+    data: jnp.ndarray, templates_true: jnp.ndarray, mu: jnp.ndarray,
+    scale: jnp.ndarray, bf16: bool = False,
+) -> jnp.ndarray:
+    """MXU engine twin of ``xcorr.compute_cross_correlograms_corrected``
+    (same signature, same ``[nT, ..., n]`` output, same template triple
+    from ``padded_template_stats``): the raw correlation runs as a
+    banded-Toeplitz matmul instead of an rFFT product. f32 everywhere;
+    ``bf16=True`` rounds the matmul INPUTS to bf16 with f32 accumulation
+    — only the precision-gated router may select that."""
+    return _matmul_correlograms_body(data, templates_true, mu, scale, bf16)
+
+
+def correlograms_body(data, templates_true, mu, scale, engine: str):
+    """Engine dispatch for the correlate stage, usable INSIDE a caller's
+    jit (the detection programs thread ``mf_engine`` as a static and
+    call this; compilation belongs to the outer program)."""
+    if engine == "fft":
+        return xcorr.compute_cross_correlograms_corrected(
+            data, templates_true, mu, scale
+        )
+    if engine not in ("matmul", "matmul-bf16"):
+        raise ValueError(
+            f"unknown mf_engine {engine!r}; expected one of {MF_ENGINES}"
+        )
+    return _matmul_correlograms_body(
+        data, templates_true, mu, scale, engine == "matmul-bf16"
+    )
+
+
+# ---------------------------------------------------------------------------
+# f-k apply as a channel-axis DFT-matrix matmul (arxiv 2002.03260)
+# ---------------------------------------------------------------------------
+
+
+def dft_matrices(n: int, dtype=np.float32) -> Tuple[np.ndarray, np.ndarray]:
+    """``(cos, sin)`` parts of the forward DFT matrix
+    ``W[j, k] = exp(-2 pi i j k / n)``, designed in float64 (phase from
+    ``(j k) mod n`` so the angle never leaves ``[-2 pi, 0]`` — exact for
+    ``n^2`` within float64) and cast to ``dtype``. The inverse transform
+    reuses the pair: ``W^-1 = (cos - i sin) / n``."""
+    # deliberate float64 DESIGN precision (host, once per shape): the
+    # phase grid must be exact before the f32 cast — the ops/image.py
+    # design-constant precedent
+    k = np.arange(n, dtype=np.float64)  # daslint: allow[R3] f64 design grid, cast to f32 below
+    ang = (-2.0 * np.pi / n) * (np.outer(k, k) % n)
+    return np.cos(ang).astype(dtype), np.sin(ang).astype(dtype)
+
+
+def _mm(a, b):
+    """``[M, K] @ [K, N]`` with f32 accumulation on the MXU."""
+    return jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def fk_apply_dft_matmul(
+    trace: jnp.ndarray, mask_band: jnp.ndarray, lo: int, hi: int,
+    wr: jnp.ndarray, wi: jnp.ndarray,
+) -> jnp.ndarray:
+    """``fk_filter_apply_rfft_banded`` with the channel-axis FFT pair as
+    DFT-matrix matmuls fused with the mask: ``Z = W^-1 (M . (W X))``
+    runs as eight real ``[C, C] @ [C, band]`` MXU contractions on the
+    in-band rfft columns only. The time-axis rFFT/irFFT stays an FFT.
+    ``(wr, wi)`` is :func:`dft_matrices` at the trace's channel count.
+
+    Output equals the banded FFT applier up to matmul-vs-FFT rounding
+    (~1e-6 relative at f32); picks downstream are pinned bit-identical
+    by the router's tests wherever it selects this route."""
+    nnx, nns = trace.shape
+    Xf = jnp.fft.rfft(trace, axis=1)                  # [C, F]
+    xr = Xf.real[:, lo:hi]
+    xi = Xf.imag[:, lo:hi]
+    # forward channel DFT: Y = W X
+    yr = _mm(wr, xr) - _mm(wi, xi)
+    yi = _mm(wr, xi) + _mm(wi, xr)
+    m = mask_band.astype(yr.dtype)
+    yr = yr * m
+    yi = yi * m
+    # inverse channel DFT: Z = conj(W) Y / C
+    inv = jnp.asarray(1.0 / nnx, yr.dtype)
+    zr = (_mm(wr, yr) + _mm(wi, yi)) * inv
+    zi = (_mm(wr, yi) - _mm(wi, yr)) * inv
+    Z = jnp.zeros_like(Xf).at[:, lo:hi].set(jax.lax.complex(zr, zi))
+    return jnp.fft.irfft(Z, n=nns, axis=1).astype(trace.dtype)
+
+
+#: Standalone jitted entry for A/B timing and tests (the detection
+#: programs inline :func:`fk_apply_dft_matmul` under their own jit).
+fk_apply_dft_matmul_jit = jax.jit(
+    fk_apply_dft_matmul, static_argnames=("lo", "hi")
+)
+
+
+def fk_apply_body(trace, mask_band, lo, hi, engine: str, fk_dft):
+    """Engine dispatch for the f-k apply, usable inside a caller's jit
+    (``fk_engine`` static). ``fk_dft`` is the ``(wr, wi)`` device pair
+    for the matmul engine (None on the FFT route)."""
+    if engine == "matmul":
+        wr, wi = fk_dft
+        return fk_apply_dft_matmul(trace, mask_band, lo, hi, wr, wi)
+    if engine != "fft":
+        raise ValueError(
+            f"unknown fk_engine {engine!r}; expected one of {FK_ENGINES}"
+        )
+    return fk_ops.fk_filter_apply_rfft_banded(trace, mask_band, lo, hi)
+
+
+# ---------------------------------------------------------------------------
+# Per-shape A/B calibration table (persisted like the compile cache)
+# ---------------------------------------------------------------------------
+
+
+class CalibrationTable:
+    """Tiny on-disk key -> record store for the engine router: per-shape
+    A/B walls and bf16 precision-gate verdicts, measured once per
+    (backend, shape) and persisted so later processes route without
+    re-measuring (the compile-cache pattern, config.calibration_cache_path).
+    Best-effort durable: a missing/corrupt file reads as empty, writes
+    are atomic (tmp + replace) and a write failure never breaks routing.
+    """
+
+    def __init__(self, path: str | None = None):
+        self.path = path or config.calibration_cache_path()
+        self._mem: Dict[str, dict] = {}
+        self._loaded = False
+
+    def _load(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        self._mem.update(self._read_disk())
+
+    def _read_disk(self) -> Dict[str, dict]:
+        try:
+            with open(self.path) as fh:
+                data = json.load(fh)
+            if isinstance(data, dict):
+                return {k: v for k, v in data.items()
+                        if isinstance(v, dict)}
+        except (OSError, json.JSONDecodeError, ValueError):
+            pass
+        return {}
+
+    def get(self, key: str) -> dict | None:
+        self._load()
+        return self._mem.get(key)
+
+    def put(self, key: str, value: dict) -> None:
+        self._load()
+        self._mem[key] = dict(value)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            # merge the CURRENT on-disk entries under ours before the
+            # atomic replace: another process (a multiprocess campaign
+            # worker, a concurrent bench rung) may have persisted shapes
+            # this instance never loaded — dumping a stale snapshot
+            # would discard their multi-second measurements and make the
+            # fleet re-calibrate forever. Last-writer-wins per key;
+            # whole-file loss never.
+            merged = self._read_disk()
+            merged.update(self._mem)
+            self._mem = merged
+            with open(tmp, "w") as fh:
+                json.dump(merged, fh, indent=0, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+_default_table_cache: Dict[str, CalibrationTable] = {}
+
+
+def default_table() -> CalibrationTable:
+    """The process's shared calibration table at the configured path
+    (re-resolved per path so tests pointing ``DAS_CALIBRATION_CACHE``
+    at a tmpdir get their own)."""
+    path = config.calibration_cache_path()
+    tab = _default_table_cache.get(path)
+    if tab is None:
+        tab = _default_table_cache[path] = CalibrationTable(path)
+    return tab
+
+
+def _best_wall(fn, repeats: int = 2) -> float:
+    """Best-of-N wall of ``fn`` after a compile+warm call — the A/B
+    measurement unit (design-time, once per shape, cached)."""
+    jax.block_until_ready(fn())
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+#: A/B measurement channel cap: both correlate engines are linear in
+#: channels, so the per-channel comparison at <=2048 rows decides the
+#: full-shape winner without materializing canonical-scale temps.
+_CAL_MAX_CHANNELS = 2048
+
+
+def calibrate_correlate(C: int, n: int, m: int, nT: int, *,
+                        table: CalibrationTable | None = None,
+                        backend: str | None = None,
+                        repeats: int = 2) -> dict:
+    """A/B the correlate engines (fft / matmul / matmul-bf16) at the
+    given shape on the live backend; measured ONCE and cached in the
+    calibration table. Both engines are linear in channels, so the
+    measurement runs at ``min(C, 2048)`` rows (recorded as
+    ``cal_channels``)."""
+    table = table or default_table()
+    backend = backend or jax.default_backend()
+    key = f"correlate|{backend}|C{C}xN{n}|m{m}T{nT}"
+    hit = table.get(key)
+    if hit is not None:
+        return hit
+    Cc = min(int(C), _CAL_MAX_CHANNELS)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(Cc, n)).astype(np.float32))
+    tt = jnp.asarray(rng.normal(size=(nT, m)).astype(np.float32))
+    mu = jnp.zeros((nT,), jnp.float32)
+    sc = jnp.ones((nT,), jnp.float32)
+    entry = {"cal_channels": Cc}
+    entry["fft_s"] = _best_wall(
+        lambda: xcorr.compute_cross_correlograms_corrected(x, tt, mu, sc),
+        repeats,
+    )
+    entry["matmul_s"] = _best_wall(
+        lambda: compute_cross_correlograms_matmul(x, tt, mu, sc, bf16=False),
+        repeats,
+    )
+    entry["matmul_bf16_s"] = _best_wall(
+        lambda: compute_cross_correlograms_matmul(x, tt, mu, sc, bf16=True),
+        repeats,
+    )
+    entry["winner"] = (
+        "fft" if entry["fft_s"] <= entry["matmul_s"] else "matmul"
+    )
+    table.put(key, entry)
+    return entry
+
+
+def calibrate_fk(C: int, n: int, lo: int, hi: int, *,
+                 table: CalibrationTable | None = None,
+                 backend: str | None = None, repeats: int = 2) -> dict:
+    """A/B the banded f-k appliers (channel FFT pair vs DFT matmul) at
+    the given shape; measured once, cached. The DFT matrix pair is built
+    fresh for the measurement and dropped."""
+    table = table or default_table()
+    backend = backend or jax.default_backend()
+    key = f"fk|{backend}|C{C}xN{n}|band{hi - lo}"
+    hit = table.get(key)
+    if hit is not None:
+        return hit
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(int(C), int(n))).astype(np.float32))
+    mb = jnp.asarray(
+        rng.uniform(size=(int(C), int(hi - lo))).astype(np.float32)
+    )
+    wr_np, wi_np = dft_matrices(int(C))
+    wr, wi = jnp.asarray(wr_np), jnp.asarray(wi_np)
+    entry = {
+        "fft_s": _best_wall(
+            lambda: fk_ops.fk_filter_apply_rfft_banded(x, mb, int(lo), int(hi)),
+            repeats,
+        ),
+        "matmul_s": _best_wall(
+            lambda: fk_apply_dft_matmul_jit(x, mb, int(lo), int(hi), wr, wi),
+            repeats,
+        ),
+    }
+    entry["winner"] = (
+        "fft" if entry["fft_s"] <= entry["matmul_s"] else "matmul"
+    )
+    table.put(key, entry)
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# bf16 precision gate
+# ---------------------------------------------------------------------------
+
+
+def calibration_record(shape, templates_true, seed: int = 2408,
+                       noise_rms: float = 0.02) -> np.ndarray:
+    """The deterministic gate record: fixed-seed noise with the ACTUAL
+    templates injected at staggered channels/onsets and graded
+    amplitudes (strong and near-threshold copies), so the gate scores
+    the pick decisions this template set really makes."""
+    C, n = int(shape[0]), int(shape[1])
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0.0, noise_rms, size=(C, n)).astype(np.float32)
+    tt = np.atleast_2d(np.asarray(templates_true, np.float32))
+    nT, m = tt.shape
+    k = 0
+    for amp in (0.6, 0.25, 0.1):
+        for i in range(nT):
+            ch = (k * 7 + 3) % C
+            onset = (k * (n // 7) + n // 11) % max(1, n - m)
+            x[ch, onset : onset + m] += amp * tt[i]
+            k += 1
+    return x
+
+
+def _gate_picks(corr, max_peaks: int = 64):
+    """The engine-independent downstream of the gate: reference threshold
+    policy -> envelope -> fixed-capacity sparse peaks (the one-program
+    route's pick math at quick scale)."""
+    from ..models.matched_filter import (
+        REL_THRESHOLD,
+        reference_threshold_factors,
+    )
+
+    env = spectral.envelope_sqrt(corr, axis=-1)
+    thr = (REL_THRESHOLD * jnp.max(corr)) * reference_threshold_factors(
+        corr.shape[0], corr.dtype
+    )
+    return peak_ops.find_peaks_sparse_batched(
+        env, thr[:, None], max_peaks=max_peaks, method="topk"
+    )
+
+
+#: Gate-record channel cap (the gate is per-channel math; 512 rows of
+#: the real record length decide eligibility without canonical temps).
+_GATE_MAX_CHANNELS = 512
+
+
+def gate_key(backend, trace_shape, templates_true, mu, scale) -> str:
+    """The bf16 gate's calibration-table key. Includes a CONTENT digest
+    of the template triple, not just its shape: the gate record is built
+    from the actual templates, so two banks with equal (C, n, m, nT)
+    can have different eligibility — a shape-only key would let one
+    bank's verdict silently route another bank onto bf16."""
+    tt = np.ascontiguousarray(np.atleast_2d(np.asarray(templates_true)),
+                              dtype=np.float32)
+    digest = hashlib.sha1(
+        tt.tobytes()
+        + np.ascontiguousarray(mu, np.float32).tobytes()
+        + np.ascontiguousarray(scale, np.float32).tobytes()
+    ).hexdigest()[:10]
+    nT, m = tt.shape
+    C, n = int(trace_shape[0]), int(trace_shape[1])
+    return f"bf16gate|{backend}|C{C}xN{n}|m{m}T{nT}|t{digest}"
+
+
+def bf16_correlate_gate(trace_shape, templates_true, mu, scale, *,
+                        table: CalibrationTable | None = None,
+                        backend: str | None = None,
+                        record=None) -> Tuple[bool, str]:
+    """Eligibility of the bf16 matmul correlate at ``trace_shape``: picks
+    from the bf16 route must be BIT-IDENTICAL to the f32 FFT route on
+    the calibration record. Returns ``(eligible, reason)``; the verdict
+    (and its reason) is cached in the calibration table per
+    (backend, shape, template set) — the rejection path is an auditable
+    record, not a silent fallback. ``record`` overrides the built-in
+    fixed-seed record (tests pin both gate outcomes with it; an
+    explicit record bypasses the cache)."""
+    table = table or default_table()
+    backend = backend or jax.default_backend()
+    tt = np.atleast_2d(np.asarray(templates_true))
+    C, n = int(trace_shape[0]), int(trace_shape[1])
+    key = gate_key(backend, trace_shape, tt, mu, scale)
+    cached = record is None
+    if cached:
+        hit = table.get(key)
+        if hit is not None:
+            return bool(hit["eligible"]), str(hit["reason"])
+        record = calibration_record((min(C, _GATE_MAX_CHANNELS), n), tt)
+    x = jnp.asarray(np.asarray(record, np.float32))
+    tt_d = jnp.asarray(tt.astype(np.float32))
+    mu_d = jnp.asarray(np.asarray(mu, np.float32))
+    sc_d = jnp.asarray(np.asarray(scale, np.float32))
+    ref = _gate_picks(
+        xcorr.compute_cross_correlograms_corrected(x, tt_d, mu_d, sc_d)
+    )
+    got = _gate_picks(
+        compute_cross_correlograms_matmul(x, tt_d, mu_d, sc_d, bf16=True)
+    )
+    ref_sel = np.asarray(ref.selected, bool)
+    got_sel = np.asarray(got.selected, bool)
+    ref_pos = np.asarray(ref.positions)
+    got_pos = np.asarray(got.positions)
+    sel_same = bool(np.array_equal(ref_sel, got_sel))
+    pos_same = bool(np.array_equal(ref_pos[ref_sel], got_pos[ref_sel])) \
+        if sel_same else False
+    if sel_same and pos_same:
+        eligible, reason = True, (
+            f"picks bit-identical to the f32 FFT route on the "
+            f"[{x.shape[0]}x{n}] calibration record ({int(ref_sel.sum())} "
+            f"picks)"
+        )
+    else:
+        n_diff = (
+            int((ref_sel != got_sel).sum()) if not sel_same
+            else int((ref_pos[ref_sel] != got_pos[ref_sel]).sum())
+        )
+        what = "pick slots" if not sel_same else "pick positions"
+        eligible, reason = False, (
+            f"{n_diff} {what} differ from the f32 FFT route on the "
+            f"[{x.shape[0]}x{n}] calibration record "
+            f"({int(ref_sel.sum())} f32 picks)"
+        )
+    if cached:
+        table.put(key, {"eligible": eligible, "reason": reason})
+    return eligible, reason
+
+
+# ---------------------------------------------------------------------------
+# Engine router
+# ---------------------------------------------------------------------------
+
+
+def resolve_mf_engine(requested, trace_shape, templates_true, mu, scale, *,
+                      table: CalibrationTable | None = None,
+                      backend: str | None = None) -> Tuple[str, str]:
+    """Resolve the correlate engine for a detector at ``trace_shape``.
+
+    ``requested`` is ``"fft"`` / ``"matmul"`` (forced) /
+    ``"matmul-bf16"`` (forced but still precision-gated — an ineligible
+    shape falls back to the f32 matmul with the gate's recorded reason) /
+    ``"auto"`` / None (defer to ``DAS_MF_ENGINE``, default auto). Auto:
+    the FFT route off-TPU (no MXU to win); on TPU the per-shape A/B
+    calibration (measured once, cached) picks the faster of fft/matmul,
+    and bf16 additionally requires the precision gate AND a faster
+    calibrated wall than f32 matmul. Returns ``(engine, reason)`` —
+    the reason is stamped into bench payloads and planner ledgers."""
+    req = requested or config.mf_engine_default()
+    if req in ("fft", "matmul"):
+        return req, "forced"
+    tt = np.atleast_2d(np.asarray(templates_true))
+    nT, m = tt.shape
+    if req == "matmul-bf16":
+        ok, why = bf16_correlate_gate(
+            trace_shape, tt, mu, scale, table=table, backend=backend
+        )
+        if ok:
+            return "matmul-bf16", f"forced; precision gate passed: {why}"
+        return "matmul", f"bf16 ineligible, f32 matmul fallback: {why}"
+    if req != "auto":
+        raise ValueError(
+            f"unknown mf_engine {req!r}; expected one of "
+            f"{MF_ENGINES + ('auto',)}"
+        )
+    backend = backend or jax.default_backend()
+    if backend != "tpu":
+        return "fft", f"auto: backend {backend!r} has no MXU; FFT route"
+    C, n = int(trace_shape[0]), int(trace_shape[1])
+    ab = calibrate_correlate(C, n, m, nT, table=table, backend=backend)
+    bf16_s = ab.get("matmul_bf16_s", float("inf"))
+    best_f32 = min(ab["fft_s"], ab["matmul_s"])
+    if bf16_s < best_f32:
+        # bf16 outruns BOTH f32 engines (it can win even where fft beats
+        # the f32 matmul — the calibration measured it, so consult it):
+        # eligible only behind the gate, else fall through to the f32 A/B
+        ok, why = bf16_correlate_gate(
+            trace_shape, tt, mu, scale, table=table, backend=backend
+        )
+        if ok:
+            return "matmul-bf16", (
+                f"auto: A/B matmul-bf16 {bf16_s:.4g}s < best f32 "
+                f"{best_f32:.4g}s; precision gate passed: {why}"
+            )
+        return ab["winner"], (
+            f"auto: A/B {ab['winner']} wins at f32 (fft {ab['fft_s']:.4g}s,"
+            f" matmul {ab['matmul_s']:.4g}s); bf16 ineligible: {why}"
+        )
+    if ab["winner"] == "fft":
+        return "fft", (
+            f"auto: A/B fft {ab['fft_s']:.4g}s <= matmul "
+            f"{ab['matmul_s']:.4g}s"
+        )
+    return "matmul", (
+        f"auto: A/B matmul {ab['matmul_s']:.4g}s < fft {ab['fft_s']:.4g}s"
+    )
+
+
+def resolve_fk_engine(requested, n_channels, time_samples, band, *,
+                      table: CalibrationTable | None = None,
+                      backend: str | None = None) -> Tuple[str, str]:
+    """Resolve the f-k apply engine at ``n_channels`` (the f-k
+    transform's channel count — the padded count for channel-padded
+    designs). ``requested``: ``"fft"`` / ``"matmul"`` (forced — the
+    caller owns the O(C^2) DFT-matrix memory) / ``"auto"`` / None
+    (defer to ``DAS_FK_ENGINE``). Auto: FFT off-TPU; on TPU the matmul
+    route only below ``config.fk_matmul_max_channels()`` AND where the
+    per-shape A/B calibration says it wins. Returns
+    ``(engine, reason)``."""
+    req = requested or config.fk_engine_default()
+    if req in FK_ENGINES:
+        return req, "forced"
+    if req != "auto":
+        raise ValueError(
+            f"unknown fk_engine {req!r}; expected one of "
+            f"{FK_ENGINES + ('auto',)}"
+        )
+    backend = backend or jax.default_backend()
+    if backend != "tpu":
+        return "fft", f"auto: backend {backend!r} has no MXU; FFT route"
+    C = int(n_channels)
+    cap = config.fk_matmul_max_channels()
+    if C > cap:
+        return "fft", (
+            f"auto: C={C} above DAS_FK_MATMUL_MAX_CHANNELS={cap} "
+            f"(O(C^2) DFT matrix; FFT route)"
+        )
+    ab = calibrate_fk(C, int(time_samples), 0, int(band), table=table,
+                      backend=backend)
+    if ab["winner"] == "matmul":
+        return "matmul", (
+            f"auto: A/B matmul {ab['matmul_s']:.4g}s < fft "
+            f"{ab['fft_s']:.4g}s"
+        )
+    return "fft", (
+        f"auto: A/B fft {ab['fft_s']:.4g}s <= matmul {ab['matmul_s']:.4g}s"
+    )
+
+
+def engine_labels(detector) -> Dict[str, str]:
+    """The resolved engine labels a detector rides (empty for families
+    without engine routing) — stamped into bench payloads and the
+    planner's downshift-ledger rung descriptions so every rung's route
+    is auditable."""
+    out = {}
+    for attr in ("mf_engine", "fk_engine", "pick_engine"):
+        val = getattr(detector, attr, None)
+        if val:
+            out[attr] = str(val)
+    return out

@@ -74,6 +74,42 @@ def shift_nxcorr(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     return corr / (jnp.std(x) * jnp.std(y) * x.shape[-1])
 
 
+def _demean_peak_normalize(x: jnp.ndarray, guard_zero: bool = False) -> jnp.ndarray:
+    """The reference's per-row normalization (detect.py:140-166): demean
+    along the last axis, then divide by the peak magnitude of the RAW row.
+    ONE definition shared by every correlogram builder — FFT and matmul
+    engines (``ops.mxu``) normalize through this same code, so their
+    inputs cannot drift apart. ``guard_zero`` replaces an all-zero row's
+    peak with ``tiny`` so padding rows correlate to 0 instead of NaN."""
+    mx = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    if guard_zero:
+        mx = jnp.maximum(mx, jnp.asarray(jnp.finfo(x.dtype).tiny, x.dtype))
+    return (x - jnp.mean(x, axis=-1, keepdims=True)) / mx
+
+
+def normalized_block_and_suffix(data: jnp.ndarray):
+    """Normalized data block + its suffix sums — the engine-independent
+    prologue of the true-length-template corrected correlation (see
+    ``padded_template_stats`` for the algebra). Returns ``(xn, suffix)``
+    with ``suffix[..., k] = sum_{i>=k} xn[..., i]``."""
+    xn = _demean_peak_normalize(data, guard_zero=True)
+    suffix = jnp.flip(jnp.cumsum(jnp.flip(xn, -1), axis=-1), -1)
+    return xn, suffix
+
+
+def corrected_from_raw(raw, suffix, mu, scale, dtype):
+    """Engine-independent epilogue of the corrected correlation: subtract
+    the padded-template mean term and rescale (``padded_template_stats``).
+    ``raw [nT, ..., n]`` is the positive-lag correlation of the normalized
+    block against the TRUE-length templates — from the FFT engine
+    (``compute_cross_correlograms_corrected``) or the MXU matmul engine
+    (``ops.mxu.compute_cross_correlograms_matmul``)."""
+    nd = raw.ndim - 1
+    mu_b = mu.reshape((mu.shape[0],) + (1,) * nd)
+    scale_b = jnp.asarray(scale).reshape((scale.shape[0],) + (1,) * nd)
+    return ((raw - mu_b * suffix[None, ...]) / scale_b).astype(dtype)
+
+
 @jax.jit
 def compute_cross_correlogram(data: jnp.ndarray, template: jnp.ndarray) -> jnp.ndarray:
     """Matched-filter cross-correlogram over all channels.
@@ -84,10 +120,8 @@ def compute_cross_correlogram(data: jnp.ndarray, template: jnp.ndarray) -> jnp.n
     reference's tqdm channel loop (detect.py:163-164) becomes a single
     batched FFT over the channel axis.
     """
-    norm_data = data - jnp.mean(data, axis=-1, keepdims=True)
-    norm_data = norm_data / jnp.max(jnp.abs(data), axis=-1, keepdims=True)
-    t = template - jnp.mean(template)
-    t = t / jnp.max(jnp.abs(template))
+    norm_data = _demean_peak_normalize(data)
+    t = _demean_peak_normalize(template)
 
     n, m = data.shape[-1], t.shape[-1]
     nfft = _xcorr_full_len(n, m)
@@ -108,10 +142,8 @@ def compute_cross_correlograms_multi(data: jnp.ndarray, templates: jnp.ndarray) 
     and only the (tiny) template spectra and the inverse transforms repeat.
     Returns ``[n_templates, channel, time]``, identical numerics.
     """
-    norm_data = data - jnp.mean(data, axis=-1, keepdims=True)
-    norm_data = norm_data / jnp.max(jnp.abs(data), axis=-1, keepdims=True)
-    t = templates - jnp.mean(templates, axis=-1, keepdims=True)
-    t = t / jnp.max(jnp.abs(templates), axis=-1, keepdims=True)
+    norm_data = _demean_peak_normalize(data)
+    t = _demean_peak_normalize(templates)
 
     n, m = data.shape[-1], t.shape[-1]
     nfft = _xcorr_full_len(n, m)
@@ -123,7 +155,7 @@ def compute_cross_correlograms_multi(data: jnp.ndarray, templates: jnp.ndarray) 
     return corr[..., :n].astype(data.dtype)
 
 
-def padded_template_stats(templates_padded):
+def padded_template_stats(templates_padded, device: bool = False):
     """Decompose a trace-length zero-padded template stack into the
     true-length form used by the memory-lean correlate route.
 
@@ -143,8 +175,12 @@ def padded_template_stats(templates_padded):
     Verified exact to machine precision against the padded route.
 
     Returns ``(templates_true [nT, m], mu [nT], scale [nT])`` as host
-    numpy; ``scale`` is each template's OWN peak magnitude, matching the
-    reference's template-by-template normalization (detect.py:140-166).
+    numpy — or as device arrays with ``device=True`` (the form every
+    consumer of the triple wants: single-chip detector, batch-sharded and
+    time-sharded steps). ONE implementation for both entries, so the host
+    and device template numerics cannot drift apart; ``scale`` is each
+    template's OWN peak magnitude, matching the reference's
+    template-by-template normalization (detect.py:140-166).
     """
     t = np.asarray(templates_padded)
     t = np.atleast_2d(t)
@@ -156,16 +192,16 @@ def padded_template_stats(templates_padded):
             m = max(m, int(idx[-1]) + 1)
     mu = t.mean(axis=-1)
     scale = np.max(np.abs(t), axis=-1)
-    return t[:, :m].copy(), mu.astype(t.dtype), scale.astype(t.dtype)
+    triple = t[:, :m].copy(), mu.astype(t.dtype), scale.astype(t.dtype)
+    if device:
+        return tuple(jnp.asarray(a) for a in triple)
+    return triple
 
 
 def padded_template_stats_device(templates_padded):
-    """``padded_template_stats`` with the triple already on device — the
-    form every consumer (single-chip detector, batch-sharded and
-    time-sharded steps) wants, kept in one place so their template
-    numerics cannot drift apart."""
-    t_true, mu, scale = padded_template_stats(templates_padded)
-    return jnp.asarray(t_true), jnp.asarray(mu), jnp.asarray(scale)
+    """The device entry of :func:`padded_template_stats` (same single
+    implementation, triple placed on the default device)."""
+    return padded_template_stats(templates_padded, device=True)
 
 
 @jax.jit
@@ -183,19 +219,12 @@ def compute_cross_correlograms_corrected(
     """
     n, m = data.shape[-1], templates_true.shape[-1]
     nfft = _xcorr_full_len(n, m)
-    mean = jnp.mean(data, axis=-1, keepdims=True)
-    mx = jnp.max(jnp.abs(data), axis=-1, keepdims=True)
-    # tiny guard: all-zero (padding) rows yield corr == 0 instead of NaN
-    tiny = jnp.asarray(jnp.finfo(data.dtype).tiny, data.dtype)
-    xn = (data - mean) / jnp.maximum(mx, tiny)
-    suffix = jnp.flip(jnp.cumsum(jnp.flip(xn, -1), axis=-1), -1)
+    xn, suffix = normalized_block_and_suffix(data)
     X = jnp.fft.rfft(xn, nfft, axis=-1)
     Y = jnp.fft.rfft(templates_true, nfft, axis=-1)
     Yb = jnp.conj(Y).reshape((Y.shape[0],) + (1,) * (xn.ndim - 1) + (Y.shape[-1],))
     raw = jnp.fft.irfft(X[None, ...] * Yb, nfft, axis=-1)[..., :n]
-    mu_b = mu.reshape((mu.shape[0],) + (1,) * xn.ndim)
-    scale_b = jnp.asarray(scale).reshape((Y.shape[0],) + (1,) * xn.ndim)
-    return ((raw - mu_b * suffix[None, ...]) / scale_b).astype(data.dtype)
+    return corrected_from_raw(raw, suffix, mu, scale, data.dtype)
 
 
 @jax.jit

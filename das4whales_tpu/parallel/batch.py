@@ -53,18 +53,18 @@ from ..ops import peaks as peak_ops
 _STATIC = (
     "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp", "tile",
     "max_peaks", "capacity", "use_threshold", "pick_method", "condition",
-    "serial", "with_health", "pick_engine",
+    "serial", "with_health", "pick_engine", "mf_engine", "fk_engine",
 )
 
 
 def _batched_body(
     trace_batch, mask_band, bp_gain, templates_true, mu, scale, thr_in,
-    cond_scale, n_real, *,
+    cond_scale, n_real, fk_dft=None, *,
     band_lo: int, band_hi: int, bp_padlen: int, pad_rows: int,
     staged_bp: bool, tile: int | None, max_peaks: int, capacity: int,
     use_threshold: bool, pick_method: str, condition: bool,
     serial: bool = False, with_health: bool = False, health_clip=None,
-    pick_engine: str = "jnp",
+    pick_engine: str = "jnp", mf_engine: str = "fft", fk_engine: str = "fft",
 ):
     """The one-program route over a leading file axis, in ONE program.
 
@@ -91,13 +91,16 @@ def _batched_body(
       vmap mode's 4x working set loses to the cache (docs/PERF.md).
     """
     def one(tr, nr):
+        # fk_dft (the DFT-matmul pair) is closed over, not batched: one
+        # matrix pair serves every file of the slab
         return mf_detect_picks_program(
             tr, mask_band, bp_gain, templates_true, mu, scale, thr_in,
             band_lo, band_hi, bp_padlen, pad_rows, staged_bp, tile,
             max_peaks, capacity, use_threshold, pick_method=pick_method,
             condition=condition, cond_scale=cond_scale, cond_n_real=nr,
             with_health=with_health, health_clip=health_clip,
-            pick_engine=pick_engine,
+            pick_engine=pick_engine, mf_engine=mf_engine,
+            fk_engine=fk_engine, fk_dft=fk_dft,
         )
 
     if n_real is None:
@@ -270,7 +273,7 @@ class BatchedMatchedFilterDetector:
             return fn(
                 stack_, det._mask_band_dev, det._gain_dev,
                 det._templates_true, det._template_mu, det._template_scale,
-                thr_in, det._cond_scale, nr,
+                thr_in, det._cond_scale, nr, det._fk_dft_dev,
                 band_lo=det._band_lo, band_hi=det._band_hi,
                 bp_padlen=det.design.bp_padlen, pad_rows=det.fk_pad_rows,
                 staged_bp=not det.fused_bandpass, tile=tile, max_peaks=k,
@@ -281,6 +284,7 @@ class BatchedMatchedFilterDetector:
                 health_clip=(None if health_clip is None
                              else jnp.float32(health_clip)),
                 pick_engine=det.pick_engine,
+                mf_engine=det.mf_engine, fk_engine=det.fk_engine,
             )
 
         # the K0 launch: async — device-side failures surface at

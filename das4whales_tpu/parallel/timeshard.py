@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .compat import axis_size, shard_map
 
 from ..ops import conditioning as cond_ops
+from ..ops import mxu as mxu_ops
 from ..ops import peaks as peak_ops
 from ..ops import spectral, xcorr
 from ..ops.filters import zero_phase_gain
@@ -208,8 +209,18 @@ def make_sharded_mf_step_time(
     cond_time_samples: int | None = None,
     cond_segments=None,
     cond_means=None,
+    mf_engine: str = "fft",
 ):
     """Full flagship detection step for a TIME-sharded ``[C, T]`` block.
+
+    ``mf_engine`` picks the correlate transform inside the SPMD body:
+    the rFFT product or the MXU banded-Toeplitz matmul
+    (``ops.mxu.correlograms_body``) — the correlate runs after the
+    relabel transpose where time is whole within each channel shard, so
+    the matmul recast is the same per-channel contraction as the
+    single-chip routes. The pencil f-k transform keeps its FFT form
+    (the distributed transpose owns that layout; no ``fk_engine``
+    here).
 
     ``wire="raw"`` consumes a NARROW-WIRE record (stored-dtype counts,
     ``io.stream`` ``wire="raw"``): the conditioning prologue runs in the
@@ -365,8 +376,9 @@ def make_sharded_mf_step_time(
         # relabel: one transpose into channel-sharded layout [C/P, T]
         y = jax.lax.all_to_all(trf, time_axis, split_axis=0, concat_axis=1, tiled=True)
         # true-length-template correlate (ops/xcorr.py:padded_template_stats)
-        # — half the per-shard FFT length of the padded form
-        corr = xcorr.compute_cross_correlograms_corrected(y, tmpl, tmu, tsc)
+        # — half the per-shard FFT length of the padded form; engine-routed
+        # (ops/mxu.py: the MXU matmul recast when the router selected it)
+        corr = mxu_ops.correlograms_body(y, tmpl, tmu, tsc, mf_engine)
         env = spectral.envelope_sqrt(corr, axis=-1)
         file_max = jax.lax.pmax(jnp.max(corr), time_axis)
         thres = relative_threshold * file_max
@@ -528,6 +540,7 @@ def detect_picks_time_sharded(det, trace, mesh: Mesh, n_real=None):
         step = make_sharded_mf_step_time(
             det.design, mesh, outputs="picks", pick_mode="sparse",
             max_peaks=det.max_peaks, fused_bandpass=det.fused_bandpass,
+            mf_engine=getattr(det, "mf_engine", "fft"),
             **wire_kw,
         )
         _LADDER_STEPS[det][key] = step

@@ -138,6 +138,10 @@ def batched_program_memory(
         jax.ShapeDtypeStruct((nT,), compute_dtype),       # thr_in
         _aval_of(det._cond_scale),
         jax.ShapeDtypeStruct((int(batch),), jnp.int32),   # n_real
+        # fk_dft: the DFT-matmul pair is program input on the matmul
+        # f-k engine — priced so the preflight sees its residency too
+        (tuple(_aval_of(a) for a in det._fk_dft_dev)
+         if getattr(det, "_fk_dft_dev", None) is not None else None),
     )
     static = dict(
         band_lo=det._band_lo, band_hi=det._band_hi,
@@ -148,6 +152,8 @@ def batched_program_memory(
                                                det.max_peaks),
         condition=det.wire == "raw", serial=bdet.serial,
         with_health=with_health,
+        mf_engine=getattr(det, "mf_engine", "fft"),
+        fk_engine=getattr(det, "fk_engine", "fft"),
     )
     kwargs = {k: v for k, v in static.items() if k in _STATIC}
     if with_health and health_clip is not None:

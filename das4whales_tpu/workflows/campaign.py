@@ -835,6 +835,11 @@ def run_campaign_batched(
             )
             dets[key] = bdet
             progs[key] = MatchedFilterProgram(bdet.det)
+            # each bucket's detector resolved its own engines (per-shape
+            # A/B, ops.mxu router) — register them so that bucket's
+            # downshift events describe ITS routes, not the first
+            # bucket's
+            ladder.set_engines(key, progs[key].engines)
             if preflight:
                 preflight_bucket(key, bdet, slab)
         return bdet

@@ -92,6 +92,7 @@ def detect_long_record(
     fused_bandpass: bool | None = None,
     family_kwargs: dict | None = None,
     wire: str = "conditioned",
+    mf_engine: str | None = None,
 ) -> LongRecordResult:
     """Detect calls over a continuous multi-file record.
 
@@ -271,12 +272,22 @@ def detect_long_record(
                     axis=1,
                 ),
             )
+        # MXU correlate engine (ops/mxu.py): same per-shape router as the
+        # campaign routes — None defers to DAS_MF_ENGINE/auto, so the
+        # long-record path rides the matmul recast exactly when they do
+        from ..ops import mxu as mxu_ops
+        from ..ops.xcorr import padded_template_stats
+
+        resolved_mf, _mf_why = mxu_ops.resolve_mf_engine(
+            mf_engine, design.trace_shape,
+            *padded_template_stats(design.templates),
+        )
         step = make_sharded_mf_step_time(
             design, mesh, time_axis=time_axis, halo=halo,
             relative_threshold=relative_threshold, hf_factor=hf_factor,
             pick_mode="sparse", max_peaks=max_peaks_per_channel,
             fused_bandpass=fused_bandpass, outputs="picks",
-            wire=wire, **cond_kw,
+            wire=wire, mf_engine=resolved_mf, **cond_kw,
         )
         # async dispatch (parallel.dispatch): the device-side pick pack
         # below is dispatched back-to-back with the step — the old

@@ -99,6 +99,18 @@ class DetectorProgram:
     def __init__(self, detector):
         self.det = detector
 
+    @property
+    def engines(self) -> Dict[str, str]:
+        """Resolved execution-engine labels the family's detector rides
+        (``ops.mxu.engine_labels`` — ``mf_engine``/``fk_engine``/
+        ``pick_engine``; empty for families without engine routing).
+        Family-agnostic by construction: every family inherits engine
+        attribution in the ladder's rung descriptions the moment its
+        detector grows engine attributes."""
+        from ..ops import mxu
+
+        return mxu.engine_labels(self.det)
+
     # -- the per-rung program ---------------------------------------------
 
     def _det_at(self, stage: str):
@@ -308,7 +320,8 @@ class DownshiftLadder:
 
     def __init__(self, rz, outdir: str, batch: int = 1,
                  write: bool = True, timeshard: bool = True,
-                 stages=faults.DOWNSHIFT_STAGES, family: str = ""):
+                 stages=faults.DOWNSHIFT_STAGES, family: str = "",
+                 engines: Dict[str, str] | None = None):
         self.rz = rz
         self.outdir = outdir
         self.batch = int(batch)
@@ -316,7 +329,27 @@ class DownshiftLadder:
         self.allow_timeshard = timeshard
         self.stages = tuple(stages)
         self.family = family
+        # resolved execution-engine labels the family's detector rides
+        # (ops.mxu.engine_labels: mf/fk/pick engine) — stamped into every
+        # ledger event's rung description so a downshift audit shows not
+        # just WHERE a bucket ran but on WHICH routes. Campaign-wide
+        # default; per-bucket resolutions (each bucket's shape A/Bs
+        # independently) override via :meth:`set_engines`. The labels
+        # describe the bucket's DEVICE-rung routing — the host rung
+        # re-resolves auto engines for the CPU backend
+        # (models.matched_filter.host_view).
+        self.engines = dict(engines or {})
+        self._engines_by_key: Dict = {}
         self.sticky: Dict[tuple, tuple] = {}
+
+    def set_engines(self, key, labels) -> None:
+        """Record ``key``'s own resolved engine labels (per-bucket shapes
+        route independently; the campaign default stays for keys that
+        never registered)."""
+        self._engines_by_key[key] = dict(labels or {})
+
+    def engines_for(self, key) -> Dict[str, str]:
+        return self._engines_by_key.get(key, self.engines)
 
     def rungs(self, trace_shape=None) -> list:
         out = []
@@ -360,6 +393,8 @@ class DownshiftLadder:
                     "family": self.family,
                     "from": faults.rung_label(top),
                     "to": faults.rung_label(rung),
+                    **({"engines": eng} if (eng := self.engines_for(key))
+                       else {}),
                     "error": reason, "preflight": True, "sticky": True,
                 })
             log.info("preflight: bucket %s starts at rung %s (%s)",
@@ -385,6 +420,8 @@ class DownshiftLadder:
                 "family": self.family,
                 "from": faults.rung_label(rung),
                 "to": faults.rung_label(nxt),
+                **({"engines": eng} if (eng := self.engines_for(key))
+                   else {}),
                 "error": f"{type(exc).__name__}: {exc}", "sticky": True,
             })
         log.warning(
@@ -419,6 +456,7 @@ class RoutePlanner:
         self.ladder = DownshiftLadder(
             rz, outdir, batch=1, write=write, timeshard=timeshard,
             stages=program.stages, family=program.family,
+            engines=program.engines,
         )
 
     def current(self, key: str = "campaign") -> tuple:

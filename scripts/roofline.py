@@ -29,9 +29,14 @@ from __future__ import annotations
 import math
 
 HBM_GBS = 819e9          # v5e HBM bandwidth
-F32_FLOPS = 98e12        # v5e f32 peak (MXU bf16 is 197e12)
+F32_FLOPS = 98e12        # v5e f32 peak (MXU f32 matmul rate)
+MXU_BF16_FLOPS = 197e12  # v5e MXU bf16-input peak (f32 accumulation)
 ICI_GBS = 90e9           # v5e effective per-chip all_to_all injection BW
 PMAX_LATENCY_S = 20e-6   # scalar pmax across the slice (latency-bound)
+
+#: template tap count of the matmul correlate model: the LF fin note,
+#: 0.78 s x 200 Hz (the longer of the canonical HF/LF pair)
+MF_TAPS = 157
 
 # canonical OOI working selection (BASELINE.md; 22050 = 2*3^2*5^2*7^2)
 C, N = 22050, 12000
@@ -49,8 +54,8 @@ def cfft_flops(n):
     return 5.0 * n * math.log2(n)
 
 
-def stage(name, flops, bytes_moved, comm_s=0.0):
-    t_flops = flops / F32_FLOPS
+def stage(name, flops, bytes_moved, comm_s=0.0, flops_peak=None):
+    t_flops = flops / (flops_peak or F32_FLOPS)
     t_hbm = bytes_moved / HBM_GBS
     if comm_s > max(t_hbm, t_flops):
         bound = "ICI"
@@ -77,8 +82,17 @@ def _derived(c, n, fs, band_hz):
     return nf_pad, f_half, band
 
 
-def model(c=C, n=N, fs=FS, band_hz=BAND_HZ, nt=NT, fused=False):
-    """Single-chip per-stage roofline rows for a [c x n] block."""
+def model(c=C, n=N, fs=FS, band_hz=BAND_HZ, nt=NT, fused=False,
+          mf_engine="fft", fk_engine="fft", m_taps=MF_TAPS):
+    """Single-chip per-stage roofline rows for a [c x n] block.
+
+    ``mf_engine``/``fk_engine`` model the MXU matmul recasts
+    (``ops/mxu.py``): the matmul stages are charged at the MXU matmul
+    peak — ``F32_FLOPS`` for f32 accumulation inputs, ``MXU_BF16_FLOPS``
+    for the gated bf16 route — instead of the VPU-bound FFT cost model,
+    so ``bench.py``'s ``roofline_frac`` judges the matmul route against
+    the peak it actually targets. ``m_taps`` is the true template length
+    of the banded-Toeplitz correlate."""
     nf_bp, f_half, band = _derived(c, n, fs, band_hz)
     nf_xc = nf_bp
     rows = []
@@ -88,20 +102,51 @@ def model(c=C, n=N, fs=FS, band_hz=BAND_HZ, nt=NT, fused=False):
         by = B * c * (n + 2 * (nf_bp / 2 + 1) * 2 + n)  # in, spec rw (c64), out
         rows.append(stage("bandpass |H|^2", fl, by))
 
-    # 2. banded f-k: rfft(time) + band fft/ifft(channel) + mask + irfft(time)
-    fl = c * (rfft_flops(n) + rfft_flops(n)) + band * 2 * cfft_flops(c) + 6 * c * band
-    by = B * (c * n                       # read
-              + 2 * c * f_half * 2        # half-spectrum write+read (c64)
-              + 4 * c * band * 2          # band slice rw twice (c64)
-              + c * n)                    # out
-    rows.append(stage("f-k apply (banded)" + (" +fusedbp" if fused else ""), fl, by))
+    if fk_engine == "matmul":
+        # 2m. DFT-matmul f-k: rfft(time) + 8 real [C,C]@[C,band] MXU
+        # contractions fused with the mask + irfft(time) (ops/mxu.py,
+        # arxiv 2002.03260). f32 accumulation — F32_FLOPS is the MXU
+        # f32 matmul rate.
+        fl = c * 2 * rfft_flops(n) + 16.0 * c * c * band + 6 * c * band
+        by = B * (c * n                   # read
+                  + 2 * c * f_half * 2    # half-spectrum write+read (c64)
+                  + 2 * c * c             # DFT matrix pair read
+                  + 4 * c * band * 2      # band slice rw twice (c64)
+                  + c * n)                # out
+        rows.append(stage(
+            "f-k apply (DFT-matmul)" + (" +fusedbp" if fused else ""),
+            fl, by,
+        ))
+    else:
+        # 2. banded f-k: rfft(time) + band fft/ifft(channel) + mask + irfft(time)
+        fl = c * (rfft_flops(n) + rfft_flops(n)) + band * 2 * cfft_flops(c) + 6 * c * band
+        by = B * (c * n                       # read
+                  + 2 * c * f_half * 2        # half-spectrum write+read (c64)
+                  + 4 * c * band * 2          # band slice rw twice (c64)
+                  + c * n)                    # out
+        rows.append(stage("f-k apply (banded)" + (" +fusedbp" if fused else ""), fl, by))
 
-    # 3. correlate (tiled): norm + rfft + NT (mul + irfft) + suffix cumsum
-    fl = c * (rfft_flops(nf_xc) + nt * (rfft_flops(nf_xc) + 6 * (nf_xc / 2 + 1)) + 4 * n)
-    by = B * (c * n * 2                   # read + normalized rw
-              + c * (nf_xc / 2 + 1) * 2   # spectrum (c64)
-              + nt * c * n)               # correlogram out
-    rows.append(stage(f"correlate x{nt} (tiled)", fl, by))
+    if mf_engine in ("matmul", "matmul-bf16"):
+        # 3m. correlate as banded-Toeplitz matmul: norm + suffix cumsum
+        # + the [frames, tap] @ [tap, template] contraction on the MXU
+        # (ops/mxu.py, arxiv 2408.16551) — FLOP-bound by design, judged
+        # at the MXU peak (bf16 inputs double the rate)
+        peak = MXU_BF16_FLOPS if mf_engine == "matmul-bf16" else F32_FLOPS
+        fl = c * (2.0 * n * m_taps * nt + 8 * n + 2 * n * nt)
+        by = B * (c * n * 2               # read + normalized rw
+                  + c * n                 # suffix sums
+                  + nt * c * n)           # correlogram out
+        rows.append(stage(
+            f"correlate x{nt} (matmul m={m_taps}, {mf_engine})", fl, by,
+            flops_peak=peak,
+        ))
+    else:
+        # 3. correlate (tiled): norm + rfft + NT (mul + irfft) + suffix cumsum
+        fl = c * (rfft_flops(nf_xc) + nt * (rfft_flops(nf_xc) + 6 * (nf_xc / 2 + 1)) + 4 * n)
+        by = B * (c * n * 2                   # read + normalized rw
+                  + c * (nf_xc / 2 + 1) * 2   # spectrum (c64)
+                  + nt * c * n)               # correlogram out
+        rows.append(stage(f"correlate x{nt} (tiled)", fl, by))
 
     # 4. envelope: analytic signal = fft + ifft on [NT, C, N] + abs
     fl = nt * c * (cfft_flops(n) + 2 * n)
@@ -181,9 +226,18 @@ def main():
     ap.add_argument("--chips", type=int, default=8)
     ap.add_argument("--fused", action="store_true",
                     help="model the fused-bandpass route (bench default)")
+    ap.add_argument("--mf-engine", default="fft",
+                    choices=("fft", "matmul", "matmul-bf16"),
+                    help="correlate engine to model (ops/mxu.py routes)")
+    ap.add_argument("--fk-engine", default="fft", choices=("fft", "matmul"),
+                    help="f-k apply engine to model")
     args = ap.parse_args()
 
-    t1 = print_rows(model(fused=args.fused), C, N, "single v5e chip (per-file)")
+    t1 = print_rows(
+        model(fused=args.fused, mf_engine=args.mf_engine,
+              fk_engine=args.fk_engine),
+        C, N, "single v5e chip (per-file)",
+    )
     rows8, c_pad = model_sharded(args.chips, fused=args.fused)
     t8 = print_rows(
         rows8, c_pad, N,

@@ -1,0 +1,19 @@
+"""Shared template-bank construction for the MXU engine suites
+(tests/test_mxu.py + tests/test_precision.py): ONE definition of the
+deterministic 137-tap HF/LF chirp pair, so the bf16-gate and
+precision-matrix tests always score the same bank (the same
+drift-by-duplication risk this PR's `padded_template_stats` dedupe
+closes in the library)."""
+
+import numpy as np
+
+FS = 200.0
+
+
+def fin_template_pair(m: int = 137) -> np.ndarray:
+    """A deterministic HF/LF chirp pair at the fin-note tap count
+    (0.68 s × 200 Hz), Hann-windowed like the real templates."""
+    t = np.arange(m) / FS
+    hf = np.cos(2 * np.pi * (25.0 * t + 8.0 * t * t)) * np.hanning(m)
+    lf = np.cos(2 * np.pi * (18.0 * t + 5.0 * t * t)) * np.hanning(m)
+    return np.stack([hf, lf]).astype(np.float32)

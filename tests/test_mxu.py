@@ -1,0 +1,501 @@
+"""MXU matmul routes (ISSUE 9, ``ops/mxu.py``): the correlate and f-k
+stages recast as MXU matmuls must be PICK-BIT-IDENTICAL to the FFT
+routes wherever the router selects them (f32 everywhere; bf16 only
+behind a passing precision gate), the ``auto`` router must consult the
+per-shape A/B calibration table (measured once, persisted) and the
+channel-count threshold, the bf16 gate's rejection path must record its
+reason, and an engine switch must cost at most one extra compile per
+(bucket, B, engine) — pinned here on the CPU tier-1 backend with forced
+engines (the same code path ``auto`` selects on a TPU).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from das4whales_tpu import config
+from das4whales_tpu.io import synth
+from das4whales_tpu.io.stream import stream_strain_blocks
+from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+from das4whales_tpu.ops import fk as fk_ops
+from das4whales_tpu.ops import mxu, xcorr
+from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+
+FS, DX = 200.0, 2.042
+
+
+def _scene_file(tmp_path, nx=24, ns=900, seed=3, stem="mx"):
+    scene = synth.SyntheticScene(
+        nx=nx, ns=ns, noise_rms=0.05, seed=seed,
+        calls=[
+            synth.SyntheticCall(t0=1.2, x0_m=nx / 2 * DX, amplitude=2.0),
+            synth.SyntheticCall(t0=2.6, x0_m=nx / 3 * DX, amplitude=0.9),
+        ],
+    )
+    return synth.write_synthetic_file(str(tmp_path / f"{stem}.h5"), scene)
+
+
+def _block(path, nx, wire):
+    return next(stream_strain_blocks([path], [0, nx, 1], as_numpy=True,
+                                     wire=wire))
+
+
+def _det(meta, nx, ns, wire="conditioned", **kw):
+    kw.setdefault("pick_mode", "sparse")
+    kw.setdefault("keep_correlograms", False)
+    return MatchedFilterDetector(meta, [0, nx, 1], (nx, ns), wire=wire, **kw)
+
+
+def _assert_picks_equal(a, b):
+    assert set(a) == set(b)
+    total = 0
+    for name in a:
+        np.testing.assert_array_equal(a[name], b[name])
+        total += a[name].shape[1]
+    assert total > 0, "parity over an empty pick set proves nothing"
+
+
+# ---------------------------------------------------------------------------
+# Kernel-level parity (values, not just picks)
+# ---------------------------------------------------------------------------
+
+
+def test_matmul_correlograms_match_fft_values():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 600)).astype(np.float32))
+    tt = jnp.asarray(rng.normal(size=(2, 41)).astype(np.float32))
+    mu = jnp.asarray(rng.normal(size=(2,)).astype(np.float32))
+    sc = jnp.asarray((np.abs(rng.normal(size=(2,))) + 1).astype(np.float32))
+    a = np.asarray(xcorr.compute_cross_correlograms_corrected(x, tt, mu, sc))
+    b = np.asarray(mxu.compute_cross_correlograms_matmul(x, tt, mu, sc))
+    assert a.shape == b.shape
+    rel = np.abs(a - b).max() / np.abs(a).max()
+    assert rel < 5e-6, rel
+
+
+def test_fk_dft_matmul_matches_banded_fft():
+    rng = np.random.default_rng(1)
+    C, N, lo, hi = 40, 512, 20, 90
+    tr = jnp.asarray(rng.normal(size=(C, N)).astype(np.float32))
+    mb = jnp.asarray(rng.uniform(size=(C, hi - lo)).astype(np.float32))
+    wr, wi = mxu.dft_matrices(C)
+    a = np.asarray(fk_ops.fk_filter_apply_rfft_banded(tr, mb, lo, hi))
+    b = np.asarray(mxu.fk_apply_dft_matmul_jit(
+        tr, mb, lo, hi, jnp.asarray(wr), jnp.asarray(wi)
+    ))
+    rel = np.abs(a - b).max() / np.abs(a).max()
+    assert rel < 5e-6, rel
+
+
+def test_correlate_taps_is_exact_toeplitz():
+    # against an explicit O(n m) loop: the conv recast must be the exact
+    # positive-lag banded-Toeplitz contraction, zero-padded past the end
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(3, 50)).astype(np.float32)
+    tt = rng.normal(size=(2, 7)).astype(np.float32)
+    got = np.asarray(mxu.correlate_taps(jnp.asarray(x), jnp.asarray(tt)))
+    want = np.zeros((2, 3, 50), np.float32)
+    for t in range(2):
+        for c in range(3):
+            for k in range(50):
+                for j in range(7):
+                    if k + j < 50:
+                        want[t, c, k] += x[c, k + j] * tt[t, j]
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Detector-level pick parity: matmul routes vs FFT routes (f32)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("wire", ["conditioned", "raw"])
+@pytest.mark.parametrize("shape", [(24, 900), (48, 1200)])
+def test_mf_matmul_picks_bit_identical(tmp_path, wire, shape):
+    nx, ns = shape
+    path = _scene_file(tmp_path, nx=nx, ns=ns, seed=nx)
+    blk = _block(path, nx, wire)
+    ref = _det(blk.metadata, nx, ns, wire=wire, mf_engine="fft")
+    got = _det(blk.metadata, nx, ns, wire=wire, mf_engine="matmul")
+    assert got.mf_engine == "matmul" and got.mf_engine_reason == "forced"
+    r0 = ref.detect_picks(jnp.asarray(blk.trace))
+    r1 = got.detect_picks(jnp.asarray(blk.trace))
+    _assert_picks_equal(r0.picks, r1.picks)
+    assert r0.thresholds == pytest.approx(r1.thresholds, rel=1e-5)
+
+
+def test_mf_matmul_tiled_route_picks_bit_identical(tmp_path):
+    nx, ns = 24, 900
+    path = _scene_file(tmp_path, nx=nx, ns=ns)
+    blk = _block(path, nx, "conditioned")
+    ref = _det(blk.metadata, nx, ns, channel_tile=8, mf_engine="fft")
+    got = _det(blk.metadata, nx, ns, channel_tile=8, mf_engine="matmul")
+    assert got._route() == "tiled"
+    _assert_picks_equal(
+        ref.detect_picks(jnp.asarray(blk.trace)).picks,
+        got.detect_picks(jnp.asarray(blk.trace)).picks,
+    )
+
+
+@pytest.mark.parametrize("wire", ["conditioned", "raw"])
+def test_fk_matmul_picks_bit_identical(tmp_path, wire):
+    nx, ns = 24, 900
+    path = _scene_file(tmp_path, nx=nx, ns=ns, seed=7)
+    blk = _block(path, nx, wire)
+    ref = _det(blk.metadata, nx, ns, wire=wire)
+    got = _det(blk.metadata, nx, ns, wire=wire, fk_engine="matmul")
+    assert got.fk_engine == "matmul" and got._fk_dft_dev is not None
+    _assert_picks_equal(
+        ref.detect_picks(jnp.asarray(blk.trace)).picks,
+        got.detect_picks(jnp.asarray(blk.trace)).picks,
+    )
+
+
+def test_both_matmul_engines_together(tmp_path):
+    nx, ns = 24, 900
+    path = _scene_file(tmp_path, nx=nx, ns=ns, seed=9)
+    blk = _block(path, nx, "conditioned")
+    ref = _det(blk.metadata, nx, ns)
+    got = _det(blk.metadata, nx, ns, mf_engine="matmul", fk_engine="matmul")
+    _assert_picks_equal(
+        ref.detect_picks(jnp.asarray(blk.trace)).picks,
+        got.detect_picks(jnp.asarray(blk.trace)).picks,
+    )
+
+
+@pytest.mark.parametrize("wire", ["conditioned", "raw"])
+@pytest.mark.parametrize("B", [1, 2, 4])
+def test_batched_matmul_picks_bit_identical(tmp_path, B, wire):
+    """The batched slab route rides the engines: B-file slabs through the
+    matmul-engined batched program == the unbatched FFT-engined
+    per-file route, bit-identical per file."""
+    nx, ns = 24, 900
+    paths = [_scene_file(tmp_path, nx=nx, ns=ns, seed=10 + k,
+                         stem=f"b{k}") for k in range(B)]
+    blocks = [_block(p, nx, wire) for p in paths]
+    meta = blocks[0].metadata
+    ref = _det(meta, nx, ns, wire=wire, mf_engine="fft")
+    mm = _det(meta, nx, ns, wire=wire, mf_engine="matmul",
+              fk_engine="matmul")
+    bdet = BatchedMatchedFilterDetector(mm, donate=False)
+    stack = jnp.asarray(np.stack([np.asarray(b.trace) for b in blocks]))
+    out = bdet.detect_batch(stack)
+    assert len(out) == B
+    for k, entry in enumerate(out):
+        assert entry is not None
+        picks, thr = entry[0], entry[1]
+        r = ref.detect_picks(jnp.asarray(blocks[k].trace))
+        _assert_picks_equal(r.picks, picks)
+        assert r.thresholds == pytest.approx(thr, rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Router + calibration table
+# ---------------------------------------------------------------------------
+
+
+def test_auto_is_fft_off_tpu(tmp_path):
+    nx, ns = 24, 900
+    path = _scene_file(tmp_path, nx=nx, ns=ns)
+    blk = _block(path, nx, "conditioned")
+    det = _det(blk.metadata, nx, ns)  # mf_engine=None -> DAS_MF_ENGINE/auto
+    assert det.mf_engine == "fft" and "no MXU" in det.mf_engine_reason
+    assert det.fk_engine == "fft" and "no MXU" in det.fk_engine_reason
+    assert det._fk_dft_dev is None
+
+
+def test_calibration_table_roundtrip_and_corruption(tmp_path):
+    p = str(tmp_path / "cal.json")
+    t = mxu.CalibrationTable(p)
+    assert t.get("k") is None
+    t.put("k", {"winner": "matmul", "fft_s": 1.0})
+    t2 = mxu.CalibrationTable(p)
+    assert t2.get("k")["winner"] == "matmul"
+    with open(p, "w") as fh:
+        fh.write("{not json")
+    t3 = mxu.CalibrationTable(p)
+    assert t3.get("k") is None          # corrupt file reads as empty
+    t3.put("k2", {"winner": "fft"})     # and stays writable
+    assert mxu.CalibrationTable(p).get("k2")["winner"] == "fft"
+
+
+def test_auto_router_consults_calibration_table(tmp_path):
+    """With backend pinned to "tpu" and a prefilled table, auto routes by
+    the recorded A/B winner — no measurement runs (the table IS the
+    cache; a measurement would need a real TPU here)."""
+    table = mxu.CalibrationTable(str(tmp_path / "cal.json"))
+    tt = np.zeros((2, 37), np.float32)
+    mu = np.zeros((2,), np.float32)
+    sc = np.ones((2,), np.float32)
+    key = "correlate|tpu|C64xN900|m37T2"
+    gkey = mxu.gate_key("tpu", (64, 900), tt, mu, sc)
+    table.put(key, {"winner": "matmul", "fft_s": 2.0, "matmul_s": 1.0,
+                    "matmul_bf16_s": 0.6})
+    # bf16 gate verdict prefilled as ineligible -> f32 matmul wins
+    table.put(gkey,
+              {"eligible": False, "reason": "prefilled: 3 pick slots differ"})
+    eng, why = mxu.resolve_mf_engine(
+        "auto", (64, 900), tt, mu, sc, table=table, backend="tpu"
+    )
+    assert eng == "matmul" and "matmul wins" in why and "bf16" in why
+    # flip the gate verdict: bf16 is eligible AND calibrated faster
+    table.put(gkey, {"eligible": True, "reason": "prefilled: bit-identical"})
+    eng, why = mxu.resolve_mf_engine(
+        "auto", (64, 900), tt, mu, sc, table=table, backend="tpu"
+    )
+    assert eng == "matmul-bf16" and "gate passed" in why
+    # bf16 fastest overall while fft beats the f32 matmul: the gated
+    # bf16 route must still be considered (and win)
+    table.put(key, {"winner": "fft", "fft_s": 1.0, "matmul_s": 2.0,
+                    "matmul_bf16_s": 0.5})
+    eng, why = mxu.resolve_mf_engine(
+        "auto", (64, 900), tt, mu, sc, table=table, backend="tpu"
+    )
+    assert eng == "matmul-bf16" and "best f32" in why
+    # fft winner with no faster bf16 routes fft without touching the gate
+    table.put(key, {"winner": "fft", "fft_s": 1.0, "matmul_s": 2.0,
+                    "matmul_bf16_s": 1.5})
+    eng, why = mxu.resolve_mf_engine(
+        "auto", (64, 900), tt, mu, sc, table=table, backend="tpu"
+    )
+    assert eng == "fft" and "A/B fft" in why
+
+
+def test_fk_auto_channel_threshold(tmp_path, monkeypatch):
+    table = mxu.CalibrationTable(str(tmp_path / "cal.json"))
+    monkeypatch.setenv("DAS_FK_MATMUL_MAX_CHANNELS", "100")
+    eng, why = mxu.resolve_fk_engine("auto", 101, 900, 64, table=table,
+                                     backend="tpu")
+    assert eng == "fft" and "above DAS_FK_MATMUL_MAX_CHANNELS" in why
+    table.put("fk|tpu|C64xN900|band32",
+              {"winner": "matmul", "fft_s": 2.0, "matmul_s": 1.0})
+    eng, why = mxu.resolve_fk_engine("auto", 64, 900, 32, table=table,
+                                     backend="tpu")
+    assert eng == "matmul" and "A/B matmul" in why
+
+
+def test_calibrate_correlate_measures_once(tmp_path):
+    """The A/B calibration is measured ONCE per shape and persisted: a
+    second call (and a fresh table object at the same path) returns the
+    recorded entry without re-measuring."""
+    table = mxu.CalibrationTable(str(tmp_path / "cal.json"))
+    e1 = mxu.calibrate_correlate(32, 400, 21, 2, table=table, repeats=1)
+    assert e1["winner"] in ("fft", "matmul")
+    assert e1["fft_s"] > 0 and e1["matmul_s"] > 0 and e1["matmul_bf16_s"] > 0
+    e2 = mxu.calibrate_correlate(32, 400, 21, 2, table=table, repeats=1)
+    assert e2 == e1
+    e3 = mxu.calibrate_correlate(
+        32, 400, 21, 2,
+        table=mxu.CalibrationTable(str(tmp_path / "cal.json")), repeats=1,
+    )
+    assert e3 == e1
+
+
+def test_invalid_engine_values_raise():
+    tt = np.zeros((2, 5), np.float32)
+    z = np.zeros((2,), np.float32)
+    with pytest.raises(ValueError, match="mf_engine"):
+        mxu.resolve_mf_engine("nope", (8, 100), tt, z, z)
+    with pytest.raises(ValueError, match="fk_engine"):
+        mxu.resolve_fk_engine("nope", 8, 100, 10)
+    with pytest.raises(ValueError, match="mf_engine"):
+        mxu.correlograms_body(jnp.zeros((2, 8)), jnp.zeros((1, 2)),
+                              jnp.zeros((1,)), jnp.ones((1,)), "nope")
+
+
+# ---------------------------------------------------------------------------
+# bf16 precision gate: rejection recorded, fallback engine f32
+# ---------------------------------------------------------------------------
+
+
+def test_bf16_gate_rejection_recorded_and_falls_back(tmp_path):
+    """An ineligible shape (noisy record, near-threshold picks) fails the
+    gate; the verdict + reason land in the calibration table and the
+    forced matmul-bf16 request falls back to the f32 matmul."""
+    table = mxu.CalibrationTable(str(tmp_path / "cal.json"))
+    tt, mu, sc = xcorr.padded_template_stats(
+        np.pad(synth_template(), ((0, 0), (0, 900 - 137)))
+    )
+    # a record whose pick set straddles the threshold: dense weak copies
+    rng = np.random.default_rng(0)
+    rec = rng.normal(0.0, 1.0, size=(48, 900)).astype(np.float32)
+    ok, why = mxu.bf16_correlate_gate((48, 900), tt, mu, sc, table=table,
+                                      record=rec)
+    if ok:
+        pytest.skip("bf16 happened to match f32 bitwise on this record")
+    assert "differ from the f32 FFT route" in why
+    # the forced-bf16 request at a shape whose CACHED verdict is a
+    # rejection resolves to the f32 matmul, reason carried
+    key = mxu.gate_key("cpu", (48, 900), tt, mu, sc)
+    table.put(key, {"eligible": False, "reason": why})
+    eng, reason = mxu.resolve_mf_engine(
+        "matmul-bf16", (48, 900), tt, mu, sc, table=table, backend="cpu"
+    )
+    assert eng == "matmul"
+    assert "bf16 ineligible" in reason and "differ" in reason
+
+
+def test_gate_key_depends_on_template_content():
+    """Two template banks with IDENTICAL (C, n, m, nT) must not share a
+    cached gate verdict — the record is built from the actual templates,
+    so the key carries a content digest."""
+    mu = np.zeros((1,), np.float32)
+    sc = np.ones((1,), np.float32)
+    a = np.zeros((1, 9), np.float32)
+    a[0, 4] = 1.0
+    b = np.zeros((1, 9), np.float32)
+    b[0, 3] = 1.0
+    ka = mxu.gate_key("tpu", (16, 300), a, mu, sc)
+    assert ka != mxu.gate_key("tpu", (16, 300), b, mu, sc)
+    assert ka == mxu.gate_key("tpu", (16, 300), a.copy(), mu, sc)
+    assert ka != mxu.gate_key("cpu", (16, 300), a, mu, sc)
+
+
+def test_bf16_gate_verdict_cached(tmp_path):
+    table = mxu.CalibrationTable(str(tmp_path / "cal.json"))
+    tt = np.zeros((1, 9), np.float32)
+    tt[0, 4] = 1.0
+    mu = np.zeros((1,), np.float32)
+    sc = np.ones((1,), np.float32)
+    ok1, why1 = mxu.bf16_correlate_gate((16, 300), tt, mu, sc, table=table)
+    # cached verdict: identical result from a fresh table at the path,
+    # without recomputing (the entry is present on disk)
+    entry = mxu.CalibrationTable(str(tmp_path / "cal.json")).get(
+        mxu.gate_key(jax.default_backend(), (16, 300), tt, mu, sc)
+    )
+    assert entry is not None and entry["eligible"] == ok1
+    ok2, why2 = mxu.bf16_correlate_gate((16, 300), tt, mu, sc, table=table)
+    assert (ok2, why2) == (ok1, why1)
+
+
+from _mxu_helpers import fin_template_pair as synth_template  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Compile budget: engine switch costs <= 1 extra compile per (bucket, B,
+# engine)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_switch_compile_budget(tmp_path, compile_guard):
+    """Each (shape, engine) pair compiles its program ONCE: repeated
+    detect_picks under either engine after warmup triggers zero XLA
+    compiles — switching engines costs at most the one compile its own
+    program always cost, never a retrace of the other's."""
+    nx, ns = 24, 900
+    path = _scene_file(tmp_path, nx=nx, ns=ns, seed=21)
+    blk = _block(path, nx, "conditioned")
+    x = jnp.asarray(blk.trace)
+    fft_det = _det(blk.metadata, nx, ns, mf_engine="fft")
+    mm_det = _det(blk.metadata, nx, ns, mf_engine="matmul",
+                  fk_engine="matmul")
+    fft_det.detect_picks(x)     # warm each engine's program once
+    mm_det.detect_picks(x)
+    with compile_guard.forbid_recompile(
+        "alternating engines at a warmed shape"
+    ):
+        for _ in range(2):
+            r0 = fft_det.detect_picks(x)
+            r1 = mm_det.detect_picks(x)
+    _assert_picks_equal(r0.picks, r1.picks)
+
+
+def test_batched_engine_switch_compile_budget(tmp_path, compile_guard):
+    """The batched route: one compile per (bucket, B, engine) — warmed
+    B=2 slabs re-detect under both engines with zero new compiles."""
+    nx, ns = 24, 900
+    paths = [_scene_file(tmp_path, nx=nx, ns=ns, seed=30 + k,
+                         stem=f"c{k}") for k in range(2)]
+    blocks = [_block(p, nx, "conditioned") for p in paths]
+    meta = blocks[0].metadata
+    stack = jnp.asarray(np.stack([np.asarray(b.trace) for b in blocks]))
+    bdets = [
+        BatchedMatchedFilterDetector(
+            _det(meta, nx, ns, mf_engine=eng), donate=False
+        )
+        for eng in ("fft", "matmul")
+    ]
+    outs = [b.detect_batch(stack) for b in bdets]   # warm both
+    with compile_guard.forbid_recompile(
+        "warmed (bucket, B=2) slab under both engines"
+    ):
+        outs = [b.detect_batch(stack) for b in bdets]
+    for k in range(2):
+        _assert_picks_equal(outs[0][k][0], outs[1][k][0])
+
+
+def test_timeshard_step_rides_mf_engine():
+    """The time-sharded rung threads ``mf_engine`` into its SPMD body:
+    matmul-engined step picks bitwise-equal to the FFT-engined step on
+    the virtual 4-device mesh (same correlate layout — time is whole
+    within each channel shard after the relabel transpose)."""
+    from das4whales_tpu.config import AcquisitionMetadata
+    from das4whales_tpu.models.matched_filter import design_matched_filter
+    from das4whales_tpu.parallel import make_mesh
+    from das4whales_tpu.parallel.timeshard import (
+        make_sharded_mf_step_time,
+        time_sharding,
+    )
+
+    nx, ns = 24, 1024
+    mesh = make_mesh(shape=(4,), axis_names=("time",),
+                     devices=jax.devices()[:4])
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    design = design_matched_filter((nx, ns), [0, nx, 1], meta)
+    rng = np.random.default_rng(5)
+    x = rng.normal(0.0, 0.05, size=(nx, ns)).astype(np.float32)
+    x[10, 300 : 300 + 200] += 1.5 * np.asarray(design.templates)[0, :200]
+    xd = jax.device_put(jnp.asarray(x), time_sharding(mesh))
+    outs = {}
+    for eng in ("fft", "matmul"):
+        step = make_sharded_mf_step_time(
+            design, mesh, halo=128, outputs="picks", mf_engine=eng
+        )
+        picks, thres = jax.block_until_ready(step(xd))
+        outs[eng] = (np.asarray(picks.positions),
+                     np.asarray(picks.selected), float(thres))
+    np.testing.assert_array_equal(outs["fft"][1], outs["matmul"][1])
+    sel = outs["fft"][1].astype(bool)
+    assert sel.any()
+    np.testing.assert_array_equal(outs["fft"][0][sel], outs["matmul"][0][sel])
+    assert outs["fft"][2] == pytest.approx(outs["matmul"][2], rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Views / rungs ride the engines
+# ---------------------------------------------------------------------------
+
+
+def test_host_view_re_resolves_auto_engines(tmp_path):
+    nx, ns = 24, 900
+    path = _scene_file(tmp_path, nx=nx, ns=ns, seed=40)
+    blk = _block(path, nx, "conditioned")
+    det = _det(blk.metadata, nx, ns, mf_engine="matmul", fk_engine="matmul")
+    hv = det.host_view()
+    # forced engines survive the host rung (the caller asked for them)...
+    assert hv.mf_engine == "matmul" and hv.fk_engine == "matmul"
+    # ...and the tiled view shares the parent's resolution outright
+    tv = det.tiled_view()
+    assert tv.mf_engine == "matmul" and tv.fk_engine == "matmul"
+    # an auto-resolved detector's host view re-resolves for the CPU
+    auto = _det(blk.metadata, nx, ns)
+    ahv = auto.host_view()
+    assert ahv.mf_engine == "fft" and ahv.fk_engine == "fft"
+
+
+def test_planner_ladder_describes_engines(tmp_path):
+    from das4whales_tpu.workflows.planner import program_for
+
+    nx, ns = 24, 900
+    path = _scene_file(tmp_path, nx=nx, ns=ns, seed=41)
+    blk = _block(path, nx, "conditioned")
+    det = _det(blk.metadata, nx, ns, mf_engine="matmul")
+    prog = program_for(det)
+    eng = prog.engines
+    assert eng["mf_engine"] == "matmul"
+    assert eng["fk_engine"] == "fft"
+    assert "pick_engine" in eng

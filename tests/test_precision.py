@@ -1,6 +1,8 @@
 """Precision regression: float32 must remain decision-identical to
-float64 on the flagship pipeline (docs/PRECISION.md records the study;
-this test keeps it true)."""
+float64 on the flagship pipeline, and the MXU engines' precision
+contract must hold — f32 matmul decision-identical to the f32 FFT
+route, bf16 only behind the bit-identity gate (docs/PRECISION.md
+records the study; this test keeps it true)."""
 
 import numpy as np
 import jax.numpy as jnp
@@ -9,6 +11,7 @@ import pytest
 import das4whales_tpu.io as dio
 from das4whales_tpu.io import synth
 from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+from das4whales_tpu.ops import mxu, xcorr
 
 FS, DX, NX, NS = 200.0, 4.0, 48, 6000
 
@@ -63,3 +66,91 @@ def test_f32_decision_identical_to_f64(scene_file):
     assert matched == p64.shape[1], (matched, p64.shape[1])
     # and pick counts agree to within 2%
     assert abs(p32.shape[1] - p64.shape[1]) <= max(2, 0.02 * p64.shape[1])
+
+
+# ---------------------------------------------------------------------------
+# MXU engine precision matrix (ISSUE 9, ops/mxu.py + docs/PRECISION.md)
+# ---------------------------------------------------------------------------
+
+
+from _mxu_helpers import fin_template_pair as _templates  # noqa: E402
+
+
+def _triple():
+    padded = np.pad(_templates(), ((0, 0), (0, NS - 137)))
+    return xcorr.padded_template_stats(padded)
+
+
+def test_f32_matmul_decision_identical_to_f32_fft(scene_file):
+    """The f32 matmul correlate is decision-identical to the f32 FFT
+    correlate: correlogram values within FFT-roundoff distance (the two
+    transforms round differently; neither is 'wrong') and the pick
+    decisions bitwise-equal — the contract the router relies on when it
+    selects the matmul route without a gate."""
+    meta = dio.get_acquisition_parameters(scene_file, "optasense")
+    blk = dio.load_das_data(scene_file, [0, NX, 1], meta,
+                            dtype=jnp.float32, engine="h5py")
+    x = jnp.asarray(blk.trace)
+    tt, mu, sc = _triple()
+    a = np.asarray(xcorr.compute_cross_correlograms_corrected(
+        x, jnp.asarray(tt), jnp.asarray(mu), jnp.asarray(sc)))
+    b = np.asarray(mxu.compute_cross_correlograms_matmul(
+        x, jnp.asarray(tt), jnp.asarray(mu), jnp.asarray(sc)))
+    rel = np.abs(a - b).max() / np.abs(a).max()
+    assert rel < 5e-6, rel
+
+
+@pytest.mark.parametrize(
+    "record_kind,expect_eligible",
+    [("noisy-marginal", False), ("clean-strong", True)],
+)
+def test_bf16_gate_matrix(tmp_path, record_kind, expect_eligible):
+    """The bf16 eligibility matrix of docs/PRECISION.md, verdicts PINNED
+    per record kind: a noisy record with near-threshold picks must
+    REJECT bf16 (the marginal-pick flips the gate exists to catch), a
+    clean strong scene must pass; either way the reason names the
+    calibration evidence, the verdict round-trips through the table,
+    and a rejection resolves the engine to the f32 matmul — never a
+    silent bf16."""
+    table = mxu.CalibrationTable(str(tmp_path / f"{record_kind}.json"))
+    tt, mu, sc = _triple()
+    rng = np.random.default_rng(5)
+    if record_kind == "noisy-marginal":
+        rec = rng.normal(0.0, 1.0, size=(32, NS)).astype(np.float32)
+    else:
+        rec = rng.normal(0.0, 0.01, size=(32, NS)).astype(np.float32)
+        rec[5, 800 : 800 + 137] += 2.0 * _templates()[0]
+        rec[20, 3000 : 3000 + 137] += 2.0 * _templates()[1]
+    ok, why = mxu.bf16_correlate_gate((32, NS), tt, mu, sc, table=table,
+                                      record=rec)
+    assert ok == expect_eligible, why
+    assert "calibration record" in why
+    if not ok:
+        assert "differ from the f32 FFT route" in why
+    # the router honors the cached verdict bit-for-bit
+    key = mxu.gate_key("cpu", (32, NS), tt, mu, sc)
+    table.put(key, {"eligible": ok, "reason": why})
+    eng, reason = mxu.resolve_mf_engine(
+        "matmul-bf16", (32, NS), tt, mu, sc, table=table, backend="cpu"
+    )
+    assert eng == ("matmul-bf16" if ok else "matmul")
+    if not ok:
+        assert "bf16 ineligible" in reason
+
+
+def test_bf16_matmul_error_bound():
+    """bf16 inputs with f32 accumulation stay within the documented
+    ~1e-3 relative band of the f32 route on correlogram VALUES (the
+    PRECISION.md bf16 table) — the gate exists because that band is not
+    zero, not because the kernel is broken."""
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(16, 2000)).astype(np.float32))
+    tt, mu, sc = (jnp.asarray(a) for a in
+                  xcorr.padded_template_stats(
+                      np.pad(_templates(), ((0, 0), (0, 2000 - 137)))))
+    f32 = np.asarray(mxu.compute_cross_correlograms_matmul(x, tt, mu, sc))
+    b16 = np.asarray(
+        mxu.compute_cross_correlograms_matmul(x, tt, mu, sc, bf16=True)
+    )
+    rel = np.abs(f32 - b16).max() / np.abs(f32).max()
+    assert 0 < rel < 2e-2, rel
