@@ -93,6 +93,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "with JAX_COORDINATOR/JAX_NUM_PROCESSES/"
                          "JAX_PROCESS_ID and the same command; "
                          "workflows.campaign.run_campaign_multiprocess)")
+    pc.add_argument("--bank", default=None,
+                    help="mf-family TEMPLATE BANK: a registered name "
+                         "(fin, fin-variants, blue) or a "
+                         "'chirp-grid:T[:fmin-fmax[:durs]]' spec — all T "
+                         "templates detect in ONE dispatch per file/slab "
+                         "(models/templates.py; default: "
+                         "DAS_TEMPLATE_BANK, else the reference fin pair)")
     pc.add_argument("--family", default="mf",
                     choices=("mf", "spectro", "gabor", "learned"),
                     help="detector family (spectro/gabor run through the "
@@ -333,6 +340,11 @@ def main(argv=None) -> int:
         else:
             print("campaign: no file in the list is probeable; nothing to do")
             return 3
+        if args.bank and (args.family != "mf" or args.sharded
+                          or args.multihost):
+            print("campaign: --bank applies to the single-chip/batched "
+                  "mf family (the bank axis rides the one-program route)")
+            return 2
         detector = None
         if args.family == "learned":
             if args.sharded:
@@ -403,6 +415,8 @@ def main(argv=None) -> int:
                     # (single dispatch + single packed fetch per file)
                     "keep_correlograms": False,
                 }
+                if args.bank:
+                    kwargs["templates"] = args.bank
                 res = run_campaign(
                     args.files, sel, args.outdir, detector=detector,
                     resume=not args.no_resume, max_failures=args.max_failures,

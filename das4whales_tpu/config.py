@@ -154,13 +154,23 @@ class FkFilterConfig:
 
 @dataclass(frozen=True)
 class CallTemplateConfig:
-    """Chirp call-template parameters (detect.py:68-93)."""
+    """Chirp call-template parameters (detect.py:68-93).
+
+    ``threshold_factor`` is THIS template's multiplier on the relative
+    pick threshold (``REL_THRESHOLD * max``): the reference picks its HF
+    fin note at 0.9x the threshold (main_mfdetect.py:97) — previously a
+    hardcoded "index 0 is HF" assumption in
+    ``models.matched_filter.reference_threshold_factors``; now each
+    template carries its own factor and the detection programs derive
+    the per-template vector from the bank
+    (``models.templates.TemplateBank.threshold_factors``)."""
 
     fmin: float
     fmax: float
     duration: float
     window: bool = True
     method: str = "hyperbolic"
+    threshold_factor: float = 1.0
 
 
 # Scientific defaults preserved from the reference entry-point scripts.
@@ -172,8 +182,11 @@ SELECTED_CHANNELS_M = (20000.0, 65000.0, 5.0)
 #: Script-level f-k fan + passband (main_mfdetect.py:46-47).
 SCRIPT_FK = FkFilterConfig(cs_min=1350.0, cp_min=1450.0, cp_max=3300.0, cs_max=3450.0, fmin=14.0, fmax=30.0)
 
-#: Fin-whale 20-Hz call note templates (main_mfdetect.py:72-73).
-FIN_HF_NOTE = CallTemplateConfig(fmin=17.8, fmax=28.8, duration=0.68)
+#: Fin-whale 20-Hz call note templates (main_mfdetect.py:72-73). The HF
+#: note picks at 0.9x the relative threshold (main_mfdetect.py:97) —
+#: carried on the config itself, not inferred from stack position.
+FIN_HF_NOTE = CallTemplateConfig(fmin=17.8, fmax=28.8, duration=0.68,
+                                 threshold_factor=0.9)
 FIN_LF_NOTE = CallTemplateConfig(fmin=14.7, fmax=21.8, duration=0.78)
 
 #: Spectrogram-correlation kernels (main_spectrodetect.py:91-92).
@@ -380,6 +393,16 @@ def dispatch_depth_default() -> int:
         return int(raw) if raw else DEFAULT_DISPATCH_DEPTH
     except ValueError:
         return DEFAULT_DISPATCH_DEPTH
+
+
+def template_bank_default() -> str:
+    """Name of the template bank a detector builds when the caller
+    passes ``templates=None`` (``DAS_TEMPLATE_BANK`` env; empty =
+    ``"fin"``, the reference's HF/LF fin-note pair). Any registered
+    bank name (``models.templates.bank_names()``) or a chirp-grid spec
+    ``"chirp-grid:T"`` / ``"chirp-grid:T:fmin-fmax:durs"`` is accepted —
+    ``models.templates.resolve_bank`` owns the parse."""
+    return os.environ.get("DAS_TEMPLATE_BANK", "") or "fin"
 
 
 def mf_engine_default() -> str:

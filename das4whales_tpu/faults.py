@@ -425,21 +425,44 @@ def counters_delta(before: Mapping[str, int]) -> Dict[str, int]:
 #: (``planner.DetectorProgram.stages``); every family's ladder starts
 #: at ``file`` and ends at ``host``, so the order here totally orders
 #: any family's rungs.
+#:
+#: The BANK-SPLIT stage (``"bank"``, splittable template banks only —
+#: ``models.templates.TemplateBank.splittable``) interleaves: a
+#: ``("bank", b)`` rung runs the SAME batch ``b`` as two T/2 sub-bank
+#: dispatches, and sits between ``("batched", b)`` and
+#: ``("batched", b/2)`` — the T axis is sacrificed before B is
+#: (ISSUE 10); ``("bank", 1)`` is the per-file analog, between
+#: ``file`` and ``tiled``. :func:`rung_rank` owns that interleaving.
 DOWNSHIFT_STAGES = ("batched", "file", "tiled", "timeshard", "host")
+
+#: stages a family may declare beyond :data:`DOWNSHIFT_STAGES` — the
+#: interleaved bank-split stage (see above).
+BANK_STAGE = "bank"
 
 
 def rung_rank(rung) -> tuple:
     """Sort key placing rungs in ladder order: earlier (hungrier) rungs
     rank lower. Within the ``batched`` stage larger batches come first
-    (``('batched', 8) < ('batched', 4) < ... < ('file', 1)``)."""
+    (``('batched', 8) < ('batched', 4) < ... < ('file', 1)``); a
+    bank-split rung ranks just past its batch's full-bank rung
+    (``('batched', 4) < ('bank', 4) < ('batched', 2)``; ``('file', 1)
+    < ('bank', 1) < ('tiled', 1)``)."""
     stage, batch = rung
-    return (DOWNSHIFT_STAGES.index(stage), -int(batch))
+    b = int(batch)
+    if stage == BANK_STAGE:
+        if b > 1:
+            return (0, -b, 1)
+        return (DOWNSHIFT_STAGES.index("file"), -1, 1)
+    return (DOWNSHIFT_STAGES.index(stage), -b, 0)
 
 
 def rung_label(rung) -> str:
-    """Human/manifest form of a rung: ``"batched:4"`` / ``"tiled"``."""
+    """Human/manifest form of a rung: ``"batched:4"`` / ``"bank:4"`` /
+    ``"bank"`` / ``"tiled"``."""
     stage, batch = rung
-    return f"{stage}:{int(batch)}" if stage == "batched" else stage
+    if stage == "batched" or (stage == BANK_STAGE and int(batch) > 1):
+        return f"{stage}:{int(batch)}"
+    return stage
 
 
 # ---------------------------------------------------------------------------

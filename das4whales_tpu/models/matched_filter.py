@@ -43,7 +43,7 @@ from ..ops import peaks as peak_ops
 from ..ops import spectral, xcorr
 from ..ops.filters import zero_phase_gain
 from ..utils.checkpoint import register_design
-from .templates import gen_template_fincall
+from .templates import TemplateBank, gen_template_fincall, resolve_bank
 
 
 @register_design
@@ -63,10 +63,57 @@ class MatchedFilterDesign:
     # padded channel count the f-k mask was designed for (== trace_shape[0]
     # when no padding); see design_matched_filter(channel_pad=...)
     fk_channels: int = 0
+    # per-template relative-threshold multipliers, in stack order —
+    # derived from the bank's CallTemplateConfig.threshold_factor
+    # entries (models/templates.py); None (a pre-bank design artifact)
+    # reconstructs the legacy index-0-is-HF vector
+    threshold_factors: np.ndarray | None = None
+    # "global" (the reference's one-max-couples-all policy) or
+    # "per_template" (decoupled maxima: the splittable bank scope) —
+    # TemplateBank.threshold_scope
+    threshold_scope: str = "global"
 
     def __post_init__(self):
         if not self.fk_channels:
             self.fk_channels = self.fk_mask.shape[0]
+        if self.threshold_factors is None:
+            self.threshold_factors = np.asarray(
+                reference_threshold_factors(self.templates.shape[0])
+            )
+
+    def resolve_threshold_policy(self, hf_factor=None, threshold_factors=None,
+                                 threshold_scope=None):
+        """THE one resolution of the bank threshold policy for every
+        consumer of this design (the sharded/time-sharded step
+        factories, the sharded campaigns, ``detect_long_record``):
+        returns ``(factors [nT] float32, scope)``.
+
+        Precedence: an explicit legacy ``hf_factor`` reconstructs the
+        pre-bank index-0-is-HF vector AND pins the legacy global
+        coupling (unless ``threshold_scope`` overrides); an explicit
+        ``threshold_factors`` vector wins next; otherwise the design's
+        own bank-derived vector and scope apply."""
+        n = self.templates.shape[0]
+        if hf_factor is not None:
+            fac = np.ones(n, np.float32)
+            fac[0] = float(hf_factor)
+            scope = threshold_scope or "global"
+        elif threshold_factors is not None:
+            fac = np.asarray(threshold_factors, np.float32)
+            scope = threshold_scope or self.threshold_scope
+        else:
+            fac = np.asarray(self.threshold_factors, np.float32)
+            scope = threshold_scope or self.threshold_scope
+        if fac.shape != (n,):
+            raise ValueError(
+                f"threshold factors shape {fac.shape} != ({n},)"
+            )
+        if scope not in ("global", "per_template"):
+            raise ValueError(
+                f"unknown threshold_scope {scope!r}; expected 'global' "
+                "or 'per_template'"
+            )
+        return fac, scope
 
     def sparsity_report(self, verbose: bool = False):
         return fk_ops.compression_report(self.fk_mask, verbose=verbose)
@@ -78,7 +125,7 @@ def design_matched_filter(
     metadata,
     fk_config: FkFilterConfig = SCRIPT_FK,
     bp_band=(14.0, 30.0),
-    templates: Dict[str, CallTemplateConfig] | None = None,
+    templates: TemplateBank | Dict[str, CallTemplateConfig] | str | None = None,
     channel_pad: int | str | None = None,
 ) -> MatchedFilterDesign:
     """Design the full pipeline for a given block shape.
@@ -86,7 +133,11 @@ def design_matched_filter(
     Defaults reproduce ``main_mfdetect.py``: hybrid_ninf f-k filter with the
     script fan (main_mfdetect.py:46-47), 14-30 Hz Butterworth-8 bandpass
     (main_mfdetect.py:53), and the HF/LF fin-call note templates
-    (main_mfdetect.py:72-73).
+    (main_mfdetect.py:72-73). ``templates`` accepts a
+    :class:`models.templates.TemplateBank` (or a registered bank name /
+    chirp-grid spec / legacy config mapping — ``resolve_bank``); the
+    bank compiles into the design's ``[T, time]`` stack, and its
+    per-template threshold factors + scope ride the design.
 
     ``channel_pad`` pads the CHANNEL axis of the f-k transform:
     ``"auto"`` rounds the channel count up to the next 5-smooth FFT length
@@ -102,8 +153,7 @@ def design_matched_filter(
     """
     meta = as_metadata(metadata)
     sel = ChannelSelection.from_list(selected_channels)
-    if templates is None:
-        templates = {"HF": FIN_HF_NOTE, "LF": FIN_LF_NOTE}
+    bank = resolve_bank(templates)
 
     if channel_pad == "auto":
         fk_channels = xcorr.next_fast_len(trace_shape[0])
@@ -129,23 +179,19 @@ def design_matched_filter(
     padlen = 3 * (2 * len(sos) + 1)
     bp_gain = butter_zero_phase_gain(trace_shape[1] + 2 * padlen, meta.fs, bp_band)
 
-    time = np.arange(trace_shape[1]) / meta.fs
-    tstack = np.stack(
-        [
-            np.asarray(gen_template_fincall(time, meta.fs, c.fmin, c.fmax, c.duration, c.window))
-            for c in templates.values()
-        ]
-    )
+    tstack = bank.compile(trace_shape[1], meta.fs)
     return MatchedFilterDesign(
         fk_mask=mask.astype(np.float32),
         bp_gain=bp_gain.astype(np.float32),
         bp_padlen=padlen,
-        templates=tstack.astype(np.float32),
-        template_names=tuple(templates.keys()),
+        templates=tstack,
+        template_names=bank.names,
         trace_shape=tuple(trace_shape),
         fs=float(meta.fs),
         bp_band=(float(bp_band[0]), float(bp_band[1])),
         fk_channels=fk_channels,
+        threshold_factors=bank.threshold_factors(),
+        threshold_scope=bank.threshold_scope,
     )
 
 
@@ -270,10 +316,14 @@ def mf_correlate_tiled(
     tile, n] correlogram output, and the FFT runs at the true-template
     length (``ops.xcorr.padded_template_stats``).
 
-    Returns ``(corr_tiles [n_tiles, nT, tile, n], gmax)`` where ``gmax`` is
-    the global correlogram max over REAL channels only (zero-padding rows
-    are excluded so the reference's ``thres = 0.5 * max`` is unchanged,
-    main_mfdetect.py:94). ``mf_engine`` picks the per-tile correlate
+    Returns ``(corr_tiles [n_tiles, nT, tile, n], gmax [nT])`` where
+    ``gmax`` is each TEMPLATE's correlogram max over REAL channels only
+    (zero-padding rows are excluded). The reference's global
+    ``thres = 0.5 * max`` (main_mfdetect.py:94) is ``gmax.max()`` —
+    bitwise the old scalar (max reductions are exact in any order) —
+    while the per-template vector is what the bank's decoupled
+    ``threshold_scope="per_template"`` policy consumes
+    (models/templates.py). ``mf_engine`` picks the per-tile correlate
     transform: the rFFT product or the MXU banded-Toeplitz matmul
     (``ops.mxu.correlograms_body`` — identical normalization/correction
     math either way).
@@ -290,11 +340,12 @@ def mf_correlate_tiled(
         corr = mxu.correlograms_body(
             x, templates_true, mu, scale, mf_engine
         )
-        tmax = jnp.max(jnp.where(v[None, :, None], corr, neg_inf))
+        tmax = jnp.max(jnp.where(v[None, :, None], corr, neg_inf),
+                       axis=(1, 2))                      # [nT]
         return corr, tmax
 
     corr_tiles, tile_maxes = jax.lax.map(per_tile, (xp, valid))
-    return corr_tiles, jnp.max(tile_maxes)
+    return corr_tiles, jnp.max(tile_maxes, axis=0)
 
 
 @functools.partial(
@@ -378,15 +429,25 @@ def merge_tiled_picks(picks, template_idx: int, tile: int, n_channels: int) -> n
 
 # THE reference threshold policy (main_mfdetect.py:94-99): every route —
 # in-graph (mf_envelope_and_threshold, mf_detect_picks_program) and host
-# (_call_tiled) — derives its thresholds from these two constants via
-# reference_threshold_factors; a policy change edits exactly one place.
+# (_call_tiled) — derives its thresholds from REL_THRESHOLD and the
+# PER-TEMPLATE factor vector carried by the design (each
+# config.CallTemplateConfig brings its own threshold_factor;
+# models/templates.py TemplateBank.threshold_factors). HF_FACTOR is the
+# reference HF note's factor (config.FIN_HF_NOTE.threshold_factor) —
+# kept as the named constant legacy callers and the pre-bank
+# reference_threshold_factors vector read.
 REL_THRESHOLD = 0.5
-HF_FACTOR = 0.9
+HF_FACTOR = FIN_HF_NOTE.threshold_factor
 
 
 def reference_threshold_factors(n_templates: int, dtype=None) -> jnp.ndarray:
-    """Per-template multipliers on ``REL_THRESHOLD * global_max``: the
-    first (HF) template picks at ``HF_FACTOR`` of the threshold."""
+    """The LEGACY pre-bank factor vector — first template at
+    ``HF_FACTOR``, the rest at 1.0. Exactly the default "fin" bank's
+    derived vector (pinned by tests/test_templates_bank.py); kept for
+    pre-bank design artifacts and callers without a bank in hand. New
+    code derives factors from the bank
+    (``TemplateBank.threshold_factors`` /
+    ``MatchedFilterDesign.threshold_factors``)."""
     return jnp.ones((n_templates,), dtype or jnp.float32).at[0].set(HF_FACTOR)
 
 
@@ -396,7 +457,7 @@ def reference_threshold_factors(n_templates: int, dtype=None) -> jnp.ndarray:
         "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp",
         "tile", "max_peaks", "capacity", "use_threshold", "pick_method",
         "condition", "cond_demean", "with_health", "pick_engine",
-        "mf_engine", "fk_engine",
+        "mf_engine", "fk_engine", "thr_scope",
     ),
 )
 def mf_detect_picks_program(
@@ -427,6 +488,8 @@ def mf_detect_picks_program(
     mf_engine: str = "fft",
     fk_engine: str = "fft",
     fk_dft=None,
+    thr_factors=None,
+    thr_scope: str = "global",
 ):
     """The WHOLE detection step as ONE XLA program: [optional narrow-wire
     conditioning prologue ->] bandpass -> f-k filter
@@ -482,6 +545,14 @@ def mf_detect_picks_program(
     f-k route). Normalization, thresholds and pick kernels are shared
     code across engines, so picks are bit-identical wherever the
     router selects a matmul route (tests/test_mxu.py).
+
+    ``thr_factors`` (``[nT]``, traced) is the bank's per-template
+    threshold-factor vector (None: the legacy index-0-is-HF vector);
+    ``thr_scope`` the bank's coupling policy — ``"global"`` bases every
+    template's threshold on the one max over ALL correlograms (the
+    reference policy), ``"per_template"`` on each template's OWN max,
+    decoupling the bank so one-dispatch picks are bit-identical to
+    sequential sub-bank runs (models/templates.py TemplateBank).
     """
     C = trace.shape[0]
     nT = templates_true.shape[0]
@@ -515,16 +586,21 @@ def mf_detect_picks_program(
         trf = mf_filter_fused(trace, mask_band, band_lo, band_hi, pad_rows,
                               fk_engine, fk_dft)
 
-    def resolve_thr(gmax):
+    def resolve_thr(gmax_vec):
+        """``gmax_vec [nT]``: per-template correlogram maxima. The
+        global scope folds them (``jnp.max`` of maxima == the old
+        whole-array max, bitwise — max is exact in any order)."""
         if use_threshold:
             return thr_in.astype(trace.dtype)
-        return (REL_THRESHOLD * gmax) * reference_threshold_factors(
-            nT, trace.dtype
-        )
+        fac = (reference_threshold_factors(nT, trace.dtype)
+               if thr_factors is None else thr_factors.astype(trace.dtype))
+        if thr_scope == "per_template":
+            return (REL_THRESHOLD * gmax_vec) * fac
+        return (REL_THRESHOLD * jnp.max(gmax_vec)) * fac
 
     if tile is None:
         corr = mxu.correlograms_body(trf, templates_true, mu, scale, mf_engine)
-        thr = resolve_thr(jnp.max(corr))
+        thr = resolve_thr(jnp.max(corr, axis=(1, 2)))
         if pick_engine == "pallas":
             from ..ops import pallas_picks
 
@@ -556,14 +632,21 @@ def mf_detect_picks_program(
     return chan, times, cnt, sat_count, thr
 
 
-@jax.jit
-def mf_envelope_and_threshold(corr: jnp.ndarray):
-    """Envelope of the correlograms + the reference's threshold policy:
-    ``thres = 0.5 * max(all correlograms)``, first (HF) template picked at
-    ``0.9 * thres`` (main_mfdetect.py:94-99)."""
+@functools.partial(jax.jit, static_argnames=("thr_scope",))
+def mf_envelope_and_threshold(corr: jnp.ndarray, thr_factors=None,
+                              thr_scope: str = "global"):
+    """Envelope of the correlograms + the bank threshold policy:
+    ``thres = 0.5 * max`` scaled by each template's own factor
+    (main_mfdetect.py:94-99; factors from the bank — None reconstructs
+    the legacy index-0-is-HF vector). ``thr_scope="per_template"``
+    bases each template's threshold on ITS correlogram max (the
+    splittable bank scope, models/templates.py)."""
     env = spectral.envelope_sqrt(corr, axis=-1)
-    thres = REL_THRESHOLD * jnp.max(corr)
-    return env, thres * reference_threshold_factors(corr.shape[0])
+    fac = (reference_threshold_factors(corr.shape[0])
+           if thr_factors is None else thr_factors.astype(corr.dtype))
+    if thr_scope == "per_template":
+        return env, (REL_THRESHOLD * jnp.max(corr, axis=(1, 2))) * fac
+    return env, (REL_THRESHOLD * jnp.max(corr)) * fac
 
 
 @dataclass
@@ -620,7 +703,7 @@ class MatchedFilterDetector:
         trace_shape,
         fk_config: FkFilterConfig = SCRIPT_FK,
         bp_band=(14.0, 30.0),
-        templates: Dict[str, CallTemplateConfig] | None = None,
+        templates: TemplateBank | Dict[str, CallTemplateConfig] | str | None = None,
         peak_block: int = 1024,
         pick_mode: str = "auto",
         max_peaks: int = 256,
@@ -646,14 +729,22 @@ class MatchedFilterDetector:
         # conditioned wire (same affine map, device-executed).
         self.wire = wire
         self._cond_scale = jnp.float32(self.metadata.scale_factor)
-        if templates is None:
-            templates = {"HF": FIN_HF_NOTE, "LF": FIN_LF_NOTE}
+        # the template BANK: a TemplateBank / registered name / chirp-grid
+        # spec / legacy config mapping / None (DAS_TEMPLATE_BANK env,
+        # default the reference "fin" pair) — models/templates.py
+        self.bank = resolve_bank(templates)
         # resolved name -> CallTemplateConfig mapping (consumed by eval.py's
         # call-to-template auto-association)
-        self.template_configs = dict(templates)
+        self.template_configs = self.bank.configs
         self.design = design_matched_filter(
             trace_shape, selected_channels, self.metadata, fk_config, bp_band,
-            templates, channel_pad=channel_pad,
+            self.bank, channel_pad=channel_pad,
+        )
+        # bank threshold policy (models/templates.py): per-template factor
+        # vector + coupling scope, threaded into every detection program
+        self.threshold_scope = self.design.threshold_scope
+        self._thr_factors_dev = jnp.asarray(
+            np.asarray(self.design.threshold_factors, np.float32)
         )
         self.peak_block = peak_block
         if pick_mode == "auto":
@@ -787,7 +878,8 @@ class MatchedFilterDetector:
             with jax.default_device(cpu):
                 for attr in ("_mask_band_dev", "_gain_dev", "_templates_dev",
                              "_templates_true", "_template_mu",
-                             "_template_scale", "_cond_scale"):
+                             "_template_scale", "_thr_factors_dev",
+                             "_cond_scale"):
                     setattr(det, attr,
                             jnp.asarray(np.asarray(getattr(self, attr))))
                 # engine routing is per backend: an "auto" decision made
@@ -816,6 +908,102 @@ class MatchedFilterDetector:
 
         return cached_shallow_view(self, "_host_view_cache", mutate)
 
+    @property
+    def supports_bank_split(self) -> bool:
+        """True when the downshift ladder's BANK-SPLIT rung may run this
+        detector as T/2 sub-banks with picks bit-identical to the full
+        bank: the bank's per-template thresholds must be decoupled
+        (``threshold_scope="per_template"``) and T >= 2
+        (models/templates.py ``TemplateBank.splittable``)."""
+        return self.bank.splittable
+
+    def bank_view(self, lo: int, hi: int) -> "MatchedFilterDetector":
+        """A shallow view of this detector restricted to the contiguous
+        SUB-BANK ``[lo:hi)`` of its template stack — the unit of the
+        downshift ladder's bank-split rung and of the bank-parity
+        oracle (tests/test_templates_bank.py).
+
+        The view SLICES the parent's design arrays and device triple
+        (``templates_true``/``mu``/``scale``/factor vector) rather than
+        re-deriving them: ``padded_template_stats`` pads every template
+        to the BANK-wide true length ``m`` (a row's zero tail is exact
+        — an rFFT of trailing zeros is the unpadded spectrum; extra
+        matmul taps multiply by 0.0), so under the decoupled
+        ``per_template`` threshold scope a sub-bank run's picks are
+        BIT-IDENTICAL to the corresponding rows of the full-bank
+        dispatch on the FFT engine, whose per-template transforms are
+        row-independent. The MATMUL engine's raw conv may round
+        differently as its out-channel (template) dim changes with T —
+        XLA blocks the widened contraction differently — so its
+        sub-bank correlograms/threshold bases are ulp-close rather
+        than bitwise (picks agree away from exact-threshold ties;
+        tests pin picks bitwise on both engines). A fresh detector
+        designed on the sub-bank alone would additionally compute its
+        own (possibly smaller) ``m`` and a different correlate FFT
+        length — use views, not fresh designs, as the parity oracle.
+        Shares the f-k design, mask, DFT pair and resolved engines
+        (the slab-shaped programs differ only in T); cached per
+        ``(lo, hi)``."""
+        import dataclasses
+
+        key = (int(lo), int(hi))
+        cache = self.__dict__.setdefault("_bank_view_cache", {})
+        view = cache.get(key)
+        if view is not None:
+            return view
+        import copy
+
+        from ..utils.views import _VIEW_CACHE_ATTRS
+
+        sub = self.bank.subset(*key)
+        view = copy.copy(self)
+        for attr in _VIEW_CACHE_ATTRS:
+            view.__dict__.pop(attr, None)
+        view.bank = sub
+        view.template_configs = sub.configs
+        view.design = dataclasses.replace(
+            self.design,
+            templates=self.design.templates[lo:hi],
+            template_names=tuple(self.design.template_names[lo:hi]),
+            threshold_factors=np.asarray(
+                self.design.threshold_factors[lo:hi]
+            ),
+        )
+        for attr in ("_templates_dev", "_templates_true", "_template_mu",
+                     "_template_scale", "_thr_factors_dev"):
+            setattr(view, attr, getattr(self, attr)[lo:hi])
+        if self.mf_engine == "matmul-bf16":
+            # the bf16 gate verdict is CONTENT-keyed (ops.mxu.gate_key):
+            # the sub-bank is a different template set at a different T,
+            # so the parent's eligibility must not launder onto it —
+            # re-resolve (gate + A/B, cached per sliced bank). The f32
+            # engines stay inherited: they are decision-identical by the
+            # f32 precision contract (docs/PRECISION.md), no gate to
+            # earn.
+            view.mf_engine, view.mf_engine_reason = mxu.resolve_mf_engine(
+                self._mf_engine_requested, self.design.trace_shape,
+                np.asarray(view._templates_true),
+                np.asarray(view._template_mu),
+                np.asarray(view._template_scale),
+            )
+        cache[key] = view
+        return view
+
+    def split_views(self):
+        """The bank-split rung's ``(first-half view, second-half view)``
+        pair (T -> ceil(T/2) + floor(T/2)); requires
+        :attr:`supports_bank_split`."""
+        if not self.supports_bank_split:
+            raise ValueError(
+                f"bank {self.bank.name!r} is not splittable "
+                f"(threshold_scope={self.threshold_scope!r}, "
+                f"T={len(self.bank)}): sub-bank picks would not be "
+                "bit-identical to the one-dispatch bank"
+            )
+        nT = len(self.bank)
+        mid = (nT + 1) // 2
+        return self.bank_view(0, mid), self.bank_view(mid, nT)
+
     def monolithic_temp_estimate(self) -> int:
         """Rough byte estimate of the one-program correlate+envelope route's
         simultaneously-live temps at the design shape (spectrum + product +
@@ -839,7 +1027,14 @@ class MatchedFilterDetector:
         return self.channel_tile if isinstance(self.channel_tile, int) else 512
 
     def _warn_saturated(self, name: str, saturated) -> None:
-        peak_ops.warn_saturated(saturated, f"template {name}", self.max_peaks)
+        # label by BANK-ENTRY name (chirp-grid entries carry deterministic
+        # auto-names), bank-qualified for named non-default banks, so a
+        # T=32 saturation warning identifies the culprit template — never
+        # a stack index
+        label = (name if self.bank.name in ("fin", "custom")
+                 else f"{self.bank.name}/{name}")
+        peak_ops.warn_saturated(saturated, f"template {label}",
+                                self.max_peaks)
 
     @property
     def fk_pad_rows(self) -> int:
@@ -1035,6 +1230,8 @@ class MatchedFilterDetector:
                 mf_engine=self.mf_engine,
                 fk_engine=self.fk_engine,
                 fk_dft=self._fk_dft_dev,
+                thr_factors=self._thr_factors_dev,
+                thr_scope=self.threshold_scope,
             )
 
         # the K0 launch: async — errors of the device computation itself
@@ -1112,7 +1309,9 @@ class MatchedFilterDetector:
         # everything downstream of it) is bit-identical
         trf_fk = self.filter_block(trace)
         corr = xcorr.compute_cross_correlograms_multi(trf_fk, self._templates_dev)
-        env, thresholds = mf_envelope_and_threshold(corr)
+        env, thresholds = mf_envelope_and_threshold(
+            corr, self._thr_factors_dev, self.threshold_scope
+        )
         if threshold is not None:
             thresholds = jnp.full_like(thresholds, threshold)
 
@@ -1168,11 +1367,17 @@ class MatchedFilterDetector:
             trf_fk, self._templates_true, self._template_mu,
             self._template_scale, tile, self.mf_engine
         )
-        # reference threshold policy (main_mfdetect.py:94-99) via the
-        # shared constants/factors
+        # bank threshold policy (main_mfdetect.py:94-99 generalized) via
+        # the design's per-template factors; gmax is the per-template max
+        # vector — its fold is bitwise the reference's global max
         if threshold is None:
-            thres = REL_THRESHOLD * float(gmax)
-            thr_np = thres * np.asarray(reference_threshold_factors(nT))
+            fac = np.asarray(self.design.threshold_factors, np.float32)
+            g = np.asarray(gmax)
+            if self.threshold_scope == "per_template":
+                thr_np = (REL_THRESHOLD * g) * fac
+            else:
+                thres = REL_THRESHOLD * float(g.max())
+                thr_np = thres * fac
         else:
             thr_np = np.full((nT,), float(threshold), dtype=np.float32)
         # compute dtype, NOT trace.dtype: on the raw wire trace is still

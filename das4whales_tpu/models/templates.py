@@ -1,4 +1,4 @@
-"""Whale-call template synthesis (chirps).
+"""Whale-call template synthesis (chirps) and the TEMPLATE-BANK registry.
 
 Parity targets: reference ``detect.gen_linear_chirp``,
 ``gen_hyperbolic_chirp`` and ``gen_template_fincall`` (detect.py:20-93),
@@ -6,13 +6,31 @@ which wrap ``scipy.signal.chirp``. The chirp phase laws are evaluated in
 closed form in jnp so template generation is jittable and differentiable
 (templates can be optimized against data — something the reference's scipy
 path cannot do).
+
+The reference re-runs the ENTIRE bandpass + f-k front end once per call
+type it hunts (one script invocation per template set, PAPER.md §L2-L3).
+Here the template axis is a first-class, arbitrarily-sized BANK
+(:class:`TemplateBank`): named sets of call templates — the reference's
+fin HF/LF pair, fin variants, blue-call notes, configurable chirp grids —
+compile into one ``[T, time]`` stack that threads through the whole
+detection stack (``models.matched_filter``, ``parallel.batch``,
+``ops.xcorr``/``ops.mxu``), so one slab dispatch + one packed fetch
+yields picks for ALL T templates from a single filter pass
+(filter-once / correlate-many; docs/PERF.md "Template banks"). The
+matmul correlate's ``[tap, template]`` contraction dimension simply
+widens with T — growing the bank is exactly how the MXU recast
+approaches the chip's peak (TINA, arxiv 2408.16551).
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass, replace
+from typing import Dict, Mapping, Tuple
+
 import jax.numpy as jnp
 import numpy as np
 
+from ..config import FIN_HF_NOTE, FIN_LF_NOTE, CallTemplateConfig
 from ..ops.spectral import hann_window
 
 
@@ -57,14 +75,314 @@ def gen_template_fincall(
     fmax: float = 25.0,
     duration: float = 1.0,
     window: bool = True,
+    method: str = "hyperbolic",
 ) -> jnp.ndarray:
-    """Fin-whale call template: Hann-windowed hyperbolic chirp zero-padded
+    """Fin-whale call template: Hann-windowed down-swept chirp zero-padded
     to the length of ``time``.
 
-    Parity: reference ``detect.gen_template_fincall`` (detect.py:68-93).
+    Parity: reference ``detect.gen_template_fincall`` (detect.py:68-93);
+    ``method`` picks the chirp phase law (``"hyperbolic"``, the
+    reference's default, or ``"linear"`` — the
+    ``config.CallTemplateConfig.method`` vocabulary).
     """
-    chirp = gen_hyperbolic_chirp(fmin, fmax, duration, fs)
+    if method == "hyperbolic":
+        chirp = gen_hyperbolic_chirp(fmin, fmax, duration, fs)
+    elif method == "linear":
+        chirp = gen_linear_chirp(fmin, fmax, duration, fs)
+    else:
+        raise ValueError(
+            f"unknown chirp method {method!r}; expected 'hyperbolic' or "
+            "'linear'"
+        )
     if window:
         chirp = chirp * hann_window(chirp.shape[0], periodic=False, dtype=chirp.dtype)
     template = jnp.zeros(np.shape(time), dtype=chirp.dtype)
+    # a call longer than the record truncates (short test records against
+    # long bank entries, e.g. the blue B-call fundamental)
+    chirp = chirp[: int(np.shape(time)[-1])]
     return template.at[: chirp.shape[0]].set(chirp)
+
+
+# ---------------------------------------------------------------------------
+# Template banks: named, arbitrarily-sized template sets (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TemplateBank:
+    """An ordered, named set of call templates — the detection stack's
+    first-class T axis.
+
+    ``entries`` maps template name -> :class:`config.CallTemplateConfig`
+    (insertion-ordered; the order IS the stack order). Each entry
+    carries its own band (fmin/fmax), duration, window, chirp method and
+    per-template ``threshold_factor``.
+
+    ``threshold_scope`` fixes how the relative pick threshold couples
+    the bank's templates:
+
+    * ``"global"`` — the reference policy (main_mfdetect.py:94-99): one
+      base threshold ``REL_THRESHOLD * max(ALL correlograms)``, scaled
+      per template by its factor. Template thresholds are COUPLED
+      through the global max, so a bank cannot be split into sub-banks
+      without changing picks — the default "fin" bank uses this for
+      bit-exact reference parity.
+    * ``"per_template"`` — each template's base threshold is
+      ``REL_THRESHOLD * max(ITS correlogram)``. Thresholds decouple, so
+      a one-dispatch T-bank is BIT-IDENTICAL to sequential sub-bank
+      runs at any split (the bank-parity contract, tests) — the
+      splittable scope every generated/named bank defaults to, and what
+      the downshift ladder's bank-split rung requires
+      (docs/ROBUSTNESS.md).
+
+    An explicit caller threshold (``detect_picks(threshold=...)``)
+    bypasses the scope entirely (same value for every template).
+    """
+
+    name: str
+    entries: Tuple[Tuple[str, CallTemplateConfig], ...]
+    threshold_scope: str = "per_template"
+
+    def __post_init__(self):
+        if self.threshold_scope not in ("global", "per_template"):
+            raise ValueError(
+                f"unknown threshold_scope {self.threshold_scope!r}; "
+                "expected 'global' or 'per_template'"
+            )
+        if not self.entries:
+            raise ValueError(f"template bank {self.name!r} is empty")
+        names = [n for n, _ in self.entries]
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"template bank {self.name!r} has duplicate entry names"
+            )
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.entries)
+
+    @property
+    def configs(self) -> Dict[str, CallTemplateConfig]:
+        """name -> config mapping (insertion order preserved) — the
+        legacy ``templates`` dict every existing consumer reads
+        (``MatchedFilterDetector.template_configs``, eval.py's
+        call-to-template association, io/annotations.py)."""
+        return dict(self.entries)
+
+    def threshold_factors(self, dtype=np.float32) -> np.ndarray:
+        """The per-template threshold-factor vector, in stack order —
+        derived from each entry's own ``threshold_factor`` (no index-0
+        HF assumption)."""
+        return np.asarray(
+            [c.threshold_factor for _, c in self.entries], dtype
+        )
+
+    def compile(self, n_time: int, fs: float, dtype=np.float32) -> np.ndarray:
+        """The bank as one ``[T, n_time]`` template stack (host numpy) —
+        each entry synthesized by the reference chirp law at its own
+        band/duration/method and zero-padded to the record length."""
+        time = np.arange(int(n_time)) / float(fs)
+        return np.stack([
+            np.asarray(gen_template_fincall(
+                time, fs, c.fmin, c.fmax, c.duration, c.window,
+                method=c.method,
+            ))
+            for _, c in self.entries
+        ]).astype(dtype)
+
+    def subset(self, lo: int, hi: int) -> "TemplateBank":
+        """The contiguous sub-bank ``entries[lo:hi]`` (stack order
+        preserved) — the unit of the downshift ladder's bank-split rung
+        and of the sequential-parity oracle."""
+        if not 0 <= lo < hi <= len(self.entries):
+            raise ValueError(
+                f"sub-bank [{lo}:{hi}] out of range for T={len(self.entries)}"
+            )
+        return replace(
+            self, name=f"{self.name}[{lo}:{hi}]",
+            entries=self.entries[lo:hi],
+        )
+
+    def split(self) -> Tuple["TemplateBank", "TemplateBank"]:
+        """Halve the bank: ``(entries[:ceil(T/2)], entries[ceil(T/2):])``
+        — the T -> T/2 step of the bank-split downshift rung. Requires
+        T >= 2."""
+        if len(self.entries) < 2:
+            raise ValueError(f"cannot split a T={len(self.entries)} bank")
+        mid = (len(self.entries) + 1) // 2
+        return self.subset(0, mid), self.subset(mid, len(self.entries))
+
+    @property
+    def splittable(self) -> bool:
+        """True when sub-bank runs are bit-identical to the one-dispatch
+        bank (decoupled per-template thresholds, T >= 2) — the
+        bank-split downshift rung's eligibility."""
+        return self.threshold_scope == "per_template" and len(self) >= 2
+
+
+# -- built-in banks ----------------------------------------------------------
+
+#: Fin B-call note variants around the canonical HF/LF pair: the same
+#: down-swept 20-Hz-call morphology at the band/duration spreads reported
+#: across NE-Pacific fin populations — one campaign covers the family.
+_FIN_VARIANTS = (
+    ("HF", FIN_HF_NOTE),
+    ("LF", FIN_LF_NOTE),
+    ("HF-short", CallTemplateConfig(fmin=18.5, fmax=28.0, duration=0.55,
+                                    threshold_factor=0.9)),
+    ("LF-long", CallTemplateConfig(fmin=14.0, fmax=20.5, duration=0.95)),
+)
+
+#: Blue-whale northeast-Pacific call components in the fin passband's
+#: neighborhood: the B-call's third-harmonic downsweep (~46->43 Hz is out
+#: of band; its 15-16 Hz fundamental is not) and the D-call downsweep.
+_BLUE_ENTRIES = (
+    ("B-fund", CallTemplateConfig(fmin=14.5, fmax=16.2, duration=5.0)),
+    ("D-call", CallTemplateConfig(fmin=22.0, fmax=28.0, duration=1.8,
+                                  method="linear")),
+    ("D-low", CallTemplateConfig(fmin=15.0, fmax=22.0, duration=2.5,
+                                 method="linear")),
+)
+
+
+def chirp_grid(
+    n: int,
+    band=(14.0, 30.0),
+    durations=(0.7,),
+    method: str = "hyperbolic",
+    width_hz: float = 8.0,
+    threshold_factor: float = 1.0,
+    name: str | None = None,
+) -> TemplateBank:
+    """A configurable T-template chirp grid: ``n`` down-swept chirps whose
+    ``width_hz``-wide sub-bands tile ``band``, crossed with ``durations``
+    (cycled when ``n`` exceeds the sweep count). Entry names are
+    DETERMINISTIC — ``chirp-<method>-<fmin>-<fmax>-<duration>s`` — so a
+    saturation warning or pick artifact at T=32 names the culprit
+    template, not a stack index (``warn_saturated`` contract).
+
+    Every grid bank is ``threshold_scope="per_template"`` (splittable:
+    one-dispatch picks == sequential sub-bank picks, bit-identical)."""
+    if n < 1:
+        raise ValueError(f"chirp grid needs n >= 1, got {n}")
+    lo, hi = float(band[0]), float(band[1])
+    width = min(float(width_hz), hi - lo)
+    durs = tuple(float(d) for d in durations) or (0.7,)
+    n_sweeps = max(1, -(-n // len(durs)))
+    entries = []
+    for k in range(n):
+        s, d = k % n_sweeps, durs[(k // n_sweeps) % len(durs)]
+        f0 = lo + (hi - lo - width) * (s / max(1, n_sweeps - 1)
+                                       if n_sweeps > 1 else 0.0)
+        cfg = CallTemplateConfig(
+            fmin=round(f0, 2), fmax=round(f0 + width, 2), duration=d,
+            method=method, threshold_factor=threshold_factor,
+        )
+        entries.append(
+            (f"chirp-{method[:3]}-{cfg.fmin:g}-{cfg.fmax:g}-{d:g}s", cfg)
+        )
+    # distinct (sweep, duration) pairs by construction; dedupe defensively
+    # against degenerate grids (n > sweeps*durs cycles)
+    seen, uniq = set(), []
+    for nm, cfg in entries:
+        if nm in seen:
+            nm = f"{nm}#{len(uniq)}"
+        seen.add(nm)
+        uniq.append((nm, cfg))
+    return TemplateBank(
+        name=name or f"chirp-grid-{n}", entries=tuple(uniq),
+        threshold_scope="per_template",
+    )
+
+
+_REGISTRY: Dict[str, TemplateBank] = {}
+
+
+def register_bank(bank: TemplateBank) -> TemplateBank:
+    """Register ``bank`` under its name (last registration wins) and
+    return it — campaigns then select it via ``templates="<name>"`` or
+    ``DAS_TEMPLATE_BANK=<name>``."""
+    _REGISTRY[bank.name] = bank
+    return bank
+
+
+def bank_names() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def get_bank(name: str) -> TemplateBank:
+    """Look up a registered bank, or parse a chirp-grid spec
+    (``chirp-grid:T`` / ``chirp-grid:T:fmin-fmax`` /
+    ``chirp-grid:T:fmin-fmax:d0,d1,...``)."""
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    if name.startswith("chirp-grid:"):
+        parts = name.split(":")[1:]
+        n = int(parts[0])
+        band = (14.0, 30.0)
+        if len(parts) > 1 and parts[1]:
+            b0, b1 = parts[1].split("-")
+            band = (float(b0), float(b1))
+        durs = (0.7,)
+        if len(parts) > 2 and parts[2]:
+            durs = tuple(float(d) for d in parts[2].split(","))
+        return chirp_grid(n, band=band, durations=durs, name=name)
+    raise KeyError(
+        f"unknown template bank {name!r}; registered: {bank_names()} "
+        "(or a 'chirp-grid:T[:fmin-fmax[:durs]]' spec)"
+    )
+
+
+#: THE reference default: the HF/LF fin-note pair under the reference's
+#: GLOBAL threshold policy — every pick this bank makes is bit-identical
+#: to the pre-bank detector (pinned by tests/test_templates_bank.py).
+FIN_BANK = register_bank(TemplateBank(
+    name="fin", entries=(("HF", FIN_HF_NOTE), ("LF", FIN_LF_NOTE)),
+    threshold_scope="global",
+))
+
+FIN_VARIANTS_BANK = register_bank(TemplateBank(
+    name="fin-variants", entries=_FIN_VARIANTS,
+    threshold_scope="per_template",
+))
+
+BLUE_BANK = register_bank(TemplateBank(
+    name="blue", entries=_BLUE_ENTRIES, threshold_scope="per_template",
+))
+
+
+def resolve_bank(templates=None) -> TemplateBank:
+    """The detector-facing resolver: accept a :class:`TemplateBank`
+    (as-is), a registered-bank name / chirp-grid spec (str), a legacy
+    ``{name: CallTemplateConfig}`` mapping, or None — the
+    ``DAS_TEMPLATE_BANK`` env default (``config.template_bank_default``,
+    "fin" unless set).
+
+    A mapping wraps as an anonymous GLOBAL-scope bank (the pre-bank
+    threshold coupling) with factors from each config's OWN
+    ``threshold_factor``. That is the deliberate fix of the old
+    index-0-is-HF rule: a mapping of the named FIN constants reproduces
+    the legacy ``[0.9, 1, ...]`` vector bitwise, but a custom config at
+    index 0 with the default ``threshold_factor=1.0`` now thresholds at
+    1.0 — it was never an HF note; callers that relied on the
+    positional 0.9 set ``threshold_factor=0.9`` explicitly."""
+    if isinstance(templates, TemplateBank):
+        return templates
+    if templates is None:
+        from ..config import template_bank_default
+
+        return get_bank(template_bank_default())
+    if isinstance(templates, str):
+        return get_bank(templates)
+    if isinstance(templates, Mapping):
+        return TemplateBank(
+            name="custom", entries=tuple(templates.items()),
+            threshold_scope="global",
+        )
+    raise TypeError(
+        f"templates must be a TemplateBank, bank name, mapping or None — "
+        f"got {type(templates).__name__}"
+    )

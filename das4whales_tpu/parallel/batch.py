@@ -54,17 +54,19 @@ _STATIC = (
     "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp", "tile",
     "max_peaks", "capacity", "use_threshold", "pick_method", "condition",
     "serial", "with_health", "pick_engine", "mf_engine", "fk_engine",
+    "thr_scope",
 )
 
 
 def _batched_body(
     trace_batch, mask_band, bp_gain, templates_true, mu, scale, thr_in,
-    cond_scale, n_real, fk_dft=None, *,
+    cond_scale, n_real, fk_dft=None, thr_factors=None, *,
     band_lo: int, band_hi: int, bp_padlen: int, pad_rows: int,
     staged_bp: bool, tile: int | None, max_peaks: int, capacity: int,
     use_threshold: bool, pick_method: str, condition: bool,
     serial: bool = False, with_health: bool = False, health_clip=None,
     pick_engine: str = "jnp", mf_engine: str = "fft", fk_engine: str = "fft",
+    thr_scope: str = "global",
 ):
     """The one-program route over a leading file axis, in ONE program.
 
@@ -91,8 +93,9 @@ def _batched_body(
       vmap mode's 4x working set loses to the cache (docs/PERF.md).
     """
     def one(tr, nr):
-        # fk_dft (the DFT-matmul pair) is closed over, not batched: one
-        # matrix pair serves every file of the slab
+        # fk_dft (the DFT-matmul pair) and the bank's thr_factors are
+        # closed over, not batched: one matrix pair / factor vector
+        # serves every file of the slab
         return mf_detect_picks_program(
             tr, mask_band, bp_gain, templates_true, mu, scale, thr_in,
             band_lo, band_hi, bp_padlen, pad_rows, staged_bp, tile,
@@ -101,6 +104,7 @@ def _batched_body(
             with_health=with_health, health_clip=health_clip,
             pick_engine=pick_engine, mf_engine=mf_engine,
             fk_engine=fk_engine, fk_dft=fk_dft,
+            thr_factors=thr_factors, thr_scope=thr_scope,
         )
 
     if n_real is None:
@@ -174,6 +178,27 @@ class BatchedMatchedFilterDetector:
         if serial is None:
             serial = jax.default_backend() == "cpu"
         self.serial = bool(serial)
+
+    def split_views(self) -> tuple:
+        """The bank-split downshift rung's pair of SUB-BANK batched
+        facades (T -> ceil(T/2) + floor(T/2) over the same bucket shape
+        and batch; ``MatchedFilterDetector.split_views``): two
+        dispatches instead of one, each with roughly half the
+        correlate/envelope/pick working set, before the ladder
+        sacrifices B (docs/ROBUSTNESS.md "Resource ladder"). Neither
+        half donates — the first sub-bank's program must leave the slab
+        alive for the second's dispatch. Cached (the winning rung is
+        sticky: one facade pair per bucket for the campaign)."""
+        cached = self.__dict__.get("_split_cache")
+        if cached is None:
+            a, b = self.det.split_views()
+            cached = self.__dict__["_split_cache"] = (
+                BatchedMatchedFilterDetector(a, donate=False,
+                                             serial=self.serial),
+                BatchedMatchedFilterDetector(b, donate=False,
+                                             serial=self.serial),
+            )
+        return cached
 
     def detect_batch(
         self, stack, n_real=None, n_valid: int | None = None,
@@ -274,6 +299,7 @@ class BatchedMatchedFilterDetector:
                 stack_, det._mask_band_dev, det._gain_dev,
                 det._templates_true, det._template_mu, det._template_scale,
                 thr_in, det._cond_scale, nr, det._fk_dft_dev,
+                det._thr_factors_dev,
                 band_lo=det._band_lo, band_hi=det._band_hi,
                 bp_padlen=det.design.bp_padlen, pad_rows=det.fk_pad_rows,
                 staged_bp=not det.fused_bandpass, tile=tile, max_peaks=k,
@@ -285,6 +311,7 @@ class BatchedMatchedFilterDetector:
                              else jnp.float32(health_clip)),
                 pick_engine=det.pick_engine,
                 mf_engine=det.mf_engine, fk_engine=det.fk_engine,
+                thr_scope=det.threshold_scope,
             )
 
         # the K0 launch: async — device-side failures surface at

@@ -38,9 +38,11 @@ def _mf_body(
     trace, mask_band, bp_gain, templates_true, template_mu, template_scale,
     cond_scale, *,
     band_lo: int, band_hi: int, bp_padlen: int, channel_axis: str,
-    relative_threshold: float, hf_factor: float, pick_mode: str, max_peaks: int,
+    relative_threshold: float, threshold_factors, pick_mode: str,
+    max_peaks: int,
     outputs: str = "full", fused: bool = False, pick_tile: int = 512,
     pick_method: str = "topk", condition: bool = False,
+    threshold_scope: str = "global",
 ):
     """shard_map body. Local shapes: trace [B/Pf, C/Pc, T], mask_band
     [K, Bpad/Pc] (band-limited half-spectrum — the all_to_alls and
@@ -67,12 +69,23 @@ def _mf_body(
     )
     env = spectral.envelope_sqrt(corr, axis=-1)
 
-    # per-file threshold: global max over templates/channels/time of the file
-    local_max = jnp.max(corr, axis=(0, 2, 3))                     # [B/Pf]
-    file_max = jax.lax.pmax(local_max, channel_axis)
-    thres = relative_threshold * file_max                          # [B/Pf]
-    factors = jnp.ones(templates_true.shape[0]).at[0].set(hf_factor)  # HF first
-    thr = thres[None, :, None, None] * factors[:, None, None, None]
+    # per-file threshold base; the bank's per-template factor vector
+    # (models/templates.py) is closed over at factory time — no
+    # index-0-is-HF assumption
+    factors = jnp.asarray(threshold_factors)
+    if threshold_scope == "per_template":
+        # decoupled bank scope: each template's base from ITS OWN
+        # per-file max (pmax over the channel shards) — [nT, B/Pf]
+        local_max = jnp.max(corr, axis=(2, 3))
+        thres = relative_threshold * jax.lax.pmax(local_max, channel_axis)
+        thr = (thres * factors[:, None])[:, :, None, None]
+    else:
+        # reference policy: one max over templates/channels/time couples
+        # every template of the file
+        local_max = jnp.max(corr, axis=(0, 2, 3))                 # [B/Pf]
+        file_max = jax.lax.pmax(local_max, channel_axis)
+        thres = relative_threshold * file_max                      # [B/Pf]
+        thr = thres[None, :, None, None] * factors[:, None, None, None]
 
     if pick_mode == "sparse":
         # TPU production route (ops/peaks.py): envelope peaks are
@@ -106,7 +119,9 @@ def make_sharded_mf_step(
     file_axis: str = "file",
     channel_axis: str = "channel",
     relative_threshold: float = 0.5,
-    hf_factor: float = 0.9,
+    hf_factor: float | None = None,
+    threshold_factors=None,
+    threshold_scope: str | None = None,
     pick_mode: str = "sparse",
     max_peaks: int = 256,
     outputs: str = "full",
@@ -196,6 +211,15 @@ def make_sharded_mf_step(
 
     cond_scale = jnp.asarray(0.0 if scale_factor is None else scale_factor,
                              jnp.float32)
+    # bank threshold policy — ONE resolution for every design consumer
+    # (MatchedFilterDesign.resolve_threshold_policy: explicit legacy
+    # hf_factor pins the index-0 vector + global coupling; explicit
+    # vector next; else the design's bank. per_template scope returns
+    # the [nT, B/Pf] pre-factor base instead of the coupled [B/Pf]
+    # scalar-per-file.)
+    factors_np, thr_scope = design.resolve_threshold_policy(
+        hf_factor, threshold_factors, threshold_scope
+    )
     body = functools.partial(
         _mf_body,
         band_lo=band_lo,
@@ -204,7 +228,8 @@ def make_sharded_mf_step(
         fused=fused_bandpass,
         channel_axis=channel_axis,
         relative_threshold=relative_threshold,
-        hf_factor=hf_factor,
+        threshold_factors=factors_np,
+        threshold_scope=thr_scope,
         pick_mode=pick_mode,
         max_peaks=max_peaks,
         outputs=outputs,
@@ -220,6 +245,11 @@ def make_sharded_mf_step(
         )
     else:
         picks_spec = tfc
+    # threshold-base output: the coupled [B/Pf] per-file scalar under
+    # the reference global scope; the decoupled [nT, B/Pf] per-template
+    # base under the bank's per_template scope
+    thres_spec = (P(None, file_axis) if thr_scope == "per_template"
+                  else P(file_axis))
     fn = shard_map(
         body,
         mesh=mesh,
@@ -233,14 +263,14 @@ def make_sharded_mf_step(
             P(),                                # conditioning scale (replicated)
         ),
         out_specs=(
-            (picks_spec, P(file_axis))                # picks, thresholds
+            (picks_spec, thres_spec)                  # picks, thresholds
             if outputs == "picks"
             else (
                 P(file_axis, channel_axis, None),     # trf_fk
                 tfc,                                  # corr
                 tfc,                                  # env
                 picks_spec,
-                P(file_axis),                         # thresholds
+                thres_spec,                           # threshold base
             )
         ),
         check_vma=False,

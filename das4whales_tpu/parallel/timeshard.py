@@ -197,7 +197,9 @@ def make_sharded_mf_step_time(
     time_axis: str = "time",
     halo: int = 512,
     relative_threshold: float = 0.5,
-    hf_factor: float = 0.9,
+    hf_factor: float | None = None,
+    threshold_factors=None,
+    threshold_scope: str | None = None,
     pick_mode: str = "sparse",
     max_peaks: int = 256,
     outputs: str = "full",
@@ -318,7 +320,12 @@ def make_sharded_mf_step_time(
     templates_true, template_mu, template_scale = (
         xcorr.padded_template_stats_device(design.templates)
     )
-    n_templates = design.templates.shape[0]
+    # bank threshold policy — ONE resolution for every design consumer
+    # (models.matched_filter.MatchedFilterDesign.resolve_threshold_policy:
+    # explicit legacy hf_factor > explicit vector > the design's bank)
+    factors_np, thr_scope = design.resolve_threshold_policy(
+        hf_factor, threshold_factors, threshold_scope
+    )
 
     condition = wire == "raw"
     cond_scale = jnp.asarray(0.0 if scale_factor is None else scale_factor,
@@ -380,10 +387,19 @@ def make_sharded_mf_step_time(
         # (ops/mxu.py: the MXU matmul recast when the router selected it)
         corr = mxu_ops.correlograms_body(y, tmpl, tmu, tsc, mf_engine)
         env = spectral.envelope_sqrt(corr, axis=-1)
-        file_max = jax.lax.pmax(jnp.max(corr), time_axis)
-        thres = relative_threshold * file_max
-        factors = jnp.ones(n_templates).at[0].set(hf_factor)
-        thr = thres * factors[:, None, None]
+        factors = jnp.asarray(factors_np)
+        if thr_scope == "per_template":
+            # decoupled bank scope: each template's base threshold from
+            # ITS OWN global max (pmax over the relabeled channel shards)
+            file_max = jax.lax.pmax(jnp.max(corr, axis=(1, 2)), time_axis)
+            thres = relative_threshold * file_max          # [nT]
+            thr = (thres * factors)[:, None, None]
+        else:
+            # reference policy: one max couples all templates; thres
+            # stays the scalar PRE-factor base (output back-compat)
+            file_max = jax.lax.pmax(jnp.max(corr), time_axis)
+            thres = relative_threshold * file_max
+            thr = thres * factors[:, None, None]
         if pick_mode == "sparse":
             # TPU production route: time is whole within each channel
             # shard here, so positions are global sample indices.
@@ -403,6 +419,10 @@ def make_sharded_mf_step_time(
         return trf, corr, env, picks, thres
 
     ct = P(None, time_axis, None)  # [template, channel(relabeled), *]
+    # threshold output: the scalar pre-factor base under the reference
+    # global scope; the [nT] per-template base vector under the bank's
+    # decoupled scope (replicated either way)
+    thres_spec = P(None) if thr_scope == "per_template" else P()
     if pick_mode == "sparse":
         picks_spec = peak_ops.SparsePicks(
             positions=ct, heights=ct, prominences=ct, selected=ct,
@@ -425,14 +445,14 @@ def make_sharded_mf_step_time(
             P(None, None),        # per-file host means (replicated)
         ) if segmented else ()),
         out_specs=(
-            (picks_spec, P())           # picks, threshold
+            (picks_spec, thres_spec)    # picks, threshold base
             if outputs == "picks"
             else (
                 P(None, time_axis),     # trf_fk stays time-sharded
                 ct,                     # corr: channel-sharded (relabeled axis)
                 ct,                     # env
                 picks_spec,
-                P(),                    # threshold (replicated scalar)
+                thres_spec,             # threshold base (replicated)
             )
         ),
         check_vma=False,
@@ -549,13 +569,15 @@ def detect_picks_time_sharded(det, trace, mesh: Mesh, n_real=None):
     picks = sparse_time_picks_to_dict(
         sp_picks, det.design.template_names, n_samples=n_real
     )
-    from ..models.matched_filter import reference_threshold_factors
-
-    factors = np.asarray(reference_threshold_factors(
-        len(det.design.template_names)
-    ))
+    # the step returns the PRE-factor threshold base: a scalar under the
+    # reference global scope, the per-template max vector under the
+    # bank's decoupled scope — the factors come from the design's bank
+    factors = np.asarray(det.design.threshold_factors, np.float32)
+    base = np.broadcast_to(
+        np.asarray(thres, np.float32), factors.shape
+    )
     thresholds = {
-        name: float(thres) * float(factors[i])
+        name: float(base[i]) * float(factors[i])
         for i, name in enumerate(det.design.template_names)
     }
     return picks, thresholds

@@ -73,6 +73,12 @@ def load_design(path: str, cls: Type | None = None) -> Any:
     # dataclasses with tuple-typed fields get lists back from JSON; coerce
     kwargs = {}
     for f in dataclasses.fields(cls):
+        if f.name not in fields:
+            # a field added after this checkpoint was written (e.g. the
+            # template-bank threshold_factors/threshold_scope pair):
+            # the dataclass default/__post_init__ reconstructs the
+            # legacy value, so old artifacts keep loading
+            continue
         value = fields[f.name]
         if isinstance(value, list):
             value = tuple(value)

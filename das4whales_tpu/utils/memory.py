@@ -142,6 +142,10 @@ def batched_program_memory(
         # f-k engine — priced so the preflight sees its residency too
         (tuple(_aval_of(a) for a in det._fk_dft_dev)
          if getattr(det, "_fk_dft_dev", None) is not None else None),
+        # the bank's per-template threshold-factor vector: the T axis
+        # is part of the priced program (a T=32 bank's correlate /
+        # envelope / pick temps all scale with it)
+        jax.ShapeDtypeStruct((nT,), compute_dtype),       # thr_factors
     )
     static = dict(
         band_lo=det._band_lo, band_hi=det._band_hi,
@@ -154,6 +158,7 @@ def batched_program_memory(
         with_health=with_health,
         mf_engine=getattr(det, "mf_engine", "fft"),
         fk_engine=getattr(det, "fk_engine", "fft"),
+        thr_scope=getattr(det, "threshold_scope", "global"),
     )
     kwargs = {k: v for k, v in static.items() if k in _STATIC}
     if with_health and health_clip is not None:
@@ -169,20 +174,34 @@ def batched_program_memory(
     )
 
 
+def first_fitting(price, candidates, budget_bytes: int):
+    """THE preflight fitting policy, in one place: walk ``candidates``
+    in the given (ladder) order and return the first whose priced
+    program fits ``budget_bytes`` (``stats.peak < budget``). A
+    candidate whose pricing is unsupported (None) is treated as fitting
+    — no gate is better than a false one; the downshift ladder still
+    protects the run. Returns None when every candidate is priced AND
+    over budget. ``price(candidate) -> MemoryStats | None``; candidates
+    may be batch sizes, rung tuples, or any key the pricer understands
+    (the batched campaign walks interleaved ``("batched", B)`` /
+    ``("bank", B)`` rungs through here)."""
+    for cand in candidates:
+        stats = price(cand)
+        if stats is None or stats.fits(budget_bytes):
+            return cand
+    return None
+
+
 def max_fitting_batch(
     price: Callable[[int], MemoryStats | None],
     candidates: Sequence[int],
     budget_bytes: int,
 ) -> int | None:
     """The largest batch in ``candidates`` whose priced program fits
-    ``budget_bytes`` (``stats.peak < budget``) — the preflight's rung
-    chooser. Candidates are tried largest-first; a candidate whose
-    pricing is unsupported (None) is treated as fitting (no gate is
-    better than a false one — the downshift ladder still protects the
-    run). Returns None when every candidate is priced AND over budget.
-    """
-    for b in sorted({int(c) for c in candidates}, reverse=True):
-        stats = price(b)
-        if stats is None or stats.fits(budget_bytes):
-            return b
-    return None
+    ``budget_bytes`` — :func:`first_fitting` over the batch sizes,
+    largest first (the pre-bank preflight chooser, kept for callers
+    without a bank axis)."""
+    return first_fitting(
+        price, sorted({int(c) for c in candidates}, reverse=True),
+        budget_bytes,
+    )

@@ -14,8 +14,10 @@ import copy
 
 #: every view-cache slot a shallow copy must shed: a view must never
 #: inherit its parent's cached views (a tiled view's host_view must be
-#: derived from the tiled knobs, not aliased to the parent's)
-_VIEW_CACHE_ATTRS = ("_tiled_view_cache", "_host_view_cache")
+#: derived from the tiled knobs, not aliased to the parent's; a bank
+#: view's tiled/host views from the sub-bank slice, and vice versa)
+_VIEW_CACHE_ATTRS = ("_tiled_view_cache", "_host_view_cache",
+                     "_bank_view_cache")
 
 
 def cached_shallow_view(obj, cache_attr: str, mutate):

@@ -784,17 +784,42 @@ def run_campaign_batched(
             b //= 2
         dt = np.asarray(slab.blocks[0].trace).dtype
 
-        def price(b_):
+        def price(bd, b_):
             return memutils.batched_program_memory(
-                bdet, b_, dt, with_health=with_health, health_clip=clip
+                bd, b_, dt, with_health=with_health, health_clip=clip
             )
 
-        best = memutils.max_fitting_batch(price, cands, budget)
+        # candidate rungs in LADDER order: the full bank at each B, then
+        # — for splittable banks — the bank-split rung at the same B
+        # (the T axis is priced before B is sacrificed); the fitting
+        # policy itself (unpriceable-reads-as-fitting) lives in ONE
+        # place, utils.memory.first_fitting
+        split = bdet.det.supports_bank_split
+        rung_cands = []
+        for b_ in cands:
+            rung_cands.append(("batched", b_))
+            if split:
+                rung_cands.append(("bank", b_))
+
+        def price_rung(rung_):
+            stage_, b_ = rung_
+            # the LARGER (ceil) T/2 sub-bank certifies the split pair
+            bd = bdet.split_views()[0] if stage_ == "bank" else bdet
+            return price(bd, b_)
+
+        best = memutils.first_fitting(price_rung, rung_cands, budget)
         if best is not None:
-            if best < batch:
+            stage_, b_ = best
+            if stage_ == "bank":
+                ladder.pin(key, ("bank", b_), (
+                    f"preflight: full T={len(bdet.det.bank)} bank over "
+                    f"budget at B={b_}; T/2 sub-banks fit "
+                    f"{budget / 2**30:.2f} GiB"
+                ))
+            elif b_ < batch:
                 ladder.pin(
-                    key, ("batched", best) if best > 1 else ("file", 1),
-                    f"preflight: largest fitting batch B={best} under "
+                    key, ("batched", b_) if b_ > 1 else ("file", 1),
+                    f"preflight: largest fitting batch B={b_} under "
                     f"{budget / 2**30:.2f} GiB",
                 )
             return
@@ -840,6 +865,10 @@ def run_campaign_batched(
             # downshift events describe ITS routes, not the first
             # bucket's
             ladder.set_engines(key, progs[key].engines)
+            if bdet.det.supports_bank_split:
+                # splittable template bank: this bucket's ladder gains
+                # the bank-split rung (T/2 sub-banks before B shrinks)
+                ladder.enable_bank_split(key)
             if preflight:
                 preflight_bucket(key, bdet, slab)
         return bdet
@@ -905,6 +934,46 @@ def run_campaign_batched(
                 entries.extend(
                     dispatched(list(sub.paths), rung, fn)[: sub.n_valid]
                 )
+            return entries
+        if stage == "bank":
+            # the bank-split rung: the SAME batch as two T/2 sub-bank
+            # dispatches (parallel.batch split_views — picks
+            # bit-identical to the one-dispatch bank under the
+            # splittable per_template scope), before B is sacrificed.
+            # Any in-flight full-bank handle was discarded by the
+            # caller when the bucket left its top rung.
+            subs = [slab] if b >= batch else subdivide_slab(slab, b)
+            half_a, half_b = bdet.split_views()
+            entries = []
+            for sub in subs:
+                halves = []
+                for j, hdet in enumerate((half_a, half_b)):
+                    # health stats describe the INPUT block — identical
+                    # either half, so only the FIRST dispatch computes
+                    # them (the second would pay the on-device reduction
+                    # twice and compile a with_health program variant
+                    # for nothing — the planner's per-file bank rung
+                    # plays the same trick)
+                    def fn(sub=sub, hdet=hdet, j=j):
+                        return hdet.detect_batch(
+                            sub.stack, n_real=sub.n_real,
+                            n_valid=sub.n_valid,
+                            with_health=with_health and j == 0,
+                            health_clip=clip,
+                        )
+                    halves.append(
+                        dispatched(list(sub.paths), rung, fn)[: sub.n_valid]
+                    )
+                for ea, eb in zip(*halves):
+                    if ea is None or eb is None:
+                        entries.append(None)   # overflow: exact fallback
+                        continue
+                    merged_picks = {**ea[0], **eb[0]}
+                    merged_thr = {**ea[1], **eb[1]}
+                    entries.append(
+                        (merged_picks, merged_thr, ea[2]) if with_health
+                        else (merged_picks, merged_thr)
+                    )
             return entries
         entries = []
         for k in range(slab.n_valid):
@@ -1327,7 +1396,7 @@ def run_campaign_sharded(
     prefetch: int = 2,
     engine: str = "h5py",
     relative_threshold: float = 0.5,
-    hf_factor: float = 0.9,
+    hf_factor: float | None = None,
     fused_bandpass: bool = True,
     wire: str = "conditioned",
     retry=None,
@@ -1428,8 +1497,11 @@ def run_campaign_sharded(
         fused_bandpass=fused_bandpass, **wire_kw,
     )
 
-    factors = {name: (hf_factor if i == 0 else 1.0)
-               for i, name in enumerate(design.template_names)}
+    # per-template factors — the SAME resolution the step factory ran
+    # (MatchedFilterDesign.resolve_threshold_policy)
+    fac_vec, _ = design.resolve_threshold_policy(hf_factor)
+    factors = {name: float(f)
+               for name, f in zip(design.template_names, fac_vec)}
 
     from ..parallel import dispatch as dispatch_mod
 
@@ -1495,8 +1567,14 @@ def run_campaign_sharded(
                     host_picks, design.template_names, file_index=k,
                     n_samples=spec0.meta.ns,
                 )
-            thresholds = {name: float(thres_np[k]) * factors[name]
-                          for name in design.template_names}
+            # thres base: [B] under the global scope, [nT, B] under a
+            # bank's decoupled per_template scope (parallel.pipeline)
+            base = np.asarray(thres_np)
+            thresholds = {
+                name: float(base[i, k] if base.ndim == 2 else base[k])
+                * factors[name]
+                for i, name in enumerate(design.template_names)
+            }
             _file_record(outdir, path, picks, thresholds,
                          round(wall / max(len(blocks), 1), 3), records,
                          family="mf", rung="sharded")
@@ -1627,7 +1705,7 @@ def run_campaign_multiprocess(
     max_failures: int | None = None,
     interrogator: str = "optasense",
     relative_threshold: float = 0.5,
-    hf_factor: float = 0.9,
+    hf_factor: float | None = None,
     fused_bandpass: bool = True,
     wire: str = "conditioned",
 ) -> CampaignResult:
@@ -1694,14 +1772,29 @@ def run_campaign_multiprocess(
     C = sel.n_channels(spec0.meta.nx)
     ns = spec0.meta.ns
     design = design_matched_filter((C, ns), selected_channels, spec0.meta)
+    if design.resolve_threshold_policy(hf_factor)[1] == "per_template":
+        # the multihost threshold allgather assumes the coupled
+        # per-file scalar base; wiring the decoupled [nT, B] base
+        # across processes is untested on this runtime — fail fast
+        # instead of silently coupling a bank that promises decoupled
+        # thresholds (single-chip/batched/sharded routes honor it)
+        raise ValueError(
+            "run_campaign_multiprocess does not support "
+            "threshold_scope='per_template' banks yet; use the "
+            "single-chip, batched or single-host sharded campaign, or "
+            "a global-scope bank"
+        )
     step_k0, step_full = _adaptive_sharded_steps(
         make_sharded_mf_step, design, mesh,
         relative_threshold=relative_threshold, hf_factor=hf_factor,
         fused_bandpass=fused_bandpass,
     )
     sharding = input_sharding(mesh)
-    factors = {name: (hf_factor if i == 0 else 1.0)
-               for i, name in enumerate(design.template_names)}
+    # per-template factors — the SAME resolution the step factory ran
+    # (MatchedFilterDesign.resolve_threshold_policy)
+    fac_vec, _ = design.resolve_threshold_policy(hf_factor)
+    factors = {name: float(f)
+               for name, f in zip(design.template_names, fac_vec)}
 
     for s in range(0, len(healthy_specs), batch):
         group = healthy_specs[s : s + batch]

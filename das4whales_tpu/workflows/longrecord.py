@@ -83,7 +83,7 @@ def detect_long_record(
     engine: str = "auto",
     interrogator: str = "optasense",
     relative_threshold: float = 0.5,
-    hf_factor: float = 0.9,
+    hf_factor: float | None = None,
     templates=None,
     bp_band=(14.0, 30.0),
     fk_config=None,
@@ -298,10 +298,18 @@ def detect_long_record(
         # block_until_ready did
         sp_picks, thres = dispatch_mod.launch(step, xd)
         names = design.template_names
-        thr_map_fn = lambda: {
-            name: float(thres) * (hf_factor if i == 0 else 1.0)
-            for i, name in enumerate(names)
-        }
+        # per-template factors — the SAME resolution the step factory
+        # ran (MatchedFilterDesign.resolve_threshold_policy); thres is
+        # the scalar pre-factor base under the global scope, the [nT]
+        # vector under the bank's per_template scope
+        fac, _ = design.resolve_threshold_policy(hf_factor)
+
+        def thr_map_fn():
+            base = np.broadcast_to(np.asarray(thres, np.float32), fac.shape)
+            return {
+                name: float(base[i]) * float(fac[i])
+                for i, name in enumerate(names)
+            }
         pos_scale = 1
     else:
         # shared front end (the spectro/gabor workflows' prologue):
@@ -361,9 +369,11 @@ def detect_long_record(
             # actual row count (meta_rec.nx is already post-selection) drives
             # the sharding validation. outputs='picks' keeps the full-record
             # correlograms out of the program outputs (campaign mode).
+            # the gabor family keeps its HF/LF-named legacy factor pair
+            hf_leg = 0.9 if hf_factor is None else float(hf_factor)
             step, names = make_sharded_gabor_step_time(
                 meta_rec, blocks[0].selection.to_list(), mesh,
-                relative_threshold=relative_threshold, hf_factor=hf_factor,
+                relative_threshold=relative_threshold, hf_factor=hf_leg,
                 max_peaks=max_peaks_per_channel, time_axis=time_axis,
                 n_channels=nnx, outputs="picks",
                 **fam_kw,
@@ -371,7 +381,7 @@ def detect_long_record(
             sp_picks, thres = dispatch_mod.launch(step, trf_dev)
             # deferred (fetched after the pick pack is dispatched)
             thr_map_fn = lambda: {
-                name: float(thres) * (hf_factor if name == "HF" else 1.0)
+                name: float(thres) * (hf_leg if name == "HF" else 1.0)
                 for name in names
             }
             pos_scale = 1

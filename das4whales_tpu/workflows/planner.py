@@ -183,6 +183,11 @@ class MatchedFilterProgram(DetectorProgram):
             getattr(detector, "supports_fused_health", False)
         )
         self.supports_dispatch = getattr(detector, "pick_mode", "") == "sparse"
+        if getattr(detector, "supports_bank_split", False):
+            # splittable template bank (models/templates.py): the ladder
+            # gains the bank-split rung — T/2 sub-bank dispatches before
+            # the route itself is sacrificed (faults.BANK_STAGE)
+            self.stages = ("file", "bank", "tiled", "timeshard", "host")
 
     def dispatch(self, trace, *, with_health=False, clip=None):
         if not self.supports_dispatch:
@@ -197,6 +202,24 @@ class MatchedFilterProgram(DetectorProgram):
 
         det = self.det
         stage = rung[0]
+        if stage == "bank":
+            # the bank-split rung: T/2 sub-bank views, two dispatches,
+            # merged per-file picks — bit-identical to the one-dispatch
+            # bank under the splittable per_template threshold scope
+            # (models.matched_filter.bank_view documents the exactness).
+            # Health stats describe the INPUT block — identical either
+            # half; computed once on the first.
+            picks, thresholds, stats = {}, {}, {}
+            for i, d in enumerate(det.split_views()):
+                res = d.detect_picks(
+                    jnp.asarray(trace), n_real=n_real,
+                    with_health=with_health and i == 0, health_clip=clip,
+                )
+                picks.update(res.picks)
+                thresholds.update(res.thresholds)
+                if i == 0:
+                    stats = res.health
+            return picks, thresholds, stats
         if stage == "timeshard":
             from ..parallel.timeshard import (
                 detect_picks_time_sharded,
@@ -341,6 +364,24 @@ class DownshiftLadder:
         self.engines = dict(engines or {})
         self._engines_by_key: Dict = {}
         self.sticky: Dict[tuple, tuple] = {}
+        # keys whose detector rides a SPLITTABLE template bank
+        # (models.templates.TemplateBank.splittable): only they get the
+        # interleaved bank-split rungs (faults.BANK_STAGE)
+        self._bank_keys: set = set()
+        self._bank_all = False
+
+    def enable_bank_split(self, key=None) -> None:
+        """Arm the bank-split rung for ``key`` (None: every key — the
+        unbatched planner, whose one program serves the whole run). The
+        campaign calls this per bucket once the bucket's detector proves
+        ``supports_bank_split``."""
+        if key is None:
+            self._bank_all = True
+        else:
+            self._bank_keys.add(key)
+
+    def bank_split_enabled(self, key=None) -> bool:
+        return self._bank_all or key in self._bank_keys
 
     def set_engines(self, key, labels) -> None:
         """Record ``key``'s own resolved engine labels (per-bucket shapes
@@ -351,14 +392,22 @@ class DownshiftLadder:
     def engines_for(self, key) -> Dict[str, str]:
         return self._engines_by_key.get(key, self.engines)
 
-    def rungs(self, trace_shape=None) -> list:
+    def rungs(self, trace_shape=None, key=None) -> list:
+        bank = self.bank_split_enabled(key)
         out = []
         if "batched" in self.stages:
             b = self.batch
             while b > 1:
                 out.append(("batched", b))
+                if bank:
+                    # sacrifice the T axis before B: the same batch as
+                    # two T/2 sub-bank dispatches (faults.rung_rank
+                    # interleaves bank:b between batched:b and b/2)
+                    out.append(("bank", b))
                 b //= 2
         out.append(("file", 1))
+        if bank:
+            out.append(("bank", 1))
         if "tiled" in self.stages:
             out.append(("tiled", 1))
         if ("timeshard" in self.stages and self.allow_timeshard
@@ -405,7 +454,7 @@ class DownshiftLadder:
         resource-class failure; returns the new rung, or None when the
         ladder is exhausted (the failure dispositions per-file)."""
         nxt = None
-        for cand in self.rungs(trace_shape):
+        for cand in self.rungs(trace_shape, key):
             if faults.rung_rank(cand) > faults.rung_rank(rung):
                 nxt = cand
                 break
@@ -458,6 +507,10 @@ class RoutePlanner:
             stages=program.stages, family=program.family,
             engines=program.engines,
         )
+        if "bank" in program.stages:
+            # one program serves the whole unbatched run: the splittable-
+            # bank capability holds for every ladder key
+            self.ladder.enable_bank_split()
 
     def current(self, key: str = "campaign") -> tuple:
         return self.ladder.current(key)

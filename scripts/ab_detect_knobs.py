@@ -80,7 +80,8 @@ def main():
             a, det._templates_true, det._template_mu, det._template_scale, tile
         )
         corr_s, corr_c, (corr_tiles, gmax) = timed(corr_fn, trf)
-        thr = jnp.asarray([0.45 * float(gmax), 0.5 * float(gmax)], jnp.float32)
+        g = float(jnp.max(gmax))   # per-template max vector -> global max
+        thr = jnp.asarray([0.45 * g, 0.5 * g], jnp.float32)
         env_s, env_c, _ = timed(mf_envelope_tiled, corr_tiles)
         row = {"tile": tile, "correlate_s": round(corr_s, 4),
                "envelope_only_s": round(env_s, 4)}

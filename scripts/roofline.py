@@ -231,14 +231,22 @@ def main():
                     help="correlate engine to model (ops/mxu.py routes)")
     ap.add_argument("--fk-engine", default="fft", choices=("fft", "matmul"),
                     help="f-k apply engine to model")
+    ap.add_argument("--templates", type=int, default=NT,
+                    help="template-bank size T: correlate/envelope/pick "
+                         "rows scale with it (the filter rows do not — "
+                         "filter-once/correlate-many, ops/xcorr+mxu)")
+    ap.add_argument("--taps", type=int, default=MF_TAPS,
+                    help="true template tap count of the matmul correlate")
     args = ap.parse_args()
 
     t1 = print_rows(
         model(fused=args.fused, mf_engine=args.mf_engine,
-              fk_engine=args.fk_engine),
-        C, N, "single v5e chip (per-file)",
+              fk_engine=args.fk_engine, nt=args.templates,
+              m_taps=args.taps),
+        C, N, f"single v5e chip (per-file, T={args.templates})",
     )
-    rows8, c_pad = model_sharded(args.chips, fused=args.fused)
+    rows8, c_pad = model_sharded(args.chips, fused=args.fused,
+                                 nt=args.templates)
     t8 = print_rows(
         rows8, c_pad, N,
         f"v5e-{args.chips} channel-sharded (per-chip, {c_pad // args.chips} "

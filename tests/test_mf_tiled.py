@@ -137,8 +137,13 @@ def test_correlate_tiled_matches_monolithic_and_masks_padding():
         )
     )
     np.testing.assert_allclose(got, untiled, atol=1e-6 * float(np.abs(golden).max()))
-    # gmax excludes the padded rows and matches the golden max
-    assert float(gmax) == pytest.approx(float(golden.max()), rel=1e-5)
+    # gmax is per-template, excludes the padded rows, and matches the
+    # golden per-template maxima (its fold = the reference global max)
+    assert gmax.shape == (nT,)
+    np.testing.assert_allclose(
+        np.asarray(gmax), golden.max(axis=(1, 2)), rtol=1e-5
+    )
+    assert float(jnp.max(gmax)) == pytest.approx(float(golden.max()), rel=1e-5)
 
 
 @pytest.mark.parametrize("pick_mode", ["sparse", "scipy"])
@@ -249,7 +254,8 @@ def test_device_compaction_matches_full_transfer_merge():
     corr_tiles, gmax = mf_correlate_tiled(
         trf_fk, det._templates_true, det._template_mu, det._template_scale, tile
     )
-    thr = jnp.asarray([0.45 * float(gmax), 0.35 * float(gmax)], jnp.float32)
+    g = float(jnp.max(gmax))   # per-template max vector -> global max
+    thr = jnp.asarray([0.45 * g, 0.35 * g], jnp.float32)
     sp = mf_pick_tiled(corr_tiles, thr, det.max_peaks)
     cap = nx * det.max_peaks
     chan, times, cnt = mf_compact_tiled_picks(sp.positions, sp.selected, nx, cap)

@@ -1,0 +1,556 @@
+"""Template-bank axis (ISSUE 10): B files x T templates in one dispatch.
+
+The contract pinned here:
+
+* the reference default is BIT-IDENTICAL to the pre-bank detector — the
+  "fin" bank derives exactly the legacy index-0-is-HF threshold-factor
+  vector, under the reference's global threshold scope;
+* bank parity — a one-dispatch T-template bank's picks equal sequential
+  per-sub-bank runs (``bank_view`` halves and singletons) bit-for-bit,
+  matrixed over correlate engines (fft/matmul) x wires x routes
+  (mono / tiled / batched at B in {1, 2, 4});
+* compile discipline — one compile per (bucket, B, T) shape: re-running
+  a warmed bank (and its warmed sub-bank views) triggers zero compiles;
+* the downshift ladder's BANK-SPLIT rung — T/2 sub-banks before B
+  shrinks — recovers an injected resource failure in both the planner's
+  per-file route and the batched campaign, with the manifest ledger
+  naming the ``bank:<B>`` rung; the AOT preflight can pin it up front;
+* the T-amortization sweep (``bench.bench_template_sweep``): one
+  dispatch + one packed fetch per call regardless of T, picks identical
+  to the sequential route at every T.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from das4whales_tpu import faults
+from das4whales_tpu.config import (
+    FIN_HF_NOTE,
+    FIN_LF_NOTE,
+    AcquisitionMetadata,
+    CallTemplateConfig,
+)
+from das4whales_tpu.models import templates as T
+from das4whales_tpu.models.matched_filter import (
+    HF_FACTOR,
+    MatchedFilterDetector,
+    reference_threshold_factors,
+)
+from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+
+NX, NS = 24, 900
+FS, DX = 200.0, 2.042
+SEL = [0, NX, 1]
+META = AcquisitionMetadata(fs=FS, dx=DX, nx=NX, ns=NS, scale_factor=1e-3)
+
+BANK4 = T.chirp_grid(4, band=(14.0, 30.0), durations=(0.6,))
+
+
+def _block(seed=0, amplitude=2.0):
+    """Noise block with one injected fin-like chirp (float32 strain)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 0.05, (NX, NS)).astype(np.float32)
+    c = np.asarray(T.gen_template_fincall(
+        np.arange(NS) / FS, FS, 17.8, 28.8, 0.68
+    ))
+    x[NX // 2] += amplitude * np.roll(c, 220)
+    return x
+
+
+def _as_wire(block, wire):
+    """The block as the requested wire carries it (raw: int16 counts at
+    META's scale_factor)."""
+    if wire == "raw":
+        return np.round(block / META.scale_factor).astype(np.int16)
+    return block
+
+
+def _det(wire="conditioned", templates=None, tile=None, **kw):
+    return MatchedFilterDetector(
+        META, SEL, (NX, NS), wire=wire, templates=templates,
+        pick_mode="sparse", keep_correlograms=False, channel_tile=tile,
+        **kw,
+    )
+
+
+def _assert_same_picks(a_picks, a_thr, b_picks, b_thr, thr_exact=True):
+    """Pick arrays must match BITWISE in every case. Thresholds are
+    bitwise on the FFT engine; the matmul engine's raw conv may round
+    differently as the out-channel (template) dim changes with T — XLA
+    blocks the widened contraction differently — so sub-bank threshold
+    bases are ulp-close there (``thr_exact=False``), never program-
+    visibly different (models.matched_filter.bank_view)."""
+    assert set(a_picks) == set(b_picks)
+    total = 0
+    for name in a_picks:
+        np.testing.assert_array_equal(a_picks[name], b_picks[name])
+        if thr_exact:
+            assert a_thr[name] == b_thr[name]
+        else:
+            assert a_thr[name] == pytest.approx(b_thr[name], rel=1e-6)
+        total += a_picks[name].shape[1]
+    assert total > 0, "parity over an empty pick set proves nothing"
+
+
+# ---------------------------------------------------------------------------
+# The bank registry and the reference-default pin
+# ---------------------------------------------------------------------------
+
+
+def test_fin_bank_is_the_legacy_reference_default():
+    """Satellite 1: the per-template factors moved into
+    CallTemplateConfig; the default bank derives EXACTLY the legacy
+    index-0-is-HF vector and the global scope, so reference picks are
+    unchanged by construction."""
+    fin = T.get_bank("fin")
+    assert fin.threshold_scope == "global"
+    assert fin.names == ("HF", "LF")
+    assert FIN_HF_NOTE.threshold_factor == HF_FACTOR == 0.9
+    assert FIN_LF_NOTE.threshold_factor == 1.0
+    np.testing.assert_array_equal(
+        fin.threshold_factors(), np.asarray(reference_threshold_factors(2))
+    )
+    assert not fin.splittable   # global scope: sub-banks change picks
+
+    # a detector built with templates=None vs the explicit legacy dict:
+    # identical bank, identical design, identical picks
+    d0 = _det()
+    d1 = _det(templates={"HF": FIN_HF_NOTE, "LF": FIN_LF_NOTE})
+    assert d0.bank.name == "fin" and d0.threshold_scope == "global"
+    np.testing.assert_array_equal(d0.design.templates, d1.design.templates)
+    np.testing.assert_array_equal(
+        d0.design.threshold_factors, d1.design.threshold_factors
+    )
+    x = jnp.asarray(_block())
+    r0, r1 = d0.detect_picks(x), d1.detect_picks(x)
+    _assert_same_picks(r0.picks, r0.thresholds, r1.picks, r1.thresholds)
+
+
+def test_registry_and_chirp_grid():
+    assert {"fin", "fin-variants", "blue"} <= set(T.bank_names())
+    with pytest.raises(KeyError):
+        T.get_bank("nope")
+    g = T.get_bank("chirp-grid:6:15-28:0.5,0.8")
+    assert len(g) == 6 and g.threshold_scope == "per_template"
+    # deterministic entry names carry method/band/duration — a T=32
+    # saturation warning names the culprit template, never an index
+    assert len(set(g.names)) == 6
+    assert all(n.startswith("chirp-hyp-") for n in g.names)
+    assert T.get_bank("chirp-grid:6:15-28:0.5,0.8").names == g.names
+
+    a, b = BANK4.split()
+    assert a.names == BANK4.names[:2] and b.names == BANK4.names[2:]
+    assert BANK4.subset(1, 3).names == BANK4.names[1:3]
+    with pytest.raises(ValueError):
+        BANK4.subset(3, 2)
+    with pytest.raises(ValueError):
+        T.TemplateBank(name="x", entries=())
+    with pytest.raises(ValueError):
+        T.TemplateBank(
+            name="x", threshold_scope="nope",
+            entries=(("a", FIN_HF_NOTE),),
+        )
+    with pytest.raises(ValueError):
+        T.TemplateBank(
+            name="x", entries=(("a", FIN_HF_NOTE), ("a", FIN_LF_NOTE)),
+        )
+
+
+def test_bank_env_resolution(monkeypatch):
+    monkeypatch.setenv("DAS_TEMPLATE_BANK", "blue")
+    assert T.resolve_bank(None).name == "blue"
+    monkeypatch.setenv("DAS_TEMPLATE_BANK", "chirp-grid:3")
+    assert len(T.resolve_bank(None)) == 3
+    monkeypatch.delenv("DAS_TEMPLATE_BANK")
+    assert T.resolve_bank(None).name == "fin"
+    assert T.resolve_bank(BANK4) is BANK4
+    legacy = T.resolve_bank({"HF": FIN_HF_NOTE})
+    assert legacy.threshold_scope == "global" and legacy.name == "custom"
+    with pytest.raises(TypeError):
+        T.resolve_bank(42)
+
+
+def test_saturation_warning_names_bank_entry():
+    det = _det(templates=BANK4)
+    with pytest.warns(UserWarning, match=r"chirp-grid-4/chirp-hyp-14"):
+        det._warn_saturated(det.bank.names[0], 3)
+
+
+# ---------------------------------------------------------------------------
+# Bank parity: one dispatch == sequential sub-bank runs (bit-identical)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine,wire,route", [
+    # engines x wires on the mono route; the tiled route (its own
+    # compiled programs) x engines once on the conditioned wire — the
+    # wire is orthogonal to tiling (a conditioning prologue ahead of an
+    # unchanged correlate), so the full cross adds compiles, not
+    # coverage
+    ("fft", "conditioned", "mono"),
+    ("fft", "raw", "mono"),
+    ("matmul", "conditioned", "mono"),
+    ("matmul", "raw", "mono"),
+    ("fft", "conditioned", "tiled"),
+    ("matmul", "conditioned", "tiled"),
+])
+def test_bank_parity_unbatched(wire, engine, route):
+    """One-dispatch T=4 picks == the union of sequential sub-bank runs
+    (halves AND singletons), bit-identical, on both correlate engines,
+    both wires, monolithic and channel-tiled."""
+    det = _det(wire=wire, templates=BANK4, mf_engine=engine,
+               tile=8 if route == "tiled" else None)
+    x = jnp.asarray(_as_wire(_block(), wire))
+    full = det.detect_picks(x)
+    assert set(full.picks) == set(BANK4.names)
+
+    # halves everywhere; T=1 singletons on one representative config
+    # (each extra T is a fresh compile per (engine, wire, route) combo —
+    # the T=1 shape is already certified by the bench sweep test)
+    splits = [[det.bank_view(0, 2), det.bank_view(2, 4)]]
+    if engine == "fft" and route == "mono":
+        splits.append([det.bank_view(i, i + 1) for i in range(4)])
+    for views in splits:
+        picks, thr = {}, {}
+        for v in views:
+            r = v.detect_picks(x)
+            picks.update(r.picks)
+            thr.update(r.thresholds)
+        _assert_same_picks(full.picks, full.thresholds, picks, thr,
+                           thr_exact=engine == "fft")
+
+
+@pytest.mark.parametrize("wire", ["conditioned", "raw"])
+def test_bank_parity_batched(wire):
+    """The batched slab route at B in {1, 2, 4}: one-dispatch T=4 bank
+    picks per file == the unbatched bank run, bit-identical; the
+    sub-bank-SPLIT batched run matches at B=2 (one facade serves every
+    B, so the split program compiles once)."""
+    det = _det(wire=wire, templates=BANK4)
+    bdet = BatchedMatchedFilterDetector(det, donate=False)
+    blocks = [_as_wire(_block(seed=k), wire) for k in range(4)]
+    refs = [det.detect_picks(jnp.asarray(b)) for b in blocks]
+    for B in (1, 2, 4):
+        stack = jnp.asarray(np.stack(blocks[:B]))
+        batched = bdet.detect_batch(stack)
+        for k in range(B):
+            _assert_same_picks(refs[k].picks, refs[k].thresholds,
+                               batched[k][0], batched[k][1])
+    stack2 = jnp.asarray(np.stack(blocks[:2]))
+    ha, hb = bdet.split_views()
+    split_a, split_b = ha.detect_batch(stack2), hb.detect_batch(stack2)
+    for k in range(2):
+        merged = {**split_a[k][0], **split_b[k][0]}
+        merged_thr = {**split_a[k][1], **split_b[k][1]}
+        _assert_same_picks(refs[k].picks, refs[k].thresholds,
+                           merged, merged_thr)
+
+
+@pytest.mark.parametrize("engine", ["fft", "matmul"])
+def test_bank_parity_batched_engines(engine):
+    """Engine x batched spot of the matrix: the matmul correlate's
+    [tap, template] contraction simply widens with T — batched bank
+    picks stay bit-identical to the unbatched run under either engine."""
+    det = _det(templates=BANK4, mf_engine=engine)
+    bdet = BatchedMatchedFilterDetector(det, donate=False)
+    blocks = [_block(seed=k) for k in range(2)]
+    out = bdet.detect_batch(jnp.asarray(np.stack(blocks)))
+    for k in range(2):
+        ref = det.detect_picks(jnp.asarray(blocks[k]))
+        _assert_same_picks(ref.picks, ref.thresholds, out[k][0], out[k][1])
+
+
+def test_compile_guard_one_compile_per_T(compile_guard):
+    """<= 1 compile per (bucket, B, T): a warmed T=4 bank program, its
+    warmed T=2 sub-bank views and a warmed batched B=2 slab all re-run
+    with ZERO fresh XLA compiles."""
+    det = _det(templates=BANK4)
+    bdet = BatchedMatchedFilterDetector(det, donate=False)
+    x = jnp.asarray(_block())
+    stack = jnp.asarray(np.stack([_block(0), _block(1)]))
+    views = det.split_views()
+    det.detect_picks(x)                       # warm T=4 @ B=1
+    for v in views:
+        v.detect_picks(x)                     # warm T=2 @ B=1 (one shape)
+    bdet.detect_batch(stack)                  # warm T=4 @ B=2
+    with compile_guard.forbid_recompile(
+        "warmed (bucket, B, T) shapes must not recompile"
+    ):
+        det.detect_picks(x)
+        for v in views:
+            v.detect_picks(x)
+        bdet.detect_batch(stack)
+
+
+# ---------------------------------------------------------------------------
+# The downshift ladder's bank-split rung
+# ---------------------------------------------------------------------------
+
+
+def test_rung_vocabulary_interleaves_bank():
+    assert faults.rung_label(("bank", 4)) == "bank:4"
+    assert faults.rung_label(("bank", 1)) == "bank"
+    order = [("batched", 4), ("bank", 4), ("batched", 2), ("bank", 2),
+             ("file", 1), ("bank", 1), ("tiled", 1), ("timeshard", 1),
+             ("host", 1)]
+    ranks = [faults.rung_rank(r) for r in order]
+    assert ranks == sorted(ranks)
+
+
+def test_planner_bank_rung_and_drill(tmp_path):
+    """The per-file planner: the bank rung's merged sub-bank picks equal
+    the one-dispatch bank's; an injected resource failure at the file
+    rung lands on ``bank`` (sticky, family-ledgered) and recovers."""
+    from das4whales_tpu.workflows.campaign import _Resilience
+    from das4whales_tpu.workflows.planner import (
+        MatchedFilterProgram,
+        RoutePlanner,
+    )
+
+    det = _det(templates=BANK4)
+    prog = MatchedFilterProgram(det)
+    assert "bank" in prog.stages
+    assert "bank" not in MatchedFilterProgram(_det()).stages  # global scope
+
+    block = _block()
+    ref = det.detect_picks(jnp.asarray(block))
+    picks, thr, _ = prog.detect(("bank", 1), block)
+    _assert_same_picks(ref.picks, ref.thresholds, picks, thr)
+
+    # drill: the file rung exhausts; the ladder must stop at bank
+    class OOMAtFile(MatchedFilterProgram):
+        def detect(self, rung, trace, **kw):
+            if rung[0] == "file":
+                raise faults.InjectedResourceExhausted(
+                    "injected: full-bank program exhausts HBM"
+                )
+            return super().detect(rung, trace, **kw)
+
+    outdir = str(tmp_path / "drill")
+    import os
+
+    os.makedirs(outdir)
+    records = []
+    rz = _Resilience(outdir, records, None, retry=False, health=False)
+    route = RoutePlanner(rz, outdir, OOMAtFile(det))
+    picks, thr, _, rung = route.run_file("f0", block)
+    assert rung == ("bank", 1)
+    assert route.ladder.current("campaign") == ("bank", 1)   # sticky
+    _assert_same_picks(ref.picks, ref.thresholds, picks, thr)
+    assert rz.tallies["downshifts"] == 1
+    assert rz.tallies["oom_recoveries"] == 1
+
+
+def _write_bank_files(tmp_path, n, stem="f"):
+    from das4whales_tpu.io.synth import (
+        SyntheticCall,
+        SyntheticScene,
+        write_synthetic_file,
+    )
+
+    paths = []
+    for k in range(n):
+        scene = SyntheticScene(
+            nx=NX, ns=NS, noise_rms=0.05, seed=k,
+            calls=[SyntheticCall(t0=1.2 + 0.3 * k, x0_m=NX / 2 * DX,
+                                 amplitude=2.0)],
+        )
+        p = str(tmp_path / f"{stem}{k}.h5")
+        write_synthetic_file(p, scene)
+        paths.append(p)
+    return paths
+
+
+def test_batched_campaign_bank_split_rung(tmp_path, monkeypatch):
+    """A batched campaign whose FULL-bank slab program always exhausts
+    resources downshifts to the bank-split rung (T/2 sub-banks at the
+    SAME B — the T axis is sacrificed before B), completes every file
+    with picks bit-identical to the healthy campaign, and ledgers the
+    move as ``batched:2 -> bank:2``."""
+    from das4whales_tpu.workflows.campaign import (
+        load_picks,
+        run_campaign_batched,
+    )
+
+    paths = _write_bank_files(tmp_path, 4)
+    healthy = run_campaign_batched(
+        paths, SEL, str(tmp_path / "healthy"), batch=2, bucket="exact",
+        persistent_cache=False, dispatch_depth=1, templates=BANK4,
+        health=False,
+    )
+    assert healthy.n_done == 4
+
+    real = BatchedMatchedFilterDetector.detect_batch
+
+    def oom_full_bank(self, *a, **kw):
+        if self.det.design.templates.shape[0] == len(BANK4):
+            raise faults.InjectedResourceExhausted(
+                "injected: full-bank slab program exhausts HBM"
+            )
+        return real(self, *a, **kw)
+
+    monkeypatch.setattr(BatchedMatchedFilterDetector, "detect_batch",
+                        oom_full_bank)
+    res = run_campaign_batched(
+        paths, SEL, str(tmp_path / "split"), batch=2, bucket="exact",
+        persistent_cache=False, dispatch_depth=1, templates=BANK4,
+        resume=False, health=False,
+    )
+    assert res.n_done == 4 and res.n_failed == 0
+    from das4whales_tpu.workflows.campaign import summarize_campaign
+
+    summary = summarize_campaign(str(tmp_path / "split"))
+    ledger = summary["downshift_ledger"]
+    assert ledger and ledger[0]["from"] == "batched:2"
+    assert ledger[0]["to"] == "bank:2"
+    assert {r.rung for r in res.records if r.status == "done"} == {"bank:2"}
+    for h, s in zip(healthy.records, res.records):
+        assert h.path == s.path
+        a, b = load_picks(h.picks_file), load_picks(s.picks_file)
+        assert set(a) == set(b)
+        for name in a:
+            np.testing.assert_array_equal(a[name], b[name])
+
+
+def test_preflight_pins_bank_rung(tmp_path, monkeypatch):
+    """The AOT memory preflight prices the T axis: when the full-bank
+    program is over budget but the T/2 sub-bank fits, the bucket starts
+    AT the bank-split rung — no dispatch ever OOMs."""
+    from das4whales_tpu.utils import memory as memutils
+    from das4whales_tpu.workflows.campaign import run_campaign_batched
+
+    def fake_price(bdet, b_, dt, **kw):
+        nT = bdet.det.design.templates.shape[0]
+        peak = (100 if nT == len(BANK4) else 10) * 2**20
+        return memutils.MemoryStats(
+            temp_bytes=peak, output_bytes=0, argument_bytes=0,
+            generated_code_bytes=0,
+        )
+
+    monkeypatch.setattr(memutils, "batched_program_memory", fake_price)
+    monkeypatch.setenv("DAS_HBM_BUDGET_GB", str(50 / 1024))   # 50 MiB
+    paths = _write_bank_files(tmp_path, 2)
+    res = run_campaign_batched(
+        paths, SEL, str(tmp_path / "pre"), batch=2, bucket="exact",
+        persistent_cache=False, dispatch_depth=1, templates=BANK4,
+        preflight=True, health=False,
+    )
+    assert res.n_done == 2
+    assert {r.rung for r in res.records if r.status == "done"} == {"bank:2"}
+    from das4whales_tpu.workflows.campaign import summarize_campaign
+
+    ledger = summarize_campaign(str(tmp_path / "pre"))["downshift_ledger"]
+    assert ledger and ledger[0].get("preflight") and ledger[0]["to"] == "bank:2"
+
+
+def test_preflight_prices_T_axis():
+    """Real pricing (no fakes): the T/2 sub-bank program's peak is
+    strictly below the full T=4 bank's at the same (bucket, B)."""
+    from das4whales_tpu.utils import memory as memutils
+
+    det = _det(templates=BANK4)
+    bdet = BatchedMatchedFilterDetector(det, donate=False)
+    full = memutils.batched_program_memory(bdet, 2, np.float32)
+    if full is None:
+        pytest.skip("memory_analysis unsupported on this backend")
+    half = memutils.batched_program_memory(
+        bdet.split_views()[0], 2, np.float32
+    )
+    assert half is not None and half.peak < full.peak
+
+
+def test_bank_view_regates_bf16(monkeypatch):
+    """A sub-bank view whose parent rode the precision-gated bf16
+    engine must RE-RESOLVE (the gate verdict is content-keyed — a T/2
+    slice is different content; docs/PRECISION.md); f32 engines are
+    inherited without a re-resolve."""
+    from das4whales_tpu.ops import mxu
+
+    det = _det(templates=BANK4, mf_engine="fft")
+    calls = []
+
+    def spy(requested, shape, tt, mu, sc, **kw):
+        calls.append((requested, np.atleast_2d(np.asarray(tt)).shape[0]))
+        return "matmul", "re-gated: bf16 ineligible on the sliced bank"
+
+    monkeypatch.setattr(mxu, "resolve_mf_engine", spy)
+    assert det.bank_view(0, 2).mf_engine == "fft"   # f32: inherited
+    assert not calls
+    det.__dict__.pop("_bank_view_cache", None)
+    det.mf_engine = "matmul-bf16"
+    det._mf_engine_requested = "matmul-bf16"
+    v = det.bank_view(0, 2)
+    assert calls == [("matmul-bf16", 2)]            # sliced T=2 triple
+    assert v.mf_engine == "matmul"
+    assert "re-gated" in v.mf_engine_reason
+
+
+def test_sharded_step_honors_per_template_scope():
+    """The channel-sharded SPMD step decouples per a splittable bank's
+    scope: the threshold base comes out ``[nT, B]`` (per-template maxima
+    under pmax) and matches the single-chip per-template thresholds —
+    not silently re-coupled through the file-global max."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import jax
+
+    from das4whales_tpu.models.matched_filter import design_matched_filter
+    from das4whales_tpu.parallel import make_mesh
+    from das4whales_tpu.parallel.pipeline import make_sharded_mf_step
+
+    nx, ns = 32, 1024
+    meta = AcquisitionMetadata(fs=FS, dx=DX, nx=nx, ns=ns)
+    rng = np.random.default_rng(3)
+    blocks = np.stack([
+        rng.normal(0, 0.05, (nx, ns)).astype(np.float32) for _ in range(2)
+    ])
+    mesh = make_mesh(shape=(2, 4), axis_names=("file", "channel"))
+    design = design_matched_filter((nx, ns), [0, nx, 1], meta,
+                                   templates=BANK4)
+    assert design.threshold_scope == "per_template"
+    step = make_sharded_mf_step(design, mesh, outputs="picks")
+    xb = jax.device_put(
+        blocks, NamedSharding(mesh, P("file", "channel", None))
+    )
+    _, thres = jax.block_until_ready(step(xb))
+    thres = np.asarray(thres)
+    assert thres.shape == (len(BANK4), 2)
+    det = MatchedFilterDetector(meta, [0, nx, 1], (nx, ns), templates=BANK4,
+                                pick_mode="sparse", keep_correlograms=False)
+    fac = np.asarray(design.threshold_factors)
+    for k in range(2):
+        ref = det.detect_picks(jnp.asarray(blocks[k])).thresholds
+        for i, name in enumerate(design.template_names):
+            assert float(thres[i, k]) * float(fac[i]) == pytest.approx(
+                ref[name], rel=1e-4
+            )
+
+
+# ---------------------------------------------------------------------------
+# T-amortization sweep (the bench acceptance harness, quick sizes)
+# ---------------------------------------------------------------------------
+
+
+def test_template_sweep_structure_and_parity():
+    """``bench.bench_template_sweep``: ONE dispatch + one packed fetch
+    per call regardless of T, vs T of each on the sequential route, and
+    picks bit-identical at every T. (The <= 0.35 wall ratio at T=8 is a
+    TPU acceptance number — on CPU both routes are compute-bound and
+    the ratio is ~1; the dispatch counts pin the structure that yields
+    it.)"""
+    import bench
+
+    block = _block()
+    out = bench.bench_template_sweep(
+        META, NX, NS, block, "conditioned", repeats=1, sizes=(2, 4)
+    )
+    for t in ("2", "4"):
+        row = out[t]
+        assert row["picks_identical"]
+        assert row["bank_dispatches"] == 1.0
+        assert row["sequential_dispatches"] == int(t)
+        assert row["ratio"] > 0
