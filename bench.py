@@ -304,13 +304,15 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
     # host-conditioned float32 wire.
     if wire is None:
         wire = os.environ.get("DAS_BENCH_WIRE", "raw")
-    from das4whales_tpu import faults
+    from das4whales_tpu.telemetry import metrics as tmetrics
 
     # resilience attribution (ISSUE 4): snapshot the process-wide
     # counters around the measured run so any retry/degradation/
     # quarantine overhead on the hot path is VISIBLE in the payload next
-    # to the headline (a healthy bench reports zeros — that is the claim)
-    resilience_before = faults.counters()
+    # to the headline (a healthy bench reports zeros — that is the
+    # claim). ISSUE 11: read through the telemetry metrics registry view
+    # (the faults.counters storage — same keys, same values)
+    resilience_before = tmetrics.resilience_counters()
     meta = AcquisitionMetadata(fs=fs, dx=dx, nx=nx, ns=ns,
                                scale_factor=BENCH_SCALE)
     det = MatchedFilterDetector(
@@ -374,12 +376,12 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
     # route: exactly 1 dispatch + 1 sync per file (an adaptive-K
     # escalation adds one pair; the staged route reports zeros — its
     # syncs are uncounted block_until_ready, which is itself the finding)
-    seg_before = faults.counters()
+    seg_before = tmetrics.resilience_counters()
     for _ in range(repeats):
         t0 = time.perf_counter()
         res = run()
         times.append(time.perf_counter() - t0)
-    seg = faults.counters_delta(seg_before)
+    seg = tmetrics.resilience_delta(seg_before)
     n_picks = sum(int(v.shape[1]) for v in res.picks.values())
     stages = bench_stages(det, x, repeats=repeats) if with_stages else {}
     # h2d rides in the stage table even on no-stage rungs: the acceptance
@@ -429,7 +431,7 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
         batch_info = dict(batch_info, bank_sweep=bench_template_sweep(
             meta, nx, ns, block, wire, repeats
         ))
-    delta = faults.counters_delta(resilience_before)
+    delta = tmetrics.resilience_delta(resilience_before)
     resilience = {"retries": delta["retries"],
                   "degradations": delta["degradations"],
                   "quarantined": delta["quarantined"],
@@ -476,17 +478,17 @@ def _bench_batch(meta, nx, ns, block, wire, peak_block, channel_tile,
     )
     bdet = BatchedMatchedFilterDetector(det, donate=False)  # stack reused
 
-    from das4whales_tpu import faults as _faults
+    from das4whales_tpu.telemetry import metrics as _tmetrics
 
     def best(fn):
         fn()  # compile + warm
         walls = []
-        before = _faults.counters()
+        before = _tmetrics.resilience_counters()
         for _ in range(repeats):
             t0 = time.perf_counter()
             fn()  # one-program routes return host picks: the fetch IS the sync
             walls.append(time.perf_counter() - t0)
-        delta = _faults.counters_delta(before)
+        delta = _tmetrics.resilience_delta(before)
         # per measured call: the batched segment's dispatch/sync budget
         # (healthy: 1 dispatch + 1 sync per SLAB, however many files ride it)
         return min(walls), (round(delta.get("dispatches", 0) / repeats, 2),
@@ -538,9 +540,9 @@ def bench_template_sweep(meta, nx, ns, block, wire, repeats=3,
     import jax
     import jax.numpy as jnp
 
-    from das4whales_tpu import faults
     from das4whales_tpu.models.matched_filter import MatchedFilterDetector
     from das4whales_tpu.models.templates import chirp_grid
+    from das4whales_tpu.telemetry import metrics as _tmetrics
 
     x = jax.block_until_ready(jnp.asarray(block))
     out = {}
@@ -554,12 +556,12 @@ def bench_template_sweep(meta, nx, ns, block, wire, repeats=3,
         def best(fn):
             fn()  # compile + warm
             walls = []
-            before = faults.counters()
+            before = _tmetrics.resilience_counters()
             for _ in range(max(1, repeats)):
                 t0 = time.perf_counter()
                 fn()  # one-program route: the packed fetch IS the sync
                 walls.append(time.perf_counter() - t0)
-            delta = faults.counters_delta(before)
+            delta = _tmetrics.resilience_delta(before)
             return min(walls), round(
                 delta.get("dispatches", 0) / max(1, repeats), 2
             )
@@ -571,13 +573,13 @@ def bench_template_sweep(meta, nx, ns, block, wire, repeats=3,
         views = [det.bank_view(i, i + 1) for i in range(int(t))]
         views[0].detect_picks(x)   # the shared compile
         seq_wall, seq_picks = 0.0, {}
-        seq_before = faults.counters()
+        seq_before = _tmetrics.resilience_counters()
         for v in views:
             t0 = time.perf_counter()
             r = v.detect_picks(x)
             seq_wall += time.perf_counter() - t0
             seq_picks.update(r.picks)
-        seq_disp = faults.counters_delta(seq_before).get("dispatches", 0)
+        seq_disp = _tmetrics.resilience_delta(seq_before).get("dispatches", 0)
         identical = set(seq_picks) == set(res_bank.picks) and all(
             np.array_equal(seq_picks[k], res_bank.picks[k])
             for k in res_bank.picks
@@ -613,19 +615,19 @@ def bench_stages(det, x, repeats=3):
         mf_envelope_tiled,
         mf_pick_tiled,
     )
+    from das4whales_tpu.telemetry import trace as telemetry
     from das4whales_tpu.ops import peaks as peak_ops
     from das4whales_tpu.ops import spectral
 
     nT = det.design.templates.shape[0]
 
-    def timed(fn, *args):
-        out = jax.block_until_ready(fn(*args))  # compile + warm
-        best = float("inf")
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            jax.block_until_ready(fn(*args))
-            best = min(best, time.perf_counter() - t0)
-        return best, out
+    def timed(fn, *args, name="stage"):
+        # THE timing definition (telemetry.trace.timed_best, ISSUE 11):
+        # warm + best-of-N with the result blocked; each measured repeat
+        # is a "bench.<stage>" span, so a DAS_TRACE=1 bench run leaves
+        # the stage walls on the trace timeline too
+        return telemetry.timed_best(fn, *args, repeats=repeats,
+                                    name=f"bench.{name}")
 
     def host_peaks_fn(env, thr):
         """The scipy engine's timed unit: device->host envelope copy + the
@@ -643,11 +645,12 @@ def bench_stages(det, x, repeats=3):
     # against a 6.5 ms roofline bound, i.e. ~0.27 s of pure sync), so the
     # payload carries it for stage-wall interpretation
     one = jnp.ones((8,), jnp.float32)  # not x.dtype: the raw wire is int16
-    stages["sync_overhead"], _ = timed(jax.jit(lambda a: a + 1.0), one)
+    stages["sync_overhead"], _ = timed(jax.jit(lambda a: a + 1.0), one,
+                                       name="sync_overhead")
 
     # the detector's own filter program (covers the staged, fused-bandpass
     # and channel-padded routes uniformly)
-    stages["filter"], trf = timed(det.filter_block, x)
+    stages["filter"], trf = timed(det.filter_block, x, name="filter")
 
     if det._route() == "tiled":
         tile = det.effective_channel_tile
@@ -659,7 +662,8 @@ def bench_stages(det, x, repeats=3):
             a, det._templates_true, det._template_mu, det._template_scale,
             tile, det.mf_engine,
         )
-        stages["correlate"], (corr_tiles, gmax) = timed(corr_fn, trf)
+        stages["correlate"], (corr_tiles, gmax) = timed(corr_fn, trf,
+                                                        name="correlate")
         # gmax is the per-template max vector (bank threshold policy);
         # its fold is the reference global max
         thres = 0.5 * float(jnp.max(gmax))
@@ -674,7 +678,8 @@ def bench_stages(det, x, repeats=3):
                 ),
                 det.pick_k0, det.max_peaks,
             )
-            stages["envelope+peaks"], _ = timed(pick_fn, corr_tiles, thr)
+            stages["envelope+peaks"], _ = timed(pick_fn, corr_tiles, thr,
+                                                name="envelope+peaks")
         else:  # scipy/dense engines untile the envelope (matched_filter._call_tiled)
             C = trf.shape[0]
 
@@ -685,10 +690,12 @@ def bench_stages(det, x, repeats=3):
                     nT, -1, trf.shape[1]
                 )[:, :C]
 
-            stages["envelope"], env_full = timed(env_untiled, corr_tiles)
+            stages["envelope"], env_full = timed(env_untiled, corr_tiles,
+                                                 name="envelope")
             peaks_fn = (host_peaks_fn if det.pick_mode == "scipy"
                         else _dense_peaks_fn(det, peak_ops))
-            stages["peaks"], _ = timed(peaks_fn, env_full, np.asarray(thr))
+            stages["peaks"], _ = timed(peaks_fn, env_full, np.asarray(thr),
+                                       name="peaks")
     else:
         from das4whales_tpu.ops import mxu
 
@@ -715,12 +722,12 @@ def bench_stages(det, x, repeats=3):
                 for i in range(env.shape[0])
             ]
 
-        stages["correlate"], corr = timed(corr_fn, trf)
-        stages["envelope"], env = timed(env_fn, corr)
+        stages["correlate"], corr = timed(corr_fn, trf, name="correlate")
+        stages["envelope"], env = timed(env_fn, corr, name="envelope")
         thr = jnp.full((env.shape[0],), 0.5 * float(jnp.max(corr)))
         peaks_fn = {"sparse": sparse_peaks_fn, "scipy": host_peaks_fn,
                     "dense": _dense_peaks_fn(det, peak_ops)}[det.pick_mode]
-        stages["peaks"], _ = timed(peaks_fn, env, thr)
+        stages["peaks"], _ = timed(peaks_fn, env, thr, name="peaks")
     stages.update(_engine_ab_stages(det, x, trf, timed))
     return {k: round(v, 4) for k, v in stages.items()}
 
@@ -760,7 +767,7 @@ def _engine_ab_stages(det, x, trf, timed):
                 a, det._templates_true, det._template_mu,
                 det._template_scale, e,
             ))
-        stages[f"correlate[{eng}]"], _ = timed(fn, trf)
+        stages[f"correlate[{eng}]"], _ = timed(fn, trf, name=f"correlate[{eng}]")
     if det._fk_dft_dev is not None:
         cond = det.condition_input(x)
         for eng in ("fft", "matmul"):
@@ -777,7 +784,7 @@ def _engine_ab_stages(det, x, trf, timed):
                     pad_rows=det.fk_pad_rows, fk_engine=e,
                     fk_dft=det._fk_dft_dev,
                 )
-            stages[f"filter[{eng}]"], _ = timed(fn, cond)
+            stages[f"filter[{eng}]"], _ = timed(fn, cond, name=f"filter[{eng}]")
     return stages
 
 

@@ -81,6 +81,13 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("--channels", default=None,
                     help="start,stop,step channel selection (default: all of file 0)")
     pc.add_argument("--max-failures", type=int, default=None)
+    pc.add_argument("--trace", action="store_true", default=None,
+                    help="arm the flight recorder: span-trace the campaign "
+                         "and export <outdir>/trace.json "
+                         "(Perfetto/Chrome-trace; same as DAS_TRACE=1 — "
+                         "docs/OBSERVABILITY.md). Single-chip campaigns "
+                         "only; ignored with a warning under "
+                         "--sharded/--multihost")
     pc.add_argument("--no-resume", action="store_true",
                     help="reprocess files already recorded done in the manifest")
     pc.add_argument("--interrogator", default="optasense")
@@ -384,6 +391,13 @@ def main(argv=None) -> int:
                 detector = campaign_detector(meta0, sel,
                                              fused_bandpass=args.fused)
         try:
+            if args.trace and (args.multihost or args.sharded):
+                # the flight recorder covers the single-chip runners
+                # today — say so instead of silently dropping the flag
+                print("campaign: --trace covers single-chip campaigns "
+                      "only; proceeding WITHOUT a trace (use the "
+                      "single-chip runner, or DAS_TRACE=1 for raw spans "
+                      "without the trace.json export)")
             if args.multihost:
                 if detector is not None:
                     print("campaign: --multihost supports the mf family only")
@@ -420,7 +434,8 @@ def main(argv=None) -> int:
                 res = run_campaign(
                     args.files, sel, args.outdir, detector=detector,
                     resume=not args.no_resume, max_failures=args.max_failures,
-                    interrogator=args.interrogator, **kwargs,
+                    interrogator=args.interrogator, trace=args.trace,
+                    **kwargs,
                 )
         except CampaignAborted as exc:
             print(f"campaign aborted: {exc} (progress kept in {args.outdir})")

@@ -46,6 +46,17 @@ R6  sync-in-loop — HOST-side device syncs inside a ``for``/``while``
     layer (``parallel.dispatch``) exists so hot loops never need one.
     Intentional sites (a drain point, a scalar decision the host must
     make) are baselined with a reason.
+R7  unblocked timing — a ``time.perf_counter()`` bracket (``t0 =
+    time.perf_counter()`` … ``time.perf_counter() - t0``) enclosing a
+    dispatch-suspect call with NO sync (``jax.block_until_ready`` /
+    ``jax.device_get`` / a counted ``fetch``/``sync`` / ``np.asarray``
+    / ``.item()`` / an in-flight ``.resolve()``) between the two clock
+    reads. JAX dispatch is async: such a wall times the LAUNCH, not the
+    work — the number is a lie the flight recorder exists to replace
+    (``telemetry.trace.timed_best`` is the one blessed timing
+    definition; ``telemetry/`` itself is out of scope by construction).
+    Intentional sites — walls whose sync happens inside a callee the
+    AST cannot see — are baselined with a reason.
 
 Suppression: an inline ``# daslint: allow[R2]`` (comma list, or
 ``daslint: ignore`` for all rules) on the finding's line or the line above
@@ -61,7 +72,7 @@ import re
 from pathlib import PurePosixPath
 from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
 
 #: (path suffix, function name or "*") pairs where explicit float64 is the
 #: documented host-side design contract (masks and filter coefficients are
@@ -98,6 +109,35 @@ _R6_SCOPE = frozenset({"ops", "parallel", "models", "workflows", "io"})
 #: Host calls that synchronize the device stream when applied to an
 #: in-flight array (R6).
 _R6_SYNC_FUNCS = frozenset({"jax.block_until_ready", "jax.device_get"})
+
+#: R7 (unblocked timing) shares R6's host-driver scope; ``telemetry/``
+#: is outside it by construction (its ``timed_best`` IS the blessed
+#: timing definition).
+_R7_SCOPE = _R6_SCOPE
+
+#: dotted calls that make a perf_counter bracket honest (the wall ends
+#: at a real sync).
+_R7_SYNC_DOTTED = frozenset({
+    "jax.block_until_ready", "jax.device_get",
+    "numpy.asarray", "numpy.array",
+})
+
+#: final-attribute calls treated as syncs for R7: the counted dispatch
+#: helpers (``parallel.dispatch.fetch``/``sync``), an in-flight
+#: handle's ``resolve``, a future's ``result``, scalar ``.item()``,
+#: and the jax sync pair however the module object is named.
+_R7_SYNC_ATTRS = frozenset({
+    "block_until_ready", "device_get", "fetch", "sync", "item",
+    "resolve", "result", "asarray", "array",
+})
+
+#: bare-name calls that are plain host work, never a device dispatch.
+_R7_HOST_CALLS = frozenset({
+    "len", "min", "max", "round", "abs", "sum", "int", "float", "str",
+    "bool", "repr", "format", "sorted", "list", "dict", "tuple", "set",
+    "print", "isinstance", "getattr", "setattr", "hasattr", "range",
+    "enumerate", "zip", "map", "filter", "any", "all", "type", "id",
+})
 
 _ALLOW_RE = re.compile(r"daslint:\s*(?:allow\[([A-Za-z0-9,\s]+)\]|ignore)")
 
@@ -346,6 +386,10 @@ class _Analyzer(ast.NodeVisitor):
             self._check_donation(jit_kws, anchor)
 
         self._fn_stack.append(node)
+        if jit_kws is None:
+            # R7 runs per HOST function (jit bodies cannot meaningfully
+            # read the host clock; R1 owns their sync hazards)
+            self._check_unblocked_timing(node)
         if jit_kws is not None:
             params = {a.arg for a in (node.args.posonlyargs + node.args.args
                                       + node.args.kwonlyargs)} - {"self", "cls"}
@@ -428,6 +472,85 @@ class _Analyzer(ast.NodeVisitor):
                        "device→host transfer (and sync) per iteration; "
                        "batch the computation or fetch once after the "
                        "loop")
+
+    def _check_unblocked_timing(self, fn: ast.FunctionDef):
+        """R7: a perf_counter bracket timing a dispatch-suspect call
+        with no sync between the clock reads (async dispatch makes the
+        wall a lie). One function at a time; nodes inside nested defs
+        belong to the nested function's own check (they run at ITS call
+        time, not between this function's clock reads)."""
+        if ("R7" not in self.rules
+                or not _in_scope(self.path, _R7_SCOPE)):
+            return
+        nested_ids: Set[int] = set()
+        for nd in ast.walk(fn):
+            if (isinstance(nd, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and nd is not fn):
+                nested_ids.update(id(sub) for sub in ast.walk(nd))
+        own = [n for n in ast.walk(fn) if id(n) not in nested_ids]
+        # name -> ALL linenos of `name = time.perf_counter()` (a timer
+        # variable reused for sequential brackets must match each delta
+        # against its NEAREST preceding assignment, or earlier brackets
+        # silently escape the check)
+        assigns: dict = {}
+        for n in own:
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and isinstance(n.value, ast.Call)
+                    and self.imports.resolve(n.value.func)
+                    == "time.perf_counter"):
+                assigns.setdefault(n.targets[0].id, []).append(n.lineno)
+        if not assigns:
+            return
+        calls = [n for n in own if isinstance(n, ast.Call)]
+        for n in own:
+            if not (isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub)
+                    and isinstance(n.right, ast.Name)
+                    and n.right.id in assigns
+                    and isinstance(n.left, ast.Call)
+                    and self.imports.resolve(n.left.func)
+                    == "time.perf_counter"):
+                continue
+            l2 = n.lineno
+            starts = [ln for ln in assigns[n.right.id] if ln < l2]
+            if not starts:
+                continue
+            l1 = max(starts)   # the nearest preceding assignment
+            suspect, synced = None, False
+            for c in calls:
+                if not l1 < c.lineno <= l2:
+                    continue
+                dotted = self.imports.resolve(c.func) or ""
+                attr = (c.func.attr
+                        if isinstance(c.func, ast.Attribute) else "")
+                if dotted.startswith("jax.numpy."):
+                    # jnp.asarray/jnp.array are ASYNC device ops, not
+                    # syncs — they must not clear the bracket (only
+                    # numpy's asarray/array, a host transfer, does)
+                    suspect = suspect or c
+                    continue
+                if dotted in _R7_SYNC_DOTTED or attr in _R7_SYNC_ATTRS:
+                    synced = True
+                    break
+                if dotted == "time.perf_counter":
+                    continue
+                if isinstance(c.func, ast.Name):
+                    if c.func.id not in _R7_HOST_CALLS:
+                        suspect = suspect or c   # an opaque callable: may dispatch
+                elif (dotted.startswith("jax.") or attr == "launch"
+                      or "detect" in attr or "dispatch" in attr):
+                    suspect = suspect or c
+            if suspect is not None and not synced:
+                self._emit(
+                    "R7", "unblocked-timing", n,
+                    "`time.perf_counter()` bracket times a dispatch-"
+                    "suspect call with no block_until_ready/fetch "
+                    "between the clock reads — async dispatch makes "
+                    "this wall measure the LAUNCH, not the work; sync "
+                    "inside the bracket (telemetry.trace.timed_best is "
+                    "the blessed pattern) or baseline with the reason "
+                    "the sync happens inside a callee",
+                )
 
     def _check_static_spec(self, keywords, anchor):
         """R2: static_argnums/static_argnames specs that are themselves

@@ -51,6 +51,8 @@ from typing import Dict, Mapping
 
 import numpy as np
 
+from .telemetry import metrics, probes, trace
+
 FAULT_CLASSES = ("transient", "corrupt", "data", "resource", "fatal")
 
 # ---------------------------------------------------------------------------
@@ -372,43 +374,43 @@ class RetryState:
         self.spent[fclass] = self.spent.get(fclass, 0) + 1
         count("retries")
         delay = self.policy.delay_s(key, self.attempts.get(key, 1))
-        if delay > 0:
-            sleep(delay)
+        with trace.span("retry", file=os.path.basename(key),
+                        fault_class=fclass,
+                        attempt=self.attempts.get(key, 1)):
+            if delay > 0:
+                sleep(delay)
         return delay
 
 
 # ---------------------------------------------------------------------------
 # Process-wide resilience counters (reported by bench.py)
 # ---------------------------------------------------------------------------
-
-_counters_lock = threading.Lock()
-_COUNTERS: Dict[str, int] = {
-    "retries": 0, "degradations": 0, "quarantined": 0, "timeouts": 0,
-    "downshifts": 0, "oom_recoveries": 0, "watchdog_timeouts": 0,
-    # dispatch-wall attribution (ISSUE 6): device program launches and
-    # blocking host fetches/syncs taken by the detection hot paths —
-    # bench.py reports the per-segment deltas next to stage_wall_s so
-    # the dispatch/sync wall is a regression-gated number
-    "dispatches": 0, "syncs": 0,
-}
+# ISSUE 11: the counter STORAGE moved into the telemetry metrics registry
+# (telemetry.metrics "das_resilience_events_total{kind=...}") so the same
+# numbers ride the Prometheus exposition and JSON snapshot; these three
+# functions are the pinned back-compat view — same keys, same values,
+# same delta semantics (tests/test_telemetry.py holds the parity pin).
 
 
 def count(name: str, n: int = 1) -> None:
     """Increment a process-wide resilience counter."""
-    with _counters_lock:
-        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+    metrics.count_resilience(name, n)
+    # probe signals ride the same call sites (telemetry.probes): a
+    # watchdog trip degrades liveness, a quarantine degrades readiness
+    if name == "watchdog_timeouts":
+        probes.note_watchdog_timeout()
+    elif name == "quarantined":
+        probes.note_quarantine()
 
 
 def counters() -> Dict[str, int]:
     """Snapshot of the process-wide resilience counters."""
-    with _counters_lock:
-        return dict(_COUNTERS)
+    return metrics.resilience_counters()
 
 
 def counters_delta(before: Mapping[str, int]) -> Dict[str, int]:
     """Counters accrued since a :func:`counters` snapshot."""
-    now = counters()
-    return {k: now.get(k, 0) - before.get(k, 0) for k in now}
+    return metrics.resilience_delta(before)
 
 
 # ---------------------------------------------------------------------------

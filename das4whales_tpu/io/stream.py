@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeout
@@ -285,19 +286,25 @@ def stream_strain_blocks(
         reader = _read_host
 
     def probe_and_read(i):
-        spec = spec_for(i) if i == 0 else _probe(files[i], interrogator, metas[i])
-        if fault_plan is not None:
-            fault_plan.on_read(files[i])        # chaos harness: raise/hang
-        host = reader(spec, sel)
-        if fault_plan is not None:
-            host = fault_plan.poison_read(files[i], host)
+        from ..telemetry import trace as _trace
+
+        name = os.path.basename(files[i])
+        with _trace.span("read", file=name):
+            spec = (spec_for(i) if i == 0
+                    else _probe(files[i], interrogator, metas[i]))
+            if fault_plan is not None:
+                fault_plan.on_read(files[i])    # chaos harness: raise/hang
+            host = reader(spec, sel)
+            if fault_plan is not None:
+                host = fault_plan.poison_read(files[i], host)
         if overlap and not as_numpy:
             # dispatch the H2D transfer from the read worker, the moment
             # the read completes — jax.device_put is async, so the worker
             # is not pinned and the copy overlaps compute on earlier files
             if fault_plan is not None:
                 fault_plan.on_transfer(files[i])
-            return spec, place(host)
+            with _trace.span("h2d", file=name):
+                return spec, place(host)
         return spec, host
 
     # not a `with` block: when a deadline is configured the pool must
@@ -704,12 +711,16 @@ def stream_batched_slabs(
         return
 
     def place(slab: BatchSlab) -> BatchSlab:
-        if sharding is not None:
-            stack = jax.device_put(slab.stack, sharding)
-        elif device is not None:
-            stack = jax.device_put(slab.stack, device)
-        else:
-            stack = jnp.asarray(slab.stack)
+        from ..telemetry import trace as _trace
+
+        with _trace.span("h2d", index0=slab.index0, n_files=slab.n_valid,
+                         bucket_ns=slab.bucket_ns):
+            if sharding is not None:
+                stack = jax.device_put(slab.stack, sharding)
+            elif device is not None:
+                stack = jax.device_put(slab.stack, device)
+            else:
+                stack = jnp.asarray(slab.stack)
         return dataclasses.replace(slab, stack=stack)
 
     error: SlabReadError | None = None

@@ -38,6 +38,8 @@ failure surfaces, never WHERE it is attributed.
 
 from __future__ import annotations
 
+import os
+import time
 from collections import deque
 from typing import Any, Iterator, List, Tuple
 
@@ -45,9 +47,34 @@ import jax
 
 from .. import faults
 from ..config import dispatch_depth_default
+from ..telemetry import metrics, probes, trace
+
+# ISSUE 11 flight-recorder surfaces: per-rung/family resolve tallies, the
+# pipeline's queue depth + in-flight residency, and the watchdog's
+# deadline margin — all in the one metrics registry the Prometheus
+# exposition and probes read (docs/OBSERVABILITY.md).
+_resolves = metrics.counter(
+    "das_rung_resolves_total",
+    "watchdogged dispatch/resolve calls by rung, family and outcome",
+    ("rung", "family", "outcome"),
+)
+_queue_depth = metrics.gauge(
+    "das_dispatch_queue_depth",
+    "PipelinedDispatch tokens currently in flight (dispatched, unresolved)",
+)
+_residency = metrics.histogram(
+    "das_dispatch_inflight_residency_seconds",
+    "seconds a PipelinedDispatch token spent in flight (submit to resolve)",
+)
+_watchdog_margin = metrics.histogram(
+    "das_watchdog_deadline_margin_seconds",
+    "dispatch_deadline_s minus the resolve wall — headroom before the "
+    "watchdog would have fired (a shrinking margin predicts timeouts)",
+)
 
 
-def resolve_watchdogged(fn, paths, rung, deadline_s, fault_plan=None):
+def resolve_watchdogged(fn, paths, rung, deadline_s, fault_plan=None,
+                        family: str = ""):
     """One watchdogged device dispatch/resolve, shared by every campaign
     flavor and every detector family (``workflows.planner``): the chaos
     harness's dispatch hook (``faults.FaultPlan.on_dispatch``) fires for
@@ -66,16 +93,38 @@ def resolve_watchdogged(fn, paths, rung, deadline_s, fault_plan=None):
                 fault_plan.on_dispatch(p, rung)
         return fn()
 
-    try:
-        return faults.call_with_deadline(
-            run, deadline_s, paths[0] if paths else "<dispatch>"
-        )
-    except Exception as exc:
+    label = faults.rung_label(rung)
+    outcome = "error"
+    with trace.span("resolve", rung=label, family=family,
+                    n_files=len(paths),
+                    file=os.path.basename(paths[0]) if paths else ""):
+        # the deadline-bounded call below ends at fn's own packed fetch,
+        # so the margin wall is an honest (synced) number
+        t0 = time.perf_counter()
         try:
-            exc.campaign_rung = faults.rung_label(rung)
-        except Exception:  # noqa: BLE001 — slots/frozen exc: skip the tag
-            pass
-        raise
+            out = faults.call_with_deadline(
+                run, deadline_s, paths[0] if paths else "<dispatch>"
+            )
+            outcome = "ok"
+            if deadline_s is not None:
+                _watchdog_margin.observe(
+                    max(0.0, deadline_s - (time.perf_counter() - t0))
+                )
+            return out
+        except faults.DispatchDeadlineExceeded as exc:
+            outcome = "timeout"
+            if deadline_s is not None:
+                _watchdog_margin.observe(0.0)
+            exc.campaign_rung = label
+            raise
+        except Exception as exc:
+            try:
+                exc.campaign_rung = label
+            except Exception:  # noqa: BLE001 — slots/frozen exc: skip the tag
+                pass
+            raise
+        finally:
+            _resolves.inc(rung=label, family=family, outcome=outcome)
 
 
 def launch(fn, *args, **kwargs):
@@ -85,21 +134,28 @@ def launch(fn, *args, **kwargs):
     fetch of the outputs (``np.asarray`` / packed ``device_get``) is
     the sync — pair with :func:`fetch` so it is counted."""
     faults.count("dispatches")
-    return fn(*args, **kwargs)
+    with trace.span("dispatch"):
+        return fn(*args, **kwargs)
 
 
 def fetch(tree):
     """Counted blocking fetch: ``jax.device_get`` on a tree of in-flight
     device arrays — the ONE sync its dispatch chain pays."""
     faults.count("syncs")
-    return jax.device_get(tree)
+    with trace.span("fetch"):
+        out = jax.device_get(tree)
+    probes.note_dispatch_ok()   # the runtime answered: liveness heartbeat
+    return out
 
 
 def sync(tree):
     """Counted ``jax.block_until_ready`` (for callers that need the
     arrays resident on device, not on host)."""
     faults.count("syncs")
-    return jax.block_until_ready(tree)
+    with trace.span("sync"):
+        out = jax.block_until_ready(tree)
+    probes.note_dispatch_ok()
+    return out
 
 
 class PipelinedDispatch:
@@ -143,14 +199,21 @@ class PipelinedDispatch:
     def __len__(self) -> int:
         return len(self._q)
 
+    def _pop(self) -> Tuple[Any, Any]:
+        key, handle, t_in = self._q.popleft()
+        _queue_depth.set(len(self._q))
+        _residency.observe(time.perf_counter() - t_in)
+        return key, handle
+
     def submit(self, key: Any, handle: Any) -> List[Tuple[Any, Any]]:
         """Enqueue a dispatched token; returns the (key, handle) tokens
         that must be resolved NOW to keep at most ``depth`` in flight
         (oldest first)."""
-        self._q.append((key, handle))
+        self._q.append((key, handle, time.perf_counter()))
+        _queue_depth.set(len(self._q))
         out: List[Tuple[Any, Any]] = []
         while len(self._q) > self.depth:
-            out.append(self._q.popleft())
+            out.append(self._pop())
         return out
 
     def drain(self) -> Iterator[Tuple[Any, Any]]:
@@ -158,4 +221,4 @@ class PipelinedDispatch:
         or pre-sync-path — flush). Resolving the last token is the
         segment's single remaining sync."""
         while self._q:
-            yield self._q.popleft()
+            yield self._pop()

@@ -1,0 +1,52 @@
+"""Campaign flight recorder: spans, labeled metrics, and probe surfaces.
+
+The observability subsystem the whole stack emits into (ISSUE 11). The
+reference's only observability is tqdm bars and prints (SURVEY §5.1/
+§5.5); this repo's campaign machinery — async pipelined dispatch, the
+downshift ladder, per-shape engine routing — was invisible between a
+campaign's start and its manifest. Three surfaces fix that:
+
+* :mod:`~das4whales_tpu.telemetry.trace` — host-side span tracing with a
+  no-op fast path (``DAS_TRACE`` / ``run_campaign*(trace=)`` enables),
+  paired with ``jax.profiler.TraceAnnotation`` on the same names so host
+  and device timelines correlate, exported as Chrome-trace/Perfetto JSON
+  next to the manifest; span ids are stamped into manifest ledger events
+  so a campaign becomes a replayable flight record
+  (``scripts/trace_report.py`` renders it).
+* :mod:`~das4whales_tpu.telemetry.metrics` — a labeled counter/gauge/
+  histogram registry with Prometheus text exposition and a JSON
+  snapshot; subsumes ``faults.counters()`` as a back-compat view (same
+  keys, same values, same delta semantics).
+* :mod:`~das4whales_tpu.telemetry.probes` — ``liveness()`` /
+  ``readiness()`` driven by the dispatch-watchdog, health-quarantine and
+  dispatch-progress signals: the service substrate the streaming
+  multi-tenant item needs (ROADMAP item 1).
+
+Import discipline: this package (and everything it imports at module
+level) is pure stdlib — ``faults`` imports it at package init, and the
+disabled-mode fast path must never pay a jax import.
+"""
+
+from . import metrics, probes, progress, trace  # noqa: F401
+from .metrics import (  # noqa: F401
+    REGISTRY,
+    counter,
+    gauge,
+    histogram,
+    prometheus_text,
+    resilience_counters,
+    resilience_delta,
+    snapshot,
+)
+from .probes import liveness, readiness  # noqa: F401
+from .progress import progress as progress_bar  # noqa: F401
+from .trace import (  # noqa: F401
+    campaign_trace,
+    current_span_id,
+    disable,
+    enable,
+    enabled,
+    export_chrome_trace,
+    span,
+    timed_best,
+)

@@ -5,4 +5,5 @@ from . import audio, checkpoint, log, profiling, views  # noqa: F401
 from .audio import export_audio, read_audio  # noqa: F401
 from .checkpoint import load_design, register_design, save_design  # noqa: F401
 from .log import get_logger, log_metadata  # noqa: F401
-from .profiling import StageTimer, annotate, block_and_time, device_trace, progress  # noqa: F401
+from ..telemetry.progress import progress  # noqa: F401
+from .profiling import StageTimer, annotate, block_and_time, device_trace  # noqa: F401

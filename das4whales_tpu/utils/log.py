@@ -9,15 +9,25 @@ import sys
 _FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
 
 
-def get_logger(name: str = "das4whales_tpu", level: int = logging.INFO) -> logging.Logger:
-    """Package logger with a single stderr handler (idempotent)."""
+def get_logger(name: str = "das4whales_tpu",
+               level: int | None = None) -> logging.Logger:
+    """Package logger with a single stderr handler (idempotent).
+
+    ``level=None`` (the default) sets INFO on first creation and leaves
+    an existing logger's level ALONE — so the many internal
+    ``get_logger(name)`` call sites can never clobber a level an
+    operator configured. An EXPLICIT ``level`` is honored on every call
+    (it used to be silently ignored once the handler existed — the
+    ISSUE 11 satellite fix)."""
     logger = logging.getLogger(name)
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stderr)
         handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
         logger.addHandler(handler)
-        logger.setLevel(level)
+        logger.setLevel(logging.INFO if level is None else level)
         logger.propagate = False
+    elif level is not None:
+        logger.setLevel(level)
     return logger
 
 

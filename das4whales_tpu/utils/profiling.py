@@ -39,16 +39,16 @@ def block_and_time(fn, *args, repeats: int = 3, **kwargs):
     """Best-of-``repeats`` wall time of ``fn(*args)`` with the result tree
     blocked to completion (JAX dispatch is async; un-blocked timing lies).
 
-    Returns ``(best_seconds, last_result)``. The first call is excluded
-    when it is the slowest (compile amortization)."""
-    times = []
-    result = None
-    for _ in range(max(repeats, 1) + 1):
-        t0 = time.perf_counter()
-        result = fn(*args, **kwargs)
-        jax.block_until_ready(result)
-        times.append(time.perf_counter() - t0)
-    return min(times[1:]), result
+    Returns ``(best_seconds, result)``; the warm-up (compile) call is
+    excluded. Delegates to THE one timing definition,
+    ``telemetry.trace.timed_best`` (ISSUE 11) — each measured repeat is
+    a ``timed`` span when tracing is on."""
+    from ..telemetry import trace as _trace
+
+    return _trace.timed_best(
+        (lambda *a: fn(*a, **kwargs)) if kwargs else fn,
+        *args, repeats=repeats,
+    )
 
 
 @dataclass
@@ -77,11 +77,18 @@ class StageTimer:
 
 
 def progress(iterable: Iterable, desc: str | None = None, total: int | None = None) -> Iterator:
-    """tqdm when available (the reference's surface), plain passthrough
-    otherwise — host loops only; device work never needs this."""
-    try:
-        from tqdm import tqdm
+    """DEPRECATED alias of ``telemetry.progress.progress`` — import from
+    there. The old no-tqdm fallback here returned a bare ``iter()``,
+    dropping ``total``/``desc`` and ``len()`` (the ISSUE 11 satellite);
+    the telemetry version preserves them and records a ``progress`` span
+    when tracing is on."""
+    import warnings
 
-        return tqdm(iterable, desc=desc, total=total)
-    except ImportError:
-        return iter(iterable)
+    warnings.warn(
+        "das4whales_tpu.utils.profiling.progress is deprecated; use "
+        "das4whales_tpu.telemetry.progress.progress",
+        DeprecationWarning, stacklevel=2,
+    )
+    from ..telemetry.progress import progress as _progress
+
+    return _progress(iterable, desc=desc, total=total)

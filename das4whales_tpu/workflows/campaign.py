@@ -42,9 +42,24 @@ from .. import faults
 from ..config import as_health_config
 from ..io.stream import stream_strain_blocks
 from ..models.matched_filter import MatchedFilterDetector
+from ..telemetry import metrics as tmetrics
+from ..telemetry import probes as tprobes
+from ..telemetry import trace as telemetry
 from ..utils.log import get_logger
 
 log = get_logger("campaign")
+
+# flight-recorder metrics (ISSUE 11, docs/OBSERVABILITY.md): slab wall
+# percentiles for the batched route and the AOT preflight's HBM
+# high-water, next to the dispatch/queue metrics parallel.dispatch owns
+_h_slab_wall = tmetrics.histogram(
+    "das_slab_wall_seconds",
+    "wall seconds per batched slab (dispatch through bookkeeping)",
+)
+_g_preflight_hwm = tmetrics.gauge(
+    "das_preflight_hbm_peak_bytes",
+    "largest AOT-priced program HBM peak seen by the memory preflight",
+)
 
 MANIFEST = "manifest.jsonl"
 
@@ -310,9 +325,15 @@ class _Resilience:
 
     def flush_tallies(self) -> None:
         """Write the end-of-run counters event — only when nonzero, so a
-        healthy campaign's manifest stays pure file records."""
+        healthy campaign's manifest stays pure file records. Stamped
+        with the enclosing span id (the campaign root) when the flight
+        recorder is on."""
         if self.write and any(self.tallies.values()):
-            _append_event(self.outdir, {"event": "counters", **self.tallies})
+            event = {"event": "counters", **self.tallies}
+            sid = telemetry.current_span_id()
+            if sid is not None:
+                event["span_id"] = sid
+            _append_event(self.outdir, event)
 
     def attempt(self, path: str) -> int:
         return self.state.attempt(path)
@@ -395,11 +416,20 @@ def run_campaign(
     read_deadline_s: float | None = None,
     dispatch_deadline_s: float | None = None,
     dispatch_depth: int | None = None,
+    trace: bool | None = None,
     fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
     """Detect over ``files``, tolerating per-file failures and resuming
     past completed work.
+
+    ``trace`` (None: the ``DAS_TRACE`` env default) arms the FLIGHT
+    RECORDER (``das4whales_tpu.telemetry``): the campaign runs inside a
+    root span, every read/h2d/resolve/downshift/retry is a span with
+    file/rung/family attributes, the ledger's downshift events carry
+    their span ids, and ``<outdir>/trace.json`` (Chrome-trace/Perfetto)
+    is exported next to the manifest — picks are bit-identical with
+    tracing on or off (docs/OBSERVABILITY.md).
 
     ``detector=None`` builds a ``MatchedFilterDetector`` from the first
     readable file's shape/metadata (extra ``detector_kwargs`` pass
@@ -546,6 +576,7 @@ def run_campaign(
         # phantom record that a successful retry would duplicate
         _append_manifest(outdir, rec)
         records.append(rec)
+        tprobes.note_file_ok()   # healthy file: readiness quarantine streak resets
 
     from ..parallel.dispatch import PipelinedDispatch
 
@@ -590,45 +621,47 @@ def run_campaign(
         for tok, queued in pipe.drain():
             finalize_file(*tok, queued)
 
-    i = 0
-    while i < len(pending):
-        # one stream per contiguous run of healthy files; a failure mid-
-        # stream kills the generator, so restart it after the culprit —
-        # or AT it, when its failure class earned a retry
-        stream = stream_strain_blocks(
-            pending[i:], selected_channels, pend_metas[i:],
-            interrogator=interrogator, prefetch=prefetch, engine=engine,
-            as_numpy=True, wire=wire, read_deadline_s=read_deadline_s,
-            fault_plan=fault_plan,
-        )
-        while True:
-            path = pending[i] if i < len(pending) else None
-            try:
-                block = next(stream)
-            except StopIteration:
-                i = len(pending)
-                break
-            except Exception as exc:  # noqa: BLE001 — per-file isolation
-                # queued in-flight files are earlier, healthy reads:
-                # finalize them first so their records precede the
-                # culprit's in the manifest
-                drain_pipe()
-                rz.attempt(path)
-                if rz.dispose(path, exc) == "next":
-                    i += 1
-                break  # restart the stream either way
-            t0 = time.perf_counter()
-            infl = try_dispatch_file(path, block)
-            if infl is None:
-                drain_pipe()
-                finalize_file(path, block, t0, None)
-            else:
-                for tok, queued in pipe.submit((path, block, t0), infl):
-                    finalize_file(*tok, queued)
-            i += 1
-        del stream
-    drain_pipe()   # end of segment: the one remaining sync
-    rz.flush_tallies()
+    with telemetry.campaign_trace(outdir, trace, kind="per-file",
+                                  n_files=len(files), family=rz.family):
+        i = 0
+        while i < len(pending):
+            # one stream per contiguous run of healthy files; a failure
+            # mid-stream kills the generator, so restart it after the
+            # culprit — or AT it, when its failure class earned a retry
+            stream = stream_strain_blocks(
+                pending[i:], selected_channels, pend_metas[i:],
+                interrogator=interrogator, prefetch=prefetch, engine=engine,
+                as_numpy=True, wire=wire, read_deadline_s=read_deadline_s,
+                fault_plan=fault_plan,
+            )
+            while True:
+                path = pending[i] if i < len(pending) else None
+                try:
+                    block = next(stream)
+                except StopIteration:
+                    i = len(pending)
+                    break
+                except Exception as exc:  # noqa: BLE001 — per-file isolation
+                    # queued in-flight files are earlier, healthy reads:
+                    # finalize them first so their records precede the
+                    # culprit's in the manifest
+                    drain_pipe()
+                    rz.attempt(path)
+                    if rz.dispose(path, exc) == "next":
+                        i += 1
+                    break  # restart the stream either way
+                t0 = time.perf_counter()
+                infl = try_dispatch_file(path, block)
+                if infl is None:
+                    drain_pipe()
+                    finalize_file(path, block, t0, None)
+                else:
+                    for tok, queued in pipe.submit((path, block, t0), infl):
+                        finalize_file(*tok, queued)
+                i += 1
+            del stream
+        drain_pipe()   # end of segment: the one remaining sync
+        rz.flush_tallies()
     return CampaignResult(outdir=outdir, records=records)
 
 
@@ -655,10 +688,18 @@ def run_campaign_batched(
     dispatch_deadline_s: float | None = None,
     preflight: bool | None = None,
     dispatch_depth: int | None = None,
+    trace: bool | None = None,
     fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
     """Single-chip BATCHED campaign: ``batch`` files per program step.
+
+    ``trace`` (None: the ``DAS_TRACE`` env default) arms the FLIGHT
+    RECORDER exactly like :func:`run_campaign`: a root campaign span,
+    read/h2d/slab/resolve/preflight/downshift spans, ledger events
+    stamped with span ids, and ``<outdir>/trace.json`` exported next to
+    the manifest — picks bit-identical either way
+    (docs/OBSERVABILITY.md).
 
     The throughput route for the "one file cannot saturate the chip"
     regime (BENCH_r05: every stage at ~1-2% of roofline): the slab
@@ -785,9 +826,15 @@ def run_campaign_batched(
         dt = np.asarray(slab.blocks[0].trace).dtype
 
         def price(bd, b_):
-            return memutils.batched_program_memory(
+            st = memutils.batched_program_memory(
                 bd, b_, dt, with_health=with_health, health_clip=clip
             )
+            if st is not None:
+                # preflight high-water: the hungriest program this
+                # campaign ever priced (the Prometheus surface's HBM
+                # headroom signal)
+                _g_preflight_hwm.max(float(st.peak))
+            return st
 
         # candidate rungs in LADDER order: the full bank at each B, then
         # — for splittable banks — the bank-split rung at the same B
@@ -842,8 +889,13 @@ def run_campaign_batched(
             "before dispatch"
         )
         skip_buckets[key] = reason
-        _append_event(outdir, {"event": "preflight_skip",
-                               "bucket": key if isinstance(key, str) else list(key), "reason": reason})
+        event = {"event": "preflight_skip",
+                 "bucket": key if isinstance(key, str) else list(key),
+                 "reason": reason}
+        sid = telemetry.current_span_id()   # the enclosing preflight span
+        if sid is not None:
+            event["span_id"] = sid
+        _append_event(outdir, event)
         log.warning("bucket %s: %s", key, reason)
 
     def detector_for(slab) -> BatchedMatchedFilterDetector:
@@ -870,7 +922,8 @@ def run_campaign_batched(
                 # the bank-split rung (T/2 sub-banks before B shrinks)
                 ladder.enable_bank_split(key)
             if preflight:
-                preflight_bucket(key, bdet, slab)
+                with telemetry.span("preflight", bucket=str(key)):
+                    preflight_bucket(key, bdet, slab)
         return bdet
 
     def dispatched(paths, rung, fn):
@@ -880,7 +933,7 @@ def run_campaign_batched(
         (``parallel.dispatch.resolve_watchdogged`` — shared with the
         planner's per-file executor)."""
         return resolve_watchdogged(fn, paths, rung, dispatch_deadline_s,
-                                   fault_plan)
+                                   fault_plan, family="mf")
 
     def per_file_fallback(slab, k, prog, rung=("file", 1)):
         """The unbatched per-file route on the assembler's host block
@@ -1081,6 +1134,7 @@ def run_campaign_batched(
             )
             degraded = True
         wall = time.perf_counter() - t0
+        _h_slab_wall.observe(wall)
         shape = (int(slab.stack.shape[1]), slab.bucket_ns)
         for k in range(slab.n_valid):
             if not ok[k]:
@@ -1178,7 +1232,11 @@ def run_campaign_batched(
 
     def finalize(slab, inflight) -> None:
         try:
-            handle_slab(slab, inflight)
+            with telemetry.span("slab", index0=slab.index0,
+                                n_files=slab.n_valid,
+                                bucket_ns=slab.bucket_ns,
+                                pipelined=inflight is not None):
+                handle_slab(slab, inflight)
         except CampaignAborted:
             raise
         except Exception as exc:  # noqa: BLE001 — slab-level guard
@@ -1206,44 +1264,49 @@ def run_campaign_batched(
     # the combined residency bound: in_flight + depth + 1 slabs)
     stream_in_flight = max(in_flight, pipe.depth) if pipe.enabled else in_flight
 
-    i = 0
-    while i < len(pending):
-        slabs = stream_batched_slabs(
-            pending[i:], selected_channels, pend_metas[i:], batch=batch,
-            bucket=bucket, interrogator=interrogator, prefetch=prefetch,
-            engine=engine, wire=wire, in_flight=stream_in_flight,
-            read_deadline_s=read_deadline_s, fault_plan=fault_plan,
-        )
-        try:
-            for slab in slabs:
-                infl = try_dispatch(slab)
-                if infl is None:
-                    # ineligible slab: flush the queue (FIFO — manifest
-                    # order is file order) and run it synchronously
-                    drain_pipe()
-                    finalize(slab, None)
+    with telemetry.campaign_trace(outdir, trace, kind="batched",
+                                  n_files=len(files), batch=batch,
+                                  family="mf"):
+        i = 0
+        while i < len(pending):
+            slabs = stream_batched_slabs(
+                pending[i:], selected_channels, pend_metas[i:], batch=batch,
+                bucket=bucket, interrogator=interrogator, prefetch=prefetch,
+                engine=engine, wire=wire, in_flight=stream_in_flight,
+                read_deadline_s=read_deadline_s, fault_plan=fault_plan,
+            )
+            try:
+                for slab in slabs:
+                    infl = try_dispatch(slab)
+                    if infl is None:
+                        # ineligible slab: flush the queue (FIFO — manifest
+                        # order is file order) and run it synchronously
+                        drain_pipe()
+                        finalize(slab, None)
+                    else:
+                        for tok in pipe.submit(slab, infl):
+                            finalize(*tok)
+                # end of segment: resolving the queued tail is the
+                # segment's one remaining sync — no per-slab
+                # block_until_ready anywhere
+                drain_pipe()
+            except SlabReadError as exc:
+                # the assembler attributes the culprit's index; classify
+                # its cause — transient earns a retry AT the culprit,
+                # timeout / corrupt / data disposition it and resume past.
+                # Queued in-flight slabs hold earlier (healthy) files:
+                # finalize them first so their records precede the
+                # culprit's
+                drain_pipe()
+                path = pending[i + exc.index]
+                rz.attempt(path)
+                if rz.dispose(path, exc.cause) == "retry":
+                    i = i + exc.index
                 else:
-                    for tok in pipe.submit(slab, infl):
-                        finalize(*tok)
-            # end of segment: resolving the queued tail is the segment's
-            # one remaining sync — no per-slab block_until_ready anywhere
-            drain_pipe()
-        except SlabReadError as exc:
-            # the assembler attributes the culprit's index; classify its
-            # cause — transient earns a retry AT the culprit, timeout /
-            # corrupt / data disposition it and resume past. Queued
-            # in-flight slabs hold earlier (healthy) files: finalize them
-            # first so their records precede the culprit's
-            drain_pipe()
-            path = pending[i + exc.index]
-            rz.attempt(path)
-            if rz.dispose(path, exc.cause) == "retry":
-                i = i + exc.index
-            else:
-                i = i + exc.index + 1
-            continue
-        i = len(pending)
-    rz.flush_tallies()
+                    i = i + exc.index + 1
+                continue
+            i = len(pending)
+        rz.flush_tallies()
     return CampaignResult(outdir=outdir, records=records)
 
 
@@ -1380,6 +1443,7 @@ def _file_record(outdir, path, picks, thresholds, wall_s, records,
     if write:
         _append_manifest(outdir, rec)
     records.append(rec)
+    tprobes.note_file_ok()   # healthy file: readiness quarantine streak resets
     return rec
 
 

@@ -33,6 +33,7 @@ from ..ops import peaks as peak_ops
 from ..parallel import dispatch as dispatch_mod
 from ..parallel.mesh import make_mesh
 from ..parallel.timeshard import make_sharded_mf_step_time, time_sharding
+from ..telemetry import trace as telemetry
 from ..utils.log import get_logger
 
 log = get_logger("das4whales_tpu.workflows.longrecord")
@@ -170,10 +171,13 @@ def detect_long_record(
         mesh = make_mesh(shape=(len(jax.devices()),), axis_names=(time_axis,))
     p = mesh.shape[time_axis]
 
-    blocks = list(stream_strain_blocks(
-        files, selected_channels, metadata,
-        interrogator=interrogator, engine=engine, as_numpy=True, wire=wire,
-    ))
+    with telemetry.span("longrecord.read", n_files=len(files),
+                        family=family):
+        blocks = list(stream_strain_blocks(
+            files, selected_channels, metadata,
+            interrogator=interrogator, engine=engine, as_numpy=True,
+            wire=wire,
+        ))
     meta = as_metadata(blocks[0].metadata)
     record = np.concatenate([b.trace for b in blocks], axis=-1)
     n_samples = record.shape[-1]
@@ -396,13 +400,16 @@ def detect_long_record(
     # already queued behind)
     ns_eff = (n_samples - 1) // pos_scale + 1
     cap = min(int(np.prod(sp_picks.positions.shape[-2:])), _PICK_PACK_CAP)
-    rows_d, times_d, cnt_d = dispatch_mod.launch(
-        _pack_record_picks, sp_picks.positions, sp_picks.selected, ns_eff, cap
-    )
-    saturated = dispatch_mod.fetch(sp_picks.saturated)
-    thr_map = thr_map_fn()   # scalar transfer; the step already finished
-    faults.count("syncs")   # compacted_to_host's np.asarray is the sync
-    packed = peak_ops.compacted_to_host(rows_d, times_d, cnt_d, cap)
+    with telemetry.span("longrecord.resolve", family=family,
+                        n_samples=n_samples):
+        rows_d, times_d, cnt_d = dispatch_mod.launch(
+            _pack_record_picks, sp_picks.positions, sp_picks.selected,
+            ns_eff, cap
+        )
+        saturated = dispatch_mod.fetch(sp_picks.saturated)
+        thr_map = thr_map_fn()   # scalar transfer; the step already finished
+        faults.count("syncs")   # compacted_to_host's np.asarray is the sync
+        packed = peak_ops.compacted_to_host(rows_d, times_d, cnt_d, cap)
     if packed is not None:
         rows_np, times_np, cnt = packed
         positions = selected = None

@@ -37,11 +37,13 @@ parity suites gate this). Coverage matrix: docs/ROBUSTNESS.md
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Tuple
 
 import numpy as np
 
 from .. import faults
+from ..telemetry import trace as telemetry
 from ..utils.log import get_logger
 
 log = get_logger("planner")
@@ -427,6 +429,37 @@ class DownshiftLadder:
             key, ("batched", self.batch) if self.batch > 1 else ("file", 1)
         )
 
+    def _ledger(self, key, from_rung, to_rung, error: str,
+                preflight: bool = False) -> None:
+        """One downshift ledger move: a ``downshift`` SPAN paired with
+        the manifest event, the span's id stamped into the event — so a
+        trace-side downshift and its ledger line resolve one-to-one
+        (the flight-recorder contract, docs/OBSERVABILITY.md). Spans
+        (and events) only exist for writing ladders, keeping the
+        pairing exact."""
+        if not self.write:
+            return
+        with telemetry.span(
+            "downshift", bucket=str(key), family=self.family,
+            from_rung=faults.rung_label(from_rung),
+            to_rung=faults.rung_label(to_rung), preflight=preflight,
+        ) as sp:
+            event = {
+                "event": "downshift",
+                "bucket": key if isinstance(key, str) else list(key),
+                "family": self.family,
+                "from": faults.rung_label(from_rung),
+                "to": faults.rung_label(to_rung),
+                **({"engines": eng} if (eng := self.engines_for(key))
+                   else {}),
+                "error": error, "sticky": True,
+            }
+            if preflight:
+                event["preflight"] = True
+            if sp.span_id is not None:
+                event["span_id"] = sp.span_id
+            _append_event(self.outdir, event)
+
     def pin(self, key, rung, reason: str) -> None:
         """Preflight placement: start ``key`` at ``rung`` (no failure
         occurred — ledgered as a preflight downshift when it moves the
@@ -435,17 +468,7 @@ class DownshiftLadder:
         self.sticky[key] = rung
         if faults.rung_rank(rung) > faults.rung_rank(top):
             self.rz.tally("downshifts")
-            if self.write:
-                _append_event(self.outdir, {
-                    "event": "downshift",
-                    "bucket": key if isinstance(key, str) else list(key),
-                    "family": self.family,
-                    "from": faults.rung_label(top),
-                    "to": faults.rung_label(rung),
-                    **({"engines": eng} if (eng := self.engines_for(key))
-                       else {}),
-                    "error": reason, "preflight": True, "sticky": True,
-                })
+            self._ledger(key, top, rung, reason, preflight=True)
             log.info("preflight: bucket %s starts at rung %s (%s)",
                      key, faults.rung_label(rung), reason)
 
@@ -462,17 +485,7 @@ class DownshiftLadder:
             return None
         self.sticky[key] = nxt
         self.rz.tally("downshifts")
-        if self.write:
-            _append_event(self.outdir, {
-                "event": "downshift",
-                "bucket": key if isinstance(key, str) else list(key),
-                "family": self.family,
-                "from": faults.rung_label(rung),
-                "to": faults.rung_label(nxt),
-                **({"engines": eng} if (eng := self.engines_for(key))
-                   else {}),
-                "error": f"{type(exc).__name__}: {exc}", "sticky": True,
-            })
+        self._ledger(key, rung, nxt, f"{type(exc).__name__}: {exc}")
         log.warning(
             "resource exhaustion at rung %s (%s: %s); downshifting bucket "
             "%s to %s (sticky)", faults.rung_label(rung),
@@ -528,37 +541,41 @@ class RoutePlanner:
 
         recovered = False
         shape = np.asarray(trace).shape
-        while True:   # rung loop: resource failures downshift, sticky
-            rung = self.ladder.current(key)
-            if inflight is not None and rung != self.top:
-                # the campaign downshifted between this file's dispatch
-                # and its resolve: the in-flight program ran at a rung
-                # now known to exhaust — abandon it
-                inflight = None
+        with telemetry.span("file", file=os.path.basename(path),
+                            family=self.program.family):
+            while True:   # rung loop: resource failures downshift, sticky
+                rung = self.ladder.current(key)
+                if inflight is not None and rung != self.top:
+                    # the campaign downshifted between this file's dispatch
+                    # and its resolve: the in-flight program ran at a rung
+                    # now known to exhaust — abandon it
+                    inflight = None
 
-            def fn(inflight=inflight, rung=rung):
-                if inflight is not None:
-                    # the pipeline's pre-dispatched program: this is its
-                    # packed fetch (the one sync), inside the watchdog
-                    res = inflight.resolve()
-                    return res.picks, res.thresholds, res.health
-                return self.program.detect(
-                    rung, trace, n_real=n_real,
-                    with_health=with_health, clip=clip,
-                )
+                def fn(inflight=inflight, rung=rung):
+                    if inflight is not None:
+                        # the pipeline's pre-dispatched program: this is its
+                        # packed fetch (the one sync), inside the watchdog
+                        res = inflight.resolve()
+                        return res.picks, res.thresholds, res.health
+                    return self.program.detect(
+                        rung, trace, n_real=n_real,
+                        with_health=with_health, clip=clip,
+                    )
 
-            try:
-                picks, thresholds, stats = dispatch_mod.resolve_watchdogged(
-                    fn, [path], rung, self.deadline_s, self.fault_plan
-                )
-                break
-            except Exception as exc:  # noqa: BLE001 — ladder absorbs resource
-                inflight = None   # spent/abandoned: never consume twice
-                if (faults.classify_failure(exc) == "resource"
-                        and self.ladder.downshift(key, rung, exc, shape)):
-                    recovered = True
-                    continue
-                raise
+                try:
+                    picks, thresholds, stats = \
+                        dispatch_mod.resolve_watchdogged(
+                            fn, [path], rung, self.deadline_s,
+                            self.fault_plan, family=self.program.family,
+                        )
+                    break
+                except Exception as exc:  # noqa: BLE001 — ladder absorbs resource
+                    inflight = None   # spent/abandoned: never consume twice
+                    if (faults.classify_failure(exc) == "resource"
+                            and self.ladder.downshift(key, rung, exc, shape)):
+                        recovered = True
+                        continue
+                    raise
         if recovered:
             self.rz.tally("oom_recoveries")
         return picks, thresholds, stats, rung

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Render a campaign flight record: timeline + aggregates from the trace.
+
+Reads the Chrome-trace JSON the telemetry layer exports next to the
+manifest (``run_campaign*(trace=True)`` / ``DAS_TRACE=1`` →
+``<outdir>/trace.json``) plus the manifest itself, and prints:
+
+* a per-span-name aggregate table (count, total wall, share of the
+  campaign span, mean / p50 / p95) — where the campaign's time went,
+  stage by stage;
+* a per-rung × per-family table of done files and mean wall from the
+  manifest records, with the downshift ledger resolved against its
+  spans by span id (the one-to-one flight-record contract) and the
+  ledger's engine labels;
+* the slowest individual spans (the timeline's outliers).
+
+Usage::
+
+    python scripts/trace_report.py OUTDIR            # human tables
+    python scripts/trace_report.py OUTDIR --json     # machine payload
+
+Pure stdlib — no jax import, safe anywhere the artifacts are.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+
+def load_trace(path: str) -> List[Dict]:
+    with open(path) as fh:
+        payload = json.load(fh)
+    return [e for e in payload.get("traceEvents", [])
+            if e.get("ph") == "X"]
+
+
+def load_manifest(path: str) -> List[Dict]:
+    recs = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    recs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return recs
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+def span_aggregates(events: List[Dict]) -> Dict:
+    """Per-name totals over the ``"X"`` events, in seconds."""
+    by_name: Dict[str, List[float]] = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e.get("dur", 0.0) / 1e6)
+    t0 = min((e["ts"] for e in events), default=0.0) / 1e6
+    t1 = max((e["ts"] + e.get("dur", 0.0) for e in events), default=0.0) / 1e6
+    wall = max(t1 - t0, 1e-12)
+    out = {}
+    for name, durs in sorted(by_name.items(),
+                             key=lambda kv: -sum(kv[1])):
+        durs = sorted(durs)
+        total = sum(durs)
+        out[name] = {
+            "count": len(durs), "total_s": round(total, 4),
+            "share": round(total / wall, 4),
+            "mean_s": round(total / len(durs), 4),
+            "p50_s": round(_pctl(durs, 0.50), 4),
+            "p95_s": round(_pctl(durs, 0.95), 4),
+        }
+    return {"wall_s": round(wall, 4), "by_name": out}
+
+
+def rung_family_table(manifest: List[Dict]) -> Dict:
+    """Done counts + mean wall per (family, rung) from the LAST record
+    per path (resume/retry semantics), plus the downshift ledger."""
+    latest = {r["path"]: r for r in manifest if "path" in r}
+    cells: Dict[tuple, Dict] = {}
+    for r in latest.values():
+        if r.get("status") != "done":
+            continue
+        key = (r.get("family", "") or "?", r.get("rung", "") or "?")
+        cell = cells.setdefault(key, {"n": 0, "wall": 0.0})
+        cell["n"] += 1
+        cell["wall"] += float(r.get("wall_s", 0.0))
+    table = [
+        {"family": fam, "rung": rung, "n_done": c["n"],
+         "mean_wall_s": round(c["wall"] / c["n"], 4)}
+        for (fam, rung), c in sorted(cells.items())
+    ]
+    ledger = [r for r in manifest
+              if r.get("event") == "downshift" and "path" not in r]
+    return {"rungs": table, "downshift_ledger": ledger}
+
+
+def resolve_ledger_spans(ledger: List[Dict], events: List[Dict]) -> Dict:
+    """Match ledger events to trace spans by span id — the flight-record
+    audit: every ledger line should resolve to exactly one span."""
+    spans_by_id = {e["args"]["span_id"]: e for e in events
+                   if "span_id" in e.get("args", {})}
+    resolved, unresolved = [], []
+    for ev in ledger:
+        sid = ev.get("span_id")
+        sp = spans_by_id.get(sid) if sid is not None else None
+        (resolved if sp is not None else unresolved).append(
+            {"event": ev, "span": sp}
+        )
+    return {"n_resolved": len(resolved), "n_unresolved": len(unresolved),
+            "unresolved": [u["event"] for u in unresolved]}
+
+
+def build_report(outdir: str, trace_path: str | None = None) -> Dict:
+    trace_path = trace_path or os.path.join(outdir, "trace.json")
+    events = load_trace(trace_path) if os.path.exists(trace_path) else []
+    manifest = load_manifest(os.path.join(outdir, "manifest.jsonl"))
+    agg = span_aggregates(events) if events else {"wall_s": 0.0,
+                                                  "by_name": {}}
+    rungs = rung_family_table(manifest)
+    audit = resolve_ledger_spans(rungs["downshift_ledger"], events)
+    slowest = sorted(events, key=lambda e: -e.get("dur", 0.0))[:10]
+    return {
+        "outdir": outdir, "trace": trace_path,
+        "n_spans": len(events), "spans": agg, "rungs": rungs["rungs"],
+        "downshift_ledger": rungs["downshift_ledger"],
+        "ledger_span_audit": audit,
+        "slowest_spans": [
+            {"name": e["name"], "dur_s": round(e.get("dur", 0.0) / 1e6, 4),
+             "args": e.get("args", {})}
+            for e in slowest
+        ],
+    }
+
+
+def print_report(rep: Dict) -> None:
+    print(f"flight record: {rep['outdir']}")
+    print(f"  trace: {rep['trace']} ({rep['n_spans']} spans, "
+          f"{rep['spans']['wall_s']} s wall)")
+    print("\n  span aggregates (share of campaign wall):")
+    print(f"    {'name':<22s} {'count':>6s} {'total s':>9s} {'share':>7s} "
+          f"{'mean s':>8s} {'p50 s':>8s} {'p95 s':>8s}")
+    for name, row in rep["spans"]["by_name"].items():
+        print(f"    {name:<22s} {row['count']:>6d} {row['total_s']:>9.3f} "
+              f"{row['share']:>6.1%} {row['mean_s']:>8.4f} "
+              f"{row['p50_s']:>8.4f} {row['p95_s']:>8.4f}")
+    if rep["rungs"]:
+        print("\n  done files per (family, rung):")
+        for row in rep["rungs"]:
+            print(f"    {row['family']:<10s} {row['rung']:<12s} "
+                  f"n={row['n_done']:<5d} mean wall {row['mean_wall_s']} s")
+    ledger = rep["downshift_ledger"]
+    if ledger:
+        audit = rep["ledger_span_audit"]
+        print(f"\n  downshift ledger ({len(ledger)} moves; "
+              f"{audit['n_resolved']} resolve to trace spans, "
+              f"{audit['n_unresolved']} do not):")
+        for ev in ledger:
+            eng = ev.get("engines")
+            print(f"    {ev.get('from')} -> {ev.get('to')} "
+                  f"[{ev.get('family', '')}] span={ev.get('span_id')}"
+                  + (f" engines={eng}" if eng else "")
+                  + (" (preflight)" if ev.get("preflight") else ""))
+    if rep["slowest_spans"]:
+        print("\n  slowest spans:")
+        for s in rep["slowest_spans"][:5]:
+            print(f"    {s['name']:<22s} {s['dur_s']:>8.4f} s  {s['args']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("outdir", help="campaign output directory "
+                                   "(manifest.jsonl [+ trace.json])")
+    ap.add_argument("--trace", default=None,
+                    help="trace path (default: <outdir>/trace.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    args = ap.parse_args(argv)
+    rep = build_report(args.outdir, args.trace)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2)
+        print()
+    else:
+        print_report(rep)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -817,3 +817,184 @@ class TestR6SyncInLoop:
             path=self.PATH,
         )
         assert f == []
+
+
+# ---------------------------------------------------------------------------
+# R7 — unblocked timing: perf_counter brackets around async dispatch
+# (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+class TestR7UnblockedTiming:
+    PATH = "das4whales_tpu/workflows/scratch.py"
+
+    def test_unblocked_bracket_flagged(self):
+        f = run(
+            """
+            import time
+
+            def wall(step, x):
+                t0 = time.perf_counter()
+                y = step(x)            # async dispatch: unfetched
+                return time.perf_counter() - t0, y
+            """,
+            path=self.PATH,
+        )
+        assert codes(f) == ["unblocked-timing"]
+
+    def test_blocked_bracket_not_flagged(self):
+        f = run(
+            """
+            import time
+            import jax
+
+            def wall(step, x):
+                t0 = time.perf_counter()
+                y = jax.block_until_ready(step(x))
+                return time.perf_counter() - t0, y
+            """,
+            path=self.PATH,
+        )
+        assert f == []
+
+    def test_counted_fetch_clears_the_bracket(self):
+        f = run(
+            """
+            import time
+            from das4whales_tpu.parallel import dispatch as dispatch_mod
+
+            def wall(step, x):
+                t0 = time.perf_counter()
+                h = dispatch_mod.launch(step, x)
+                out = dispatch_mod.fetch(h)
+                return time.perf_counter() - t0, out
+            """,
+            path=self.PATH,
+        )
+        assert f == []
+
+    def test_launch_without_fetch_flagged(self):
+        f = run(
+            """
+            import time
+            from das4whales_tpu.parallel import dispatch as dispatch_mod
+
+            def wall(step, x):
+                t0 = time.perf_counter()
+                h = dispatch_mod.launch(step, x)
+                return time.perf_counter() - t0, h
+            """,
+            path=self.PATH,
+        )
+        assert codes(f) == ["unblocked-timing"]
+
+    def test_jnp_asarray_does_not_clear_the_bracket(self):
+        # jnp.asarray is an ASYNC device op, not a sync — a bracket
+        # "cleared" only by it must still be flagged; np.asarray (host
+        # transfer) is a genuine sync
+        flagged = run(
+            """
+            import time
+            import jax.numpy as jnp
+
+            def wall(step, x):
+                t0 = time.perf_counter()
+                y = jnp.asarray(step(x))
+                return time.perf_counter() - t0, y
+            """,
+            path=self.PATH,
+        )
+        assert codes(flagged) == ["unblocked-timing"]
+        clean = run(
+            """
+            import time
+            import numpy as np
+
+            def wall(step, x):
+                t0 = time.perf_counter()
+                y = np.asarray(step(x))
+                return time.perf_counter() - t0, y
+            """,
+            path=self.PATH,
+        )
+        assert clean == []
+
+    def test_reused_timer_checks_each_bracket(self):
+        # t0 reused for two sequential brackets: the FIRST (unblocked)
+        # bracket must still be flagged against its own assignment
+        f = run(
+            """
+            import time
+            import jax
+
+            def walls(step, x):
+                t0 = time.perf_counter()
+                y = step(x)                     # unblocked: flagged
+                w1 = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                z = jax.block_until_ready(step(x))
+                w2 = time.perf_counter() - t0   # blocked: clean
+                return w1, w2, y, z
+            """,
+            path=self.PATH,
+        )
+        assert codes(f) == ["unblocked-timing"]
+
+    def test_host_only_bracket_not_flagged(self):
+        f = run(
+            """
+            import time
+
+            def wall(items):
+                t0 = time.perf_counter()
+                n = len(items)
+                total = sum(range(n))
+                return time.perf_counter() - t0, total
+            """,
+            path=self.PATH,
+        )
+        assert f == []
+
+    def test_nested_function_brackets_are_scoped_separately(self):
+        # the delta lives in the nested fn whose t0 is a parameter: no
+        # bracket in either scope (the campaign's detect_one shape)
+        f = run(
+            """
+            import time
+
+            def outer(step, xs):
+                def finish(x, t0):
+                    y = step(x)
+                    return time.perf_counter() - t0
+                t0 = time.perf_counter()
+                return [finish(x, t0) for x in xs]
+            """,
+            path=self.PATH,
+        )
+        assert f == []
+
+    def test_out_of_scope_and_telemetry_exempt(self):
+        src = """
+            import time
+
+            def wall(step, x):
+                t0 = time.perf_counter()
+                y = step(x)
+                return time.perf_counter() - t0, y
+            """
+        assert run(src, path="das4whales_tpu/viz/scratch.py") == []
+        assert run(src, path="das4whales_tpu/telemetry/scratch.py") == []
+
+    def test_inline_allow_suppresses(self):
+        f = run(
+            """
+            import time
+
+            def wall(step, x):
+                t0 = time.perf_counter()
+                y = step(x)
+                # daslint: allow[R7] the sync happens inside step's packed fetch
+                return time.perf_counter() - t0, y
+            """,
+            path=self.PATH,
+        )
+        assert f == []

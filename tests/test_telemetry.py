@@ -1,0 +1,480 @@
+"""Flight recorder (ISSUE 11): spans, metrics registry, probes, and the
+traced-campaign acceptance drill.
+
+Contracts pinned here:
+
+* span nesting + attribute schema, Chrome-trace JSON round-trip;
+* the DISABLED fast path is a shared no-op singleton that adds no
+  dispatches or compiles (``compile_guard``) and costs ~ns per site;
+* the metrics registry view is value- and key-identical to
+  ``faults.counters()`` (the back-compat pin), delta semantics hold
+  under threads, and the Prometheus/JSON surfaces render;
+* the probe truth table: healthy / watchdog-tripped /
+  quarantine-breached;
+* a chaos-seeded batched campaign with tracing ON yields bit-identical
+  picks, a Perfetto-loadable trace whose root span covers >= 95% of the
+  campaign wall, and a downshift ledger whose span ids resolve
+  one-to-one against the trace;
+* the satellites: ``get_logger`` honors explicit levels,
+  ``progress`` keeps ``len()``/``desc`` without tqdm, and
+  ``timed_best`` is the one timing definition.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from das4whales_tpu import faults
+from das4whales_tpu.io.synth import (
+    SyntheticCall,
+    SyntheticScene,
+    write_synthetic_file,
+)
+from das4whales_tpu.telemetry import metrics, probes, trace
+from das4whales_tpu.telemetry.progress import _PlainProgress, progress
+from das4whales_tpu.workflows.campaign import load_picks, run_campaign_batched
+
+NX, NS = 24, 900
+SEL = [0, NX, 1]
+N_FILES = 4
+
+
+@pytest.fixture(scope="module")
+def file_set(tmp_path_factory):
+    d = tmp_path_factory.mktemp("teledata")
+    paths = []
+    for k in range(N_FILES):
+        scene = SyntheticScene(
+            nx=NX, ns=NS, noise_rms=0.05, seed=100 + k,
+            calls=[SyntheticCall(t0=1.2 + 0.3 * k, x0_m=NX / 2 * 2.042,
+                                 amplitude=2.0)],
+        )
+        p = str(d / f"tf{k}.h5")
+        write_synthetic_file(p, scene)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture()
+def tracing():
+    """Enabled, cleared tracer for the duration of one test."""
+    was = trace.enabled()
+    trace.enable(clear=True)
+    try:
+        yield trace
+    finally:
+        if not was:
+            trace.disable()
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_attribute_schema(tracing):
+    with trace.span("outer", file="a.h5", rung="batched:4") as so:
+        assert trace.current_span_id() == so.span_id
+        with trace.span("inner", family="mf", b=4) as si:
+            assert si.parent_id == so.span_id
+    assert trace.current_span_id() is None
+    recs = {r["name"]: r for r in trace.spans()}
+    assert recs["inner"]["parent_id"] == recs["outer"]["span_id"]
+    assert recs["outer"]["parent_id"] is None
+    assert recs["outer"]["attrs"] == {"file": "a.h5", "rung": "batched:4"}
+    assert recs["inner"]["attrs"] == {"family": "mf", "b": 4}
+    for r in recs.values():   # schema: every span carries the full tuple
+        assert {"name", "span_id", "parent_id", "t0", "t1", "thread",
+                "attrs"} <= set(r)
+        assert r["t1"] >= r["t0"]
+
+
+def test_span_records_error_and_unwinds(tracing):
+    with pytest.raises(ValueError):
+        with trace.span("boom"):
+            raise ValueError("x")
+    rec = trace.spans()[-1]
+    assert rec["name"] == "boom" and rec["error"] == "ValueError"
+    assert trace.current_span_id() is None   # stack unwound
+
+
+def test_chrome_trace_roundtrip(tracing, tmp_path):
+    with trace.span("campaign", n_files=2):
+        with trace.span("file", file="f0.h5"):
+            pass
+    out = trace.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(out) as fh:
+        payload = json.load(fh)
+    evs = [e for e in payload["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in evs} == {"campaign", "file"}
+    for e in evs:
+        assert e["dur"] >= 0 and isinstance(e["ts"], float)
+        assert "span_id" in e["args"]
+    child = next(e for e in evs if e["name"] == "file")
+    parent = next(e for e in evs if e["name"] == "campaign")
+    assert child["args"]["parent_span_id"] == parent["args"]["span_id"]
+
+
+def test_disabled_mode_is_shared_noop_singleton():
+    assert not trace.enabled()
+    assert trace.span("a", x=1) is trace.span("b")   # no per-call object
+    with trace.span("a") as sp:
+        assert sp.span_id is None
+    assert trace.current_span_id() is None
+
+
+def test_disabled_spans_add_no_dispatch_or_compile(compile_guard):
+    """compile_guard pin: tracing must not add dispatches or compiles —
+    a disabled span around a jitted call is pure Python."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a: a * 2.0)
+    x = jnp.arange(8.0)
+    jax.block_until_ready(f(x))   # warm
+    with compile_guard.forbid_recompile("disabled-span around jit"):
+        with trace.span("quick", file="x"):
+            jax.block_until_ready(f(x))
+
+
+def test_disabled_overhead_budget():
+    """The no-op fast path at ~ns scale: 100k disabled span entries in
+    well under a second — against ms-scale slab walls that is < 1%
+    overhead at any realistic span rate (docs/OBSERVABILITY.md)."""
+    assert not trace.enabled()
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        with trace.span("hot", file="f", rung="batched:4"):
+            pass
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_span_buffer_is_bounded(tracing, monkeypatch):
+    """An always-on service must not grow the flight record without
+    bound: past DAS_TRACE_BUFFER new spans count as dropped."""
+    monkeypatch.setenv("DAS_TRACE_BUFFER", "3")
+    for _ in range(5):
+        with trace.span("s"):
+            pass
+    assert len(trace.spans()) == 3
+    assert trace.n_dropped() == 2
+    trace.enable(clear=True)   # clear resets the drop counter too
+    assert trace.n_dropped() == 0
+
+
+def test_timed_best_blocks_and_returns_result():
+    import jax.numpy as jnp
+
+    best, out = trace.timed_best(lambda a: jnp.sum(a * a),
+                                 jnp.arange(100.0), repeats=2)
+    assert best >= 0.0
+    assert float(out) == float(np.sum(np.arange(100.0) ** 2))
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry + the faults.counters back-compat view
+# ---------------------------------------------------------------------------
+
+
+def test_counters_view_parity_with_faults():
+    before_f = faults.counters()
+    before_m = metrics.resilience_counters()
+    assert before_f == before_m                      # same keys, same values
+    assert set(metrics.RESILIENCE_KEYS) <= set(before_f)
+    faults.count("retries")
+    faults.count("dispatches", 3)
+    delta_f = faults.counters_delta(before_f)
+    delta_m = metrics.resilience_delta(before_m)
+    assert delta_f == delta_m
+    assert delta_f["retries"] == 1 and delta_f["dispatches"] == 3
+
+
+def test_counter_delta_semantics_under_threads():
+    before = metrics.resilience_counters()
+    n, per = 8, 500
+
+    def worker():
+        for _ in range(per):
+            faults.count("retries")
+
+    threads = [threading.Thread(target=worker) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert metrics.resilience_delta(before)["retries"] == n * per
+
+
+def test_registry_surfaces_render():
+    c = metrics.counter("das_test_events_total", "test counter", ("kind",))
+    c.inc(2, kind="a")
+    g = metrics.gauge("das_test_gauge", "test gauge")
+    g.set(4.5)
+    g.max(3.0)           # high-water keeps the max
+    assert g.value() == 4.5
+    h = metrics.histogram("das_test_wall_seconds", "test hist",
+                          buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    assert h.quantile(0.5) == 1.0
+    text = metrics.prometheus_text()
+    assert 'das_test_events_total{kind="a"} 2' in text
+    assert "# TYPE das_test_wall_seconds histogram" in text
+    assert 'das_test_wall_seconds_bucket{le="+Inf"} 3' in text
+    snap = metrics.snapshot()
+    assert snap["das_test_gauge"]["values"][0]["value"] == 4.5
+    row = snap["das_test_wall_seconds"]["values"][0]
+    assert row["count"] == 3 and row["max"] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# Probes: the liveness/readiness truth table
+# ---------------------------------------------------------------------------
+
+
+def test_probe_truth_table():
+    probes.reset()
+    # healthy
+    assert probes.liveness(max_watchdog_streak=1)
+    assert probes.readiness(max_watchdog_streak=1, max_quarantine_streak=3)
+    # watchdog-tripped: liveness AND readiness fail
+    probes.note_watchdog_timeout()
+    live = probes.liveness(max_watchdog_streak=1)
+    assert not live and live.reason == "watchdog-tripped"
+    ready = probes.readiness(max_watchdog_streak=1, max_quarantine_streak=3)
+    assert not ready and ready.reason == "watchdog-tripped"
+    # progress recovers liveness
+    probes.note_dispatch_ok()
+    assert probes.liveness(max_watchdog_streak=1)
+    # quarantine-breached: ready fails, live holds
+    for _ in range(3):
+        probes.note_quarantine()
+    assert probes.liveness(max_watchdog_streak=1)
+    ready = probes.readiness(max_watchdog_streak=1, max_quarantine_streak=3)
+    assert not ready and ready.reason == "quarantine-breached"
+    # a healthy done file resets the quarantine streak
+    probes.note_file_ok()
+    assert probes.readiness(max_watchdog_streak=1, max_quarantine_streak=3)
+    probes.reset()
+
+
+def test_probes_driven_by_faults_counters():
+    """The wiring: faults.count() IS the probe signal path."""
+    probes.reset()
+    faults.count("watchdog_timeouts")
+    assert not probes.liveness(max_watchdog_streak=1)
+    probes.note_dispatch_ok()
+    faults.count("quarantined")
+    assert not probes.readiness(max_quarantine_streak=1)
+    probes.reset()
+
+
+# ---------------------------------------------------------------------------
+# Satellites: progress fallback, logger level
+# ---------------------------------------------------------------------------
+
+
+def test_progress_fallback_preserves_len_total_desc():
+    bar = _PlainProgress(range(5), desc="files", total=None)
+    assert len(bar) == 5                       # sized iterable -> len works
+    assert list(bar) == [0, 1, 2, 3, 4]
+    bar = _PlainProgress(iter(range(3)), desc="x", total=3)
+    assert len(bar) == 3 and bar.desc == "x"   # explicit total honored
+    bar = _PlainProgress(iter(range(3)), desc="y", total=None)
+    with pytest.raises(TypeError):
+        len(bar)                               # honest: no silent 0
+    assert list(progress(range(4), desc="d")) == [0, 1, 2, 3]
+
+
+def test_progress_records_span_when_tracing(tracing):
+    assert list(progress([1, 2, 3], desc="loop")) == [1, 2, 3]
+    names = [r["name"] for r in trace.spans()]
+    assert "progress" in names
+
+
+def test_old_progress_entry_point_deprecated():
+    from das4whales_tpu.utils import profiling
+
+    with pytest.warns(DeprecationWarning):
+        out = list(profiling.progress(range(3), desc="old"))
+    assert out == [0, 1, 2]
+
+
+def test_get_logger_honors_explicit_level():
+    """Satellite: an explicit level is honored on EVERY call (it used to
+    be silently ignored once the handler existed), while the default
+    leaves an existing logger's level alone."""
+    from das4whales_tpu.utils.log import get_logger
+
+    name = "das4whales_tpu.test_level"
+    log = get_logger(name, level=logging.INFO)
+    assert log.level == logging.INFO
+    assert get_logger(name, level=logging.DEBUG).level == logging.DEBUG
+    # default call must NOT clobber the explicitly configured level
+    assert get_logger(name).level == logging.DEBUG
+    assert get_logger(name, level=logging.WARNING).level == logging.WARNING
+    assert len(log.handlers) == 1              # still one handler
+
+
+# ---------------------------------------------------------------------------
+# The acceptance drill: chaos campaign with the flight recorder on
+# ---------------------------------------------------------------------------
+
+
+def _load_trace_events(outdir):
+    with open(f"{outdir}/trace.json") as fh:
+        payload = json.load(fh)
+    return [e for e in payload["traceEvents"] if e.get("ph") == "X"]
+
+
+_TRACED_RESULT: dict = {}
+
+
+@pytest.fixture(scope="module")
+def traced_campaign(file_set, tmp_path_factory):
+    """ONE chaos-seeded (oom) batched campaign with the flight recorder
+    on, shared by the acceptance and report tests."""
+    out = str(tmp_path_factory.mktemp("traced") / "camp")
+    _TRACED_RESULT["res"] = run_campaign_batched(
+        file_set, SEL, out, batch=2, bucket="exact",
+        persistent_cache=False,
+        fault_plan=faults.FaultPlan(7, rate=1.0, kinds=("oom",)),
+        trace=True,
+    )
+    return out
+
+
+def test_chaos_campaign_traced_bit_identical_and_ledger_resolves(
+        file_set, traced_campaign, tmp_path):
+    """A chaos-seeded (oom) batched campaign with tracing ON: picks
+    bit-identical to tracing OFF, trace.json is Chrome-trace/Perfetto
+    valid, spans cover >= 95% of the campaign wall, and every downshift
+    ledger event resolves to exactly one downshift span by span id."""
+    import os
+
+    out_off = str(tmp_path / "off")
+    res_off = run_campaign_batched(
+        file_set, SEL, out_off, batch=2, bucket="exact",
+        persistent_cache=False,
+        fault_plan=faults.FaultPlan(7, rate=1.0, kinds=("oom",)),
+        trace=False,
+    )
+    assert not os.path.exists(f"{out_off}/trace.json")   # untraced: no record
+    out_on, res_on = traced_campaign, _TRACED_RESULT["res"]
+    assert not trace.enabled()   # per-campaign enable restores
+    assert res_on.n_done == res_off.n_done == N_FILES
+    assert res_on.n_failed == res_off.n_failed == 0
+
+    # bit-identical picks, file by file
+    off_by_path = {r.path: r for r in res_off.records}
+    for rec in res_on.records:
+        ref = load_picks(off_by_path[rec.path].picks_file)
+        got = load_picks(rec.picks_file)
+        assert set(got) == set(ref)
+        for name in ref:
+            np.testing.assert_array_equal(got[name], ref[name])
+
+    events = _load_trace_events(out_on)
+    assert events, "tracing on must leave a trace next to the manifest"
+
+    # root campaign span covers >= 95% of the span-set wall
+    t0 = min(e["ts"] for e in events)
+    t1 = max(e["ts"] + e["dur"] for e in events)
+    root = next(e for e in events if e["name"] == "campaign")
+    assert root["dur"] >= 0.95 * (t1 - t0)
+
+    # the span vocabulary showed up with its attributes
+    names = {e["name"] for e in events}
+    assert {"campaign", "slab", "resolve", "read", "downshift"} <= names
+    resolve = next(e for e in events if e["name"] == "resolve")
+    assert {"rung", "family", "n_files", "file"} <= set(resolve["args"])
+
+    # downshift ledger <-> downshift spans, one-to-one by span id
+    ledger = []
+    with open(f"{out_on}/manifest.jsonl") as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("event") == "downshift":
+                ledger.append(rec)
+    assert ledger, "the oom plan must have downshifted"
+    span_ids = [e["args"]["span_id"] for e in events
+                if e["name"] == "downshift"]
+    assert sorted(span_ids) == sorted(ev["span_id"] for ev in ledger)
+    assert len(set(span_ids)) == len(span_ids)
+    # counters event stamped with the enclosing (campaign root) span
+    counters_evs = []
+    with open(f"{out_on}/manifest.jsonl") as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("event") == "counters":
+                counters_evs.append(rec)
+    assert counters_evs and counters_evs[0]["span_id"] == \
+        root["args"]["span_id"]
+
+
+def test_trace_report_renders_the_flight_record(traced_campaign, capsys):
+    import importlib.util
+    import os
+
+    out = traced_campaign
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(root, "scripts", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rep = mod.build_report(out)
+    assert rep["n_spans"] > 0
+    assert rep["spans"]["by_name"]["campaign"]["count"] == 1
+    assert rep["ledger_span_audit"]["n_unresolved"] == 0
+    assert rep["ledger_span_audit"]["n_resolved"] >= 1
+    assert any(r["n_done"] for r in rep["rungs"])
+    mod.print_report(rep)
+    out_text = capsys.readouterr().out
+    assert "span aggregates" in out_text and "downshift ledger" in out_text
+
+
+def test_per_file_campaign_traced(file_set, tmp_path):
+    """run_campaign's trace= path: root span + per-file/resolve spans,
+    export next to the manifest, tracer restored after."""
+    from das4whales_tpu.io.stream import stream_strain_blocks
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.workflows.campaign import run_campaign
+
+    files = file_set[:2]   # two files exercise the whole span path
+    blk = next(stream_strain_blocks(files[:1], SEL, as_numpy=True))
+    det = MatchedFilterDetector(
+        blk.metadata, SEL, np.asarray(blk.trace).shape,
+        pick_mode="sparse", keep_correlograms=False,
+    )
+    out = str(tmp_path / "perfile")
+    res = run_campaign(files, SEL, out, detector=det, trace=True)
+    assert res.n_done == len(files) and not trace.enabled()
+    events = _load_trace_events(out)
+    names = {e["name"] for e in events}
+    assert {"campaign", "file", "resolve", "read"} <= names
+    assert sum(e["name"] == "file" for e in events) == len(files)
+    t0 = min(e["ts"] for e in events)
+    t1 = max(e["ts"] + e["dur"] for e in events)
+    root = next(e for e in events if e["name"] == "campaign")
+    assert root["dur"] >= 0.95 * (t1 - t0)
+
+
+def test_dispatch_metrics_populated_by_campaign(traced_campaign):
+    """The labeled surfaces the service substrate reads: per-rung
+    resolve tallies, queue-depth/residency, slab walls — populated by
+    the shared traced campaign (no extra run)."""
+    snap = metrics.snapshot()
+    resolves = snap["das_rung_resolves_total"]["values"]
+    assert any(r["labels"]["outcome"] == "ok" and r["value"] >= 1
+               for r in resolves)
+    assert all({"rung", "family", "outcome"} == set(r["labels"])
+               for r in resolves)
+    slab = snap.get("das_slab_wall_seconds", {"values": []})["values"]
+    assert slab and slab[0]["count"] >= 1
