@@ -25,7 +25,7 @@ def __getattr__(name):
     # viz needs matplotlib (an optional extra); load it on first use so a
     # base install can run detection/localization headless. eval/parallel/
     # workflows load lazily to keep plain-kernel imports light.
-    if name in ("viz", "parallel", "workflows", "eval"):
+    if name in ("viz", "parallel", "workflows", "eval", "service"):
         import importlib
 
         module = importlib.import_module(f".{name}", __name__)

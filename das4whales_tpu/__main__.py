@@ -119,6 +119,30 @@ def build_parser() -> argparse.ArgumentParser:
     _add_route_flags(pc, default=True,
                      extra=" (library default; also governs the spectro/"
                            "gabor families' shared bandpass+f-k front end)")
+    ps = sub.add_parser(
+        "serve",
+        help="run the streaming multi-tenant detection service: "
+             "continuous ingest, fair multi-stream scheduling, and the "
+             "picks/health HTTP API (das4whales_tpu.service; "
+             "docs/SERVICE.md)",
+    )
+    ps.add_argument("config",
+                    help="JSON tenant registry (tenants, outdir, port — "
+                         "schema in docs/SERVICE.md)")
+    ps.add_argument("--port", type=int, default=None,
+                    help="override the registry's API port (0: ephemeral)")
+    ps.add_argument("--outdir", default=None,
+                    help="override the registry's output root")
+    ps.add_argument("--until-idle", action="store_true",
+                    help="exit once every replay source is exhausted and "
+                         "resolved (backfill mode) instead of serving "
+                         "until SIGTERM")
+    ps.add_argument("--no-resume", action="store_true",
+                    help="reprocess files already settled in the tenant "
+                         "manifests")
+    ps.add_argument("--trace", action="store_true", default=None,
+                    help="arm the flight recorder for the whole service "
+                         "run (exports <outdir>/trace.json at drain)")
     pl = sub.add_parser(
         "longrecord",
         help="continuous detection across file boundaries: consecutive "
@@ -274,6 +298,28 @@ def main(argv=None) -> int:
                 print("wrote", path, file=sys.stderr)
         print(json.dumps(payload, indent=1))
         return 0
+    if args.workflow == "serve":
+        from das4whales_tpu.service import load_service_config
+        from das4whales_tpu.service.runner import serve
+
+        cfg = load_service_config(args.config)
+        if args.port is not None:
+            cfg.port = args.port
+        if args.outdir is not None:
+            cfg.outdir = args.outdir
+        if args.no_resume:
+            cfg.resume = False
+        if args.trace:
+            cfg.trace = True
+        results = serve(cfg, until_idle=args.until_idle)
+        n_failed = 0
+        for name, res in results.items():
+            n_failed += res.n_failed
+            print(f"serve: tenant {name}: {res.n_done} done, "
+                  f"{res.n_failed} failed, {res.n_skipped} skipped, "
+                  f"{res.n_quarantined} quarantined, "
+                  f"{res.n_timeout} timeout -> {res.outdir}")
+        return 0 if n_failed == 0 else 3
     if args.workflow == "longrecord":
         import json as _json
 

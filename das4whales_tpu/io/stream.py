@@ -524,6 +524,37 @@ class BatchSlab:
         return len(self.blocks)
 
 
+def assemble_slab(blocks, paths, index0: int, batch: int,
+                  bucket_ns: int) -> BatchSlab:
+    """Stack same-bucket host blocks into one :class:`BatchSlab` — THE
+    bucket/padding rule of the batched ingest, in one place.
+
+    Every block is zero-padded on the time axis to ``bucket_ns`` and the
+    stack allocates the FULL ``batch`` file slots (trailing slots zero),
+    so one compiled program per (bucket, batch) shape serves full and
+    partial slabs alike. Shared by the campaign assembler
+    (:func:`stream_batched_slabs`), the ladder's re-bucketing
+    (:func:`subdivide_slab` builds its sub-stacks the same way) and the
+    service's continuous slicer (``service.ingest``) — a slab formed
+    from a live ring buffer is bit-identical to one formed from the
+    same files by the batch campaign.
+    """
+    blocks = tuple(blocks)
+    if not 1 <= len(blocks) <= batch:
+        raise ValueError(f"got {len(blocks)} blocks for a batch of {batch}")
+    tr0 = np.asarray(blocks[0].trace)
+    stack = np.zeros((batch, tr0.shape[0], int(bucket_ns)), tr0.dtype)
+    n_reals = []
+    for j, b in enumerate(blocks):
+        tr = np.asarray(b.trace)
+        stack[j, :, : tr.shape[1]] = tr
+        n_reals.append(tr.shape[1])
+    return BatchSlab(
+        stack=stack, blocks=blocks, paths=tuple(paths), index0=int(index0),
+        bucket_ns=int(bucket_ns), n_real=tuple(n_reals),
+    )
+
+
 def subdivide_slab(slab: BatchSlab, batch: int) -> list:
     """Split one :class:`BatchSlab` into smaller slabs of at most
     ``batch`` files each, re-assembled from the HOST blocks (the device
@@ -538,24 +569,15 @@ def subdivide_slab(slab: BatchSlab, batch: int) -> list:
     """
     if batch < 1:
         raise ValueError("batch must be >= 1")
-    subs = []
-    for s in range(0, slab.n_valid, batch):
-        group = slab.blocks[s : s + batch]
-        tr0 = np.asarray(group[0].trace)
-        # every sub-slab allocates the FULL rung batch (trailing file
-        # slots zero, like the assembler's partial slabs): one program
-        # per (bucket, batch) shape, not one per remainder size
-        stack = np.zeros((batch, tr0.shape[0], slab.bucket_ns), tr0.dtype)
-        for j, b in enumerate(group):
-            tr = np.asarray(b.trace)
-            stack[j, :, : tr.shape[1]] = tr
-        subs.append(BatchSlab(
-            stack=stack, blocks=tuple(group),
-            paths=slab.paths[s : s + batch], index0=slab.index0 + s,
-            bucket_ns=slab.bucket_ns,
-            n_real=slab.n_real[s : s + batch],
-        ))
-    return subs
+    # every sub-slab allocates the FULL rung batch (trailing file slots
+    # zero, like the assembler's partial slabs): one program per
+    # (bucket, batch) shape, not one per remainder size — assemble_slab
+    # owns that rule
+    return [
+        assemble_slab(slab.blocks[s : s + batch], slab.paths[s : s + batch],
+                      slab.index0 + s, batch, slab.bucket_ns)
+        for s in range(0, slab.n_valid, batch)
+    ]
 
 
 class SlabReadError(RuntimeError):
@@ -584,23 +606,15 @@ def _assemble_host_slabs(files, selected_channels, metadata, *, batch,
     partial slab), so per-file pick order is stable across mixed-bucket
     campaigns."""
     pending: list = []
-    n_reals: list = []
     idx0 = 0
     cur_key = None  # (channels, bucket_ns, wire dtype)
 
     def flush():
-        nonlocal pending, n_reals
-        C, b_ns, dt = cur_key
-        stack = np.zeros((batch, C, b_ns), dt)
-        for j, b in enumerate(pending):
-            tr = np.asarray(b.trace)
-            stack[j, :, : tr.shape[1]] = tr
-        slab = BatchSlab(
-            stack=stack, blocks=tuple(pending),
-            paths=tuple(files[idx0 : idx0 + len(pending)]), index0=idx0,
-            bucket_ns=b_ns, n_real=tuple(n_reals),
-        )
-        pending, n_reals = [], []
+        nonlocal pending
+        _C, b_ns, _dt = cur_key
+        slab = assemble_slab(pending, files[idx0 : idx0 + len(pending)],
+                             idx0, batch, b_ns)
+        pending = []
         return slab
 
     stream = stream_strain_blocks(
@@ -629,7 +643,6 @@ def _assemble_host_slabs(files, selected_channels, metadata, *, batch,
             idx0 = i
         cur_key = key
         pending.append(blk)
-        n_reals.append(tr.shape[1])
         if len(pending) == batch:
             yield flush()
             idx0 = i + 1

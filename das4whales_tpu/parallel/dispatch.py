@@ -199,9 +199,28 @@ class PipelinedDispatch:
     def __len__(self) -> int:
         return len(self._q)
 
+    def in_flight(self) -> int:
+        """Tokens currently dispatched and unresolved — the queue depth
+        the ``das_dispatch_queue_depth`` gauge mirrors. The service
+        scheduler's overlap accounting (and tests) read this instead of
+        reaching into the queue internals."""
+        return len(self._q)
+
+    def pending(self) -> Tuple[Any, ...]:
+        """The KEYS of the in-flight tokens, oldest first — what would
+        come back from :meth:`drain`, without resolving anything. The
+        multi-stream scheduler uses this to see WHOSE slabs are in
+        flight (fairness/overlap decisions); campaign code uses it for
+        bookkeeping assertions."""
+        return tuple(key for key, _handle, _t in self._q)
+
+    def _note_depth(self) -> None:
+        # the gauge rides the public accessor: one definition of depth
+        _queue_depth.set(self.in_flight())
+
     def _pop(self) -> Tuple[Any, Any]:
         key, handle, t_in = self._q.popleft()
-        _queue_depth.set(len(self._q))
+        self._note_depth()
         _residency.observe(time.perf_counter() - t_in)
         return key, handle
 
@@ -210,7 +229,7 @@ class PipelinedDispatch:
         that must be resolved NOW to keep at most ``depth`` in flight
         (oldest first)."""
         self._q.append((key, handle, time.perf_counter()))
-        _queue_depth.set(len(self._q))
+        self._note_depth()
         out: List[Tuple[Any, Any]] = []
         while len(self._q) > self.depth:
             out.append(self._pop())

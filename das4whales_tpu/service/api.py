@@ -1,0 +1,285 @@
+"""The served surface: picks streams, metrics, probes, live ingest.
+
+Pure stdlib (``http.server``) so the service has zero web-framework
+dependencies — the same discipline as ``telemetry`` (Prometheus text is
+just text). Endpoints (docs/SERVICE.md):
+
+``GET /livez`` / ``GET /readyz``
+    ``telemetry.probes`` verdicts as 200/503 + JSON detail — the exact
+    truth table PR 10 pinned (healthy / watchdog-tripped /
+    quarantine-breached), now actually answerable by a load balancer.
+``GET /metrics``
+    The whole labeled registry as Prometheus text exposition 0.0.4
+    (``telemetry.metrics.prometheus_text``).
+``GET /tenants``
+    JSON service snapshot: per-tenant disposition counts, ring depth,
+    sticky rungs, DRR deficits.
+``GET /picks/<tenant>?cursor=N&wait_s=S&limit=M&picks=1``
+    The tenant's pick stream as NDJSON with CURSOR RESUME, backed by
+    the append-only manifest: each line is one manifest record plus a
+    ``cursor`` field naming the NEXT line to request, so a subscriber
+    that reconnects with its last cursor misses nothing and re-reads
+    nothing — the manifest IS the stream, no second bookkeeping.
+    ``wait_s`` long-polls: with no new records the response blocks up
+    to that long before returning (possibly empty), so a subscriber
+    holds one cheap request open instead of hammering. ``picks=1``
+    embeds the pick arrays from the ``.npz`` artifact into each
+    ``done`` record.
+``POST /ingest/<tenant>``
+    One live block (binary body, shape/dtype in headers) into the
+    tenant's ring buffer. A full ring under the tenant's ``reject``
+    policy answers **429** with ``Retry-After`` — explicit
+    backpressure the interrogator can act on; under ``drop_oldest``
+    the push always lands (202) and the evicted block is counted as
+    ``das_ingest_dropped_total{tenant}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ..telemetry import metrics, probes
+from ..utils.log import get_logger
+from .ingest import IngestItem, LiveBlock
+
+log = get_logger("service.api")
+
+#: Retry-After seconds suggested on a 429 (reject-policy full ring).
+RETRY_AFTER_S = 1
+
+
+def _probe_payload(result) -> dict:
+    return {"ok": bool(result), "reason": result.reason,
+            "detail": result.detail}
+
+
+class ServiceAPI:
+    """The HTTP server bound to one running service (``runner``)."""
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+        self.service = service
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # one service, many subscriber threads: ThreadingHTTPServer
+            # below serves each request on its own daemon thread
+            def log_message(self, fmt, *args):  # noqa: D401, N802
+                log.debug("http: " + fmt, *args)
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json",
+                      extra: dict | None = None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra or {}).items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, payload,
+                           extra: dict | None = None) -> None:
+                self._send(code, (json.dumps(payload) + "\n").encode(),
+                           extra=extra)
+
+            def do_GET(self):  # noqa: N802
+                try:
+                    api._get(self)
+                except BrokenPipeError:   # subscriber went away mid-write
+                    pass
+                except Exception as exc:  # noqa: BLE001 — 500, keep serving
+                    log.warning("http GET %s failed: %s", self.path, exc)
+                    try:
+                        self._send_json(500, {"error": str(exc)})
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def do_POST(self):  # noqa: N802
+                try:
+                    api._post(self)
+                except Exception as exc:  # noqa: BLE001
+                    log.warning("http POST %s failed: %s", self.path, exc)
+                    try:
+                        self._send_json(500, {"error": str(exc)})
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ServiceAPI":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="service-api",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- request routing ---------------------------------------------------
+
+    def _get(self, h) -> None:
+        url = urlparse(h.path)
+        parts = [p for p in url.path.split("/") if p]
+        if url.path == "/livez":
+            res = probes.liveness()
+            h._send_json(200 if res else 503, _probe_payload(res))
+        elif url.path == "/readyz":
+            res = probes.readiness()
+            h._send_json(200 if res else 503, _probe_payload(res))
+        elif url.path == "/metrics":
+            h._send(200, metrics.prometheus_text().encode(),
+                    ctype="text/plain; version=0.0.4")
+        elif url.path == "/tenants":
+            h._send_json(200, self.service.snapshot())
+        elif len(parts) == 2 and parts[0] == "picks":
+            self._get_picks(h, parts[1], parse_qs(url.query))
+        else:
+            h._send_json(404, {"error": f"no route {url.path}"})
+
+    def _get_picks(self, h, tenant: str, q) -> None:
+        t = self.service.tenant(tenant)
+        if t is None:
+            h._send_json(404, {"error": f"unknown tenant {tenant!r}"})
+            return
+        cursor = int(q.get("cursor", ["0"])[0])
+        wait_s = float(q.get("wait_s", ["0"])[0])
+        limit = int(q.get("limit", ["1000"])[0])
+        embed = q.get("picks", ["0"])[0] not in ("0", "", "false")
+        lines, cursor = self._manifest_since(t.outdir, cursor, limit, wait_s)
+        out = []
+        next_cursor = cursor - len(lines)
+        for rec in lines:
+            next_cursor += 1
+            rec["cursor"] = next_cursor
+            if embed and rec.get("status") == "done" and rec.get("picks_file"):
+                try:
+                    from ..workflows.campaign import load_picks
+
+                    rec["picks"] = {
+                        name: np.asarray(pk).tolist()
+                        for name, pk in load_picks(rec["picks_file"]).items()
+                    }
+                except OSError:
+                    rec["picks"] = None
+            out.append(json.dumps(rec))
+        body = ("\n".join(out) + ("\n" if out else "")).encode()
+        h._send(200, body, ctype="application/x-ndjson",
+                extra={"X-DAS-Cursor": cursor})
+
+    #: per-manifest line index: path -> [byte offset of line 0, line 1,
+    #: …, scan-resume offset]. The manifest is APPEND-ONLY, so offsets
+    #: never invalidate; each poll reads only bytes past the last
+    #: indexed complete line — O(new data), not O(file), which is what
+    #: keeps a long-polling subscriber cheap against a week-long
+    #: tenant's multi-MB manifest. Memory: one int per manifest line.
+    _line_index: dict = {}
+    _index_lock = threading.Lock()
+
+    @classmethod
+    def _extend_index(cls, path: str) -> list:
+        with cls._index_lock:
+            idx = cls._line_index.setdefault(path, [0])
+            try:
+                with open(path, "rb") as fh:
+                    fh.seek(idx[-1])
+                    tail = fh.read()
+            except OSError:
+                return idx
+            # only COMPLETE (newline-terminated) lines are indexed: a
+            # torn final line — a crash mid-append — stays invisible
+            # until its rewrite completes on resume
+            pos = idx[-1]
+            while True:
+                nl = tail.find(b"\n")
+                if nl < 0:
+                    break
+                pos += nl + 1
+                idx.append(pos)
+                tail = tail[nl + 1:]
+            return idx
+
+    @classmethod
+    def _manifest_since(cls, outdir: str, cursor: int, limit: int,
+                        wait_s: float):
+        """Manifest records past line ``cursor`` (the append-only file
+        is the stream). Long-polls up to ``wait_s`` when nothing is
+        new."""
+        path = os.path.join(outdir, "manifest.jsonl")
+        deadline = time.monotonic() + max(0.0, wait_s)
+        while True:
+            idx = cls._extend_index(path)
+            n_complete = len(idx) - 1
+            recs = []
+            if cursor < n_complete:
+                stop = min(cursor + limit, n_complete)
+                try:
+                    with open(path, "rb") as fh:
+                        fh.seek(idx[cursor])
+                        chunk = fh.read(idx[stop] - idx[cursor])
+                    for line in chunk.splitlines():
+                        recs.append(json.loads(line))
+                except (OSError, json.JSONDecodeError):
+                    recs = []   # raced a rewrite: retry/poll below
+            if recs or time.monotonic() >= deadline:
+                return recs, cursor + len(recs)
+            time.sleep(0.05)
+
+    def _post(self, h) -> None:
+        parts = [p for p in urlparse(h.path).path.split("/") if p]
+        if len(parts) != 2 or parts[0] != "ingest":
+            h._send_json(404, {"error": f"no route {h.path}"})
+            return
+        t = self.service.tenant(parts[1])
+        if t is None:
+            h._send_json(404, {"error": f"unknown tenant {parts[1]!r}"})
+            return
+        try:
+            shape = tuple(int(v) for v in
+                          h.headers.get("X-DAS-Shape", "").split(","))
+            dtype = np.dtype(h.headers.get("X-DAS-Dtype", "float32"))
+            if len(shape) != 2:
+                raise ValueError("X-DAS-Shape must be 'channels,samples'")
+            n = int(h.headers.get("Content-Length", 0))
+            raw = h.rfile.read(n)
+            arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
+        except Exception as exc:  # noqa: BLE001 — bad payload is a 400
+            h._send_json(400, {"error": f"bad block: {exc}"})
+            return
+        # the name is the manifest/retry/artifact identity key: un-named
+        # pushes draw a per-tenant monotonic sequence (a wall-clock
+        # default can collide within one millisecond)
+        name = h.headers.get("X-DAS-Name") or t.next_live_name()
+        block = LiveBlock(trace=arr, metadata=t.spec.live_metadata(),
+                          wire=t.spec.wire)
+        if t.ring.push(IngestItem(path=name, block=block)):
+            h._send_json(202, {"accepted": name, "ring_depth": len(t.ring)})
+        else:
+            # explicit backpressure: the ring is full under the reject
+            # policy (or closed during drain) — the interrogator should
+            # back off and retry (docs/SERVICE.md)
+            h._send_json(429, {
+                "error": "ring buffer full (reject policy)"
+                if not t.ring.closed else "service draining",
+                "ring_depth": len(t.ring),
+            }, extra={"Retry-After": RETRY_AFTER_S})

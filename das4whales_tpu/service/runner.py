@@ -1,0 +1,319 @@
+"""Service lifecycle: tenant registry, run loop, drain, resume.
+
+``python -m das4whales_tpu serve tenants.json`` builds a
+:class:`DetectionService` from a JSON tenant registry and runs it until
+SIGTERM/SIGINT. The registry (docs/SERVICE.md) is::
+
+    {
+      "outdir": "out_service",
+      "host": "127.0.0.1", "port": 8080,
+      "dispatch_depth": 2, "trace": false,
+      "tenants": [
+        {"name": "array-a", "files": ["day1/*.h5 paths..."],
+         "channels": [0, 9000, 1], "batch": 4, "bucket": "pow2",
+         "bank": "fin", "hbm_share_gb": 8.0, "weight": 1.0,
+         "ring_capacity": 8, "overflow": "reject",
+         "realtime_factor": 1.0},
+        ...
+      ]
+    }
+
+Lifecycle contract (pinned by tests/test_service.py):
+
+* **SIGTERM graceful drain** — sources stop, rings close, every
+  dispatched-unresolved slab resolves through its own tenant's
+  executor, per-tenant counters events flush, and the span trace
+  exports to ``<outdir>/trace.json`` (when tracing is on). Files that
+  were ingested but never detected simply have no manifest record.
+* **crash/drain resume** — on the next start each tenant loads its
+  settled set from its own manifest (the PR 4 semantics: done +
+  quarantined settle; failed/timeout retry) and the replay source
+  skips settled files at the source, so nothing re-runs and nothing is
+  lost.
+* per-tenant picks are bit-identical to a standalone
+  ``run_campaign_batched`` over the same files — the service is the
+  same math on the same slabs, scheduled differently.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..telemetry import trace as telemetry
+from ..utils.log import get_logger
+from .api import ServiceAPI
+from .ingest import FileReplaySource
+from .scheduler import StreamScheduler, TenantRuntime
+
+log = get_logger("service.runner")
+
+
+@dataclass
+class TenantSpec:
+    """One tenant (fiber array × subscriber configuration) in the
+    registry. ``files`` is the replay/backfill source (empty for a
+    live-ingest-only tenant); ``metadata`` (dict of
+    ``config.AcquisitionMetadata`` fields) is required for live ingest
+    and optional for replay (probed from the files otherwise)."""
+
+    name: str
+    files: List[str] = field(default_factory=list)
+    channels: List[int] | None = None
+    batch: int = 4
+    bucket: object = "pow2"
+    bank: str | None = None
+    wire: str = "conditioned"
+    interrogator: str = "optasense"
+    engine: str = "h5py"
+    metadata: Dict | None = None
+    #: DRR weight: 2.0 gets twice the megasample credit per round
+    weight: float = 1.0
+    #: this tenant's own HBM admission budget (None: the process
+    #: DAS_HBM_BUDGET_GB) — the AOT preflight prices against it
+    hbm_share_gb: float | None = None
+    admission: bool = True
+    ring_capacity: int = 8
+    #: "reject" (full ring -> 429) or "drop_oldest" (evict + count)
+    overflow: str = "reject"
+    #: replay pacing: 1.0 = real time, 0/None = as fast as the reader
+    realtime_factor: float | None = None
+    linger_s: float = 0.25
+    retry: object = None
+    health: object = True
+    max_failures: int | None = None
+    read_deadline_s: float | None = None
+    dispatch_deadline_s: float | None = None
+    donate: bool = True
+    serial: bool | None = None
+    detector_kwargs: Dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("tenant name must be non-empty")
+        if self.dispatch_deadline_s is None:
+            from ..config import dispatch_deadline_default
+
+            self.dispatch_deadline_s = dispatch_deadline_default()
+
+    def live_metadata(self):
+        """Metadata for live-ingested blocks (the HTTP feed carries
+        samples, not headers)."""
+        if self.metadata is None:
+            return None
+        from ..config import as_metadata
+
+        return as_metadata(self.metadata)
+
+
+@dataclass
+class ServiceConfig:
+    tenants: List[TenantSpec]
+    outdir: str = "out_service"
+    host: str = "127.0.0.1"
+    #: 0 binds an ephemeral port (tests); the bound port is
+    #: ``DetectionService.api.port``
+    port: int = 0
+    dispatch_depth: int | None = None
+    trace: bool | None = None
+    resume: bool = True
+    persistent_cache: bool | str = True
+
+
+_TENANT_KEYS = {f.name for f in TenantSpec.__dataclass_fields__.values()}
+
+
+def load_service_config(path: str) -> ServiceConfig:
+    """Parse a JSON tenant registry into a :class:`ServiceConfig`
+    (unknown keys fail loudly — a typo'd knob must not silently run
+    with the default)."""
+    with open(path) as fh:
+        raw = json.load(fh)
+    tenants = []
+    for t in raw.get("tenants", []):
+        unknown = set(t) - _TENANT_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown tenant keys {sorted(unknown)} for "
+                f"{t.get('name', '?')!r}; known: {sorted(_TENANT_KEYS)}"
+            )
+        tenants.append(TenantSpec(**t))
+    if not tenants:
+        raise ValueError(f"{path}: no tenants configured")
+    known = {"tenants", "outdir", "host", "port", "dispatch_depth", "trace",
+             "resume", "persistent_cache"}
+    unknown = set(raw) - known
+    if unknown:
+        raise ValueError(f"unknown service keys {sorted(unknown)}; "
+                         f"known: {sorted(known)}")
+    return ServiceConfig(
+        tenants=tenants, outdir=raw.get("outdir", "out_service"),
+        host=raw.get("host", "127.0.0.1"), port=int(raw.get("port", 0)),
+        dispatch_depth=raw.get("dispatch_depth"),
+        trace=raw.get("trace"), resume=bool(raw.get("resume", True)),
+        persistent_cache=raw.get("persistent_cache", True),
+    )
+
+
+class DetectionService:
+    """The persistent process: N tenants, one scheduler, one API.
+
+    ``fault_plans`` maps tenant name -> ``faults.FaultPlan`` (the chaos
+    harness, per tenant — tests only). Start with :meth:`start` (API +
+    sources), run the scheduler with :meth:`run`; :meth:`request_stop`
+    (the SIGTERM handler) begins the graceful drain.
+    """
+
+    def __init__(self, config: ServiceConfig, fault_plans=None):
+        self.config = config
+        os.makedirs(config.outdir, exist_ok=True)
+        if config.persistent_cache:
+            from ..config import enable_persistent_compilation_cache
+
+            enable_persistent_compilation_cache(
+                config.persistent_cache
+                if isinstance(config.persistent_cache, str) else None
+            )
+        fault_plans = fault_plans or {}
+        self.tenants: Dict[str, TenantRuntime] = {}
+        self.sources: Dict[str, FileReplaySource] = {}
+        for spec in config.tenants:
+            t = TenantRuntime(
+                spec, os.path.join(config.outdir, spec.name),
+                resume=config.resume, fault_plan=fault_plans.get(spec.name),
+            )
+            self.tenants[spec.name] = t
+            files = t.replay_files()
+            if files:
+                self.sources[spec.name] = FileReplaySource(
+                    t.ring, files, spec.channels, spec.metadata,
+                    interrogator=spec.interrogator, engine=spec.engine,
+                    wire=spec.wire,
+                    realtime_factor=spec.realtime_factor,
+                    read_deadline_s=spec.read_deadline_s,
+                    fault_plan=fault_plans.get(spec.name),
+                )
+            elif spec.files:
+                # replay tenant with every file already settled: nothing
+                # will ever arrive — close the ring so until_idle runs
+                # (and the resume drill) terminate
+                t.ring.close()
+            # tenants with NO files configured are live-only: their ring
+            # stays open for HTTP ingest until drain
+        self.scheduler = StreamScheduler(self.tenants.values(),
+                                         dispatch_depth=config.dispatch_depth)
+        self.api = ServiceAPI(self, host=config.host, port=config.port)
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+
+    # -- the API's view ----------------------------------------------------
+
+    def tenant(self, name: str) -> Optional[TenantRuntime]:
+        return self.tenants.get(name)
+
+    def snapshot(self) -> Dict:
+        from ..telemetry import probes
+
+        return {
+            "outdir": self.config.outdir,
+            "draining": self._stop.is_set(),
+            "drained": self._drained.is_set(),
+            "probes": probes.snapshot(),
+            "in_flight_slabs": self.scheduler.pipe.in_flight(),
+            "tenants": [t.snapshot() for t in self.tenants.values()],
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "DetectionService":
+        from ..telemetry import probes
+
+        # a service start is a new serving lifetime: the probe streaks
+        # describe THIS process-as-a-service, not whatever batch
+        # campaigns ran in the process before (in production the two
+        # coincide; embedded/tests they need not) — a freshly started
+        # service must answer /livez healthy until ITS dispatches say
+        # otherwise
+        probes.reset()
+        self.api.start()
+        for src in self.sources.values():
+            src.start()
+        log.info("service up: %d tenant(s), api %s",
+                 len(self.tenants), self.api.url)
+        return self
+
+    def request_stop(self) -> None:
+        """Begin the graceful drain (idempotent; the SIGTERM handler).
+        Sources stop, rings close (new ingest answers 429 'draining');
+        the run loop finishes in-flight slabs and exits."""
+        if self._stop.is_set():
+            return
+        log.info("drain requested: stopping sources, closing rings")
+        self._stop.set()
+        for src in self.sources.values():
+            src.stop()
+        for t in self.tenants.values():
+            t.ring.close()
+
+    def run(self, until_idle: bool = True) -> Dict:
+        """The scheduler loop, on the caller's thread, inside the trace
+        harness. ``until_idle=True`` (replay/bench/backfill) returns
+        once every source is exhausted and resolved; ``False`` (serve)
+        runs until :meth:`request_stop`. Either way the exit path IS
+        the drain: in-flight slabs resolve, tallies flush, the trace
+        exports to ``<outdir>/trace.json``."""
+        with telemetry.campaign_trace(
+            self.config.outdir, self.config.trace, kind="service",
+            n_tenants=len(self.tenants),
+        ):
+            try:
+                self.scheduler.run_until_idle(should_stop=self._stop.is_set)
+                if not until_idle:
+                    # serve mode: stay up past idle (a live tenant's next
+                    # HTTP push re-fills its ring) until a drain is
+                    # requested
+                    while not self._stop.is_set():
+                        self._stop.wait(0.05)
+                        self.scheduler.run_until_idle(
+                            should_stop=self._stop.is_set
+                        )
+            finally:
+                # the drain half that must happen on EVERY exit path:
+                # finish in-flight slabs, flush per-tenant counters
+                self.scheduler.drain()
+                for t in self.tenants.values():
+                    t.finish()
+                self._drained.set()
+        return {name: t.result() for name, t in self.tenants.items()}
+
+    def stop(self) -> None:
+        """Tear down the API server (after :meth:`run` returned)."""
+        self.api.stop()
+
+    def results(self) -> Dict:
+        return {name: t.result() for name, t in self.tenants.items()}
+
+
+def serve(config: ServiceConfig | str, until_idle: bool = False,
+          install_signal_handlers: bool = True) -> Dict:
+    """Run a service to completion: the ``python -m das4whales_tpu
+    serve`` body. SIGTERM/SIGINT trigger the graceful drain."""
+    if isinstance(config, str):
+        config = load_service_config(config)
+    svc = DetectionService(config)
+    if install_signal_handlers:
+        def _handler(signum, _frame):
+            log.info("signal %d: draining", signum)
+            svc.request_stop()
+
+        signal.signal(signal.SIGTERM, _handler)
+        signal.signal(signal.SIGINT, _handler)
+    svc.start()
+    try:
+        return svc.run(until_idle=until_idle)
+    finally:
+        svc.stop()

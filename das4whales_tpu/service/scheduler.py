@@ -1,0 +1,726 @@
+"""Fair multi-stream scheduling over one shared dispatch pipeline.
+
+``parallel.dispatch.PipelinedDispatch`` keeps ONE campaign's device
+queue non-empty; this module generalizes it to N tenants: every
+tenant's slabs ride the same bounded in-flight queue, interleaved by
+DEFICIT ROUND-ROBIN, so the H2D, compute and D2H of *different*
+tenants' slabs overlap exactly like one campaign's consecutive slabs
+do — the chip never idles because one tenant's ring ran dry.
+
+Per tenant (:class:`TenantRuntime`), the batch campaign's whole
+resilience stack applies independently:
+
+* **admission** — the AOT memory preflight (``utils.memory``) prices
+  every candidate ``(bucket, B)`` program against the TENANT's own HBM
+  share before its first dispatch, so one tenant's huge chirp-grid
+  bank pins ITSELF to a leaner rung (or is refused) instead of evicting
+  another tenant's steady stream;
+* **the downshift ladder, per tenant** — a resource-class failure
+  downshifts only the culprit tenant's bucket (sticky, ledgered in
+  that tenant's manifest); other tenants stay on their fast rung;
+* **classified disposition** — retry/quarantine/timeout/degrade per
+  file, through the same ``_Resilience`` machinery, into the same
+  per-tenant ``manifest.jsonl`` + ``picks/*.npz`` artifacts the batch
+  campaign writes — which is what makes service picks bit-identical to
+  each tenant's standalone ``run_campaign_batched`` run
+  (tests/test_service.py pins it).
+
+Fairness (:class:`StreamScheduler`): textbook DRR — each tenant holds a
+deficit counter in megasamples; a scheduling round credits each active
+tenant its quantum (weighted by ``TenantSpec.weight``) and serves ready
+slabs while the deficit covers their cost, so a tenant with 4× the
+channels doesn't get 4× the slab slots — byte-fairness, not slab-count
+fairness.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import faults
+from ..parallel.dispatch import PipelinedDispatch, resolve_watchdogged
+from ..telemetry import metrics, trace as telemetry
+from ..utils.log import get_logger
+from ..workflows import campaign as camp
+from ..workflows.planner import DownshiftLadder, MatchedFilterProgram
+from .ingest import IngestItem, RingBuffer, SlabSlicer
+
+log = get_logger("service.scheduler")
+
+_c_slabs = metrics.counter(
+    "das_service_slabs_total",
+    "slabs resolved by the service scheduler",
+    ("tenant",),
+)
+_c_overlapped = metrics.counter(
+    "das_service_overlapped_slabs_total",
+    "slabs whose resolve overlapped another in-flight dispatch (the "
+    "multi-stream pipelining win; fraction of das_service_slabs_total)",
+    ("tenant",),
+)
+_c_files = metrics.counter(
+    "das_service_files_total",
+    "files dispositioned by the service, by tenant and status",
+    ("tenant", "status"),
+)
+_g_deficit = metrics.gauge(
+    "das_service_deficit_msamples",
+    "each tenant's DRR deficit counter (megasamples of credit)",
+    ("tenant",),
+)
+
+
+class TenantRuntime:
+    """One tenant's continuous detection state: ring → slicer → the
+    batch campaign's per-slab executor, running forever.
+
+    ``spec`` is a ``service.runner.TenantSpec``; ``outdir`` is the
+    tenant's own manifest/picks directory (resume-compatible with —
+    and bit-identical to — a ``run_campaign_batched`` run over the
+    same files). ``fault_plan`` injects the chaos harness per tenant.
+    """
+
+    def __init__(self, spec, outdir: str, *, resume: bool = True,
+                 fault_plan=None):
+        self.spec = spec
+        self.name = spec.name
+        self.outdir = outdir
+        os.makedirs(outdir, exist_ok=True)
+        self.records: List[camp.FileRecord] = []
+        self.fault_plan = fault_plan
+        self.rz = camp._Resilience(outdir, self.records, spec.max_failures,
+                                   spec.retry, spec.health)
+        self.rz.family = "mf"
+        self.ladder = DownshiftLadder(self.rz, outdir, batch=spec.batch,
+                                      family="mf")
+        self.ring = RingBuffer(spec.name, capacity=spec.ring_capacity,
+                               policy=spec.overflow)
+        self.slicer = SlabSlicer(spec.batch, bucket=spec.bucket,
+                                 linger_s=spec.linger_s)
+        self.ready: deque = deque()       # BatchSlab | IngestItem(error)
+        self.deficit = 0.0
+        self.aborted: Optional[str] = None
+        self.settled = camp.load_settled(outdir) if resume else set()
+        for path in sorted(self.settled):
+            rec = camp.FileRecord(path=path, status="skipped")
+            self.records.append(rec)
+            _c_files.inc(tenant=self.name, status="skipped")
+        self._dets: Dict[tuple, object] = {}
+        self._progs: Dict[tuple, MatchedFilterProgram] = {}
+        self._skip_buckets: Dict[tuple, str] = {}
+        self._finished = False
+        # un-named live pushes get a per-tenant monotonic sequence: the
+        # name IS the manifest/retry/artifact identity key, so two
+        # pushes must never collide (a timestamp can, within one ms)
+        self._live_seq = itertools.count()
+
+    def next_live_name(self) -> str:
+        return f"{self.name}-live-{next(self._live_seq)}"
+
+    # -- ingest side -------------------------------------------------------
+
+    def replay_files(self) -> List[str]:
+        """The tenant's file list minus manifest-settled paths (crash
+        resume: settled files are skipped at the SOURCE, so a restarted
+        service never re-reads them)."""
+        return [f for f in self.spec.files if f not in self.settled]
+
+    def pump(self) -> None:
+        """Move ring items through the slicer into the ready queue."""
+        while True:
+            item = self.ring.pop()
+            if item is None:
+                break
+            self.ready.extend(self.slicer.offer(item))
+        if self.slicer.pending() and (
+                self.ring.exhausted() or self.slicer.linger_expired()):
+            slab = self.slicer.flush_partial()
+            if slab is not None:
+                self.ready.append(slab)
+
+    def idle(self) -> bool:
+        """Nothing buffered, nothing sliceable, source finished."""
+        return (not self.ready and self.slicer.pending() == 0
+                and self.ring.exhausted())
+
+    # -- detection side (the batch campaign's per-slab contract) -----------
+
+    def _bucket_key(self, slab) -> tuple:
+        return (slab.stack.shape[1], slab.bucket_ns,
+                np.dtype(np.asarray(slab.blocks[0].trace).dtype).name)
+
+    def _hbm_budget(self) -> int:
+        from ..config import hbm_budget_bytes
+
+        if self.spec.hbm_share_gb is not None:
+            return int(self.spec.hbm_share_gb * 2**30)
+        return hbm_budget_bytes()
+
+    def _admit_bucket(self, key, bdet, slab) -> None:
+        """Per-tenant HBM admission: the AOT preflight against THIS
+        tenant's share (``TenantSpec.hbm_share_gb``; default the
+        process budget). Mirrors the batch campaign's
+        ``preflight_bucket`` walk — full bank at each B, bank-split
+        where splittable, tiled last — but every pin/skip is ledgered
+        against the tenant so admission decisions are auditable per
+        stream."""
+        from ..parallel.batch import BatchedMatchedFilterDetector
+        from ..utils import memory as memutils
+
+        budget = self._hbm_budget()
+        dt = np.asarray(slab.blocks[0].trace).dtype
+        cands, b = [], self.spec.batch
+        while b >= 1:
+            cands.append(b)
+            b //= 2
+        split = bdet.det.supports_bank_split
+        rung_cands = []
+        for b_ in cands:
+            rung_cands.append(("batched", b_))
+            if split:
+                rung_cands.append(("bank", b_))
+
+        def price_rung(rung_):
+            stage_, b_ = rung_
+            bd = bdet.split_views()[0] if stage_ == "bank" else bdet
+            st = memutils.batched_program_memory(
+                bd, b_, dt, with_health=self.rz.health_cfg is not None,
+                health_clip=(self.rz.health_cfg.clip_abs
+                             if self.rz.health_cfg is not None else None),
+            )
+            if st is not None:
+                # the same HBM high-water the batch campaign's preflight
+                # feeds: a service-only process must still move the
+                # das_preflight_hbm_peak_bytes headroom signal
+                camp._g_preflight_hwm.max(float(st.peak))
+            return st
+
+        best = memutils.first_fitting(price_rung, rung_cands, budget)
+        if best is not None:
+            stage_, b_ = best
+            if stage_ == "bank":
+                self.ladder.pin(key, ("bank", b_), (
+                    f"admission: tenant {self.name} full "
+                    f"T={len(bdet.det.bank)} bank over its "
+                    f"{budget / 2**30:.2f} GiB share at B={b_}; T/2 "
+                    "sub-banks fit"
+                ))
+            elif b_ < self.spec.batch:
+                self.ladder.pin(
+                    key, ("batched", b_) if b_ > 1 else ("file", 1),
+                    f"admission: tenant {self.name} largest fitting batch "
+                    f"B={b_} under its {budget / 2**30:.2f} GiB share",
+                )
+            return
+        tiled = BatchedMatchedFilterDetector(
+            bdet.det.tiled_view(), donate=False, serial=bdet.serial
+        )
+        tstats = memutils.batched_program_memory(
+            tiled, 1, dt, with_health=self.rz.health_cfg is not None,
+            health_clip=(self.rz.health_cfg.clip_abs
+                         if self.rz.health_cfg is not None else None),
+        )
+        if tstats is None or tstats.fits(budget):
+            self.ladder.pin(key, ("tiled", 1), (
+                f"admission: tenant {self.name} only the tiled per-file "
+                f"program fits its {budget / 2**30:.2f} GiB share"
+            ))
+            return
+        reason = (
+            f"admission: no (bucket, B) program shape fits tenant "
+            f"{self.name}'s HBM share ({budget / 2**30:.2f} GiB); "
+            f"smallest candidate needs {tstats.peak / 2**30:.2f} GiB — "
+            "stream refused before dispatch"
+        )
+        self._skip_buckets[key] = reason
+        camp._append_event(self.outdir, {
+            "event": "admission_skip", "tenant": self.name,
+            "bucket": key if isinstance(key, str) else list(key),
+            "reason": reason,
+        })
+        log.warning("tenant %s bucket %s: %s", self.name, key, reason)
+
+    def _detector_for(self, slab):
+        from ..models.matched_filter import MatchedFilterDetector
+        from ..parallel.batch import BatchedMatchedFilterDetector
+
+        key = self._bucket_key(slab)
+        bdet = self._dets.get(key)
+        if bdet is None:
+            kwargs = dict(self.spec.detector_kwargs)
+            if self.spec.bank is not None:
+                kwargs.setdefault("templates", self.spec.bank)
+            bdet = BatchedMatchedFilterDetector(
+                MatchedFilterDetector(
+                    slab.blocks[0].metadata, self.spec.channels,
+                    (key[0], slab.bucket_ns), wire=self.spec.wire,
+                    pick_mode="sparse", keep_correlograms=False, **kwargs,
+                ),
+                donate=self.spec.donate, serial=self.spec.serial,
+            )
+            self._dets[key] = bdet
+            self._progs[key] = MatchedFilterProgram(bdet.det)
+            self.ladder.set_engines(key, self._progs[key].engines)
+            if bdet.det.supports_bank_split:
+                self.ladder.enable_bank_split(key)
+            if self.spec.admission:
+                with telemetry.span("preflight", bucket=str(key),
+                                    tenant=self.name):
+                    self._admit_bucket(key, bdet, slab)
+        return bdet
+
+    def try_dispatch(self, slab):
+        """Async K0 launch at the tenant's healthy top rung (the
+        multi-stream pipeline's dispatch phase); None routes the slab
+        to the synchronous path with identical attribution."""
+        if self.aborted or self.spec.batch < 2:
+            return None
+        try:
+            bdet = self._detector_for(slab)
+            key = self._bucket_key(slab)
+            if (key in self._skip_buckets
+                    or self.ladder.current(key)
+                    != ("batched", self.spec.batch)):
+                return None
+            return bdet.dispatch_batch(
+                slab.stack, n_real=slab.n_real, n_valid=slab.n_valid,
+                with_health=self.rz.health_cfg is not None,
+                health_clip=(self.rz.health_cfg.clip_abs
+                             if self.rz.health_cfg is not None else None),
+            )
+        except camp.CampaignAborted:
+            raise
+        except Exception:  # noqa: BLE001 — surfaces on the sync path
+            return None
+
+    def _dispatched(self, paths, rung, fn):
+        return resolve_watchdogged(fn, paths, rung,
+                                   self.spec.dispatch_deadline_s,
+                                   self.fault_plan, family="mf")
+
+    def _per_file_fallback(self, slab, k, prog, rung=("file", 1)):
+        with_health = self.rz.health_cfg is not None
+        clip = self.rz.health_cfg.clip_abs if with_health else None
+        tr = np.asarray(slab.blocks[k].trace)
+        padded = np.zeros((tr.shape[0], slab.bucket_ns), tr.dtype)
+        padded[:, : tr.shape[1]] = tr
+
+        def fn():
+            return prog.detect(rung, padded, n_real=slab.n_real[k],
+                               with_health=with_health, clip=clip)
+
+        return self._dispatched([slab.paths[k]], rung, fn)
+
+    def _run_rung(self, slab, rung, bdet, ok, inflight=None):
+        """The slab's entries at one ladder rung — the batch campaign's
+        ``run_rung`` contract (campaign.py documents the cases); raises
+        on the rung's failure for the caller's ladder."""
+        from ..io.stream import subdivide_slab
+
+        prog = self._progs[self._bucket_key(slab)]
+        with_health = self.rz.health_cfg is not None
+        clip = self.rz.health_cfg.clip_abs if with_health else None
+        stage, b = rung
+        if stage == "batched":
+            if b >= self.spec.batch:
+                if inflight is not None:
+                    return self._dispatched(list(slab.paths), rung,
+                                            inflight.resolve)
+                subs = [slab]
+            else:
+                subs = subdivide_slab(slab, b)
+            entries = []
+            for sub in subs:
+                def fn(sub=sub):
+                    return bdet.detect_batch(
+                        sub.stack, n_real=sub.n_real, n_valid=sub.n_valid,
+                        with_health=with_health, health_clip=clip,
+                    )
+                entries.extend(
+                    self._dispatched(list(sub.paths), rung, fn)[: sub.n_valid]
+                )
+            return entries
+        if stage == "bank":
+            subs = ([slab] if b >= self.spec.batch
+                    else subdivide_slab(slab, b))
+            half_a, half_b = bdet.split_views()
+            entries = []
+            for sub in subs:
+                halves = []
+                for j, hdet in enumerate((half_a, half_b)):
+                    # health stats describe the input block: first half
+                    # only (the batch campaign's rule)
+                    def fn(sub=sub, hdet=hdet, j=j):
+                        return hdet.detect_batch(
+                            sub.stack, n_real=sub.n_real,
+                            n_valid=sub.n_valid,
+                            with_health=with_health and j == 0,
+                            health_clip=clip,
+                        )
+                    halves.append(
+                        self._dispatched(list(sub.paths), rung,
+                                         fn)[: sub.n_valid]
+                    )
+                for ea, eb in zip(*halves):
+                    if ea is None or eb is None:
+                        entries.append(None)
+                        continue
+                    merged = ({**ea[0], **eb[0]}, {**ea[1], **eb[1]})
+                    entries.append(
+                        merged + (ea[2],) if with_health else merged
+                    )
+            return entries
+        entries = []
+        for k in range(slab.n_valid):
+            if not ok[k]:
+                entries.append(None)
+                continue
+            tr = np.asarray(slab.blocks[k].trace)
+            padded = np.zeros((tr.shape[0], slab.bucket_ns), tr.dtype)
+            padded[:, : tr.shape[1]] = tr
+
+            def fn(padded=padded, k=k):
+                return prog.detect(rung, padded, n_real=slab.n_real[k],
+                                   with_health=with_health, clip=clip)
+            entries.append(self._dispatched([slab.paths[k]], rung, fn))
+        return entries
+
+    def handle_error_item(self, item: IngestItem) -> None:
+        """Disposition a source-side read failure at its own position
+        (the campaign's SlabReadError contract at ring granularity).
+        Transient classes disposition terminally here — the replay
+        source has already moved past the file, so the in-run retry is
+        structurally impossible; ``failed``/``timeout`` are NOT settled
+        statuses, so a service restart re-serves the file: the durable
+        analog of the campaign's in-run retry (docs/SERVICE.md)."""
+        exc = item.error
+        self.rz.attempt(item.path)
+        try:
+            fclass = faults.classify_failure(exc)
+            if fclass == "fatal":
+                raise exc
+            if isinstance(exc, faults.DeadlineExceeded):
+                faults.count("timeouts")
+                self.rz.fail(item.path, exc, status="timeout")
+            elif fclass == "data":
+                faults.count("quarantined")
+                self.rz.fail(item.path, exc, status="quarantined",
+                             health=getattr(exc, "stats", None))
+            else:
+                self.rz.fail(item.path, exc)
+            _c_files.inc(tenant=self.name,
+                         status=self.records[-1].status)
+        except camp.CampaignAborted as aexc:
+            self.aborted = str(aexc)
+
+    def handle_slab(self, slab, inflight=None) -> None:
+        """One slab through the elastic ladder + per-file degrade +
+        health gate + artifact/manifest bookkeeping — the batch
+        campaign's ``handle_slab`` contract, per tenant."""
+        fail = self.rz.fail
+        with_health = self.rz.health_cfg is not None
+        clip = self.rz.health_cfg.clip_abs if with_health else None
+        try:
+            bdet = self._detector_for(slab)
+        except Exception as exc:  # noqa: BLE001 — whole-slab guard
+            if faults.classify_failure(exc) == "fatal":
+                raise
+            for path in slab.paths:
+                fail(path, exc)
+                _c_files.inc(tenant=self.name, status="failed")
+            return
+        det = bdet.det
+        key = self._bucket_key(slab)
+        if key in self._skip_buckets:
+            for k in range(slab.n_valid):
+                fail(slab.paths[k], RuntimeError(self._skip_buckets[key]))
+                _c_files.inc(tenant=self.name, status="failed")
+            return
+        ok = []
+        for k in range(slab.n_valid):
+            meta_k = slab.blocks[k].metadata
+            if (self.spec.wire == "raw" and meta_k is not None
+                    and meta_k.scale_factor != det.metadata.scale_factor):
+                fail(slab.paths[k], ValueError(
+                    f"scale_factor {meta_k.scale_factor!r} != detector "
+                    f"scale {det.metadata.scale_factor!r}; wire='raw' "
+                    "conditions with one scale"
+                ))
+                _c_files.inc(tenant=self.name, status="failed")
+                ok.append(False)
+            else:
+                ok.append(True)
+        t0 = time.perf_counter()
+        degraded = recovered = False
+        results = None
+        try:
+            if self.fault_plan is not None:
+                for k in range(slab.n_valid):
+                    if ok[k]:
+                        try:
+                            self.fault_plan.on_transfer(slab.paths[k])
+                            self.fault_plan.on_detect(slab.paths[k])
+                        except Exception:
+                            self.rz.attempt(slab.paths[k])
+                            raise
+            rung = self.ladder.current(key)
+            if inflight is not None and rung != ("batched", self.spec.batch):
+                inflight = None   # downshifted between dispatch and resolve
+            shape = (int(slab.stack.shape[1]), slab.bucket_ns)
+            while True:   # the elastic ladder, per tenant
+                try:
+                    results = self._run_rung(slab, rung, bdet, ok,
+                                             inflight=inflight)
+                    break
+                except Exception as exc:  # noqa: BLE001
+                    inflight = None
+                    fclass = faults.classify_failure(exc)
+                    if fclass == "fatal":
+                        raise
+                    if fclass == "resource":
+                        nxt = self.ladder.downshift(key, rung, exc, shape)
+                        if nxt is not None:
+                            rung = nxt
+                            recovered = True
+                            continue
+                    raise
+        except camp.CampaignAborted:
+            raise
+        except Exception as exc:  # noqa: BLE001 — degrade per file
+            if faults.classify_failure(exc) == "fatal":
+                raise
+            faults.count("degradations")
+            log.warning("tenant %s: slab of %d files failed (%s: %s); "
+                        "degrading to the per-file route", self.name,
+                        slab.n_valid, type(exc).__name__, exc)
+            degraded = True
+        wall = time.perf_counter() - t0
+        camp._h_slab_wall.observe(wall)
+        shape = (int(slab.stack.shape[1]), slab.bucket_ns)
+        from ..parallel.batch import trim_picks
+
+        for k in range(slab.n_valid):
+            if not ok[k]:
+                continue
+            path = slab.paths[k]
+            use_fallback = degraded or results[k] is None
+            pf_rung = max(("file", 1), self.ladder.current(key),
+                          key=faults.rung_rank)
+            file_recovered = recovered
+            while True:
+                self.rz.attempt(path)
+                try:
+                    if use_fallback:
+                        if self.fault_plan is not None and degraded:
+                            self.fault_plan.on_transfer(path)
+                            self.fault_plan.on_detect(path)
+                        picks, thresholds, stats = self._per_file_fallback(
+                            slab, k, self._progs[key], rung=pf_rung
+                        )
+                        exec_rung = pf_rung
+                    else:
+                        entry = results[k]
+                        picks, thresholds = entry[0], entry[1]
+                        stats = (entry[2] if with_health
+                                 and len(entry) > 2 else {})
+                        exec_rung = rung
+                    self.rz.check_health(path, stats,
+                                         rung=faults.rung_label(exec_rung))
+                    picks = trim_picks(picks, slab.n_real[k])
+                    if self.fault_plan is not None:
+                        self.fault_plan.detect_succeeded()
+                    camp._file_record(
+                        self.outdir, path, picks, thresholds,
+                        round(wall / max(slab.n_valid, 1), 3), self.records,
+                        attempts=self.rz.state.n_attempts(path),
+                        health=dict(stats or {}), family=bdet.family,
+                        rung=faults.rung_label(exec_rung),
+                    )
+                    _c_files.inc(tenant=self.name, status="done")
+                    if file_recovered:
+                        self.rz.tally("oom_recoveries")
+                except camp.CampaignAborted:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — per-file isolation
+                    if (use_fallback
+                            and faults.classify_failure(exc) == "resource"):
+                        nxt = self.ladder.downshift(key, pf_rung, exc, shape)
+                        if nxt is not None:
+                            self.rz.state.unattempt(path)
+                            pf_rung = nxt
+                            file_recovered = True
+                            continue
+                    if self.rz.dispose(path, exc) == "retry":
+                        use_fallback = True
+                        continue
+                    _c_files.inc(tenant=self.name,
+                                 status=self.records[-1].status)
+                break
+
+    def finish(self) -> None:
+        """Flush the end-of-run counters event (idempotent)."""
+        if not self._finished:
+            self._finished = True
+            self.rz.flush_tallies()
+
+    # -- reporting ---------------------------------------------------------
+
+    def result(self) -> camp.CampaignResult:
+        return camp.CampaignResult(outdir=self.outdir, records=self.records)
+
+    def snapshot(self) -> Dict:
+        res = self.result()
+        return {
+            "tenant": self.name,
+            "n_done": res.n_done, "n_failed": res.n_failed,
+            "n_skipped": res.n_skipped,
+            "n_quarantined": res.n_quarantined, "n_timeout": res.n_timeout,
+            "ring_depth": len(self.ring),
+            "ring_closed": self.ring.closed,
+            "ready_slabs": len(self.ready),
+            "aborted": self.aborted,
+            "rungs": {
+                str(k): faults.rung_label(r)
+                for k, r in self.ladder.sticky.items()
+            },
+            "deficit_msamples": round(self.deficit, 3),
+        }
+
+
+class StreamScheduler:
+    """Deficit-round-robin over tenants, one shared in-flight pipeline.
+
+    One :class:`~das4whales_tpu.parallel.dispatch.PipelinedDispatch`
+    serves every tenant: slab tokens are ``(tenant_name, slab)``, so
+    while tenant A's slab computes, tenant B's next slab is already
+    dispatching — the cross-tenant overlap is the same mechanism as the
+    single-campaign depth-D pipeline, reached through the public
+    ``pending()``/``in_flight()`` accessors. A tenant that leaves its
+    top rung (or whose dispatch fails) falls back to the synchronous
+    path with the campaign's exact attribution.
+    """
+
+    def __init__(self, tenants, dispatch_depth: int | None = None):
+        self.tenants: Dict[str, TenantRuntime] = {t.name: t for t in tenants}
+        if len(self.tenants) != len(list(tenants)):
+            raise ValueError("tenant names must be unique")
+        self.pipe = PipelinedDispatch(dispatch_depth)
+        self._rotation = deque(self.tenants)
+        self._base_quantum = 1.0   # megasamples; adapts to the largest slab
+
+    @staticmethod
+    def _cost(slab) -> float:
+        return float(np.asarray(slab.stack).size) / 1e6
+
+    def _finalize(self, token, inflight) -> None:
+        name, slab = token
+        t = self.tenants[name]
+        overlapped = inflight is not None and self.pipe.in_flight() > 0
+        _c_slabs.inc(tenant=name)
+        if overlapped:
+            _c_overlapped.inc(tenant=name)
+        try:
+            with telemetry.span("slab", tenant=name, index0=slab.index0,
+                                n_files=slab.n_valid,
+                                bucket_ns=slab.bucket_ns,
+                                pipelined=inflight is not None):
+                t.handle_slab(slab, inflight)
+        except camp.CampaignAborted as exc:
+            # one tenant's max_failures abort stops THAT stream only
+            t.aborted = str(exc)
+            log.error("tenant %s aborted: %s", name, exc)
+        except Exception as exc:  # noqa: BLE001 — whole-slab guard
+            if faults.classify_failure(exc) == "fatal":
+                raise
+            dispositioned = {r.path for r in t.records}
+            for path in slab.paths:
+                if path not in dispositioned:
+                    try:
+                        t.rz.fail(path, exc)
+                        _c_files.inc(tenant=name, status="failed")
+                    except camp.CampaignAborted as aexc:
+                        t.aborted = str(aexc)
+                        break
+
+    def _drain_pipe(self) -> None:
+        for token, inflight in self.pipe.drain():
+            self._finalize(token, inflight)
+
+    def _serve(self, t: TenantRuntime, slab) -> None:
+        infl = None if t.aborted else t.try_dispatch(slab)
+        if infl is None:
+            self._drain_pipe()
+            if t.aborted:
+                # an aborted tenant's remaining slabs are not detected;
+                # their files stay unrecorded (resume-able)
+                return
+            self._finalize((t.name, slab), None)
+        else:
+            for token in self.pipe.submit((t.name, slab), infl):
+                self._finalize(*token)
+
+    def step(self) -> bool:
+        """One DRR round: credit each tenant, serve what the deficits
+        cover. Returns True when any slab or error item was served (the
+        runner idles briefly on False)."""
+        any_work = False
+        for _ in range(len(self._rotation)):
+            name = self._rotation[0]
+            self._rotation.rotate(-1)
+            t = self.tenants[name]
+            t.pump()
+            # error items carry no device cost: disposition immediately
+            while t.ready and isinstance(t.ready[0], IngestItem):
+                t.handle_error_item(t.ready.popleft())
+                any_work = True
+            if not t.ready:
+                t.deficit = 0.0   # classic DRR: empty queue forfeits credit
+                _g_deficit.set(0.0, tenant=name)
+                continue
+            head_cost = self._cost(t.ready[0])
+            self._base_quantum = max(self._base_quantum, head_cost)
+            t.deficit += self._base_quantum * t.spec.weight
+            while t.ready:
+                if isinstance(t.ready[0], IngestItem):
+                    t.handle_error_item(t.ready.popleft())
+                    any_work = True
+                    continue
+                cost = self._cost(t.ready[0])
+                if cost > t.deficit:
+                    break
+                slab = t.ready.popleft()
+                t.deficit -= cost
+                self._serve(t, slab)
+                any_work = True
+            _g_deficit.set(round(t.deficit, 3), tenant=name)
+        return any_work
+
+    def drain(self) -> None:
+        """Finish in-flight slabs (the graceful half of SIGTERM): every
+        dispatched-unresolved token resolves through its own tenant's
+        executor; nothing new is dispatched."""
+        self._drain_pipe()
+
+    def run_until_idle(self, idle_sleep_s: float = 0.01,
+                       should_stop=None) -> None:
+        """Serve until every tenant's source is exhausted and all work
+        is resolved, or ``should_stop()``. In-flight tokens left by a
+        stop are the caller's to :meth:`drain` (the runner's graceful
+        exit path owns that, plus the per-tenant ``finish()``)."""
+        while True:
+            if should_stop is not None and should_stop():
+                return
+            worked = self.step()
+            if not worked:
+                if self.pipe.in_flight():
+                    self._drain_pipe()
+                    continue
+                if all(t.idle() or t.aborted for t in self.tenants.values()):
+                    return
+                time.sleep(idle_sleep_s)

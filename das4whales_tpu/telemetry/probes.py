@@ -45,6 +45,7 @@ from . import metrics
 __all__ = [
     "ProbeResult", "liveness", "note_dispatch_ok", "note_file_ok",
     "note_quarantine", "note_watchdog_timeout", "readiness", "reset",
+    "snapshot",
 ]
 
 _lock = threading.Lock()
@@ -145,6 +146,19 @@ class ProbeResult:
 def _snapshot() -> Dict:
     with _lock:
         return dict(_state)
+
+
+def snapshot() -> Dict:
+    """Both verdicts plus the raw streak state in one dict — the
+    service's ``/tenants`` surface embeds this so an operator sees the
+    probe picture without a second request (docs/SERVICE.md)."""
+    live = liveness()
+    ready = readiness()
+    return {
+        "live": bool(live), "live_reason": live.reason,
+        "ready": bool(ready), "ready_reason": ready.reason,
+        "state": _snapshot(),
+    }
 
 
 def liveness(max_watchdog_streak: int | None = None) -> ProbeResult:

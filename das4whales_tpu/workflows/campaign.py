@@ -228,6 +228,15 @@ def load_picks(picks_file: str) -> Dict[str, np.ndarray]:
         return {str(n): z[f"picks_{n}"] for n in z["template_names"]}
 
 
+def load_settled(outdir: str) -> set:
+    """Public face of the resume bookkeeping: the paths whose last
+    manifest record settles them (done/quarantined — the PR 4
+    last-record-wins semantics). The campaigns' ``resume=True`` and the
+    service's source-side skip (``das4whales_tpu.service``) both read
+    this, so "settled" has exactly one definition."""
+    return _load_settled(outdir)
+
+
 def _normalize_metas(metadata, files):
     """The stream's metadata convention (None / one-for-all / aligned
     sequence) as an explicit per-file list."""
@@ -397,6 +406,14 @@ from .planner import (  # noqa: E402
     RoutePlanner,
     program_for,
 )
+
+# The service scheduler (das4whales_tpu/service/scheduler.py) reuses
+# this module's per-file bookkeeping machinery — _Resilience,
+# _file_record, _append_event, _load_settled (via load_settled), the
+# das_slab_wall_seconds histogram — so a service tenant's manifest,
+# artifacts and failure taxonomy are the batch campaign's, by
+# construction (that shared machinery is what makes service picks
+# bit-identical to run_campaign_batched's; tests/test_service.py).
 
 
 def run_campaign(

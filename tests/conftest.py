@@ -38,3 +38,68 @@ from das4whales_tpu.analysis.pytest_plugin import compile_guard  # noqa: F401
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+# ---------------------------------------------------------------------------
+# Shared chaos-shape fixtures (session-scoped): test_chaos.py,
+# test_telemetry.py and test_service.py all drive campaigns over the SAME
+# [24 x 900] x 4-file scene set, so the synthetic files, the campaign
+# detector and the fault-free reference picks are built once per session
+# and every (bucket, B) program compiles once — the tier-1 wall pays for
+# these fixtures a single time instead of per module.
+# ---------------------------------------------------------------------------
+
+CHAOS_NX, CHAOS_NS, CHAOS_N_FILES = 24, 900, 4
+CHAOS_SEL = [0, CHAOS_NX, 1]
+
+
+@pytest.fixture(scope="session")
+def chaos_file_set(tmp_path_factory):
+    from das4whales_tpu.io.synth import (
+        SyntheticCall,
+        SyntheticScene,
+        write_synthetic_file,
+    )
+
+    d = tmp_path_factory.mktemp("chaosdata")
+    paths = []
+    for k in range(CHAOS_N_FILES):
+        scene = SyntheticScene(
+            nx=CHAOS_NX, ns=CHAOS_NS, noise_rms=0.05, seed=k,
+            calls=[SyntheticCall(t0=1.2 + 0.3 * k,
+                                 x0_m=CHAOS_NX / 2 * 2.042, amplitude=2.0)],
+        )
+        p = str(d / f"cf{k}.h5")
+        write_synthetic_file(p, scene)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="session")
+def chaos_detector(chaos_file_set):
+    """One campaign-configuration detector shared across every seeded
+    campaign (design-once/detect-many keeps the fuzz cheap: one compile
+    serves all schedules, in every module)."""
+    from das4whales_tpu.io.stream import stream_strain_blocks
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+
+    blk = next(stream_strain_blocks(chaos_file_set[:1], CHAOS_SEL,
+                                    as_numpy=True))
+    return MatchedFilterDetector(
+        blk.metadata, CHAOS_SEL, np.asarray(blk.trace).shape,
+        pick_mode="sparse", keep_correlograms=False,
+    )
+
+
+@pytest.fixture(scope="session")
+def chaos_fault_free(chaos_file_set, chaos_detector, tmp_path_factory):
+    """Reference picks from a no-faults campaign (the bit-identical
+    oracle for recovered files — and for the service's replay parity)."""
+    from das4whales_tpu.workflows.campaign import load_picks, run_campaign
+
+    out = str(tmp_path_factory.mktemp("ref") / "camp")
+    res = run_campaign(chaos_file_set, CHAOS_SEL, out,
+                       detector=chaos_detector)
+    assert res.n_done == CHAOS_N_FILES
+    return {r.path: load_picks(r.picks_file)
+            for r in res.records if r.status == "done"}

@@ -10,8 +10,12 @@ exactly once (status matching the plan's oracle: retried transients end
 hung readers ``timeout``), and a resume after an injected mid-run crash
 completes without re-running settled files.
 
-The ``chaos`` marker's quick subset (50 seeds) rides tier-1; the
-``slow``-marked soak widens the schedule space.
+The ``chaos`` marker's quick subset (a representative 12 seeds) rides
+tier-1; the ``slow``-marked extended subsets and the soak widen the
+schedule space (ISSUE 12 moved the heavy seed ranges under ``slow`` to
+recover tier-1 wall headroom — coverage moved, not deleted). The file
+set, detector and fault-free reference are the SESSION-scoped fixtures
+in conftest.py, shared with test_telemetry.py and test_service.py.
 """
 
 from __future__ import annotations
@@ -28,11 +32,6 @@ from das4whales_tpu import faults
 from das4whales_tpu.telemetry import metrics as tmetrics
 from das4whales_tpu.config import DataHealthConfig
 from das4whales_tpu.io.stream import stream_strain_blocks
-from das4whales_tpu.io.synth import (
-    SyntheticCall,
-    SyntheticScene,
-    write_synthetic_file,
-)
 from das4whales_tpu.models.matched_filter import MatchedFilterDetector
 from das4whales_tpu.workflows.campaign import (
     load_picks,
@@ -41,9 +40,11 @@ from das4whales_tpu.workflows.campaign import (
     summarize_campaign,
 )
 
-NX, NS = 24, 900
-SEL = [0, NX, 1]
-N_FILES = 4
+from tests.conftest import CHAOS_N_FILES, CHAOS_NS, CHAOS_NX, CHAOS_SEL
+
+NX, NS = CHAOS_NX, CHAOS_NS
+SEL = CHAOS_SEL
+N_FILES = CHAOS_N_FILES
 
 #: fast-but-real retry policy for injected transients (the plan's
 #: transient faults recover within max_transient_repeats=2 < 3 attempts)
@@ -53,44 +54,21 @@ DEADLINE_S = 0.75   # >> the ms-scale reads of these tiny files
 HANG_S = 8.0        # >> deadline: a hang can never sneak under it
 
 
-
+# the session-scoped chaos fixtures (conftest.py) under this module's
+# historical names — shared with test_telemetry.py / test_service.py
 @pytest.fixture(scope="module")
-def file_set(tmp_path_factory):
-    d = tmp_path_factory.mktemp("chaosdata")
-    paths = []
-    for k in range(N_FILES):
-        scene = SyntheticScene(
-            nx=NX, ns=NS, noise_rms=0.05, seed=k,
-            calls=[SyntheticCall(t0=1.2 + 0.3 * k, x0_m=NX / 2 * 2.042,
-                                 amplitude=2.0)],
-        )
-        p = str(d / f"cf{k}.h5")
-        write_synthetic_file(p, scene)
-        paths.append(p)
-    return paths
+def file_set(chaos_file_set):
+    return chaos_file_set
 
 
 @pytest.fixture(scope="module")
-def detector(file_set):
-    """One campaign-configuration detector shared across every seeded
-    campaign (design-once/detect-many keeps the fuzz cheap: one compile
-    serves all schedules)."""
-    blk = next(stream_strain_blocks(file_set[:1], SEL, as_numpy=True))
-    return MatchedFilterDetector(
-        blk.metadata, SEL, np.asarray(blk.trace).shape,
-        pick_mode="sparse", keep_correlograms=False,
-    )
+def detector(chaos_detector):
+    return chaos_detector
 
 
 @pytest.fixture(scope="module")
-def fault_free(file_set, detector, tmp_path_factory):
-    """Reference picks from a no-faults campaign (the bit-identical
-    oracle for recovered-transient files)."""
-    out = str(tmp_path_factory.mktemp("ref") / "camp")
-    res = run_campaign(file_set, SEL, out, detector=detector)
-    assert res.n_done == N_FILES
-    return {r.path: load_picks(r.picks_file)
-            for r in res.records if r.status == "done"}
+def fault_free(chaos_fault_free):
+    return chaos_fault_free
 
 
 def _assert_invariant(res, paths, plan, reference):
@@ -145,9 +123,11 @@ def _fuzz_one(seed, files, detector, reference, outdir, batched=False):
 
 @pytest.mark.chaos
 def test_chaos_fuzz_quick(file_set, detector, fault_free, tmp_path):
-    """50 seeded fault schedules through ``run_campaign`` (tier-1 —
-    the acceptance floor of ISSUE 4)."""
-    for seed in range(50):
+    """A representative 12 seeded fault schedules through
+    ``run_campaign`` (tier-1 — the acceptance floor of ISSUE 4; seeds
+    12..50 of the historical quick range now ride the ``slow``-marked
+    extension below, trading tier-1 wall for unchanged coverage)."""
+    for seed in range(12):
         _fuzz_one(seed, file_set, detector, fault_free,
                   str(tmp_path / f"c{seed}"))
 
@@ -156,8 +136,23 @@ def test_chaos_fuzz_quick(file_set, detector, fault_free, tmp_path):
 def test_chaos_fuzz_batched(file_set, detector, fault_free, tmp_path):
     """Seeded fault schedules through the BATCHED campaign: slab
     assembly, the degradation ladder and the fused health gate under
-    the same exactly-once invariant."""
-    for seed in range(12):
+    the same exactly-once invariant (representative quick subset; the
+    rest of the historical range is in the slow extension)."""
+    for seed in range(4):
+        _fuzz_one(seed, file_set, detector, fault_free,
+                  str(tmp_path / f"cb{seed}"), batched=True)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_fuzz_extended(file_set, detector, fault_free, tmp_path):
+    """The rest of the historical tier-1 quick ranges (seeds 12..50
+    per-file, 4..12 batched) — moved under ``slow`` for wall headroom
+    (ISSUE 12), run by the slow lane with the soak."""
+    for seed in range(12, 50):
+        _fuzz_one(seed, file_set, detector, fault_free,
+                  str(tmp_path / f"c{seed}"))
+    for seed in range(4, 12):
         _fuzz_one(seed, file_set, detector, fault_free,
                   str(tmp_path / f"cb{seed}"), batched=True)
 
@@ -431,13 +426,8 @@ def ladder_warm(file_set, fault_free, tmp_path_factory):
     return True
 
 
-@pytest.mark.chaos
-def test_chaos_fuzz_oom(file_set, fault_free, ladder_warm, tmp_path):
-    """Nine seeded ``oom`` schedules through the batched campaign: the
-    elastic ladder recovers EVERY file (zero ``failed`` records), picks
-    bit-identical to the fault-free run, sticky downshifts in the
-    manifest (the ISSUE 5 acceptance drill, fuzzed)."""
-    for seed in range(9):
+def _fuzz_oom_seeds(seeds, file_set, fault_free, tmp_path):
+    for seed in seeds:
         plan = faults.FaultPlan(seed, rate=0.8, kinds=("oom",))
         out = str(tmp_path / f"o{seed}")
         res = run_campaign_batched(file_set, SEL, out, batch=2,
@@ -456,13 +446,27 @@ def test_chaos_fuzz_oom(file_set, fault_free, ladder_warm, tmp_path):
 
 
 @pytest.mark.chaos
-def test_chaos_fuzz_dispatch(file_set, fault_free, ladder_warm, tmp_path):
-    """Three seeded mixed ``oom``/``hang_dispatch`` schedules: OOMs
-    recover via the ladder, wedged dispatches become ``timeout`` via the
-    watchdog, and the campaign completes within deadline-scale walls."""
+def test_chaos_fuzz_oom(file_set, fault_free, ladder_warm, tmp_path):
+    """Seeded ``oom`` schedules through the batched campaign: the
+    elastic ladder recovers EVERY file (zero ``failed`` records), picks
+    bit-identical to the fault-free run, sticky downshifts in the
+    manifest (the ISSUE 5 acceptance drill, fuzzed; a representative 3
+    seeds ride tier-1, the rest of the historical range is slow)."""
+    _fuzz_oom_seeds(range(3), file_set, fault_free, tmp_path)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_fuzz_oom_extended(file_set, fault_free, ladder_warm,
+                                 tmp_path):
+    """Seeds 3..9 of the historical oom fuzz range (slow lane)."""
+    _fuzz_oom_seeds(range(3, 9), file_set, fault_free, tmp_path)
+
+
+def _fuzz_dispatch_seeds(seeds, file_set, fault_free, tmp_path):
     import time as _time
 
-    for seed in range(3):
+    for seed in seeds:
         plan = faults.FaultPlan(seed, rate=0.55,
                                 kinds=faults.DISPATCH_FAULT_KINDS,
                                 hang_s=HANG_S)
@@ -481,6 +485,23 @@ def test_chaos_fuzz_dispatch(file_set, fault_free, ladder_warm, tmp_path):
                      if (sp := plan.spec_for(p)) and sp.kind == "hang_dispatch")
         assert s["watchdog_timeouts"] >= (1 if n_hung else 0)
         assert res.n_timeout == n_hung
+
+
+@pytest.mark.chaos
+def test_chaos_fuzz_dispatch(file_set, fault_free, ladder_warm, tmp_path):
+    """Mixed ``oom``/``hang_dispatch`` schedules: OOMs recover via the
+    ladder, wedged dispatches become ``timeout`` via the watchdog, and
+    the campaign completes within deadline-scale walls (one
+    representative seed rides tier-1; the rest are slow)."""
+    _fuzz_dispatch_seeds(range(1), file_set, fault_free, tmp_path)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_fuzz_dispatch_extended(file_set, fault_free, ladder_warm,
+                                      tmp_path):
+    """Seeds 1..3 of the historical dispatch fuzz range (slow lane)."""
+    _fuzz_dispatch_seeds(range(1, 3), file_set, fault_free, tmp_path)
 
 
 @pytest.mark.chaos

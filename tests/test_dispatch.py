@@ -95,6 +95,30 @@ def test_pipeline_queue_fifo_and_depth_bound():
     assert len(pipe) == 0
 
 
+def test_pipeline_public_pending_and_in_flight_accessors():
+    """Satellite (ISSUE 12): the queue's depth and keys are a PUBLIC
+    surface — the service scheduler (and these tests) read
+    ``in_flight()``/``pending()`` instead of the ``_q`` internals, and
+    the ``das_dispatch_queue_depth`` gauge mirrors the accessor."""
+    from das4whales_tpu.telemetry import metrics as tmetrics
+
+    gauge = tmetrics.REGISTRY.gauge("das_dispatch_queue_depth")
+    pipe = PipelinedDispatch(3)
+    assert pipe.in_flight() == 0 and pipe.pending() == ()
+    assert pipe.submit("a", 1) == []
+    assert pipe.submit("b", 2) == []
+    assert pipe.in_flight() == 2 and pipe.pending() == ("a", "b")
+    assert gauge.value() == 2                   # gauge == accessor
+    assert pipe.submit("c", 3) == []
+    forced = pipe.submit("d", 4)                # depth 3: oldest pops
+    assert [k for k, _ in forced] == ["a"]
+    assert pipe.pending() == ("b", "c", "d")
+    assert gauge.value() == pipe.in_flight() == 3
+    list(pipe.drain())
+    assert pipe.in_flight() == 0 and pipe.pending() == ()
+    assert gauge.value() == 0
+
+
 def test_pipeline_queue_disabled_below_two():
     for depth in (0, 1):
         pipe = PipelinedDispatch(depth)

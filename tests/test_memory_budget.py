@@ -142,7 +142,14 @@ def sharded_canonical():
     return design, mesh, c8
 
 
-@pytest.mark.parametrize("outputs,out_cap_gib", [("picks", 1 / 32), ("full", 1.0)])
+# the 'full' variant rides the slow lane (ISSUE 12 wall headroom —
+# coverage moved, not deleted): 'picks' is the campaign-mode pin the
+# docstring calls the point, and it alone keeps the canonical design
+# build + per-shard budget assertion in tier-1
+@pytest.mark.parametrize("outputs,out_cap_gib", [
+    ("picks", 1 / 32),
+    pytest.param("full", 1.0, marks=pytest.mark.slow),
+])
 def test_sharded_step_per_shard_budget(sharded_canonical, outputs, out_cap_gib):
     """Per-shard AOT memory of the channel-sharded step at canonical shape
     over 8 shards (VERDICT r3 next-4): ``memory_analysis()`` of the SPMD

@@ -1,0 +1,638 @@
+"""Streaming multi-tenant detection service (ISSUE 12).
+
+Contracts pinned here:
+
+* THE acceptance drill: a two-tenant chaos-seeded service run over
+  file-replay sources completes with zero failed files, per-tenant
+  picks BIT-IDENTICAL to each tenant's standalone
+  ``run_campaign_batched`` run, one tenant's injected OOM downshifts
+  only ITS ladder (the other stays on the fast rung), and
+  ``/livez``/``/readyz``/``/metrics`` answer 200 throughout the run;
+* the slab slicer forms the SAME slabs as the batch campaign's
+  assembler over the same files (the shared ``assemble_slab`` rule);
+* ring-buffer backpressure: a full ring rejects (HTTP 429 +
+  Retry-After) or drops-oldest with the drop counted as
+  ``das_ingest_dropped_total{tenant}``, per tenant config;
+* probes flip per the PR 10 truth table on an injected dispatch wedge;
+* SIGTERM graceful drain leaves resumable manifests: a real SIGTERM
+  mid-run flushes in-flight work, and a restarted service skips the
+  settled files and finishes the rest — every file dispositioned
+  exactly once across both runs;
+* per-tenant HBM admission pins the ladder before the first dispatch;
+* ``PipelinedDispatch.pending()``/``in_flight()`` accessors (the
+  scheduler's public view — satellite) live in tests/test_dispatch.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from das4whales_tpu import faults
+from das4whales_tpu.service import (
+    DetectionService,
+    IngestItem,
+    RingBuffer,
+    ServiceConfig,
+    TenantSpec,
+    load_service_config,
+)
+from das4whales_tpu.service.ingest import LiveBlock, SlabSlicer
+from das4whales_tpu.telemetry import metrics as tmetrics
+from das4whales_tpu.telemetry import probes
+from das4whales_tpu.workflows.campaign import (
+    load_picks,
+    run_campaign_batched,
+    summarize_campaign,
+)
+
+from tests.conftest import CHAOS_N_FILES, CHAOS_NS, CHAOS_NX, CHAOS_SEL
+
+NX, NS = CHAOS_NX, CHAOS_NS
+SEL = CHAOS_SEL
+N_FILES = CHAOS_N_FILES
+
+HANG_S = 8.0
+
+
+def _spec(name, files, **kw):
+    kw.setdefault("batch", 2)
+    kw.setdefault("bucket", "exact")
+    kw.setdefault("admission", False)
+    return TenantSpec(name=name, files=files, channels=SEL, **kw)
+
+
+@pytest.fixture(scope="module")
+def second_file_set(tmp_path_factory):
+    """Tenant B's own scene set (different seeds — a genuinely distinct
+    stream, same shapes so compiled programs are shared)."""
+    from das4whales_tpu.io.synth import (
+        SyntheticCall,
+        SyntheticScene,
+        write_synthetic_file,
+    )
+
+    d = tmp_path_factory.mktemp("svcdata")
+    paths = []
+    for k in range(3):
+        scene = SyntheticScene(
+            nx=NX, ns=NS, noise_rms=0.05, seed=300 + k,
+            calls=[SyntheticCall(t0=1.0 + 0.4 * k, x0_m=NX / 2 * 2.042,
+                                 amplitude=2.0)],
+        )
+        p = str(d / f"sf{k}.h5")
+        write_synthetic_file(p, scene)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def batched_refs(chaos_file_set, second_file_set, tmp_path_factory):
+    """Each tenant's STANDALONE run_campaign_batched picks — the
+    bit-identity oracle of the acceptance criterion."""
+    base = tmp_path_factory.mktemp("svcref")
+    refs = {}
+    for name, files in (("a", chaos_file_set), ("b", second_file_set)):
+        res = run_campaign_batched(files, SEL, str(base / name), batch=2,
+                                   bucket="exact", persistent_cache=False)
+        assert res.n_failed == 0
+        refs[name] = {r.path: load_picks(r.picks_file)
+                      for r in res.records if r.status == "done"}
+    return refs
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _assert_bit_identical(records, reference):
+    for rec in records:
+        if rec.status != "done":
+            continue
+        got = load_picks(rec.picks_file)
+        ref = reference[rec.path]
+        assert set(got) == set(ref)
+        for name in ref:
+            np.testing.assert_array_equal(got[name], ref[name])
+
+
+def test_two_tenant_chaos_service_acceptance(chaos_file_set,
+                                             second_file_set,
+                                             batched_refs, tmp_path):
+    """THE acceptance drill (ISSUE 12): tenant A's injected OOM
+    downshifts A's ladder only; both tenants end zero-failed with picks
+    bit-identical to their standalone batched runs; the probe and
+    metrics endpoints answer 200 the whole time."""
+    plan_a = faults.FaultPlan(0, rate=0.0)
+    plan_a.spec_for = lambda p: faults.FaultSpec(
+        "oom", "dispatch", 10**9, ok_rung=("file", 1))
+    cfg = ServiceConfig(
+        tenants=[_spec("a", chaos_file_set), _spec("b", second_file_set)],
+        outdir=str(tmp_path / "svc"), persistent_cache=False,
+    )
+    svc = DetectionService(cfg, fault_plans={"a": plan_a}).start()
+    served: list = []
+    stop_poll = threading.Event()
+
+    def poll():
+        while not stop_poll.is_set():
+            for ep in ("/livez", "/readyz", "/metrics"):
+                try:
+                    served.append((ep, _get(svc.api.url + ep)[0]))
+                except (urllib.error.URLError, OSError) as exc:
+                    served.append((ep, f"error: {exc}"))
+            time.sleep(0.01)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    try:
+        results = svc.run(until_idle=True)
+    finally:
+        stop_poll.set()
+        poller.join(5)
+        svc.stop()
+
+    # zero failed files, both tenants fully dispositioned
+    assert results["a"].n_done == N_FILES and results["a"].n_failed == 0
+    assert results["b"].n_done == 3 and results["b"].n_failed == 0
+
+    # picks bit-identical to each tenant's standalone batched run
+    _assert_bit_identical(results["a"].records, batched_refs["a"])
+    _assert_bit_identical(results["b"].records, batched_refs["b"])
+
+    # A downshifted (sticky, ledgered in A's OWN manifest); B did not
+    s_a = summarize_campaign(str(tmp_path / "svc" / "a"))
+    assert s_a["downshifts"] >= 1 and s_a["oom_recoveries"] >= 1
+    assert s_a["downshift_ledger"][0]["sticky"] is True
+    assert all(r.rung == "file" for r in results["a"].records)
+    s_b = summarize_campaign(str(tmp_path / "svc" / "b"))
+    assert s_b["downshifts"] == 0 and s_b["downshift_ledger"] == []
+    assert all(r.rung == "batched:2" for r in results["b"].records)
+
+    # probes + metrics served throughout: every poll answered 200
+    assert served, "the poller must have sampled during the run"
+    bad = [s for s in served if s[1] != 200]
+    assert not bad, f"non-200 probe answers during the run: {bad[:5]}"
+    assert {ep for ep, _ in served} == {"/livez", "/readyz", "/metrics"}
+
+
+def test_service_replay_parity_vs_unbatched_reference(chaos_file_set,
+                                                      chaos_fault_free,
+                                                      tmp_path):
+    """File-replay parity, against the UNBATCHED one-program campaign
+    reference (conftest's fault-free oracle): the service's slabs run
+    the same per-file math — replay at a finite real-time factor paces
+    ingest without changing one bit of output."""
+    cfg = ServiceConfig(
+        tenants=[_spec("a", chaos_file_set, realtime_factor=500.0)],
+        outdir=str(tmp_path / "svc"), persistent_cache=False,
+    )
+    svc = DetectionService(cfg).start()
+    try:
+        results = svc.run(until_idle=True)
+    finally:
+        svc.stop()
+    assert results["a"].n_done == N_FILES
+    _assert_bit_identical(results["a"].records, chaos_fault_free)
+
+
+def test_slab_slicer_matches_campaign_assembler(chaos_file_set):
+    """The continuous slicer forms the SAME slabs (stack bytes, paths,
+    n_real, bucket) as ``stream_batched_slabs`` over the same blocks —
+    the shared ``assemble_slab`` rule, pinned."""
+    from das4whales_tpu.io.stream import (
+        stream_batched_slabs,
+        stream_strain_blocks,
+    )
+
+    want = list(stream_batched_slabs(chaos_file_set, SEL, batch=2,
+                                     bucket="pow2", as_numpy=True))
+    slicer = SlabSlicer(batch=2, bucket="pow2")
+    got = []
+    # engine="h5py": the batch campaign's assembler default — the
+    # native engine's fused conditioning rounds differently, which is a
+    # WIRE difference, not a slicer difference
+    for path, blk in zip(chaos_file_set,
+                         stream_strain_blocks(chaos_file_set, SEL,
+                                              as_numpy=True,
+                                              engine="h5py")):
+        got.extend(s for s in slicer.offer(IngestItem(path=path, block=blk)))
+    tail = slicer.flush_partial()
+    if tail is not None:
+        got.append(tail)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g.stack),
+                                      np.asarray(w.stack))
+        assert g.paths == w.paths and g.n_real == w.n_real
+        assert g.bucket_ns == w.bucket_ns and g.index0 == w.index0
+
+
+def test_ring_buffer_backpressure_policies():
+    before = tmetrics.resilience_counters()  # noqa: F841 — registry warm
+    ring = RingBuffer("t-reject", capacity=2, policy="reject")
+    assert ring.push(IngestItem(path="a"))
+    assert ring.push(IngestItem(path="b"))
+    assert not ring.push(IngestItem(path="c"))        # full: rejected
+    assert len(ring) == 2
+    rej = tmetrics.REGISTRY.counter("das_ingest_rejected_total",
+                                    labelnames=("tenant",))
+    assert rej.value(tenant="t-reject") == 1
+
+    ring = RingBuffer("t-drop", capacity=2, policy="drop_oldest")
+    for name in ("a", "b", "c"):
+        assert ring.push(IngestItem(path=name))       # always admitted
+    assert len(ring) == 2
+    assert [it.path for it in (ring.pop(), ring.pop())] == ["b", "c"]
+    drop = tmetrics.REGISTRY.counter("das_ingest_dropped_total",
+                                     labelnames=("tenant",))
+    assert drop.value(tenant="t-drop") == 1
+
+    # closed ring refuses everything (drain semantics)
+    ring.close()
+    assert not ring.push(IngestItem(path="d"))
+    assert ring.exhausted()
+
+
+def test_http_ingest_backpressure_429(tmp_path):
+    """The live-feed endpoint: a full reject-policy ring answers 429 +
+    Retry-After; a drop-oldest tenant always accepts and counts the
+    eviction."""
+    cfg = ServiceConfig(
+        tenants=[
+            TenantSpec(name="rej", channels=SEL, ring_capacity=1,
+                       overflow="reject",
+                       metadata={"fs": 200.0, "dx": 2.042, "nx": NX,
+                                 "ns": NS}),
+            TenantSpec(name="drop", channels=SEL, ring_capacity=1,
+                       overflow="drop_oldest",
+                       metadata={"fs": 200.0, "dx": 2.042, "nx": NX,
+                                 "ns": NS}),
+        ],
+        outdir=str(tmp_path / "svc"), persistent_cache=False,
+    )
+    # API only: the scheduler never runs, so pushes stay buffered and
+    # the second push hits a genuinely full ring
+    svc = DetectionService(cfg)
+    svc.api.start()
+    try:
+        block = np.zeros((4, 8), np.float32)
+
+        def post(tenant):
+            req = urllib.request.Request(
+                f"{svc.api.url}/ingest/{tenant}", data=block.tobytes(),
+                headers={"X-DAS-Shape": "4,8", "X-DAS-Dtype": "float32"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    return r.status, dict(r.headers)
+            except urllib.error.HTTPError as e:
+                return e.code, dict(e.headers)
+
+        assert post("rej")[0] == 202
+        code, headers = post("rej")
+        assert code == 429 and "Retry-After" in headers
+
+        assert post("drop")[0] == 202
+        assert post("drop")[0] == 202          # drop-oldest: admitted
+        drop = tmetrics.REGISTRY.counter("das_ingest_dropped_total",
+                                         labelnames=("tenant",))
+        assert drop.value(tenant="drop") >= 1
+        assert post("nosuch")[0] == 404
+    finally:
+        svc.stop()
+
+
+def test_probes_flip_on_injected_dispatch_wedge(chaos_file_set, tmp_path):
+    """The PR 10 truth table, driven by the SERVICE: a wedged dispatch
+    against the last file trips the watchdog -> liveness AND readiness
+    FAIL; the next successful counted fetch recovers both."""
+    probes.reset()
+    assert probes.liveness() and probes.readiness()
+    culprit = os.path.basename(chaos_file_set[-1])
+    plan = faults.FaultPlan(0, rate=0.0, hang_s=HANG_S)
+    plan.spec_for = lambda p: (
+        faults.FaultSpec("hang_dispatch", "dispatch", 10**9)
+        if os.path.basename(p) == culprit else None
+    )
+    cfg = ServiceConfig(
+        tenants=[_spec("a", chaos_file_set, dispatch_deadline_s=1.0)],
+        outdir=str(tmp_path / "svc"), persistent_cache=False,
+    )
+    svc = DetectionService(cfg, fault_plans={"a": plan}).start()
+    t0 = time.perf_counter()
+    try:
+        results = svc.run(until_idle=True)
+    finally:
+        svc.stop()
+    wall = time.perf_counter() - t0
+    assert wall < HANG_S, f"service stalled {wall:.1f}s on a wedged dispatch"
+    st = {os.path.basename(r.path): r.status for r in results["a"].records}
+    assert st[culprit] == "timeout"
+    assert results["a"].n_done == N_FILES - 1
+    # the wedge was the LAST dispatch: the watchdog streak stands ->
+    # watchdog-tripped fails BOTH probes (the truth table's second row)
+    live, ready = probes.liveness(), probes.readiness()
+    assert not live and live.reason == "watchdog-tripped"
+    assert not ready and ready.reason == "watchdog-tripped"
+    # any successful counted fetch recovers
+    probes.note_dispatch_ok()
+    assert probes.liveness() and probes.readiness()
+    probes.reset()
+
+
+def test_sigterm_drain_leaves_resumable_manifests(second_file_set,
+                                                 tmp_path, chaos_file_set):
+    """A real SIGTERM mid-run: the service drains (in-flight slabs
+    resolve, manifests flush) and a restarted service resumes — settled
+    files skipped at the source, every file dispositioned exactly once
+    across both runs."""
+    files = list(chaos_file_set) + list(second_file_set)   # 7 files
+    outdir = str(tmp_path / "svc")
+    cfg = ServiceConfig(
+        tenants=[_spec("a", files,
+                       # pace the replay so the drain lands mid-stream
+                       realtime_factor=30.0, ring_capacity=2)],
+        outdir=outdir, persistent_cache=False,
+    )
+    from das4whales_tpu.service.runner import serve
+
+    manifest = os.path.join(outdir, "a", "manifest.jsonl")
+
+    def fire_sigterm():
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                with open(manifest) as fh:
+                    if sum(1 for line in fh if "done" in line) >= 2:
+                        break
+            except OSError:
+                pass
+            time.sleep(0.02)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    killer = threading.Thread(target=fire_sigterm, daemon=True)
+    killer.start()
+    try:
+        results = serve(cfg, until_idle=True)
+    finally:
+        killer.join(5)
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+    first = results["a"]
+    assert 0 < first.n_done < len(files), (
+        "the drain must land mid-run for this drill to mean anything"
+    )
+    with open(manifest) as fh:
+        recs = [json.loads(line) for line in fh if line.strip()]
+    settled = {r["path"] for r in recs
+               if r.get("status") in ("done", "quarantined")}
+    assert len(settled) == first.n_done
+
+    # restart: settled files are skipped AT THE SOURCE, the rest finish
+    svc2 = DetectionService(ServiceConfig(
+        tenants=[_spec("a", files)], outdir=outdir,
+        persistent_cache=False,
+    )).start()
+    try:
+        results2 = svc2.run(until_idle=True)
+    finally:
+        svc2.stop()
+    second = results2["a"]
+    assert second.n_skipped == first.n_done
+    assert second.n_done == len(files) - first.n_done
+    assert second.n_failed == 0
+    # exactly one done record per file across both runs
+    with open(manifest) as fh:
+        recs = [json.loads(line) for line in fh if line.strip()]
+    by_path: dict = {}
+    for r in recs:
+        if "path" in r:
+            by_path.setdefault(r["path"], []).append(r["status"])
+    assert sorted(by_path) == sorted(files)
+    assert all(sts.count("done") == 1 for sts in by_path.values())
+
+
+def test_admission_pins_ladder_under_tenant_share(chaos_file_set,
+                                                  tmp_path):
+    """Per-tenant HBM admission: a share between the B=1 and B=2
+    program peaks starts the tenant at the per-file rung BEFORE any
+    dispatch (ledgered as a preflight downshift in the tenant's own
+    manifest) — and detection still completes."""
+    from das4whales_tpu.io.stream import stream_strain_blocks
+    from das4whales_tpu.models.matched_filter import MatchedFilterDetector
+    from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+    from das4whales_tpu.utils import memory as memutils
+
+    blk = next(stream_strain_blocks(chaos_file_set[:1], SEL, as_numpy=True))
+    det = MatchedFilterDetector(blk.metadata, SEL,
+                                np.asarray(blk.trace).shape,
+                                pick_mode="sparse",
+                                keep_correlograms=False)
+    bdet = BatchedMatchedFilterDetector(det)
+    stats = {
+        b: memutils.batched_program_memory(bdet, b, np.float32,
+                                           with_health=True)
+        for b in (1, 2)
+    }
+    if stats[1] is None or stats[2] is None:
+        pytest.skip("memory_analysis unsupported on this backend")
+    share_gb = (stats[1].peak + stats[2].peak) / 2 / 2**30
+    cfg = ServiceConfig(
+        tenants=[_spec("a", chaos_file_set, admission=True,
+                       hbm_share_gb=share_gb)],
+        outdir=str(tmp_path / "svc"), persistent_cache=False,
+    )
+    svc = DetectionService(cfg).start()
+    try:
+        results = svc.run(until_idle=True)
+    finally:
+        svc.stop()
+    assert results["a"].n_done == N_FILES and results["a"].n_failed == 0
+    s = summarize_campaign(str(tmp_path / "svc" / "a"))
+    assert s["downshifts"] == 1
+    ev = s["downshift_ledger"][0]
+    assert ev.get("preflight") is True and ev["to"] == "file"
+    assert "admission" in ev["error"]
+    assert all(r.rung == "file" for r in results["a"].records)
+
+
+def test_service_config_loader_round_trip(tmp_path):
+    raw = {
+        "outdir": str(tmp_path / "out"),
+        "port": 0,
+        "tenants": [
+            {"name": "a", "files": ["x.h5"], "channels": [0, 8, 1],
+             "batch": 2, "overflow": "drop_oldest", "weight": 2.0},
+        ],
+    }
+    path = str(tmp_path / "svc.json")
+    with open(path, "w") as fh:
+        json.dump(raw, fh)
+    cfg = load_service_config(path)
+    assert cfg.tenants[0].name == "a"
+    assert cfg.tenants[0].overflow == "drop_oldest"
+    assert cfg.tenants[0].weight == 2.0
+
+    raw["tenants"][0]["bogus_knob"] = 1
+    with open(path, "w") as fh:
+        json.dump(raw, fh)
+    with pytest.raises(ValueError, match="bogus_knob"):
+        load_service_config(path)
+
+    with open(path, "w") as fh:
+        json.dump({"tenants": []}, fh)
+    with pytest.raises(ValueError, match="no tenants"):
+        load_service_config(path)
+
+
+def test_serve_cli_until_idle(chaos_file_set, tmp_path, capsys):
+    """The ``python -m das4whales_tpu serve`` subcommand end to end
+    (backfill mode): registry file in, per-tenant summary out, rc 0."""
+    from das4whales_tpu.__main__ import main
+
+    raw = {
+        "outdir": str(tmp_path / "svc"),
+        "tenants": [
+            {"name": "a", "files": chaos_file_set,
+             "channels": SEL, "batch": 2, "bucket": "exact",
+             "admission": False},
+        ],
+    }
+    path = str(tmp_path / "svc.json")
+    with open(path, "w") as fh:
+        json.dump(raw, fh)
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    try:
+        rc = main(["serve", path, "--until-idle", "--port", "0"])
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert f"tenant a: {N_FILES} done" in out
+
+
+def test_ndjson_cursor_resume_and_long_poll(chaos_file_set, tmp_path):
+    """The picks stream: cursor resume re-reads nothing and misses
+    nothing; ``picks=1`` embeds artifact arrays; a long-poll on a live
+    (empty) stream waits instead of spinning."""
+    cfg = ServiceConfig(
+        tenants=[_spec("a", chaos_file_set)],
+        outdir=str(tmp_path / "svc"), persistent_cache=False,
+    )
+    svc = DetectionService(cfg).start()
+    try:
+        results = svc.run(until_idle=True)
+        assert results["a"].n_done == N_FILES
+        _, body = _get(svc.api.url + "/picks/a?cursor=0")
+        lines = [json.loads(x) for x in body.splitlines()]
+        file_lines = [x for x in lines if "path" in x]
+        assert len(file_lines) == N_FILES
+        assert [x["cursor"] for x in lines] == list(range(1, len(lines) + 1))
+        # resume from a mid-stream cursor: only the tail comes back
+        mid = lines[1]["cursor"]
+        _, tail = _get(svc.api.url + f"/picks/a?cursor={mid}")
+        tail_lines = [json.loads(x) for x in tail.splitlines()]
+        assert [x["cursor"] for x in tail_lines] == [
+            x["cursor"] for x in lines[mid:]
+        ]
+        # picks=1 embeds the artifact arrays, matching the .npz
+        _, embedded = _get(svc.api.url + "/picks/a?cursor=0&picks=1")
+        done = [json.loads(x) for x in embedded.splitlines()
+                if json.loads(x).get("status") == "done"]
+        rec = done[0]
+        disk = load_picks(rec["picks_file"])
+        for name, arr in rec["picks"].items():
+            np.testing.assert_array_equal(np.asarray(arr), disk[name])
+        # long-poll: past the end, wait_s bounds the wall, empty body
+        t0 = time.perf_counter()
+        _, empty = _get(
+            svc.api.url
+            + f"/picks/a?cursor={lines[-1]['cursor']}&wait_s=0.3"
+        )
+        assert empty == "" and 0.25 <= time.perf_counter() - t0 < 3.0
+    finally:
+        svc.stop()
+
+
+def test_tenants_snapshot_surface_and_trace_export(chaos_file_set,
+                                                   tmp_path):
+    cfg = ServiceConfig(
+        tenants=[_spec("a", chaos_file_set)],
+        outdir=str(tmp_path / "svc"), persistent_cache=False,
+        trace=True,
+    )
+    svc = DetectionService(cfg).start()
+    try:
+        svc.run(until_idle=True)
+        _, body = _get(svc.api.url + "/tenants")
+        snap = json.loads(body)
+        assert snap["drained"] is True and snap["draining"] is False
+        assert snap["probes"]["live"] and snap["probes"]["ready"]
+        row = snap["tenants"][0]
+        assert row["tenant"] == "a" and row["n_done"] == N_FILES
+        assert row["ring_closed"] is True
+    finally:
+        svc.stop()
+    # the drain exported the service's flight record (trace=True)
+    trace_path = os.path.join(str(tmp_path / "svc"), "trace.json")
+    assert os.path.exists(trace_path)
+    with open(trace_path) as fh:
+        events = [e for e in json.load(fh)["traceEvents"]
+                  if e.get("ph") == "X"]
+    names = {e["name"] for e in events}
+    assert {"campaign", "slab", "resolve"} <= names
+    from das4whales_tpu.telemetry import trace as ttrace
+
+    assert not ttrace.enabled()   # per-run enable restored
+
+
+def test_live_block_roundtrip_through_scheduler(tmp_path):
+    """A live-pushed block (no file on disk) detects like any other:
+    pushed via the ring, sliced, dispatched, recorded — the 'live
+    interrogator feed' path minus HTTP framing (that layer is pinned by
+    test_http_ingest_backpressure_429)."""
+    from das4whales_tpu.io.synth import (
+        SyntheticCall,
+        SyntheticScene,
+        synthesize_scene,
+    )
+
+    scene = SyntheticScene(
+        nx=NX, ns=NS, noise_rms=0.05, seed=7,
+        calls=[SyntheticCall(t0=1.5, x0_m=NX / 2 * 2.042, amplitude=2.0)],
+    )
+    meta = scene.metadata
+    cfg = ServiceConfig(
+        tenants=[TenantSpec(name="live", channels=SEL, batch=2,
+                            bucket="exact", admission=False,
+                            metadata={"fs": meta.fs, "dx": meta.dx,
+                                      "nx": meta.nx, "ns": meta.ns,
+                                      "scale_factor": meta.scale_factor},
+                            linger_s=0.05)],
+        outdir=str(tmp_path / "svc"), persistent_cache=False,
+    )
+    svc = DetectionService(cfg)
+    t = svc.tenant("live")
+    block = LiveBlock(trace=np.asarray(synthesize_scene(scene), np.float32),
+                      metadata=t.spec.live_metadata())
+    assert t.ring.push(IngestItem(path="live-0", block=block))
+    t.ring.close()
+    results = svc.run(until_idle=True)
+    assert results["live"].n_done == 1
+    rec = results["live"].records[0]
+    assert rec.status == "done" and sum(rec.n_picks.values()) > 0

@@ -31,34 +31,24 @@ import numpy as np
 import pytest
 
 from das4whales_tpu import faults
-from das4whales_tpu.io.synth import (
-    SyntheticCall,
-    SyntheticScene,
-    write_synthetic_file,
-)
 from das4whales_tpu.telemetry import metrics, probes, trace
 from das4whales_tpu.telemetry.progress import _PlainProgress, progress
 from das4whales_tpu.workflows.campaign import load_picks, run_campaign_batched
 
-NX, NS = 24, 900
-SEL = [0, NX, 1]
-N_FILES = 4
+from tests.conftest import CHAOS_N_FILES, CHAOS_NS, CHAOS_NX, CHAOS_SEL
+
+NX, NS = CHAOS_NX, CHAOS_NS
+SEL = CHAOS_SEL
+N_FILES = CHAOS_N_FILES
 
 
 @pytest.fixture(scope="module")
-def file_set(tmp_path_factory):
-    d = tmp_path_factory.mktemp("teledata")
-    paths = []
-    for k in range(N_FILES):
-        scene = SyntheticScene(
-            nx=NX, ns=NS, noise_rms=0.05, seed=100 + k,
-            calls=[SyntheticCall(t0=1.2 + 0.3 * k, x0_m=NX / 2 * 2.042,
-                                 amplitude=2.0)],
-        )
-        p = str(d / f"tf{k}.h5")
-        write_synthetic_file(p, scene)
-        paths.append(p)
-    return paths
+def file_set(chaos_file_set):
+    """The session-scoped chaos file set (conftest.py): same shapes,
+    same compiled programs — one fixture cost for all three modules
+    that drive [24 x 900] campaigns (ISSUE 12 wall-headroom
+    satellite)."""
+    return chaos_file_set
 
 
 @pytest.fixture()
