@@ -854,7 +854,12 @@ def _bench_service(nx, ns, fs, dx, n_files: int = 6, n_tenants: int = 2,
       between tenants;
     * p95 slab latency from the ``das_slab_wall_seconds`` histogram
       (the per-slab tail a subscriber actually experiences), plus the
-      dispatch/sync counter deltas.
+      dispatch/sync counter deltas;
+    * per-lock contention from the TracedLock histograms
+      (``das_lock_wait_seconds{name}`` / ``das_lock_held_seconds{name}``,
+      utils/locks.py): p95 acquire-wait and hold per lock name — the
+      steady-state's serving-thread queueing, measured where the
+      TPU_RUNBOOK lock triage reads it.
     """
     import tempfile
 
@@ -935,6 +940,23 @@ def _bench_service(nx, ns, fs, dx, n_files: int = 6, n_tenants: int = 2,
         }
     hist = _tmetrics.REGISTRY.histogram("das_slab_wall_seconds")
     p95 = hist.quantile(0.95)
+    # per-lock contention: every TracedLock the steady state touched
+    # (ring, tenant-state, manifest-index, ...) — p95 acquire-wait and
+    # hold, from the same histograms /metrics serves
+    wait_h = _tmetrics.REGISTRY.histogram("das_lock_wait_seconds",
+                                          labelnames=("name",))
+    held_h = _tmetrics.REGISTRY.histogram("das_lock_held_seconds",
+                                          labelnames=("name",))
+    locks = {}
+    for row in snap.get("das_lock_wait_seconds", {"values": []})["values"]:
+        lname = row["labels"].get("name")
+        wq = wait_h.quantile(0.95, name=lname)
+        hq = held_h.quantile(0.95, name=lname)
+        locks[lname] = {
+            "acquisitions": row["count"],
+            "wait_p95_s": round(wq, 6) if wq is not None else None,
+            "held_p95_s": round(hq, 6) if hq is not None else None,
+        }
     tot_slabs = sum(v["slabs"] for v in per_tenant.values())
     tot_overlap = sum(
         _counter("das_service_overlapped_slabs_total", n) for n in per_tenant
@@ -952,6 +974,7 @@ def _bench_service(nx, ns, fs, dx, n_files: int = 6, n_tenants: int = 2,
         "service_n_syncs": delta.get("syncs", 0),
         "service_n_failed": n_failed,
         "service_tenants": per_tenant,
+        "service_locks": locks,
     }
 
 

@@ -34,3 +34,15 @@ def compile_guard() -> CompileGuard:
     no-retrace contract."""
     runtime.install()
     return CompileGuard()
+
+
+@pytest.fixture
+def race_guard():
+    """Seeded-interleaving guard (the concurrency analog of
+    ``compile_guard``): ``with race_guard(seed=3): ...`` shrinks the
+    switch interval, injects yields at TracedLock acquisitions, and
+    fails on lock-order inversions or torn iterations
+    (analysis/concurrency_runtime.py)."""
+    from . import concurrency_runtime
+
+    return concurrency_runtime.race_guard

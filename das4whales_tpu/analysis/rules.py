@@ -57,6 +57,26 @@ R7  unblocked timing — a ``time.perf_counter()`` bracket (``t0 =
     definition; ``telemetry/`` itself is out of scope by construction).
     Intentional sites — walls whose sync happens inside a callee the
     AST cannot see — are baselined with a reason.
+R8  unsynchronized-shared-state — a GuardedBy-style pass over the
+    THREAD-SPAWNING modules (``service/``, ``telemetry/``,
+    ``io/stream.py``, ``io/native.py``, ``parallel/dispatch.py``):
+    each class's lock discipline is inferred from the majority of
+    attribute accesses that hold ``self._lock``-style locks, the
+    unguarded minority is flagged, a ``# daslint: guarded-by[_lock]``
+    annotation pins the discipline explicitly, and public snapshot
+    methods that Python-iterate an attribute another method mutates
+    with no common lock are the torn-iteration clause. Implemented in
+    ``analysis/concurrency.py`` (R9/R10 too).
+R9  lock-order / blocking-under-lock — the static lock-acquisition
+    graph from ``with``-statement nesting (closed over same-namespace
+    calls) flags cycles, and dispatch/IO blockers held under a lock
+    (``.resolve()``, ``block_until_ready``, ``push_wait``, file
+    reads/writes, ``time.sleep``, …) flag the serving path's deadlock
+    and tail-latency hazards.
+R10 thread-hygiene — ``Condition.wait()`` outside a predicate
+    ``while``, ``Event.wait()``/``.join()`` without a timeout in
+    service modules, threads/pools spawned without a name, and
+    ``time.sleep`` polling where a Condition exists.
 
 Suppression: an inline ``# daslint: allow[R2]`` (comma list, or
 ``daslint: ignore`` for all rules) on the finding's line or the line above
@@ -72,7 +92,7 @@ import re
 from pathlib import PurePosixPath
 from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9", "R10")
 
 #: (path suffix, function name or "*") pairs where explicit float64 is the
 #: documented host-side design contract (masks and filter coefficients are
@@ -173,6 +193,29 @@ def canonical_path(path: str) -> str:
         if parts[i] == "das4whales_tpu":
             return str(PurePosixPath(*parts[i:]))
     return str(PurePosixPath(*parts))
+
+
+def line_allowed(lines: Sequence[str], f: Finding) -> bool:
+    """Inline suppression: ``# daslint: allow[R2,...]`` / ``daslint:
+    ignore`` on the finding's line, or standalone on the line above
+    (shared by the R1–R7 analyzer and the concurrency pass)."""
+    for ln in (f.line, f.line - 1):
+        if not 1 <= ln <= len(lines):
+            continue
+        text = lines[ln - 1]
+        if ln != f.line and not text.lstrip().startswith("#"):
+            # a trailing allow comment licenses ONLY its own line —
+            # the line-above form must be a standalone comment, or a
+            # suppression would bleed onto the next statement
+            continue
+        m = _ALLOW_RE.search(text)
+        if m:
+            if m.group(1) is None:  # daslint: ignore
+                return True
+            allowed = {r.strip().upper() for r in m.group(1).split(",")}
+            if f.rule in allowed:
+                return True
+    return False
 
 
 def _in_scope(path: str, scope: frozenset) -> bool:
@@ -349,23 +392,7 @@ class _Analyzer(ast.NodeVisitor):
             ))
 
     def _line_allowed(self, f: Finding) -> bool:
-        for ln in (f.line, f.line - 1):
-            if not 1 <= ln <= len(self.lines):
-                continue
-            text = self.lines[ln - 1]
-            if ln != f.line and not text.lstrip().startswith("#"):
-                # a trailing allow comment licenses ONLY its own line —
-                # the line-above form must be a standalone comment, or a
-                # suppression would bleed onto the next statement
-                continue
-            m = _ALLOW_RE.search(text)
-            if m:
-                if m.group(1) is None:  # daslint: ignore
-                    return True
-                allowed = {r.strip().upper() for r in m.group(1).split(",")}
-                if f.rule in allowed:
-                    return True
-        return False
+        return line_allowed(self.lines, f)
 
     # -- structure ---------------------------------------------------------
 
@@ -796,8 +823,15 @@ def analyze_source(source: str, path: str,
         return [Finding(rule="E0", code="syntax-error", path=cpath,
                         line=exc.lineno or 1, col=(exc.offset or 1) - 1,
                         symbol="<module>", message=f"cannot parse: {exc.msg}")]
-    analyzer = _Analyzer(cpath, source.splitlines(), rules)
-    return analyzer.run(tree)
+    lines = source.splitlines()
+    analyzer = _Analyzer(cpath, lines, rules)
+    findings = analyzer.run(tree)
+    if any(r in rules for r in ("R8", "R9", "R10")):
+        from . import concurrency
+
+        findings += [f for f in concurrency.analyze(tree, cpath, lines, rules)
+                     if not line_allowed(lines, f)]
+    return findings
 
 
 def analyze_file(path, rules: Sequence[str] = ALL_RULES) -> List[Finding]:

@@ -161,7 +161,9 @@ def call_with_deadline(fn, deadline_s: float | None, path: str):
     from concurrent.futures import ThreadPoolExecutor
     from concurrent.futures import TimeoutError as _FutTimeout
 
-    ex = ThreadPoolExecutor(max_workers=1)
+    # named so a wedged, abandoned dispatch is attributable in a stack
+    # dump / trace (the thread may outlive the campaign by design)
+    ex = ThreadPoolExecutor(max_workers=1, thread_name_prefix="das-watchdog")
     try:
         fut = ex.submit(fn)
         try:

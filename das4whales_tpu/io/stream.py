@@ -314,7 +314,8 @@ def stream_strain_blocks(
     # itself, another file's error, or consumer abandonment while a
     # hung read is in flight). Deadline-less streams keep the legacy
     # draining teardown.
-    ex = ThreadPoolExecutor(max_workers=prefetch)
+    ex = ThreadPoolExecutor(max_workers=prefetch,
+                            thread_name_prefix="das-read")
     try:
         futs = {
             i: ex.submit(probe_and_read, i)
@@ -392,7 +393,8 @@ def _native_stream(files, sel, specs, spec_for, prefetch, place, finish,
                 yield hand(i)
             return
 
-        with ThreadPoolExecutor(max_workers=1) as tx:
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="das-h2d") as tx:
             handed = 0
             futs: deque = deque()
             for i in range(n):
@@ -737,7 +739,8 @@ def stream_batched_slabs(
         return dataclasses.replace(slab, stack=stack)
 
     error: SlabReadError | None = None
-    with ThreadPoolExecutor(max_workers=1) as tx:
+    with ThreadPoolExecutor(max_workers=1,
+                            thread_name_prefix="das-h2d-slab") as tx:
         futs: deque = deque()
 
         def pump():

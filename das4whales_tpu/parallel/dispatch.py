@@ -211,8 +211,10 @@ class PipelinedDispatch:
         come back from :meth:`drain`, without resolving anything. The
         multi-stream scheduler uses this to see WHOSE slabs are in
         flight (fairness/overlap decisions); campaign code uses it for
-        bookkeeping assertions."""
-        return tuple(key for key, _handle, _t in self._q)
+        bookkeeping assertions. Iterates a C-atomic snapshot of the
+        queue: an HTTP status thread reading pending() while the
+        scheduler pops must never tear (daslint R8)."""
+        return tuple(key for key, _handle, _t in tuple(self._q))
 
     def _note_depth(self) -> None:
         # the gauge rides the public accessor: one definition of depth

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..config import as_bucket_config
 from ..telemetry import metrics
+from ..utils import locks
 from ..utils.log import get_logger
 
 log = get_logger("service.ingest")
@@ -111,8 +112,14 @@ class RingBuffer:
         self.capacity = int(capacity)
         self.policy = policy
         self._q: deque = deque()
-        self._lock = threading.Lock()
+        # a TracedLock (utils.locks): ring contention lands in the
+        # das_lock_wait/held_seconds{name="ring"} histograms and the
+        # lock-order graph the race_guard fixture asserts acyclic
+        self._lock = locks.new_lock("ring")
         self._not_empty = threading.Condition(self._lock)
+        # notified by pop(): push_wait blocks HERE instead of
+        # sleep-polling (daslint R10 sleep-polling)
+        self._space = threading.Condition(self._lock)
         self._closed = False
 
     def __len__(self) -> int:
@@ -133,6 +140,7 @@ class RingBuffer:
         with self._not_empty:
             self._closed = True
             self._not_empty.notify_all()
+            self._space.notify_all()   # blocked push_wait callers: drain
 
     def push(self, item: IngestItem) -> bool:
         """Admit ``item`` under the ring's overflow policy. Returns True
@@ -153,15 +161,17 @@ class RingBuffer:
             self._not_empty.notify()
             return True
 
-    def push_wait(self, item: IngestItem, poll_s: float = 0.005,
+    def push_wait(self, item: IngestItem, poll_s: float | None = None,
                   timeout_s: float | None = None) -> bool:
         """Blocking push for sources that must never lose items (the
-        file-replay source): wait for space instead of dropping. Returns
-        False only when the ring closes (drain) or ``timeout_s``
-        expires."""
+        file-replay source): wait for space instead of dropping. Blocks
+        on the ``_space`` condition ``pop()`` notifies (no sleep-poll —
+        the waiter wakes the moment a slot frees). Returns False only
+        when the ring closes (drain) or ``timeout_s`` expires.
+        ``poll_s`` is accepted for back-compat and ignored."""
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
-        while True:
-            with self._not_empty:
+        with self._space:
+            while True:
                 if self._closed:
                     return False
                 if len(self._q) < self.capacity:
@@ -170,18 +180,24 @@ class RingBuffer:
                     _g_depth.set(len(self._q), tenant=self.tenant)
                     self._not_empty.notify()
                     return True
-            if deadline is not None and time.monotonic() >= deadline:
-                return False
-            time.sleep(poll_s)
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                # bounded wait even without a caller timeout: a missed
+                # notify (or a consumer that died) must not hang the
+                # replay thread forever (daslint R10 unbounded-wait)
+                self._space.wait(min(remaining or 1.0, 1.0))
 
     def pop(self) -> Optional[IngestItem]:
         """The oldest buffered item, or None when the ring is empty
         (non-blocking: the scheduler decides how to idle)."""
-        with self._lock:
+        with self._space:
             if not self._q:
                 return None
             item = self._q.popleft()
             _g_depth.set(len(self._q), tenant=self.tenant)
+            self._space.notify()   # a blocked push_wait can land now
             return item
 
 
@@ -271,7 +287,11 @@ class FileReplaySource:
                     if self.factor > 0 and block is not None:
                         dur = block_duration_s(block)
                         if dur > 0:
-                            time.sleep(dur / self.factor)
+                            # pace on the stop Event, not time.sleep: a
+                            # drain request wakes the replay immediately
+                            # instead of after the block's remaining
+                            # real-time budget
+                            self._stop.wait(dur / self.factor)
                 del stream
         finally:
             if self.close_when_done:

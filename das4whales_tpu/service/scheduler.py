@@ -46,6 +46,7 @@ import numpy as np
 from .. import faults
 from ..parallel.dispatch import PipelinedDispatch, resolve_watchdogged
 from ..telemetry import metrics, trace as telemetry
+from ..utils import locks
 from ..utils.log import get_logger
 from ..workflows import campaign as camp
 from ..workflows.planner import DownshiftLadder, MatchedFilterProgram
@@ -104,6 +105,11 @@ class TenantRuntime:
         self.slicer = SlabSlicer(spec.batch, bucket=spec.bucket,
                                  linger_s=spec.linger_s)
         self.ready: deque = deque()       # BatchSlab | IngestItem(error)
+        # guards the snapshot-visible scheduler state below: the DRR
+        # deficit and the abort marker are written by the scheduler
+        # thread and read by HTTP handler threads through snapshot()
+        # (ISSUE 13 — the R8 discipline the race_guard drill exercises)
+        self._lock = locks.new_lock("tenant-state")
         self.deficit = 0.0
         self.aborted: Optional[str] = None
         self.settled = camp.load_settled(outdir) if resume else set()
@@ -122,6 +128,36 @@ class TenantRuntime:
 
     def next_live_name(self) -> str:
         return f"{self.name}-live-{next(self._live_seq)}"
+
+    # -- scheduler-visible state (written by the scheduler thread, read
+    # -- by HTTP snapshot threads: every mutation goes through _lock) ------
+
+    def credit(self, quantum: float) -> None:
+        """One DRR round's credit (weighted by ``TenantSpec.weight``).
+        The deficit gauge rides every guarded mutation, so the metric
+        and the field can never disagree."""
+        with self._lock:
+            self.deficit += quantum * self.spec.weight
+            _g_deficit.set(round(self.deficit, 3), tenant=self.name)
+
+    def forfeit(self) -> None:
+        """Classic DRR: an empty queue forfeits accumulated credit."""
+        with self._lock:
+            self.deficit = 0.0
+            _g_deficit.set(0.0, tenant=self.name)
+
+    def try_spend(self, cost: float) -> bool:
+        """Spend ``cost`` megasamples of deficit if covered."""
+        with self._lock:
+            if cost > self.deficit:
+                return False
+            self.deficit -= cost
+            _g_deficit.set(round(self.deficit, 3), tenant=self.name)
+            return True
+
+    def mark_aborted(self, reason: str) -> None:
+        with self._lock:
+            self.aborted = reason
 
     # -- ingest side -------------------------------------------------------
 
@@ -417,7 +453,7 @@ class TenantRuntime:
             _c_files.inc(tenant=self.name,
                          status=self.records[-1].status)
         except camp.CampaignAborted as aexc:
-            self.aborted = str(aexc)
+            self.mark_aborted(str(aexc))
 
     def handle_slab(self, slab, inflight=None) -> None:
         """One slab through the elastic ladder + per-file degrade +
@@ -572,10 +608,22 @@ class TenantRuntime:
     # -- reporting ---------------------------------------------------------
 
     def result(self) -> camp.CampaignResult:
-        return camp.CampaignResult(outdir=self.outdir, records=self.records)
+        # list(...) is a C-atomic copy: an HTTP thread's result() while
+        # the scheduler appends a record must never tear (daslint R8)
+        return camp.CampaignResult(outdir=self.outdir,
+                                   records=list(self.records))
 
     def snapshot(self) -> Dict:
+        """The /tenants view, safe against the scheduler thread: counts
+        come from a C-atomic copy of the records list, sticky rungs
+        from the ladder's own copy-on-read (`rung_snapshot`), and the
+        lock brackets the mutable scalars (deficit, abort marker) so a
+        poll observes one consistent DRR round."""
         res = self.result()
+        rungs = self.ladder.rung_snapshot()
+        with self._lock:
+            aborted = self.aborted
+            deficit = self.deficit
         return {
             "tenant": self.name,
             "n_done": res.n_done, "n_failed": res.n_failed,
@@ -584,12 +632,10 @@ class TenantRuntime:
             "ring_depth": len(self.ring),
             "ring_closed": self.ring.closed,
             "ready_slabs": len(self.ready),
-            "aborted": self.aborted,
-            "rungs": {
-                str(k): faults.rung_label(r)
-                for k, r in self.ladder.sticky.items()
-            },
-            "deficit_msamples": round(self.deficit, 3),
+            "aborted": aborted,
+            "rungs": {str(k): faults.rung_label(r)
+                      for k, r in rungs.items()},
+            "deficit_msamples": round(deficit, 3),
         }
 
 
@@ -633,7 +679,7 @@ class StreamScheduler:
                 t.handle_slab(slab, inflight)
         except camp.CampaignAborted as exc:
             # one tenant's max_failures abort stops THAT stream only
-            t.aborted = str(exc)
+            t.mark_aborted(str(exc))
             log.error("tenant %s aborted: %s", name, exc)
         except Exception as exc:  # noqa: BLE001 — whole-slab guard
             if faults.classify_failure(exc) == "fatal":
@@ -645,7 +691,7 @@ class StreamScheduler:
                         t.rz.fail(path, exc)
                         _c_files.inc(tenant=name, status="failed")
                     except camp.CampaignAborted as aexc:
-                        t.aborted = str(aexc)
+                        t.mark_aborted(str(aexc))
                         break
 
     def _drain_pipe(self) -> None:
@@ -680,25 +726,21 @@ class StreamScheduler:
                 t.handle_error_item(t.ready.popleft())
                 any_work = True
             if not t.ready:
-                t.deficit = 0.0   # classic DRR: empty queue forfeits credit
-                _g_deficit.set(0.0, tenant=name)
+                t.forfeit()   # classic DRR: empty queue forfeits credit
                 continue
             head_cost = self._cost(t.ready[0])
             self._base_quantum = max(self._base_quantum, head_cost)
-            t.deficit += self._base_quantum * t.spec.weight
+            t.credit(self._base_quantum)
             while t.ready:
                 if isinstance(t.ready[0], IngestItem):
                     t.handle_error_item(t.ready.popleft())
                     any_work = True
                     continue
-                cost = self._cost(t.ready[0])
-                if cost > t.deficit:
+                if not t.try_spend(self._cost(t.ready[0])):
                     break
                 slab = t.ready.popleft()
-                t.deficit -= cost
                 self._serve(t, slab)
                 any_work = True
-            _g_deficit.set(round(t.deficit, 3), tenant=name)
         return any_work
 
     def drain(self) -> None:

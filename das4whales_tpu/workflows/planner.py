@@ -429,6 +429,14 @@ class DownshiftLadder:
             key, ("batched", self.batch) if self.batch > 1 else ("file", 1)
         )
 
+    def rung_snapshot(self) -> Dict[tuple, tuple]:
+        """A copy of the sticky map for cross-thread readers (the
+        service's /tenants snapshot): ``dict(...)`` of a dict is a
+        C-atomic copy, so an HTTP thread never iterates the live map
+        while the scheduler thread downshifts it (daslint R8's
+        torn-iteration clause — ISSUE 13)."""
+        return dict(self.sticky)
+
     def _ledger(self, key, from_rung, to_rung, error: str,
                 preflight: bool = False) -> None:
         """One downshift ledger move: a ``downshift`` SPAN paired with

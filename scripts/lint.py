@@ -10,15 +10,82 @@ Usage::
 
     python scripts/lint.py                # gate the installed package
     python scripts/lint.py path [...]     # gate specific files/subtrees
+    python scripts/lint.py --changed      # gate only files in the git diff
+
+``--changed`` lints the union of unstaged, staged, and untracked ``.py``
+files under the repo (the pre-commit fast path); the FULL tree remains
+the tier-1 default — a changed-only pass cannot catch a hazard whose
+trigger lives in an unchanged file (e.g. a baseline entry going stale).
+With no changed Python files it exits 0 without analyzing anything.
 """
 
 import os
+import subprocess
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 from das4whales_tpu.analysis.__main__ import main  # noqa: E402
 
+
+def changed_python_files(repo: str | None = None,
+                         package: str = "das4whales_tpu") -> list:
+    """Absolute paths of ``.py`` files the working tree changed vs HEAD:
+    unstaged + staged (``git diff HEAD``) plus untracked. Deleted files
+    are excluded (there is nothing left to lint). ``repo`` defaults to
+    the git toplevel of the CURRENT directory, so the fast path works
+    from any checkout, not just this script's own repo.
+
+    When the repo has a top-level ``package`` directory, only changed
+    files INSIDE it count: ``--changed`` must be a fast SUBSET of the
+    full gate (which lints the installed package), never a stricter
+    one — bench/tests/scripts findings the gate deliberately ignores
+    would otherwise fail the fast path where the full gate passes. A
+    repo without the package dir lints every changed ``.py``."""
+    if repo is None:
+        repo = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+    out = subprocess.run(
+        ["git", "-C", repo, "diff", "--name-only", "--diff-filter=d",
+         "HEAD", "--"],
+        capture_output=True, text=True, check=True,
+    ).stdout.splitlines()
+    out += subprocess.run(
+        ["git", "-C", repo, "ls-files", "--others", "--exclude-standard"],
+        capture_output=True, text=True, check=True,
+    ).stdout.splitlines()
+    scoped = os.path.isdir(os.path.join(repo, package))
+    seen = []
+    for rel in out:
+        p = os.path.join(repo, rel)
+        if not rel.endswith(".py") or not os.path.exists(p) or p in seen:
+            continue
+        if scoped and not rel.startswith(package + "/"):
+            continue
+        seen.append(p)
+    return seen
+
+
+def run(argv) -> int:
+    """The ``scripts/lint.py`` entry, callable in-process (tests)."""
+    argv = list(argv)
+    if "--changed" in argv:
+        argv.remove("--changed")
+        try:
+            paths = changed_python_files()
+        except subprocess.CalledProcessError as exc:
+            print(f"lint --changed: git diff failed: {exc}", file=sys.stderr)
+            return 2
+        if not paths:
+            print("daslint: no changed Python files", file=sys.stderr)
+            return 0
+        return main(["--check", *argv, *paths])
+    return main(["--check", *argv])
+
+
 if __name__ == "__main__":
-    sys.exit(main(["--check", *sys.argv[1:]]))
+    sys.exit(run(sys.argv[1:]))

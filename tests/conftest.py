@@ -32,7 +32,10 @@ import pytest
 # recompile guard (tests/test_daslint.py and any hot-path test): imported
 # here rather than via pytest_plugins so the fixture is available without
 # a rootdir conftest.
-from das4whales_tpu.analysis.pytest_plugin import compile_guard  # noqa: F401
+from das4whales_tpu.analysis.pytest_plugin import (  # noqa: F401
+    compile_guard,
+    race_guard,
+)
 
 
 @pytest.fixture

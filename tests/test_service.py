@@ -20,7 +20,14 @@ Contracts pinned here:
   exactly once across both runs;
 * per-tenant HBM admission pins the ladder before the first dispatch;
 * ``PipelinedDispatch.pending()``/``in_flight()`` accessors (the
-  scheduler's public view — satellite) live in tests/test_dispatch.py.
+  scheduler's public view — satellite) live in tests/test_dispatch.py;
+* the CONCURRENCY drill (ISSUE 13): the two-tenant chaos run re-run
+  under ``race_guard`` with ``/tenants``+``/metrics``+``/picks`` polled
+  hot from client threads — zero lock-order inversions, zero torn
+  iterations, every snapshot internally consistent, picks still
+  bit-identical, and the ``das_lock_*`` histograms served by
+  ``/metrics``; plus the NDJSON long-poll vs a concurrent manifest
+  writer and the per-manifest index-lock regression (R9's first catch).
 """
 
 from __future__ import annotations
@@ -636,3 +643,211 @@ def test_live_block_roundtrip_through_scheduler(tmp_path):
     assert results["live"].n_done == 1
     rec = results["live"].records[0]
     assert rec.status == "done" and sum(rec.n_picks.values()) > 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13 — the concurrency drill: race_guard + hot HTTP polling
+# ---------------------------------------------------------------------------
+
+def _race_drill(race_guard, seed, chaos_file_set, second_file_set,
+                batched_refs, outdir):
+    """THE ISSUE 13 acceptance drill: the two-tenant chaos service
+    (tenant A's injected OOM and all) re-run under seeded interleaving
+    pressure, with ``/tenants``, ``/metrics`` and ``/picks`` polled hot
+    from a client thread each. Every poll checks its surface's
+    invariants; the guard fails the test on any lock-order inversion or
+    torn iteration anywhere in the process; picks must stay
+    bit-identical to the standalone batched runs."""
+    plan_a = faults.FaultPlan(0, rate=0.0)
+    plan_a.spec_for = lambda p: faults.FaultSpec(
+        "oom", "dispatch", 10**9, ok_rung=("file", 1))
+    cfg = ServiceConfig(
+        tenants=[_spec("a", chaos_file_set), _spec("b", second_file_set)],
+        outdir=outdir, persistent_cache=False,
+    )
+    totals = {"a": N_FILES, "b": 3}
+    poll_errors: list = []
+    polled = {"/tenants": 0, "/metrics": 0, "/picks": 0}
+    metrics_bodies: list = []
+    stop_poll = threading.Event()
+
+    def check_tenants(body):
+        snap = json.loads(body)      # a torn snapshot would not parse
+        assert {row["tenant"] for row in snap["tenants"]} == {"a", "b"}
+        for row in snap["tenants"]:
+            # one consistent DRR round per poll: non-negative credit,
+            # dispositions bounded by the tenant's own file count,
+            # rungs a complete dict (copy-on-read, never mid-mutation)
+            assert row["deficit_msamples"] >= 0.0
+            assert 0 <= row["n_done"] + row["n_failed"] <= totals[row["tenant"]]
+            assert isinstance(row["rungs"], dict)
+            assert row["ring_depth"] >= 0 and row["ready_slabs"] >= 0
+
+    def check_metrics(body):
+        assert "das_" in body
+        metrics_bodies.append(body)
+
+    def check_picks(body):
+        lines = [json.loads(x) for x in body.splitlines()]
+        # cursor=0 re-read: cursors are exactly 1..n — a skip or a
+        # duplicate means the index tore under the manifest writer
+        assert [x["cursor"] for x in lines] == list(range(1, len(lines) + 1))
+
+    checks = {"/tenants": check_tenants, "/metrics": check_metrics,
+              "/picks": check_picks}
+
+    svc = DetectionService(cfg, fault_plans={"a": plan_a})
+    with race_guard(seed=seed) as report:
+        svc.start()
+
+        def poll(ep, path):
+            while not stop_poll.is_set():
+                try:
+                    status, body = _get(svc.api.url + path)
+                    assert status == 200
+                    checks[ep](body)
+                    polled[ep] += 1
+                except (urllib.error.URLError, OSError) as exc:
+                    poll_errors.append((ep, repr(exc)))
+                except AssertionError as exc:
+                    poll_errors.append((ep, f"invariant: {exc}"))
+                    stop_poll.set()
+                time.sleep(0.002)
+
+        pollers = [
+            threading.Thread(target=poll, args=(ep, path),
+                             name=f"drill-poll{ep.replace('/', '-')}")
+            for ep, path in (("/tenants", "/tenants"),
+                             ("/metrics", "/metrics"),
+                             ("/picks", "/picks/a?cursor=0"))
+        ]
+        for t in pollers:
+            t.start()
+        try:
+            results = svc.run(until_idle=True)
+        finally:
+            stop_poll.set()
+            for t in pollers:
+                t.join(5)
+            svc.stop()
+        assert report.inversions() == []
+
+    assert not poll_errors, f"poll failures: {poll_errors[:5]}"
+    assert all(n > 0 for n in polled.values()), polled
+
+    # the serving path never changed one bit of output
+    for name in ("a", "b"):
+        assert results[name].n_failed == 0
+        assert results[name].n_done == totals[name]
+        _assert_bit_identical(results[name].records, batched_refs[name])
+
+    # the lock histograms are SERVED: a /metrics scrape during the
+    # drill exposes wait + held for the traced service locks
+    locky = [b for b in metrics_bodies
+             if "das_lock_wait_seconds_bucket" in b
+             and "das_lock_held_seconds_bucket" in b]
+    assert locky, "das_lock_* histograms never appeared in /metrics"
+    assert 'name="ring"' in locky[-1]
+
+
+def test_race_guard_service_drill_hot_polling(race_guard, chaos_file_set,
+                                              second_file_set,
+                                              batched_refs, tmp_path):
+    _race_drill(race_guard, 0, chaos_file_set, second_file_set,
+                batched_refs, str(tmp_path / "svc"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_race_guard_service_drill_soak(race_guard, seed, chaos_file_set,
+                                       second_file_set, batched_refs,
+                                       tmp_path):
+    """The interleaving soak: more seeds explore more schedules. Slow
+    lane only — the quick-lane drill above keeps tier-1 in single-digit
+    seconds (the 870 s wall, CHANGES.md PR 10)."""
+    _race_drill(race_guard, seed, chaos_file_set, second_file_set,
+                batched_refs, str(tmp_path / f"svc{seed}"))
+
+
+def test_ndjson_long_poll_under_concurrent_manifest_writer(tmp_path):
+    """The satellite regression: a reader long-polling the NDJSON
+    stream while a writer appends — records arrive exactly once, in
+    order, and a torn (not yet newline-terminated) tail is never
+    surfaced. The writer deliberately splits every line into two
+    writes, so torn tails are the COMMON case the index must exclude."""
+    from das4whales_tpu.service import api as api_mod
+
+    outdir = str(tmp_path)
+    path = os.path.join(outdir, "manifest.jsonl")
+    n = 40
+
+    def writer():
+        with open(path, "ab", buffering=0) as fh:
+            for i in range(n):
+                line = json.dumps({"seq": i, "pad": "x" * 40}).encode()
+                fh.write(line[:11])            # torn tail, visible on disk
+                time.sleep(0.001)
+                fh.write(line[11:] + b"\n")    # completed next write
+                time.sleep(0.001)
+
+    w = threading.Thread(target=writer, name="manifest-writer")
+    w.start()
+    got: list = []
+    cursor = 0
+    deadline = time.monotonic() + 30
+    try:
+        while len(got) < n and time.monotonic() < deadline:
+            recs, cursor = api_mod._manifest_since(outdir, cursor, limit=7,
+                                                   wait_s=0.2)
+            # every returned record parsed — _manifest_since can never
+            # hand back a torn line (the index stops at the last \n)
+            got.extend(recs)
+            assert cursor == len(got)
+    finally:
+        w.join(5)
+    assert [r["seq"] for r in got] == list(range(n)), (
+        "cursor skipped or duplicated a record under the concurrent writer"
+    )
+
+
+def test_manifest_index_lock_is_per_manifest(tmp_path):
+    """R9's first real catch, kept as a regression: the line-offset
+    index lock was one class-level ``_index_lock`` shared by every
+    handler thread — one slow tenant's long-poll serialized ALL
+    tenants' NDJSON reads. Now each manifest owns its lock: holding
+    tenant A's lock must not stall tenant B's read."""
+    from das4whales_tpu.service import api as api_mod
+    from das4whales_tpu.service.api import ServiceAPI
+
+    assert not hasattr(ServiceAPI, "_index_lock"), (
+        "the shared class-level index lock is back — ISSUE 13 regression"
+    )
+
+    for name in ("a", "b"):
+        os.makedirs(str(tmp_path / name))
+        with open(str(tmp_path / name / "manifest.jsonl"), "w") as fh:
+            for i in range(2):
+                fh.write(json.dumps({"tenant": name, "seq": i}) + "\n")
+    pa = str(tmp_path / "a" / "manifest.jsonl")
+    pb = str(tmp_path / "b" / "manifest.jsonl")
+    ia, ib = api_mod._index_for(pa), api_mod._index_for(pb)
+    assert ia is not ib and ia.lock is not ib.lock
+    assert api_mod._index_for(pa) is ia            # created once
+
+    done = threading.Event()
+    picked: list = []
+
+    def read_b():
+        recs, cur = api_mod._manifest_since(str(tmp_path / "b"), 0, 10, 0.0)
+        picked.append((recs, cur))
+        done.set()
+
+    with ia.lock:      # tenant A's reader stalls (slow disk, long poll…)
+        t = threading.Thread(target=read_b, name="tenant-b-reader")
+        t.start()
+        assert done.wait(5.0), (
+            "tenant B's NDJSON read serialized behind tenant A's index lock"
+        )
+    t.join(5.0)
+    recs, cur = picked[0]
+    assert [r["seq"] for r in recs] == [0, 1] and cur == 2
