@@ -123,6 +123,29 @@ def _git_head() -> str | None:
         return None
 
 
+def _banked_provenance(banked_commit, *, banked_at_unix=None, age_h=None,
+                       head=None) -> dict:
+    """ONE definition of the banked-provenance stamp: ``banked`` /
+    ``banked_age_h`` / ``banked_commit`` / ``stale_commit`` (ISSUE 14
+    satellite — ``_load_banked``/``_replay_banked`` used to build these
+    inline, and the replayed ``cost_cards`` block now carries the SAME
+    fields so a replayed payload's cards can never masquerade as a live
+    measurement). ``age_h`` wins over ``banked_at_unix`` when given; an
+    unparseable timestamp reads as age -1 (the loader's reject range)."""
+    if age_h is None:
+        try:
+            age_h = (time.time() - float(banked_at_unix or 0.0)) / 3600.0
+        except (TypeError, ValueError):
+            age_h = -1.0
+    return {
+        "banked": True,
+        "banked_age_h": round(float(age_h), 2),
+        "banked_commit": banked_commit,
+        "stale_commit": bool(head and banked_commit
+                             and head != banked_commit),
+    }
+
+
 def _bank_payload(payload: dict) -> None:
     """Persist an accelerator headline for later replay. Best-effort: the
     bank is a bonus artifact and must never cost the JSON line.
@@ -181,8 +204,11 @@ def _load_banked(max_age_h: float | None = None) -> dict | None:
             payload = json.load(fh)
         if not isinstance(payload, dict):
             return None
-        age_h = (time.time() - float(payload.get("banked_at_unix", 0.0))) / 3600.0
-    except (OSError, json.JSONDecodeError, TypeError, ValueError):
+        age_h = _banked_provenance(
+            payload.get("banked_commit"),
+            banked_at_unix=payload.get("banked_at_unix"),
+        )["banked_age_h"]
+    except (OSError, json.JSONDecodeError):
         return None
     if age_h < 0 or age_h > max_age_h:
         return None
@@ -217,9 +243,16 @@ def _replay_banked(banked: dict, suffix: str, errors=None) -> None:
         banked["cpu_ref_mode"] = f"measured-same-shape({provenance})"
     head = _git_head()
     banked_commit = banked.get("banked_commit")
-    if head and banked_commit and head != banked_commit:
+    prov = _banked_provenance(banked_commit,
+                              age_h=banked.get("banked_age_h"), head=head)
+    if prov["stale_commit"]:
         banked["stale_commit"] = True
         suffix += f"; stale-commit (measured on {banked_commit}, HEAD {head})"
+    if isinstance(banked.get("cost_cards"), dict):
+        # replayed cost cards carry the SAME provenance stamp as the
+        # headline: a card priced on commit X, replayed hours later,
+        # must never read as a live device-truth measurement
+        banked["cost_cards"].update(prov)
     # structured twin of the "accelerator unreachable at report time"
     # device-string suffix: a replayed bank means THIS invocation could
     # not reach the accelerator — downstream parsing reads the flag, not
@@ -423,6 +456,7 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
                  # the single-file segment
                  "n_dispatches": round(seg.get("dispatches", 0) / repeats, 2),
                  "n_syncs": round(seg.get("syncs", 0) / repeats, 2)}
+    cost_info = _cost_card_live_report(det, block, min(times), nx, ns)
     batch_info = _bench_batch(meta, nx, ns, block, wire, peak_block,
                               channel_tile, repeats)
     if os.environ.get("DAS_BENCH_TSWEEP", "") not in ("", "0", "false"):
@@ -440,7 +474,42 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
                   "oom_recoveries": delta["oom_recoveries"],
                   "watchdog_timeouts": delta["watchdog_timeouts"]}
     return (min(times), n_picks, str(jax.devices()[0]), stages, route,
-            det.pick_mode, dict(wire_info, **batch_info, **resilience))
+            det.pick_mode,
+            dict(wire_info, **cost_info, **batch_info, **resilience))
+
+
+def _cost_card_live_report(det, block, wall, nx, ns):
+    """Cost-observatory wiring (ISSUE 14, opt-in via ``DAS_COST_CARDS=1``):
+    AOT-price the measured one-program route (the B=1 batched body — the
+    same program family the campaign preflight prices) into a cost card,
+    and divide its device-truth predicted wall by the MEASURED headline
+    wall into ``roofline_frac_live`` — the live twin of the offline-model
+    ``roofline_frac`` the parent derives from scripts/roofline.py. Opt-in
+    because the capture is one extra AOT compile, paid after the
+    measurement; decorative: a failure must never cost the JSON line."""
+    try:
+        from das4whales_tpu.telemetry import costs as _costs
+
+        if not _costs.enabled():
+            return {}
+        from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+
+        dt = np.asarray(block).dtype
+        bdet = BatchedMatchedFilterDetector(det, donate=False)
+        bucket = _costs.bucket_label((nx, ns, str(dt)))
+        _costs.capture_batched(bdet, 1, dt, bucket=bucket,
+                               program="batched:1")
+        frac = _costs.note_slab_resolved(bucket, "batched:1",
+                                         det.mf_engine, wall)
+        cards = _costs.cards_payload()
+        cards["banked"] = False   # a live measurement; the replay path
+        # overwrites this block with the full provenance stamp
+        out = {"cost_cards": cards}
+        if frac is not None:
+            out["roofline_frac_live"] = round(frac, 5)
+        return out
+    except Exception:  # noqa: BLE001 — decorative metadata only
+        return {}
 
 
 def _bench_batch(meta, nx, ns, block, wire, peak_block, channel_tile,
@@ -1490,6 +1559,13 @@ def main():
         "n_syncs": result.get("n_syncs"),
         "roofline_pred_ms": roofline_pred,
         "roofline_frac": roofline_frac,
+        # the device-truth twins (ISSUE 14, DAS_COST_CARDS=1): live
+        # fraction from the cost observatory's XLA-counted card over
+        # the MEASURED wall, and the cards themselves — null when the
+        # observatory is off; a replayed bank re-stamps cost_cards with
+        # the full banked/stale provenance (_replay_banked)
+        "roofline_frac_live": result.get("roofline_frac_live"),
+        "cost_cards": result.get("cost_cards"),
         # every successful rung's wall, so the in-path A/Bs (exact vs
         # pow2-pad channel FFT; tiled backup) stay reconstructable from
         # the artifact even though only the fastest rung is the headline

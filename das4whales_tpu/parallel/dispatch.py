@@ -47,7 +47,7 @@ import jax
 
 from .. import faults
 from ..config import dispatch_depth_default
-from ..telemetry import metrics, probes, trace
+from ..telemetry import costs, metrics, probes, trace
 
 # ISSUE 11 flight-recorder surfaces: per-rung/family resolve tallies, the
 # pipeline's queue depth + in-flight residency, and the watchdog's
@@ -99,7 +99,11 @@ def resolve_watchdogged(fn, paths, rung, deadline_s, fault_plan=None,
                     n_files=len(paths),
                     file=os.path.basename(paths[0]) if paths else ""):
         # the deadline-bounded call below ends at fn's own packed fetch,
-        # so the margin wall is an honest (synced) number
+        # so the margin wall is an honest (synced) number. The HBM
+        # occupancy samples BRACKET the resolve (ISSUE 14): one no-op
+        # check when the cost observatory is off or the backend has no
+        # memory_stats
+        costs.sample_hbm()
         t0 = time.perf_counter()
         try:
             out = faults.call_with_deadline(
@@ -110,6 +114,7 @@ def resolve_watchdogged(fn, paths, rung, deadline_s, fault_plan=None,
                 _watchdog_margin.observe(
                     max(0.0, deadline_s - (time.perf_counter() - t0))
                 )
+            costs.sample_hbm()
             return out
         except faults.DispatchDeadlineExceeded as exc:
             outcome = "timeout"

@@ -8,6 +8,12 @@ just text). Endpoints (docs/SERVICE.md):
     ``telemetry.probes`` verdicts as 200/503 + JSON detail — the exact
     truth table PR 10 pinned (healthy / watchdog-tripped /
     quarantine-breached), now actually answerable by a load balancer.
+    ``/readyz`` additionally carries ``slo_burning`` (tenants burning
+    their error budget) as detail — informational, never a 503.
+``GET /slo``
+    Per-tenant serving-SLO verdicts (``telemetry.slo``): freshness
+    target, multi-window burn rates, ``ok``/``warn``/``burning`` state,
+    and the service-level burning list (docs/SERVICE.md).
 ``GET /metrics``
     The whole labeled registry as Prometheus text exposition 0.0.4
     (``telemetry.metrics.prometheus_text``).
@@ -268,8 +274,24 @@ class ServiceAPI:
             h._send_json(200 if res else 503, _probe_payload(res))
         elif url.path == "/readyz":
             res = probes.readiness()
-            h._send_json(200 if res else 503, _probe_payload(res))
+            payload = _probe_payload(res)
+            # SLO burn detail rides the readiness answer (ISSUE 14): a
+            # tenant burning its error budget never flips readiness —
+            # the process is healthy, its latency objective is not —
+            # but the operator polling /readyz sees WHO is burning
+            # without a second request (docs/SERVICE.md)
+            burning = self.service.slo_burning()
+            if burning:
+                payload["slo_burning"] = burning
+            h._send_json(200 if res else 503, payload)
+        elif url.path == "/slo":
+            h._send_json(200, self.service.slo_report())
         elif url.path == "/metrics":
+            # burn gauges refresh at evaluation time, not per pick: a
+            # scrape must see the CURRENT window (breaches aging out
+            # decay the gauge even with no new picks), so evaluate
+            # every tenant's SLO before rendering the exposition
+            self.service.slo_report()
             h._send(200, metrics.prometheus_text().encode(),
                     ctype="text/plain; version=0.0.4")
         elif url.path == "/tenants":

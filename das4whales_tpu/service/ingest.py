@@ -77,11 +77,17 @@ class IngestItem:
     stream's ``StrainBlock`` for replay, a live push's assembled block
     for the HTTP feed. ``error`` carries a source-side failure for the
     scheduler to disposition at this item's position (the campaign's
-    per-file attribution contract, kept at ring granularity)."""
+    per-file attribution contract, kept at ring granularity).
+    ``t_ingest`` is the ``time.monotonic()`` CAPTURE STAMP the ring
+    writes at admission (``RingBuffer.push``/``push_wait``) — the zero
+    point of the ingest→pick-settled freshness SLO
+    (``telemetry.slo``, docs/SERVICE.md); a caller-provided stamp is
+    kept (a source that knows the true capture time may pre-stamp)."""
 
     path: str
     block: object | None = None
     error: Exception | None = None
+    t_ingest: float | None = None
 
 
 class RingBuffer:
@@ -155,6 +161,8 @@ class RingBuffer:
                     return False
                 self._q.popleft()   # drop-oldest: newest data wins
                 _c_dropped.inc(tenant=self.tenant)
+            if item.t_ingest is None:
+                item.t_ingest = time.monotonic()   # the SLO's zero point
             self._q.append(item)
             _c_accepted.inc(tenant=self.tenant)
             _g_depth.set(len(self._q), tenant=self.tenant)
@@ -175,6 +183,8 @@ class RingBuffer:
                 if self._closed:
                     return False
                 if len(self._q) < self.capacity:
+                    if item.t_ingest is None:
+                        item.t_ingest = time.monotonic()
                     self._q.append(item)
                     _c_accepted.inc(tenant=self.tenant)
                     _g_depth.set(len(self._q), tenant=self.tenant)

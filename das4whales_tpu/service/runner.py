@@ -80,6 +80,14 @@ class TenantSpec:
     ring_capacity: int = 8
     #: "reject" (full ring -> 429) or "drop_oldest" (evict + count)
     overflow: str = "reject"
+    #: freshness SLO target: ``slo_objective`` of this tenant's picks
+    #: must settle within ``slo_p95_s`` seconds of ring admission
+    #: (None: no SLO evaluated — the latency histogram still records).
+    #: Burn rates are evaluated over ``slo_windows`` seconds
+    #: (``telemetry.slo``, docs/SERVICE.md "Serving SLOs").
+    slo_p95_s: float | None = None
+    slo_objective: float = 0.95
+    slo_windows: List[float] | None = None
     #: replay pacing: 1.0 = real time, 0/None = as fast as the reader
     realtime_factor: float | None = None
     linger_s: float = 0.25
@@ -99,6 +107,20 @@ class TenantSpec:
             from ..config import dispatch_deadline_default
 
             self.dispatch_deadline_s = dispatch_deadline_default()
+
+    def slo_policy(self):
+        """The tenant's :class:`telemetry.slo.SLOPolicy`, or None when
+        no ``slo_p95_s`` target is configured."""
+        if self.slo_p95_s is None:
+            return None
+        from ..telemetry import slo as slo_mod
+
+        windows = (tuple(float(w) for w in self.slo_windows)
+                   if self.slo_windows else slo_mod.DEFAULT_WINDOWS)
+        return slo_mod.SLOPolicy(
+            target_s=float(self.slo_p95_s),
+            objective=float(self.slo_objective), windows=windows,
+        )
 
     def live_metadata(self):
         """Metadata for live-ingested blocks (the HTTP feed carries
@@ -120,6 +142,10 @@ class ServiceConfig:
     port: int = 0
     dispatch_depth: int | None = None
     trace: bool | None = None
+    #: arm the cost observatory (``telemetry.costs``) for this service
+    #: process: None defers to ``DAS_COST_CARDS``; True enables — cost
+    #: cards, live roofline fractions and ``cost_cards.json`` at drain
+    cost_cards: bool | None = None
     resume: bool = True
     persistent_cache: bool | str = True
 
@@ -145,7 +171,7 @@ def load_service_config(path: str) -> ServiceConfig:
     if not tenants:
         raise ValueError(f"{path}: no tenants configured")
     known = {"tenants", "outdir", "host", "port", "dispatch_depth", "trace",
-             "resume", "persistent_cache"}
+             "cost_cards", "resume", "persistent_cache"}
     unknown = set(raw) - known
     if unknown:
         raise ValueError(f"unknown service keys {sorted(unknown)}; "
@@ -154,7 +180,8 @@ def load_service_config(path: str) -> ServiceConfig:
         tenants=tenants, outdir=raw.get("outdir", "out_service"),
         host=raw.get("host", "127.0.0.1"), port=int(raw.get("port", 0)),
         dispatch_depth=raw.get("dispatch_depth"),
-        trace=raw.get("trace"), resume=bool(raw.get("resume", True)),
+        trace=raw.get("trace"), cost_cards=raw.get("cost_cards"),
+        resume=bool(raw.get("resume", True)),
         persistent_cache=raw.get("persistent_cache", True),
     )
 
@@ -171,6 +198,14 @@ class DetectionService:
     def __init__(self, config: ServiceConfig, fault_plans=None):
         self.config = config
         os.makedirs(config.outdir, exist_ok=True)
+        if config.cost_cards:
+            # the cost observatory is a process switch (its consumers —
+            # dispatch brackets, scheduler resolves — read the module
+            # flag): a service that asks for cards turns it on for its
+            # whole serving lifetime
+            from ..telemetry import costs as tcosts
+
+            tcosts.enable()
         if config.persistent_cache:
             from ..config import enable_persistent_compilation_cache
 
@@ -226,6 +261,20 @@ class DetectionService:
             "in_flight_slabs": self.scheduler.pipe.in_flight(),
             "tenants": [t.snapshot() for t in self.tenants.values()],
         }
+
+    def slo_report(self) -> Dict:
+        """The ``/slo`` surface: every tenant's SLO verdict (targets,
+        multi-window burn rates, state) plus the burning list the
+        ``/readyz`` detail embeds (docs/SERVICE.md)."""
+        tenants = [t.slo_snapshot() for t in self.tenants.values()]
+        return {
+            "tenants": tenants,
+            "burning": [s["tenant"] for s in tenants
+                        if s.get("state") == "burning"],
+        }
+
+    def slo_burning(self) -> List[str]:
+        return self.slo_report()["burning"]
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -287,6 +336,14 @@ class DetectionService:
                 self.scheduler.drain()
                 for t in self.tenants.values():
                     t.finish()
+                from ..telemetry import costs as tcosts
+
+                if tcosts.enabled() and tcosts.REGISTRY.cards():
+                    try:
+                        tcosts.export_json(os.path.join(
+                            self.config.outdir, "cost_cards.json"))
+                    except OSError:
+                        pass   # the drain outcome wins
                 self._drained.set()
         return {name: t.result() for name, t in self.tenants.items()}
 

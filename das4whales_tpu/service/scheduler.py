@@ -45,7 +45,9 @@ import numpy as np
 
 from .. import faults
 from ..parallel.dispatch import PipelinedDispatch, resolve_watchdogged
+from ..telemetry import costs as tcosts
 from ..telemetry import metrics, trace as telemetry
+from ..telemetry import slo as tslo
 from ..utils import locks
 from ..utils.log import get_logger
 from ..workflows import campaign as camp
@@ -121,6 +123,15 @@ class TenantRuntime:
         self._progs: Dict[tuple, MatchedFilterProgram] = {}
         self._skip_buckets: Dict[tuple, str] = {}
         self._finished = False
+        # freshness SLO (ISSUE 14, telemetry.slo): ring-admission stamps
+        # per path (scheduler-thread-confined — pump() writes, the
+        # settled hook pops) and the rolling burn-rate evaluator when
+        # the tenant configured a target (TenantSLO locks internally
+        # for the /slo + /readyz HTTP readers)
+        self._ingest_t: Dict[str, float] = {}
+        policy = spec.slo_policy() if hasattr(spec, "slo_policy") else None
+        self.slo = (tslo.TenantSLO(spec.name, policy)
+                    if policy is not None else None)
         # un-named live pushes get a per-tenant monotonic sequence: the
         # name IS the manifest/retry/artifact identity key, so two
         # pushes must never collide (a timestamp can, within one ms)
@@ -173,6 +184,10 @@ class TenantRuntime:
             item = self.ring.pop()
             if item is None:
                 break
+            if item.t_ingest is not None:
+                # the ring's admission stamp survives slicing: settled
+                # picks look their path up here for the freshness SLO
+                self._ingest_t[item.path] = item.t_ingest
             self.ready.extend(self.slicer.offer(item))
         if self.slicer.pending() and (
                 self.ring.exhausted() or self.slicer.linger_expired()):
@@ -184,6 +199,36 @@ class TenantRuntime:
         """Nothing buffered, nothing sliceable, source finished."""
         return (not self.ready and self.slicer.pending() == 0
                 and self.ring.exhausted())
+
+    def _drop_ingest_stamp(self, path: str) -> None:
+        """Release a file's admission stamp on a TERMINAL non-done
+        disposition (failed/quarantined/timeout/admission-skip): those
+        are not freshness samples — their own counters track them — but
+        the stamp must not outlive the file, or a chronically failing
+        source grows ``_ingest_t`` for the process lifetime."""
+        self._ingest_t.pop(path, None)
+
+    def _note_pick_settled(self, path: str) -> None:
+        """Ingest→pick-settled freshness for one done file: the ring's
+        admission stamp to now, into ``das_pick_latency_seconds`` and
+        the tenant's burn-rate evaluator (``telemetry.slo``). No stamp
+        (live push predating the stamp, resumed file) — no sample."""
+        t0 = self._ingest_t.pop(path, None)
+        if t0 is None:
+            return
+        latency = time.monotonic() - t0
+        tslo.observe_pick_latency(self.name, latency)
+        if self.slo is not None:
+            self.slo.observe(latency)
+
+    def slo_snapshot(self) -> Dict:
+        """This tenant's ``/slo`` row (a no-target tenant reports
+        ``state="ok"`` with no burn windows — the histogram still
+        records its latencies)."""
+        if self.slo is None:
+            return {"tenant": self.name, "target_s": None,
+                    "state": "ok", "burn_rates": {}}
+        return self.slo.snapshot()
 
     # -- detection side (the batch campaign's per-slab contract) -----------
 
@@ -225,11 +270,21 @@ class TenantRuntime:
         def price_rung(rung_):
             stage_, b_ = rung_
             bd = bdet.split_views()[0] if stage_ == "bank" else bdet
-            st = memutils.batched_program_memory(
-                bd, b_, dt, with_health=self.rz.health_cfg is not None,
-                health_clip=(self.rz.health_cfg.clip_abs
-                             if self.rz.health_cfg is not None else None),
-            )
+            with_health = self.rz.health_cfg is not None
+            clip = (self.rz.health_cfg.clip_abs
+                    if self.rz.health_cfg is not None else None)
+            if tcosts.enabled():
+                # admission pricing doubles as cost-card capture: one
+                # lower().compile() per candidate serves both (ISSUE 14)
+                st = tcosts.capture_batched(
+                    bd, b_, dt, bucket=tcosts.bucket_label(key),
+                    program=faults.rung_label(rung_),
+                    with_health=with_health, health_clip=clip,
+                )
+            else:
+                st = memutils.batched_program_memory(
+                    bd, b_, dt, with_health=with_health, health_clip=clip,
+                )
             if st is not None:
                 # the same HBM high-water the batch campaign's preflight
                 # feeds: a service-only process must still move the
@@ -257,11 +312,21 @@ class TenantRuntime:
         tiled = BatchedMatchedFilterDetector(
             bdet.det.tiled_view(), donate=False, serial=bdet.serial
         )
-        tstats = memutils.batched_program_memory(
-            tiled, 1, dt, with_health=self.rz.health_cfg is not None,
-            health_clip=(self.rz.health_cfg.clip_abs
-                         if self.rz.health_cfg is not None else None),
-        )
+        with_health = self.rz.health_cfg is not None
+        clip = (self.rz.health_cfg.clip_abs
+                if self.rz.health_cfg is not None else None)
+        if tcosts.enabled():
+            # the campaign's exact mirror (workflows/campaign.py): a
+            # tiled-pinned tenant is the memory-constrained case the
+            # observatory targets — it must get a card too
+            tstats = tcosts.capture_batched(
+                tiled, 1, dt, bucket=tcosts.bucket_label(key),
+                program="tiled", with_health=with_health, health_clip=clip,
+            )
+        else:
+            tstats = memutils.batched_program_memory(
+                tiled, 1, dt, with_health=with_health, health_clip=clip,
+            )
         if tstats is None or tstats.fits(budget):
             self.ladder.pin(key, ("tiled", 1), (
                 f"admission: tenant {self.name} only the tiled per-file "
@@ -309,6 +374,25 @@ class TenantRuntime:
                 with telemetry.span("preflight", bucket=str(key),
                                     tenant=self.name):
                     self._admit_bucket(key, bdet, slab)
+            if tcosts.enabled() and key not in self._skip_buckets:
+                # the starting rung always has a card, admission or not
+                # (the batch campaign's detector_for plays the same
+                # ensure — no-op when the admission walk captured it)
+                rung0 = self.ladder.current(key)
+                stage0, b0 = rung0
+                if stage0 in ("batched", "bank", "file"):
+                    bd0 = (bdet.split_views()[0] if stage0 == "bank"
+                           else bdet)
+                    tcosts.ensure_batched_card(
+                        bd0, max(1, int(b0)),
+                        np.asarray(slab.blocks[0].trace).dtype,
+                        bucket=tcosts.bucket_label(key),
+                        program=faults.rung_label(rung0),
+                        with_health=self.rz.health_cfg is not None,
+                        health_clip=(self.rz.health_cfg.clip_abs
+                                     if self.rz.health_cfg is not None
+                                     else None),
+                    )
         return bdet
 
     def try_dispatch(self, slab):
@@ -436,6 +520,7 @@ class TenantRuntime:
         statuses, so a service restart re-serves the file: the durable
         analog of the campaign's in-run retry (docs/SERVICE.md)."""
         exc = item.error
+        self._drop_ingest_stamp(item.path)   # never settles done
         self.rz.attempt(item.path)
         try:
             fclass = faults.classify_failure(exc)
@@ -470,6 +555,7 @@ class TenantRuntime:
             for path in slab.paths:
                 fail(path, exc)
                 _c_files.inc(tenant=self.name, status="failed")
+                self._drop_ingest_stamp(path)
             return
         det = bdet.det
         key = self._bucket_key(slab)
@@ -477,6 +563,7 @@ class TenantRuntime:
             for k in range(slab.n_valid):
                 fail(slab.paths[k], RuntimeError(self._skip_buckets[key]))
                 _c_files.inc(tenant=self.name, status="failed")
+                self._drop_ingest_stamp(slab.paths[k])
             return
         ok = []
         for k in range(slab.n_valid):
@@ -489,6 +576,7 @@ class TenantRuntime:
                     "conditions with one scale"
                 ))
                 _c_files.inc(tenant=self.name, status="failed")
+                self._drop_ingest_stamp(slab.paths[k])
                 ok.append(False)
             else:
                 ok.append(True)
@@ -538,6 +626,13 @@ class TenantRuntime:
             degraded = True
         wall = time.perf_counter() - t0
         camp._h_slab_wall.observe(wall)
+        if tcosts.enabled() and not degraded and results is not None:
+            # live utilization per tenant slab (the batch campaign's
+            # exact hook): predicted-at-peaks over measured
+            tcosts.note_slab_resolved(
+                tcosts.bucket_label(key), faults.rung_label(rung),
+                getattr(bdet.det, "mf_engine", "fft"), wall,
+            )
         shape = (int(slab.stack.shape[1]), slab.bucket_ns)
         from ..parallel.batch import trim_picks
 
@@ -579,6 +674,7 @@ class TenantRuntime:
                         rung=faults.rung_label(exec_rung),
                     )
                     _c_files.inc(tenant=self.name, status="done")
+                    self._note_pick_settled(path)
                     if file_recovered:
                         self.rz.tally("oom_recoveries")
                 except camp.CampaignAborted:
@@ -597,6 +693,7 @@ class TenantRuntime:
                         continue
                     _c_files.inc(tenant=self.name,
                                  status=self.records[-1].status)
+                    self._drop_ingest_stamp(path)   # terminal, not done
                 break
 
     def finish(self) -> None:
@@ -636,6 +733,7 @@ class TenantRuntime:
             "rungs": {str(k): faults.rung_label(r)
                       for k, r in rungs.items()},
             "deficit_msamples": round(deficit, 3),
+            "slo": self.slo_snapshot(),
         }
 
 

@@ -22,12 +22,23 @@ campaign's start and its manifest. Three surfaces fix that:
   dispatch-progress signals: the service substrate the streaming
   multi-tenant item needs (ROADMAP item 1).
 
+Two device-truth surfaces ride on top (ISSUE 14):
+
+* :mod:`~das4whales_tpu.telemetry.costs` — per-program COST CARDS
+  captured at the preflight's ``lower().compile()`` boundary (XLA
+  ``cost_analysis`` FLOPs/bytes, memory peaks, compile walls) and the
+  live ``das_roofline_frac`` / HBM-occupancy / pricing-honesty gauges
+  every resolved slab feeds.
+* :mod:`~das4whales_tpu.telemetry.slo` — per-tenant serving SLOs:
+  ingest→pick-settled freshness, error budgets, multi-window burn
+  rates (the service's ``/slo`` surface).
+
 Import discipline: this package (and everything it imports at module
 level) is pure stdlib — ``faults`` imports it at package init, and the
 disabled-mode fast path must never pay a jax import.
 """
 
-from . import metrics, probes, progress, trace  # noqa: F401
+from . import costs, metrics, probes, progress, slo, trace  # noqa: F401
 from .metrics import (  # noqa: F401
     REGISTRY,
     counter,

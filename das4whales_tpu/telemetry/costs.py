@@ -1,0 +1,458 @@
+"""Per-program cost cards: the device-truth cost observatory (ISSUE 14).
+
+Every perf number after BENCH_r03 is banked and every roofline fraction
+came from an OFFLINE model (``scripts/roofline.py``) compared against
+hand-run bench stages — the running system could not see its own cost.
+This module closes that loop at the one boundary where the truth is
+free: the ``lower().compile()`` crossing the AOT memory preflight
+(``utils.memory``) already pays. At compile time each priced program
+yields a :class:`CostCard` — XLA's own ``cost_analysis()`` FLOPs and
+bytes-accessed, ``memory_analysis()`` peaks, and the measured compile
+wall (``das_compile_seconds{program}`` / ``das_compiles_total``). At
+run time every resolved slab divides the card's roofline-predicted wall
+at the RESOLVED device's peaks by the measured wall into
+``das_roofline_frac{stage,engine}`` — live utilization, per rung, read
+off ``/metrics`` instead of re-derived by hand (the TINA/DFT-on-TPU
+accounting, arXiv:2408.16551 / 2002.03260). A best-effort
+``device.memory_stats()`` sampler brackets slab resolves
+(``das_hbm_bytes_in_use`` / ``das_hbm_bytes_limit``), and
+``das_preflight_pricing_error_ratio`` compares observed occupancy
+against the AOT-priced footprint — whether the preflight's admission
+math is honest, as a number.
+
+Disabled (the default — ``DAS_COST_CARDS`` / :func:`enable` /
+``run_campaign_batched(cost_cards=True)``), every hook is one module
+attribute check: no jax import, no compile, no dispatch (the PR 10
+<1% overhead budget; compile_guard-pinned). Pure stdlib at import,
+like the rest of ``telemetry``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from . import metrics
+
+__all__ = [
+    "CPU_FLOPS_DEFAULT", "CPU_HBM_GBS_DEFAULT", "CostCard", "DevicePeaks",
+    "F32_FLOPS", "HBM_GBS", "MXU_BF16_FLOPS", "REGISTRY", "bucket_label",
+    "capture_batched", "device_peaks", "enable", "enabled",
+    "ensure_batched_card", "export_json", "note_slab_resolved",
+    "resolve_enabled", "sample_hbm",
+]
+
+# ---------------------------------------------------------------------------
+# Device peaks (the scripts/roofline.py constants, importable in-package)
+# ---------------------------------------------------------------------------
+
+#: TPU v5e peaks. scripts/roofline.py carries the SAME three values (it
+#: must stay importable without the package — the bench parent process
+#: never imports jax); tests/test_costs.py pins the two copies equal.
+HBM_GBS = 819e9          # v5e HBM bandwidth, bytes/s
+F32_FLOPS = 98e12        # v5e f32 peak (MXU f32 matmul rate)
+MXU_BF16_FLOPS = 197e12  # v5e MXU bf16-input peak (f32 accumulation)
+
+#: CPU-backend peaks are order-of-magnitude defaults, overridable via
+#: ``DAS_CPU_PEAK_FLOPS`` (FLOP/s) / ``DAS_CPU_PEAK_GBS`` (GB/s): the
+#: CPU ``das_roofline_frac`` is a consistency/smoke signal for the
+#: wiring, never a perf claim (docs/OBSERVABILITY.md).
+CPU_FLOPS_DEFAULT = 1e11
+CPU_HBM_GBS_DEFAULT = 20.0   # GB/s
+
+_h_compile = metrics.histogram(
+    "das_compile_seconds",
+    "wall seconds of each AOT program compile the cost observatory "
+    "crossed (lower().compile()), by program (rung label)",
+    ("program",),
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 300.0),
+)
+_c_compiles = metrics.counter(
+    "das_compiles_total",
+    "AOT program compiles captured by the cost observatory, by program",
+    ("program",),
+)
+_g_roofline = metrics.gauge(
+    "das_roofline_frac",
+    "live fraction of roofline per resolved slab: cost-card predicted "
+    "wall at the resolved device's peaks / measured wall (1.0 = at the "
+    "HBM/FLOP bound), by rung stage and correlate engine",
+    ("stage", "engine"),
+)
+_g_hbm_used = metrics.gauge(
+    "das_hbm_bytes_in_use",
+    "device bytes in use (best-effort device.memory_stats() sample "
+    "bracketing slab resolves; absent on backends without memory_stats)",
+)
+_g_hbm_limit = metrics.gauge(
+    "das_hbm_bytes_limit",
+    "device memory limit from device.memory_stats() (the denominator "
+    "of live HBM occupancy)",
+)
+_g_pricing = metrics.gauge(
+    "das_preflight_pricing_error_ratio",
+    "observed device bytes-in-use after a resolve / the resolved "
+    "program's AOT-priced footprint (peak+args): >1 means the "
+    "preflight's admission math underpriced the program",
+)
+
+
+def _env_truthy(name: str) -> bool:
+    return os.environ.get(name, "") not in ("", "0", "false")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+_enabled = _env_truthy("DAS_COST_CARDS")
+
+
+def enabled() -> bool:
+    """Is cost-card capture on (``DAS_COST_CARDS`` / :func:`enable`)?"""
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def resolve_enabled(flag: bool | None) -> bool:
+    """Per-campaign resolution: None defers to the process switch."""
+    return _enabled if flag is None else bool(flag)
+
+
+# ---------------------------------------------------------------------------
+# Device peaks
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DevicePeaks:
+    """The resolved device's roofline denominators."""
+
+    platform: str
+    flops: float        # f32 FLOP/s peak
+    bf16_flops: float   # bf16-input FLOP/s peak (== flops off-TPU)
+    hbm_bps: float      # memory bandwidth, bytes/s
+
+    def as_dict(self) -> Dict:
+        return {"platform": self.platform, "flops": self.flops,
+                "bf16_flops": self.bf16_flops, "hbm_bps": self.hbm_bps}
+
+
+_peaks_lock = threading.Lock()
+_peaks: Optional[DevicePeaks] = None
+
+
+def device_peaks(refresh: bool = False) -> DevicePeaks:
+    """The current backend's peaks, resolved once per process: TPU uses
+    the v5e constants above; anything else the CPU env-overridable
+    defaults. The jax import (and backend touch) happens only here —
+    the first *enabled* capture/resolve pays it, never the disabled
+    fast path."""
+    global _peaks
+    with _peaks_lock:
+        if _peaks is not None and not refresh:
+            return _peaks
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — peaks must never break a resolve
+        platform = "cpu"
+    if platform == "tpu":
+        pk = DevicePeaks("tpu", F32_FLOPS, MXU_BF16_FLOPS, HBM_GBS)
+    else:
+        fl = _env_float("DAS_CPU_PEAK_FLOPS", CPU_FLOPS_DEFAULT)
+        bw = _env_float("DAS_CPU_PEAK_GBS", CPU_HBM_GBS_DEFAULT) * 1e9
+        pk = DevicePeaks(platform, fl, fl, bw)
+    with _peaks_lock:
+        _peaks = pk
+    return pk
+
+
+# ---------------------------------------------------------------------------
+# Cost cards
+# ---------------------------------------------------------------------------
+
+
+def bucket_label(key) -> str:
+    """ONE spelling of a campaign bucket key for card lookup: the
+    ``(channels, bucket_ns, dtype)`` tuple as ``"CxN/dtype"`` (a
+    non-tuple key falls back to ``str``)."""
+    try:
+        c, n, dt = key
+        return f"{c}x{n}/{dt}"
+    except (TypeError, ValueError):
+        return str(key)
+
+
+@dataclass(frozen=True)
+class CostCard:
+    """One compiled program's device-truth cost: XLA-counted FLOPs and
+    HBM traffic, AOT-priced memory peaks, and the measured compile
+    wall — keyed ``(bucket, program, engine)`` where ``program`` is the
+    ladder's rung label (``"batched:4"``, ``"bank:2"``, ``"tiled"``)."""
+
+    program: str
+    bucket: str
+    engine: str
+    batch: int
+    templates: int
+    flops: float
+    bytes_accessed: float
+    transcendentals: float
+    peak_bytes: int        # temps+outputs: the preflight admission figure
+    argument_bytes: int
+    compile_seconds: float
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.bucket, self.program, self.engine)
+
+    def predicted_wall_s(self, peaks: DevicePeaks | None = None) -> float:
+        """Roofline lower-bound wall at ``peaks``: max of the FLOP and
+        HBM times of the XLA-counted totals (bf16-input engines are
+        judged at the bf16 matmul peak, like scripts/roofline.py)."""
+        peaks = peaks or device_peaks()
+        fpeak = (peaks.bf16_flops if self.engine == "matmul-bf16"
+                 else peaks.flops)
+        t_flops = self.flops / fpeak if fpeak > 0 else 0.0
+        t_hbm = self.bytes_accessed / peaks.hbm_bps if peaks.hbm_bps else 0.0
+        return max(t_flops, t_hbm)
+
+    def as_dict(self, peaks: DevicePeaks | None = None) -> Dict:
+        peaks = peaks or device_peaks()
+        return {
+            "program": self.program, "bucket": self.bucket,
+            "engine": self.engine, "batch": self.batch,
+            "templates": self.templates, "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "transcendentals": self.transcendentals,
+            "peak_bytes": self.peak_bytes,
+            "argument_bytes": self.argument_bytes,
+            "compile_seconds": round(self.compile_seconds, 4),
+            "predicted_wall_s": self.predicted_wall_s(peaks),
+            "intensity_flops_per_byte": (
+                self.flops / self.bytes_accessed
+                if self.bytes_accessed else None
+            ),
+        }
+
+
+class CostCardRegistry:
+    """Process-wide ``(bucket, program, engine) -> CostCard``. Written
+    by the campaign/scheduler thread at capture, read at resolve time
+    and by exports — every access goes through the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cards: Dict[Tuple[str, str, str], CostCard] = {}
+
+    def record(self, card: CostCard) -> None:
+        with self._lock:
+            self._cards[card.key] = card
+
+    def get(self, bucket: str, program: str,
+            engine: str) -> Optional[CostCard]:
+        with self._lock:
+            return self._cards.get((str(bucket), str(program), str(engine)))
+
+    def cards(self) -> List[CostCard]:
+        with self._lock:
+            return list(self._cards.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._cards.clear()
+
+
+#: The process-wide card registry (one observatory per process, like the
+#: metrics registry it feeds).
+REGISTRY = CostCardRegistry()
+
+
+def capture_batched(bdet, batch: int, stack_dtype, *, bucket: str,
+                    program: str, with_health: bool = False,
+                    health_clip=None):
+    """Compile-time capture at the preflight's own boundary: AOT-price
+    the batched program (``utils.memory.batched_program_analysis``) and
+    register its :class:`CostCard` plus the compile-wall metrics.
+    Returns the program's ``MemoryStats`` (or None where the backend
+    does not support the analyses) so the memory preflight can consume
+    this as a drop-in for ``batched_program_memory`` — one compile
+    serves both the admission decision and the cost card."""
+    from ..utils import memory as memutils
+
+    an = memutils.batched_program_analysis(
+        bdet, batch, stack_dtype, with_health=with_health,
+        health_clip=health_clip,
+    )
+    if an is None:
+        return None
+    _c_compiles.inc(program=program)
+    _h_compile.observe(an.compile_seconds, program=program)
+    det = bdet.det
+    REGISTRY.record(CostCard(
+        program=str(program), bucket=str(bucket),
+        engine=str(getattr(det, "mf_engine", "fft") or "fft"),
+        batch=int(batch),
+        templates=int(det.design.templates.shape[0]),
+        flops=an.flops, bytes_accessed=an.bytes_accessed,
+        transcendentals=an.transcendentals,
+        peak_bytes=int(an.memory.peak if an.memory else 0),
+        argument_bytes=int(an.memory.argument_bytes if an.memory else 0),
+        compile_seconds=an.compile_seconds,
+    ))
+    return an.memory
+
+
+#: rung labels whose program BODY is identical to another rung's (the
+#: "file" rung runs the B=1 batched body — `_batched_program_spec`
+#: prices the same spec either way): re-register the existing card
+#: under the new label instead of paying a duplicate lower().compile()
+_RUNG_ALIASES = {"file": "batched:1"}
+
+
+def ensure_batched_card(bdet, batch: int, stack_dtype, *, bucket: str,
+                        program: str, with_health: bool = False,
+                        health_clip=None) -> None:
+    """Capture a card only when its key is absent — the no-preflight
+    campaign path captures its starting rung exactly once per bucket
+    (the preflight path already captured every rung it priced). A rung
+    whose program is an alias of an already-carded one (a bucket
+    pinned to ``("file", 1)`` after the admission walk priced
+    ``batched:1``) clones that card under its own label — zero extra
+    compiles, and the resolve-time lookup still matches the executing
+    rung's label."""
+    from dataclasses import replace
+
+    engine = str(getattr(bdet.det, "mf_engine", "fft") or "fft")
+    if REGISTRY.get(bucket, program, engine) is not None:
+        return
+    alias = _RUNG_ALIASES.get(str(program))
+    if alias is not None:
+        src = REGISTRY.get(bucket, alias, engine)
+        if src is not None:
+            REGISTRY.record(replace(src, program=str(program)))
+            return
+    capture_batched(bdet, batch, stack_dtype, bucket=bucket,
+                    program=program, with_health=with_health,
+                    health_clip=health_clip)
+
+
+# ---------------------------------------------------------------------------
+# Run-time surfaces: live roofline fraction, HBM occupancy, pricing honesty
+# ---------------------------------------------------------------------------
+
+# None = not yet probed; False = backend has no memory_stats (cache the
+# verdict so the disabled-feature cost is one attribute check per slab)
+_hbm_supported: Optional[bool] = None
+
+
+def sample_hbm(force: bool = False) -> Optional[Dict[str, int]]:
+    """Best-effort ``device.memory_stats()`` sample into the
+    ``das_hbm_bytes_in_use`` / ``das_hbm_bytes_limit`` gauges. Returns
+    the sampled dict, or None when capture is disabled (``force=True``
+    bypasses the process switch — for callers that carry their own
+    per-campaign flag) or the backend (e.g. CPU) exposes no memory
+    stats — the unsupported verdict is cached, so steady-state cost on
+    such a backend is one check."""
+    global _hbm_supported
+    if (not _enabled and not force) or _hbm_supported is False:
+        return None
+    try:
+        import jax
+
+        ms = jax.devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — sampling must never break a resolve
+        ms = None
+    if not ms:
+        _hbm_supported = False
+        return None
+    _hbm_supported = True
+    out: Dict[str, int] = {}
+    in_use = ms.get("bytes_in_use")
+    limit = ms.get("bytes_limit")
+    if in_use is not None:
+        _g_hbm_used.set(int(in_use))
+        out["bytes_in_use"] = int(in_use)
+    if limit is not None:
+        _g_hbm_limit.set(int(limit))
+        out["bytes_limit"] = int(limit)
+    return out or None
+
+
+def note_slab_resolved(bucket: str, rung_label: str, engine: str,
+                       wall_s: float) -> Optional[float]:
+    """One resolved slab's live utilization: the matching cost card's
+    predicted wall over the measured wall, into
+    ``das_roofline_frac{stage=rung, engine}``; the post-resolve HBM
+    sample feeds ``das_preflight_pricing_error_ratio`` against the
+    card's priced footprint. No card (rung never priced): no-op,
+    returns None. The CALLER owns the enabled gate (the campaign's
+    per-run ``cost_cards`` flag or the process switch) — a
+    ``cost_cards=True`` campaign works with the process switch off."""
+    if wall_s <= 0:
+        return None
+    card = REGISTRY.get(bucket, rung_label, str(engine or "fft"))
+    if card is None:
+        return None
+    frac = card.predicted_wall_s(device_peaks()) / wall_s
+    _g_roofline.set(round(frac, 6), stage=rung_label,
+                    engine=card.engine)
+    sample = sample_hbm(force=True)
+    if sample and sample.get("bytes_in_use"):
+        priced = card.peak_bytes + card.argument_bytes
+        if priced > 0:
+            _g_pricing.set(round(sample["bytes_in_use"] / priced, 4))
+    return frac
+
+
+# ---------------------------------------------------------------------------
+# Export (scripts/trace_report.py --costs reads this next to trace.json)
+# ---------------------------------------------------------------------------
+
+
+def cards_payload() -> Dict:
+    """JSON-safe dump of every card at the resolved device's peaks."""
+    peaks = device_peaks()
+    return {
+        "device": peaks.as_dict(),
+        "cards": [c.as_dict(peaks) for c in REGISTRY.cards()],
+    }
+
+
+def export_json(path: str, extra: Dict | None = None) -> str:
+    """Write the card registry (plus ``extra`` fields, e.g. bench
+    provenance) as JSON next to the manifest; returns ``path``."""
+    payload = cards_payload()
+    if extra:
+        payload.update(extra)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def reset() -> None:
+    """Clear cards + cached device verdicts (tests)."""
+    global _hbm_supported, _peaks
+    REGISTRY.reset()
+    _hbm_supported = None
+    with _peaks_lock:
+        _peaks = None

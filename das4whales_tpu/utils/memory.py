@@ -20,6 +20,7 @@ analysis prices the actual TPU executable.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Callable, Sequence
 
@@ -27,7 +28,10 @@ import jax
 
 __all__ = [
     "MemoryStats",
+    "ProgramAnalysis",
     "aot_memory_stats",
+    "aot_program_analysis",
+    "batched_program_analysis",
     "batched_program_memory",
     "max_fitting_batch",
 ]
@@ -67,34 +71,92 @@ def _analysis_int(analysis, name: str) -> int:
         return 0
 
 
+@dataclass(frozen=True)
+class ProgramAnalysis:
+    """One AOT compile's full device-truth record (ISSUE 14): the
+    :class:`MemoryStats` footprint (None where ``memory_analysis()`` is
+    unsupported), XLA's own ``cost_analysis()`` totals, and the
+    measured compile wall — everything ``telemetry.costs`` needs for a
+    cost card, captured at the one ``lower().compile()`` boundary the
+    memory preflight already crosses."""
+
+    memory: MemoryStats | None
+    flops: float
+    bytes_accessed: float
+    transcendentals: float
+    compile_seconds: float
+
+
+def _cost_float(cost, name: str) -> float:
+    """Best-effort ``cost_analysis()`` field: absent keys read 0 (the
+    dict keys vary across jaxlib versions/backends)."""
+    try:
+        return float(cost.get(name, 0.0) or 0.0)
+    except (AttributeError, TypeError, ValueError):
+        return 0.0
+
+
+def aot_program_analysis(fn, *avals,
+                         static_kwargs=None) -> ProgramAnalysis | None:
+    """AOT-compile ``fn`` at ``avals`` and return its full
+    :class:`ProgramAnalysis` — or None where the backend/jaxlib cannot
+    even compile it. ``memory_analysis()``/``cost_analysis()`` fields
+    that this jaxlib does not expose read as None/0 rather than
+    failing: a partial card is still device truth.
+
+    ``fn`` may already be a ``jax.jit`` wrapper (lowered as-is) or a
+    plain callable (jitted here with ``static_kwargs`` as
+    ``static_argnames`` values).
+    """
+    try:
+        # AOT pricing only: lowered+compiled for the analyses, never
+        # dispatched — no hot-path compile cache to miss
+        jitted = fn if hasattr(fn, "lower") else jax.jit(  # daslint: allow[R2]
+            fn, static_argnames=tuple(static_kwargs or ())
+        )
+        t0 = time.perf_counter()
+        lowered = jitted.lower(*avals, **(static_kwargs or {}))
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
+    except Exception:  # noqa: BLE001 — unsupported backend/jaxlib: no gate
+        return None
+    try:
+        analysis = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001
+        analysis = None
+    memory = None
+    if analysis is not None:
+        memory = MemoryStats(
+            temp_bytes=_analysis_int(analysis, "temp_size_in_bytes"),
+            output_bytes=_analysis_int(analysis, "output_size_in_bytes"),
+            argument_bytes=_analysis_int(analysis, "argument_size_in_bytes"),
+            generated_code_bytes=_analysis_int(
+                analysis, "generated_code_size_in_bytes"),
+        )
+    try:
+        cost = compiled.cost_analysis()
+        # older jaxlibs return a one-element list of dicts
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+    except Exception:  # noqa: BLE001
+        cost = {}
+    return ProgramAnalysis(
+        memory=memory,
+        flops=_cost_float(cost, "flops"),
+        bytes_accessed=_cost_float(cost, "bytes accessed"),
+        transcendentals=_cost_float(cost, "transcendentals"),
+        compile_seconds=compile_s,
+    )
+
+
 def aot_memory_stats(fn, *avals, static_kwargs=None) -> MemoryStats | None:
     """AOT-compile ``fn`` at ``avals`` (``jax.ShapeDtypeStruct``\\ s) and
     return its :class:`MemoryStats` — or None where this jaxlib/backend
     does not support ``memory_analysis()`` (callers proceed unpreflighted,
-    trusting the downshift ladder).
-
-    ``fn`` may already be a ``jax.jit`` wrapper (it is lowered as-is) or
-    a plain callable (jitted here with ``static_kwargs`` as
-    ``static_argnames`` values).
-    """
-    try:
-        # AOT pricing only: lowered+compiled for memory_analysis(),
-        # never dispatched — no hot-path compile cache to miss
-        jitted = fn if hasattr(fn, "lower") else jax.jit(  # daslint: allow[R2]
-            fn, static_argnames=tuple(static_kwargs or ())
-        )
-        lowered = jitted.lower(*avals, **(static_kwargs or {}))
-        analysis = lowered.compile().memory_analysis()
-    except Exception:  # noqa: BLE001 — unsupported backend/jaxlib: no gate
-        return None
-    if analysis is None:
-        return None
-    return MemoryStats(
-        temp_bytes=_analysis_int(analysis, "temp_size_in_bytes"),
-        output_bytes=_analysis_int(analysis, "output_size_in_bytes"),
-        argument_bytes=_analysis_int(analysis, "argument_size_in_bytes"),
-        generated_code_bytes=_analysis_int(analysis, "generated_code_size_in_bytes"),
-    )
+    trusting the downshift ladder). The memory half of
+    :func:`aot_program_analysis` (one compile, one definition)."""
+    an = aot_program_analysis(fn, *avals, static_kwargs=static_kwargs)
+    return an.memory if an is not None else None
 
 
 def _aval_of(arr) -> jax.ShapeDtypeStruct:
@@ -104,18 +166,13 @@ def _aval_of(arr) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
 
 
-def batched_program_memory(
-    bdet, batch: int, stack_dtype, *, with_health: bool = False,
-    health_clip: float | None = None,
-) -> MemoryStats | None:
-    """Price the batched detection program (``parallel.batch``) for
-    ``bdet`` (a ``BatchedMatchedFilterDetector``) at batch size
-    ``batch`` and wire dtype ``stack_dtype`` — the preflight unit the
-    batched campaign compares against ``config.hbm_budget_bytes()``.
-
-    Prices the FULL-CAPACITY (escalation) variant: the K0 attempt is
-    strictly smaller, so a fitting full program certifies both.
-    """
+def _batched_program_spec(bdet, batch: int, stack_dtype, *,
+                          with_health: bool = False,
+                          health_clip: float | None = None):
+    """The batched program's AOT pricing spec — ``(jitted, avals,
+    static_kwargs)`` — shared by :func:`batched_program_memory` (the
+    preflight) and :func:`batched_program_analysis` (the cost
+    observatory), so the two can never price different programs."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -167,11 +224,42 @@ def batched_program_memory(
     # batched_detect_picks_program would be equivalent, but keeping the
     # preflight's lowering separate means a preflight failure can never
     # poison the hot path's jit cache
-    return aot_memory_stats(
-        jax.jit(_batched_body, static_argnames=_STATIC),  # daslint: allow[R2] AOT pricing only — see aot_memory_stats
-        *avals,
-        static_kwargs=kwargs,
+    jitted = jax.jit(_batched_body, static_argnames=_STATIC)  # daslint: allow[R2] AOT pricing only — see aot_memory_stats
+    return jitted, avals, kwargs
+
+
+def batched_program_memory(
+    bdet, batch: int, stack_dtype, *, with_health: bool = False,
+    health_clip: float | None = None,
+) -> MemoryStats | None:
+    """Price the batched detection program (``parallel.batch``) for
+    ``bdet`` (a ``BatchedMatchedFilterDetector``) at batch size
+    ``batch`` and wire dtype ``stack_dtype`` — the preflight unit the
+    batched campaign compares against ``config.hbm_budget_bytes()``.
+
+    Prices the FULL-CAPACITY (escalation) variant: the K0 attempt is
+    strictly smaller, so a fitting full program certifies both.
+    """
+    jitted, avals, kwargs = _batched_program_spec(
+        bdet, batch, stack_dtype, with_health=with_health,
+        health_clip=health_clip,
     )
+    return aot_memory_stats(jitted, *avals, static_kwargs=kwargs)
+
+
+def batched_program_analysis(
+    bdet, batch: int, stack_dtype, *, with_health: bool = False,
+    health_clip: float | None = None,
+) -> ProgramAnalysis | None:
+    """:func:`batched_program_memory`'s full-record twin: the SAME
+    priced program's :class:`ProgramAnalysis` (memory + XLA cost
+    totals + compile wall) for the cost observatory
+    (``telemetry.costs.capture_batched``)."""
+    jitted, avals, kwargs = _batched_program_spec(
+        bdet, batch, stack_dtype, with_health=with_health,
+        health_clip=health_clip,
+    )
+    return aot_program_analysis(jitted, *avals, static_kwargs=kwargs)
 
 
 def first_fitting(price, candidates, budget_bytes: int):

@@ -42,6 +42,7 @@ from .. import faults
 from ..config import as_health_config
 from ..io.stream import stream_strain_blocks
 from ..models.matched_filter import MatchedFilterDetector
+from ..telemetry import costs as tcosts
 from ..telemetry import metrics as tmetrics
 from ..telemetry import probes as tprobes
 from ..telemetry import trace as telemetry
@@ -706,6 +707,7 @@ def run_campaign_batched(
     preflight: bool | None = None,
     dispatch_depth: int | None = None,
     trace: bool | None = None,
+    cost_cards: bool | None = None,
     fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
@@ -717,6 +719,18 @@ def run_campaign_batched(
     stamped with span ids, and ``<outdir>/trace.json`` exported next to
     the manifest — picks bit-identical either way
     (docs/OBSERVABILITY.md).
+
+    ``cost_cards`` (None: the ``DAS_COST_CARDS`` env default) arms the
+    COST OBSERVATORY (``telemetry.costs``, ISSUE 14): every priced or
+    starting rung's program yields a per-``(bucket, rung, engine)``
+    cost card at the preflight's own ``lower().compile()`` boundary
+    (XLA FLOPs/bytes, memory peaks, ``das_compile_seconds``), every
+    resolved slab feeds the live ``das_roofline_frac{stage,engine}``
+    gauge, and the registry exports to ``<outdir>/cost_cards.json``
+    next to the manifest (``scripts/trace_report.py --costs`` merges it
+    with the span walls). Picks are bit-identical either way — the
+    cards are AOT-priced, never dispatched; disabled, the hooks cost
+    one attribute check (the PR 10 overhead budget).
 
     The throughput route for the "one file cannot saturate the chip"
     regime (BENCH_r05: every stage at ~1-2% of roofline): the slab
@@ -805,6 +819,7 @@ def run_campaign_batched(
         dispatch_deadline_s = dispatch_deadline_default()
     if preflight is None:
         preflight = memory_preflight_default()
+    use_costs = tcosts.resolve_enabled(cost_cards)
     if persistent_cache:
         enable_persistent_compilation_cache(
             persistent_cache if isinstance(persistent_cache, str) else None
@@ -842,10 +857,20 @@ def run_campaign_batched(
             b //= 2
         dt = np.asarray(slab.blocks[0].trace).dtype
 
-        def price(bd, b_):
-            st = memutils.batched_program_memory(
-                bd, b_, dt, with_health=with_health, health_clip=clip
-            )
+        def price(bd, b_, program):
+            if use_costs:
+                # the cost observatory captures at the SAME compile the
+                # preflight pays: one lower().compile() serves both the
+                # admission decision and the program's cost card
+                st = tcosts.capture_batched(
+                    bd, b_, dt, bucket=tcosts.bucket_label(key),
+                    program=program, with_health=with_health,
+                    health_clip=clip,
+                )
+            else:
+                st = memutils.batched_program_memory(
+                    bd, b_, dt, with_health=with_health, health_clip=clip
+                )
             if st is not None:
                 # preflight high-water: the hungriest program this
                 # campaign ever priced (the Prometheus surface's HBM
@@ -869,7 +894,7 @@ def run_campaign_batched(
             stage_, b_ = rung_
             # the LARGER (ceil) T/2 sub-bank certifies the split pair
             bd = bdet.split_views()[0] if stage_ == "bank" else bdet
-            return price(bd, b_)
+            return price(bd, b_, faults.rung_label(rung_))
 
         best = memutils.first_fitting(price_rung, rung_cands, budget)
         if best is not None:
@@ -891,9 +916,15 @@ def run_campaign_batched(
         tiled = BatchedMatchedFilterDetector(
             bdet.det.tiled_view(), donate=False, serial=bdet.serial
         )
-        tstats = memutils.batched_program_memory(
-            tiled, 1, dt, with_health=with_health, health_clip=clip
-        )
+        if use_costs:
+            tstats = tcosts.capture_batched(
+                tiled, 1, dt, bucket=tcosts.bucket_label(key),
+                program="tiled", with_health=with_health, health_clip=clip,
+            )
+        else:
+            tstats = memutils.batched_program_memory(
+                tiled, 1, dt, with_health=with_health, health_clip=clip
+            )
         if tstats is None or tstats.fits(budget):
             ladder.pin(key, ("tiled", 1),
                        "preflight: only the tiled per-file program fits "
@@ -941,6 +972,24 @@ def run_campaign_batched(
             if preflight:
                 with telemetry.span("preflight", bucket=str(key)):
                     preflight_bucket(key, bdet, slab)
+            if use_costs and key not in skip_buckets:
+                # the bucket's STARTING rung always has a card, preflight
+                # or not (ensure: the preflight walk already captured the
+                # rungs it priced — a pinned ("file", 1) bucket still
+                # gains its own "file"-labeled card here so the resolve-
+                # time lookup matches the executing rung's label)
+                rung0 = ladder.current(key)
+                stage0, b0 = rung0
+                if stage0 in ("batched", "bank", "file"):
+                    bd0 = (bdet.split_views()[0] if stage0 == "bank"
+                           else bdet)
+                    tcosts.ensure_batched_card(
+                        bd0, max(1, int(b0)),
+                        np.asarray(slab.blocks[0].trace).dtype,
+                        bucket=tcosts.bucket_label(key),
+                        program=faults.rung_label(rung0),
+                        with_health=with_health, health_clip=clip,
+                    )
         return bdet
 
     def dispatched(paths, rung, fn):
@@ -1152,6 +1201,14 @@ def run_campaign_batched(
             degraded = True
         wall = time.perf_counter() - t0
         _h_slab_wall.observe(wall)
+        if use_costs and not degraded and results is not None:
+            # live utilization: this slab's measured wall against its
+            # rung's cost-card roofline prediction (no card priced for
+            # the rung -> no-op; never touches picks)
+            tcosts.note_slab_resolved(
+                tcosts.bucket_label(key), faults.rung_label(rung),
+                getattr(det, "mf_engine", "fft"), wall,
+            )
         shape = (int(slab.stack.shape[1]), slab.bucket_ns)
         for k in range(slab.n_valid):
             if not ok[k]:
@@ -1324,6 +1381,14 @@ def run_campaign_batched(
                 continue
             i = len(pending)
         rz.flush_tallies()
+        if use_costs and tcosts.REGISTRY.cards():
+            try:
+                # the observatory's durable artifact, next to the
+                # manifest (scripts/trace_report.py --costs merges it
+                # with the span walls)
+                tcosts.export_json(os.path.join(outdir, "cost_cards.json"))
+            except OSError:
+                pass   # the campaign outcome wins
     return CampaignResult(outdir=outdir, records=records)
 
 

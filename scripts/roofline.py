@@ -28,6 +28,12 @@ from __future__ import annotations
 
 import math
 
+# The three device peaks are ALSO importable from the package
+# (das4whales_tpu/telemetry/costs.py — the cost observatory's live
+# roofline fractions, ISSUE 14). They are mirrored literally here
+# rather than imported because this script is imported by the bench
+# PARENT process, whose contract is to never import jax (importing the
+# package would); tests/test_costs.py pins the two copies equal.
 HBM_GBS = 819e9          # v5e HBM bandwidth
 F32_FLOPS = 98e12        # v5e f32 peak (MXU f32 matmul rate)
 MXU_BF16_FLOPS = 197e12  # v5e MXU bf16-input peak (f32 accumulation)

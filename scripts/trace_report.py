@@ -12,11 +12,18 @@ manifest (``run_campaign*(trace=True)`` / ``DAS_TRACE=1`` →
   manifest records, with the downshift ledger resolved against its
   spans by span id (the one-to-one flight-record contract) and the
   ledger's engine labels;
-* the slowest individual spans (the timeline's outliers).
+* the slowest individual spans (the timeline's outliers);
+* with ``--costs``: the cost-observatory merge (ISSUE 14) — per-rung
+  ``resolve`` span walls against the cost cards' roofline-predicted
+  walls (``<outdir>/cost_cards.json``, written by a
+  ``cost_cards=True`` campaign/service), as a share-of-roofline
+  column sorted furthest-from-peak first, so a trace answers "which
+  stage is furthest from peak" directly.
 
 Usage::
 
     python scripts/trace_report.py OUTDIR            # human tables
+    python scripts/trace_report.py OUTDIR --costs    # + roofline shares
     python scripts/trace_report.py OUTDIR --json     # machine payload
 
 Pure stdlib — no jax import, safe anywhere the artifacts are.
@@ -120,7 +127,61 @@ def resolve_ledger_spans(ledger: List[Dict], events: List[Dict]) -> Dict:
             "unresolved": [u["event"] for u in unresolved]}
 
 
-def build_report(outdir: str, trace_path: str | None = None) -> Dict:
+def load_cost_cards(outdir: str, path: str | None = None) -> Dict | None:
+    """The cost observatory's export (``cost_cards.json``), or None."""
+    path = path or os.path.join(outdir, "cost_cards.json")
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+        return payload if isinstance(payload, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def cost_share_table(events: List[Dict], cost_payload: Dict) -> List[Dict]:
+    """Merge per-rung ``resolve`` span walls with the cost cards'
+    roofline-predicted walls: share_of_roofline = predicted / mean
+    measured wall per rung, sorted FURTHEST from peak first — the
+    "which stage is furthest from peak" answer, straight off the
+    flight record."""
+    cards = cost_payload.get("cards", [])
+    by_rung: Dict[str, List[float]] = {}
+    for e in events:
+        if e.get("name") != "resolve":
+            continue
+        rung = e.get("args", {}).get("rung")
+        if rung:
+            by_rung.setdefault(rung, []).append(e.get("dur", 0.0) / 1e6)
+    rows = []
+    for rung, durs in sorted(by_rung.items()):
+        # resolve spans carry the rung but not the bucket/engine, so a
+        # prediction is only honest when exactly ONE card matches the
+        # rung label: a multi-bucket or multi-engine run pools walls
+        # from programs with different predictions — mark it ambiguous
+        # rather than print a share computed against the wrong card
+        matches = [c for c in cards if c.get("program") == rung]
+        card = matches[0] if len(matches) == 1 else None
+        mean = sum(durs) / len(durs)
+        pred = card.get("predicted_wall_s") if card else None
+        rows.append({
+            "rung": rung, "n_resolves": len(durs),
+            "mean_wall_s": round(mean, 4),
+            "predicted_wall_s": (round(pred, 6)
+                                 if pred is not None else None),
+            "share_of_roofline": (round(pred / mean, 4)
+                                  if pred and mean else None),
+            "engine": (card.get("engine") if card
+                       else f"ambiguous({len(matches)} cards)"
+                       if matches else None),
+        })
+    # furthest from peak first (unmatched rungs sink to the bottom)
+    rows.sort(key=lambda r: (r["share_of_roofline"] is None,
+                             r["share_of_roofline"] or 0.0))
+    return rows
+
+
+def build_report(outdir: str, trace_path: str | None = None,
+                 costs: bool = False) -> Dict:
     trace_path = trace_path or os.path.join(outdir, "trace.json")
     events = load_trace(trace_path) if os.path.exists(trace_path) else []
     manifest = load_manifest(os.path.join(outdir, "manifest.jsonl"))
@@ -129,7 +190,7 @@ def build_report(outdir: str, trace_path: str | None = None) -> Dict:
     rungs = rung_family_table(manifest)
     audit = resolve_ledger_spans(rungs["downshift_ledger"], events)
     slowest = sorted(events, key=lambda e: -e.get("dur", 0.0))[:10]
-    return {
+    report = {
         "outdir": outdir, "trace": trace_path,
         "n_spans": len(events), "spans": agg, "rungs": rungs["rungs"],
         "downshift_ledger": rungs["downshift_ledger"],
@@ -140,6 +201,12 @@ def build_report(outdir: str, trace_path: str | None = None) -> Dict:
             for e in slowest
         ],
     }
+    if costs:
+        payload = load_cost_cards(outdir)
+        report["cost_share"] = (cost_share_table(events, payload)
+                                if payload else None)
+        report["cost_cards"] = payload
+    return report
 
 
 def print_report(rep: Dict) -> None:
@@ -174,6 +241,23 @@ def print_report(rep: Dict) -> None:
         print("\n  slowest spans:")
         for s in rep["slowest_spans"][:5]:
             print(f"    {s['name']:<22s} {s['dur_s']:>8.4f} s  {s['args']}")
+    if rep.get("cost_share"):
+        print("\n  share of roofline per rung (cost cards x resolve "
+              "spans; furthest from peak first):")
+        print(f"    {'rung':<12s} {'engine':<12s} {'n':>4s} "
+              f"{'mean s':>9s} {'pred s':>10s} {'share':>8s}")
+        for row in rep["cost_share"]:
+            share = row["share_of_roofline"]
+            pred = row["predicted_wall_s"]
+            print(f"    {row['rung']:<12s} {str(row['engine']):<12s} "
+                  f"{row['n_resolves']:>4d} {row['mean_wall_s']:>9.4f} "
+                  + (f"{pred:>10.6f} " if pred is not None
+                     else f"{'-':>10s} ")
+                  + (f"{share:>7.2%}" if share is not None
+                     else f"{'-':>8s}"))
+    elif "cost_share" in rep:
+        print("\n  (no cost_cards.json next to the manifest — run the "
+              "campaign/service with cost_cards=True / DAS_COST_CARDS=1)")
 
 
 def main(argv=None) -> int:
@@ -184,8 +268,12 @@ def main(argv=None) -> int:
                     help="trace path (default: <outdir>/trace.json)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON")
+    ap.add_argument("--costs", action="store_true",
+                    help="merge cost-card roofline predictions into a "
+                         "per-rung share-of-roofline table "
+                         "(<outdir>/cost_cards.json)")
     args = ap.parse_args(argv)
-    rep = build_report(args.outdir, args.trace)
+    rep = build_report(args.outdir, args.trace, costs=args.costs)
     if args.json:
         json.dump(rep, sys.stdout, indent=2)
         print()

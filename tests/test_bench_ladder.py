@@ -559,3 +559,51 @@ def test_replay_rederives_vs_baseline_from_measured_wall(monkeypatch, tmp_path):
     assert p["cpu_ref_mode"].startswith("measured-same-shape")
     assert p["vs_baseline_extrapolated"] == 73.32
     assert p["cpu_ref_rate_extrapolated"] == 743169.9
+
+
+def test_banked_provenance_helper_one_definition():
+    """ONE stamping helper (ISSUE 14 satellite): banked/age/commit/
+    stale_commit from either an explicit age or a bank timestamp, with
+    an unparseable timestamp reading as the loader's reject range."""
+    prov = bench._banked_provenance("aaaaaaa", age_h=2.0, head="bbbbbbb")
+    assert prov == {"banked": True, "banked_age_h": 2.0,
+                    "banked_commit": "aaaaaaa", "stale_commit": True}
+    assert bench._banked_provenance("aaaaaaa", age_h=2.0,
+                                    head="aaaaaaa")["stale_commit"] is False
+    # no HEAD (no git): never claims staleness
+    assert bench._banked_provenance("aaaaaaa",
+                                    age_h=2.0)["stale_commit"] is False
+    # timestamp path: age derived from banked_at_unix
+    recent = bench._banked_provenance(
+        "aaaaaaa", banked_at_unix=time.time() - 7200.0)
+    assert 1.9 < recent["banked_age_h"] < 2.1
+    # unparseable timestamp reads as -1 (the _load_banked reject range)
+    assert bench._banked_provenance(
+        "aaaaaaa", banked_at_unix="junk")["banked_age_h"] == -1.0
+
+
+def test_replayed_cost_cards_carry_full_provenance(monkeypatch, tmp_path):
+    """A banked payload carrying a cost_cards block replays with the
+    block re-stamped by the SAME provenance as the headline — a card
+    priced on commit X hours ago can never read as live device truth."""
+    bank = tmp_path / "bank.json"
+    bank.write_text(json.dumps({
+        "metric": "m", "value": 5.4e7, "unit": "u", "vs_baseline": 40.0,
+        "wall_s": 0.2, "shape": [128, 256], "device": "TPU v5 lite0",
+        "banked_at_unix": time.time() - 3600.0, "banked_commit": "aaaaaaa",
+        "cost_cards": {"device": {"platform": "tpu"}, "cards": [],
+                       "banked": False},
+        "roofline_frac_live": 0.42,
+    }))
+
+    def spawn(spec, timeout_s, cpu=False):
+        raise AssertionError("replay must not spawn rungs")
+
+    rc, p = run_scenario(monkeypatch, spawn, probe_ok=False,
+                         bank_path=str(bank))
+    assert rc == 0 and p["banked"] is True
+    cards = p["cost_cards"]
+    assert cards["banked"] is True                      # live flag overwritten
+    assert cards["banked_commit"] == "aaaaaaa"
+    assert cards["banked_age_h"] == p["banked_age_h"]
+    assert cards["stale_commit"] == p["stale_commit"]

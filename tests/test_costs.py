@@ -1,0 +1,457 @@
+"""The device-truth cost observatory (ISSUE 14, ``telemetry/costs.py``).
+
+Contracts pinned here:
+
+* the package's device-peak constants equal ``scripts/roofline.py``'s
+  (the script is mirrored, not imported — the bench parent never
+  imports jax — so the equality must be test-pinned);
+* :class:`CostCard` roofline math: predicted wall is the max of the
+  FLOP and HBM times at the resolved peaks, bf16-input engines judged
+  at the bf16 matmul peak;
+* compile-time capture at the preflight's own ``lower().compile()``
+  boundary registers a card with XLA-counted FLOPs/bytes, AOT-priced
+  memory, and the measured compile wall — and feeds
+  ``das_compile_seconds{program}`` / ``das_compiles_total``;
+* THE acceptance drill: a CPU-run batched campaign with
+  ``cost_cards=True`` populates the card registry, the compile
+  metrics, and the live ``das_roofline_frac`` gauge (CPU peaks), with
+  picks BIT-IDENTICAL to the untelemetered run, and exports
+  ``cost_cards.json`` next to the manifest;
+* the DISABLED path adds zero compiles and zero dispatches
+  (compile_guard-pinned — the PR 10 <1% overhead budget);
+* ``scripts/trace_report.py --costs`` merges the cards with the
+  ``resolve`` span walls into the share-of-roofline table.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from das4whales_tpu.telemetry import costs
+from das4whales_tpu.telemetry import metrics as tmetrics
+from das4whales_tpu.workflows.campaign import load_picks, run_campaign_batched
+
+from tests.conftest import CHAOS_SEL
+
+SEL = CHAOS_SEL
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Constants and pure math
+# ---------------------------------------------------------------------------
+
+
+def test_device_peaks_match_roofline_script():
+    """scripts/roofline.py mirrors the package constants literally (the
+    script must stay importable without jax); the two copies are pinned
+    equal here so they can never drift."""
+    roofline = _load_script("roofline")
+    assert roofline.HBM_GBS == costs.HBM_GBS
+    assert roofline.F32_FLOPS == costs.F32_FLOPS
+    assert roofline.MXU_BF16_FLOPS == costs.MXU_BF16_FLOPS
+
+
+def _card(engine="fft", flops=1e9, bytes_accessed=1e8, **kw):
+    kw.setdefault("program", "batched:2")
+    kw.setdefault("bucket", "24x900/float64")
+    kw.setdefault("batch", 2)
+    kw.setdefault("templates", 1)
+    kw.setdefault("transcendentals", 0.0)
+    kw.setdefault("peak_bytes", 1 << 20)
+    kw.setdefault("argument_bytes", 1 << 18)
+    kw.setdefault("compile_seconds", 0.1)
+    return costs.CostCard(engine=engine, flops=flops,
+                          bytes_accessed=bytes_accessed, **kw)
+
+
+def test_predicted_wall_is_max_of_flop_and_hbm_time():
+    peaks = costs.DevicePeaks("tpu", flops=costs.F32_FLOPS,
+                              bf16_flops=costs.MXU_BF16_FLOPS,
+                              hbm_bps=costs.HBM_GBS)
+    # HBM-bound: bytes/bw dominates flops/peak
+    c = _card(flops=1e9, bytes_accessed=819e9)   # exactly 1 s of HBM
+    assert c.predicted_wall_s(peaks) == pytest.approx(1.0)
+    # FLOP-bound: flops/peak dominates
+    c = _card(flops=98e12, bytes_accessed=1.0)   # exactly 1 s of MXU
+    assert c.predicted_wall_s(peaks) == pytest.approx(1.0)
+
+
+def test_bf16_engine_judged_at_bf16_peak():
+    peaks = costs.DevicePeaks("tpu", flops=100.0, bf16_flops=200.0,
+                              hbm_bps=1e30)
+    f32 = _card(engine="matmul", flops=100.0)
+    bf16 = _card(engine="matmul-bf16", flops=100.0)
+    assert f32.predicted_wall_s(peaks) == pytest.approx(1.0)
+    assert bf16.predicted_wall_s(peaks) == pytest.approx(0.5)
+
+
+def test_card_as_dict_is_json_safe_and_carries_intensity():
+    peaks = costs.DevicePeaks("cpu", 1e11, 1e11, 2e10)
+    d = _card(flops=1e8, bytes_accessed=1e6).as_dict(peaks)
+    json.dumps(d)   # must not raise
+    assert d["intensity_flops_per_byte"] == pytest.approx(100.0)
+    assert d["predicted_wall_s"] == pytest.approx(1e8 / 1e11)
+
+
+def test_bucket_label_spellings():
+    assert costs.bucket_label((24, 900, "float64")) == "24x900/float64"
+    assert costs.bucket_label("already-a-string") == "already-a-string"
+    assert costs.bucket_label(7) == "7"
+
+
+def test_resolve_enabled_defers_to_process_switch():
+    was = costs.enabled()
+    try:
+        costs.disable()
+        assert costs.resolve_enabled(None) is False
+        assert costs.resolve_enabled(True) is True
+        costs.enable()
+        assert costs.resolve_enabled(None) is True
+        assert costs.resolve_enabled(False) is False
+    finally:
+        costs.enable() if was else costs.disable()
+
+
+def test_registry_round_trip_and_reset():
+    reg = costs.CostCardRegistry()
+    c = _card(bucket="unit:reg")
+    reg.record(c)
+    assert reg.get("unit:reg", "batched:2", "fft") is c
+    assert reg.get("unit:reg", "batched:2", "matmul") is None
+    assert c in reg.cards()
+    reg.reset()
+    assert reg.cards() == []
+
+
+# ---------------------------------------------------------------------------
+# Run-time surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_note_slab_resolved_without_card_is_noop():
+    assert costs.note_slab_resolved("no-such-bucket", "batched:2",
+                                    "fft", 0.5) is None
+    assert costs.note_slab_resolved("no-such-bucket", "batched:2",
+                                    "fft", 0.0) is None   # zero wall too
+
+
+def test_note_slab_resolved_sets_live_roofline_gauge(monkeypatch):
+    """predicted/measured lands in das_roofline_frac{stage,engine} at
+    the resolved device's peaks (CPU env-overridable defaults here)."""
+    monkeypatch.setenv("DAS_CPU_PEAK_FLOPS", "1e9")
+    monkeypatch.setenv("DAS_CPU_PEAK_GBS", "1")   # 1e9 B/s
+    costs.reset()   # drop the cached peaks so the env overrides land
+    try:
+        card = _card(bucket="unit:frac", program="batched:2",
+                     flops=1e9, bytes_accessed=1.0)   # predicted = 1 s
+        costs.REGISTRY.record(card)
+        frac = costs.note_slab_resolved("unit:frac", "batched:2",
+                                        "fft", 2.0)
+        assert frac == pytest.approx(0.5)
+        g = tmetrics.REGISTRY.gauge("das_roofline_frac",
+                                    labelnames=("stage", "engine"))
+        assert g.value(stage="batched:2",
+                       engine="fft") == pytest.approx(0.5)
+    finally:
+        costs.reset()   # un-cache the synthetic CPU peaks
+
+
+def test_sample_hbm_disabled_then_unsupported_verdict_cached():
+    was = costs.enabled()
+    costs.reset()
+    try:
+        costs.disable()
+        assert costs.sample_hbm() is None          # disabled: no jax touch
+        # CPU backend exposes no memory_stats: the first forced sample
+        # caches the unsupported verdict, the second is one check
+        assert costs.sample_hbm(force=True) is None
+        assert costs._hbm_supported is False
+        assert costs.sample_hbm(force=True) is None
+    finally:
+        costs.enable() if was else costs.disable()
+        costs.reset()
+
+
+# ---------------------------------------------------------------------------
+# Compile-time capture (the preflight's own boundary)
+# ---------------------------------------------------------------------------
+
+
+def test_capture_batched_registers_card_and_compile_metrics(chaos_detector):
+    from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+
+    bdet = BatchedMatchedFilterDetector(chaos_detector, donate=False)
+    compiles = tmetrics.REGISTRY.counter("das_compiles_total",
+                                         labelnames=("program",))
+    before = compiles.value(program="unit:capture")
+    st = costs.capture_batched(bdet, 1, np.float64,
+                               bucket="unit:cap", program="unit:capture")
+    card = costs.REGISTRY.get("unit:cap", "unit:capture", "fft")
+    assert card is not None
+    assert card.flops > 0 and card.bytes_accessed > 0
+    assert card.compile_seconds > 0
+    assert card.predicted_wall_s() > 0
+    # the return value is the preflight's own MemoryStats (drop-in for
+    # batched_program_memory — one compile serves both consumers)
+    assert st is not None and st.peak > 0
+    assert card.peak_bytes == st.peak
+    assert compiles.value(program="unit:capture") == before + 1
+    h = tmetrics.REGISTRY.histogram("das_compile_seconds",
+                                    labelnames=("program",))
+    assert h.quantile(0.5, program="unit:capture") is not None
+
+
+def test_ensure_batched_card_is_idempotent(chaos_detector):
+    from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+
+    bdet = BatchedMatchedFilterDetector(chaos_detector, donate=False)
+    counter = tmetrics.REGISTRY.counter("das_compiles_total",
+                                        labelnames=("program",))
+    costs.ensure_batched_card(bdet, 1, np.float64,
+                              bucket="unit:ensure", program="unit:ensure")
+    n = counter.value(program="unit:ensure")
+    assert n == 1
+    costs.ensure_batched_card(bdet, 1, np.float64,
+                              bucket="unit:ensure", program="unit:ensure")
+    assert counter.value(program="unit:ensure") == n   # key present: no-op
+
+
+def test_ensure_file_rung_aliases_batched1_card_without_recompile():
+    """A bucket pinned to ("file", 1) after the admission walk priced
+    batched:1 clones the existing card under the "file" label — the
+    two rungs run the SAME B=1 program body, so a second
+    lower().compile() would be pure waste (and double-count
+    das_compiles_total)."""
+    src = _card(bucket="unit:alias", program="batched:1", batch=1,
+                flops=7e7)
+    costs.REGISTRY.record(src)
+    counter = tmetrics.REGISTRY.counter("das_compiles_total",
+                                        labelnames=("program",))
+    before = counter.value(program="file")
+
+    class _Det:
+        mf_engine = "fft"
+
+    class _BDet:
+        det = _Det()
+
+    costs.ensure_batched_card(_BDet(), 1, np.float64,
+                              bucket="unit:alias", program="file")
+    cloned = costs.REGISTRY.get("unit:alias", "file", "fft")
+    assert cloned is not None and cloned.program == "file"
+    assert cloned.flops == src.flops
+    assert counter.value(program="file") == before   # zero extra compiles
+
+
+def test_program_analysis_memory_half_matches_memory_stats(chaos_detector):
+    """aot_memory_stats is now the memory half of aot_program_analysis
+    (one definition): the preflight unit and the cost card price the
+    SAME program to the same figures."""
+    from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+    from das4whales_tpu.utils import memory as memutils
+
+    bdet = BatchedMatchedFilterDetector(chaos_detector, donate=False)
+    st = memutils.batched_program_memory(bdet, 1, np.float64)
+    an = memutils.batched_program_analysis(bdet, 1, np.float64)
+    assert st is not None and an is not None and an.memory is not None
+    assert an.memory == st
+    assert an.flops > 0 and an.compile_seconds > 0
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill: campaign with the observatory on
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cards_run(chaos_file_set, tmp_path_factory):
+    """ONE batched campaign with the observatory (and flight recorder)
+    armed, shared by the acceptance and trace-report tests."""
+    costs.REGISTRY.reset()
+    out = str(tmp_path_factory.mktemp("cardsrun") / "camp")
+    res = run_campaign_batched(
+        chaos_file_set, SEL, out, batch=2, bucket="exact",
+        persistent_cache=False, cost_cards=True, trace=True,
+    )
+    return out, res
+
+
+def _picks_by_path(res):
+    return {r.path: load_picks(r.picks_file)
+            for r in res.records if r.status == "done"}
+
+
+def test_campaign_cost_cards_picks_bit_identical(chaos_file_set, cards_run,
+                                                 tmp_path):
+    """Acceptance: the observatory never touches picks — the
+    cost_cards=True campaign's output is bit-identical to the
+    untelemetered run's."""
+    out_plain = str(tmp_path / "plain")
+    res_plain = run_campaign_batched(
+        chaos_file_set, SEL, out_plain, batch=2, bucket="exact",
+        persistent_cache=False, cost_cards=False,
+    )
+    _, res_cards = cards_run
+    plain, cards = _picks_by_path(res_plain), _picks_by_path(res_cards)
+    assert set(plain) == set(cards) and plain
+    for path, ref in plain.items():
+        got = cards[path]
+        assert set(got) == set(ref)
+        for name in ref:
+            np.testing.assert_array_equal(got[name], ref[name])
+
+
+def test_campaign_populates_cards_metrics_and_live_roofline(cards_run):
+    """Acceptance: cards exist for the executing rung, the compile
+    metrics counted, and das_roofline_frac went LIVE (CPU peaks)."""
+    _, res = cards_run
+    assert res.n_failed == 0
+    cards = costs.REGISTRY.cards()
+    rungs = {c.program for c in cards}
+    assert "batched:2" in rungs
+    card = next(c for c in cards if c.program == "batched:2")
+    assert card.flops > 0 and card.bytes_accessed > 0
+    assert card.compile_seconds > 0
+    assert tmetrics.REGISTRY.counter(
+        "das_compiles_total", labelnames=("program",),
+    ).value(program="batched:2") >= 1
+    frac = tmetrics.REGISTRY.gauge(
+        "das_roofline_frac", labelnames=("stage", "engine"),
+    ).value(stage="batched:2", engine=card.engine)
+    assert frac > 0, "the campaign must have fed the live gauge"
+
+
+def test_campaign_exports_cost_cards_json(cards_run):
+    out, _ = cards_run
+    path = os.path.join(out, "cost_cards.json")
+    assert os.path.exists(path)
+    with open(path) as fh:
+        payload = json.load(fh)
+    assert payload["device"]["platform"]
+    assert payload["device"]["flops"] > 0
+    progs = {c["program"] for c in payload["cards"]}
+    assert "batched:2" in progs
+    for c in payload["cards"]:
+        assert c["predicted_wall_s"] > 0
+
+
+def test_trace_report_costs_merges_share_of_roofline(cards_run, capsys):
+    """scripts/trace_report.py --costs: resolve span walls x card
+    predictions -> per-rung share-of-roofline, furthest from peak
+    first; the human table renders."""
+    out, _ = cards_run
+    tr = _load_script("trace_report")
+    rep = tr.build_report(out, costs=True)
+    assert rep["cost_cards"] is not None
+    rows = rep["cost_share"]
+    assert rows, "resolve spans + cards must merge into rows"
+    row = next(r for r in rows if r["rung"] == "batched:2")
+    assert row["n_resolves"] >= 1
+    assert row["share_of_roofline"] is not None
+    assert 0 < row["share_of_roofline"]
+    # sorted furthest-from-peak first; unmatched rungs sink
+    shares = [r["share_of_roofline"] for r in rows
+              if r["share_of_roofline"] is not None]
+    assert shares == sorted(shares)
+    tr.print_report(rep)
+    out_text = capsys.readouterr().out
+    assert "share of roofline" in out_text
+    # --costs without an export says so instead of silently omitting
+    rep_none = tr.build_report(out + "-nowhere", costs=True)
+    assert rep_none["cost_share"] is None
+    tr.print_report(rep_none)
+    assert "no cost_cards.json" in capsys.readouterr().out
+
+
+def test_cost_share_table_marks_ambiguous_rung_cards():
+    """Resolve spans carry the rung but not the bucket/engine: when
+    more than one card shares a rung label (multi-bucket or
+    multi-engine run) the share must read ambiguous, never a number
+    computed against the wrong card."""
+    tr = _load_script("trace_report")
+    events = [{"name": "resolve", "dur": 1e6, "args": {"rung": "batched:2"}},
+              {"name": "resolve", "dur": 2e6, "args": {"rung": "batched:2"}}]
+    two = {"cards": [
+        {"program": "batched:2", "engine": "fft", "predicted_wall_s": 0.5},
+        {"program": "batched:2", "engine": "matmul",
+         "predicted_wall_s": 0.1},
+    ]}
+    rows = tr.cost_share_table(events, two)
+    assert len(rows) == 1
+    assert rows[0]["share_of_roofline"] is None
+    assert rows[0]["predicted_wall_s"] is None
+    assert rows[0]["engine"] == "ambiguous(2 cards)"
+    # a zero-prediction card (backend without cost_analysis) still
+    # counts toward multiplicity: the survivor must NOT be scored
+    # against walls pooled from both programs
+    zero_and_one = {"cards": [
+        {"program": "batched:2", "engine": "fft", "predicted_wall_s": 0.5},
+        {"program": "batched:2", "engine": "fft", "predicted_wall_s": 0.0},
+    ]}
+    rows0 = tr.cost_share_table(events, zero_and_one)
+    assert rows0[0]["share_of_roofline"] is None
+    assert rows0[0]["engine"] == "ambiguous(2 cards)"
+    # exactly one matching card computes normally (mean 1.5 s, pred 0.5)
+    rows1 = tr.cost_share_table(events, {"cards": two["cards"][:1]})
+    assert rows1[0]["share_of_roofline"] == pytest.approx(0.3333, abs=1e-4)
+    assert rows1[0]["engine"] == "fft"
+
+
+def test_report_without_costs_flag_omits_cost_keys(cards_run):
+    out, _ = cards_run
+    tr = _load_script("trace_report")
+    rep = tr.build_report(out)
+    assert "cost_share" not in rep and "cost_cards" not in rep
+
+
+# ---------------------------------------------------------------------------
+# The disabled path: the PR 10 overhead budget
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_hooks_add_no_compile_or_dispatch(compile_guard):
+    """Disabled (the default), every hook is one attribute check: a
+    warm jitted call bracketed by the dispatch hooks must not compile
+    or dispatch anything extra (compile_guard + dispatch counters)."""
+    import jax
+    import jax.numpy as jnp
+
+    assert not costs.enabled()
+    f = jax.jit(lambda a: a * 2.0)
+    x = jnp.arange(8.0)
+    jax.block_until_ready(f(x))   # warm
+    before = tmetrics.resilience_counters()
+    with compile_guard.forbid_recompile("disabled cost-observatory hooks"):
+        costs.sample_hbm()
+        jax.block_until_ready(f(x))
+        costs.sample_hbm()
+        costs.note_slab_resolved("no-bucket", "batched:2", "fft", 0.1)
+    delta = tmetrics.resilience_delta(before)
+    assert delta["dispatches"] == 0 and delta["syncs"] == 0
+
+
+def test_disabled_hook_overhead_budget():
+    """100k disabled hook pairs in well under a second — against
+    ms-scale slab walls that is <1% at any realistic rate."""
+    import time
+
+    assert not costs.enabled()
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        costs.sample_hbm()
+    assert time.perf_counter() - t0 < 1.0

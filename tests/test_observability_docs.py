@@ -1,0 +1,100 @@
+"""Metric-name drift gate (ISSUE 14 satellite).
+
+The docs/OBSERVABILITY.md metric table used to lag the code by hand.
+This tier-1 gate pins both directions:
+
+* every ``das_*`` metric REGISTERED in ``das4whales_tpu/`` source has
+  a row in the table;
+* every ``das_*`` name in a table row is registered somewhere in the
+  package.
+
+The registration set is a STATIC source scan (every call site passes
+the name as a literal first argument to ``counter``/``gauge``/
+``histogram`` — the repo's one registration idiom), so the gate is
+deterministic regardless of which tests ran first in the process and
+which ad-hoc ``das_test_*`` metrics they registered.
+
+New metric => add the table row, or this fails. Removed metric =>
+remove the row, or this fails.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_ROOT, "das4whales_tpu")
+_DOC = os.path.join(_ROOT, "docs", "OBSERVABILITY.md")
+
+#: a registration is the literal metric name as the first argument of a
+#: counter/gauge/histogram factory call (possibly on the next line)
+_REGISTRATION = re.compile(
+    r'(?:counter|gauge|histogram)\(\s*"(das_[a-z0-9_]+)"')
+
+
+def _registered_names() -> set[str]:
+    names: set[str] = set()
+    for dirpath, _dirs, files in os.walk(_PKG):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as fh:
+                names.update(_REGISTRATION.findall(fh.read()))
+    assert names, "the scanner found no registrations — idiom changed?"
+    return names
+
+
+def _documented_names() -> set[str]:
+    """``das_*`` names from the metric table's FIRST column (prose
+    mentions elsewhere in the doc are not rows and don't count)."""
+    names: set[str] = set()
+    with open(_DOC) as fh:
+        for line in fh:
+            if not line.startswith("|"):
+                continue
+            first_cell = line.split("|")[1]
+            names.update(re.findall(r"`(das_[a-z0-9_]+)`", first_cell))
+    return names
+
+
+def test_scanner_agrees_with_the_live_registry():
+    """The static idiom scan is only trustworthy if it sees everything
+    the real registry does: import the full metric-registering surface
+    and require every live das_* name to be statically found (ad-hoc
+    das_test_* names registered by OTHER tests in this process are the
+    one excusable difference)."""
+    import das4whales_tpu.parallel.dispatch  # noqa: F401
+    import das4whales_tpu.service.api  # noqa: F401
+    import das4whales_tpu.service.ingest  # noqa: F401
+    import das4whales_tpu.service.scheduler  # noqa: F401
+    import das4whales_tpu.telemetry  # noqa: F401
+    import das4whales_tpu.utils.locks  # noqa: F401
+    import das4whales_tpu.workflows.campaign  # noqa: F401
+    from das4whales_tpu.telemetry import metrics as tmetrics
+
+    live = {n for n in tmetrics.snapshot()
+            if n.startswith("das_") and not n.startswith("das_test_")}
+    unseen = live - _registered_names()
+    assert not unseen, (
+        f"metrics registered at runtime that the static scan missed "
+        f"(registration idiom changed?): {sorted(unseen)}"
+    )
+
+
+def test_every_registered_metric_is_documented():
+    missing = _registered_names() - _documented_names()
+    assert not missing, (
+        f"das_* metrics registered in code but missing from the "
+        f"docs/OBSERVABILITY.md table: {sorted(missing)} — add a row "
+        f"per metric (name | type | labels | meaning)"
+    )
+
+
+def test_every_documented_metric_is_registered():
+    stale = _documented_names() - _registered_names()
+    assert not stale, (
+        f"das_* names documented in docs/OBSERVABILITY.md but not "
+        f"registered anywhere in the package: {sorted(stale)} — remove "
+        f"the stale rows"
+    )

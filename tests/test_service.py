@@ -27,7 +27,12 @@ Contracts pinned here:
   iterations, every snapshot internally consistent, picks still
   bit-identical, and the ``das_lock_*`` histograms served by
   ``/metrics``; plus the NDJSON long-poll vs a concurrent manifest
-  writer and the per-manifest index-lock regression (R9's first catch).
+  writer and the per-manifest index-lock regression (R9's first catch);
+* the SLO drill (ISSUE 14): an injected slow tenant (impossible
+  freshness target) flips to ``burning`` in every window while the
+  other tenant stays ``ok`` with bit-identical picks; ``/slo`` and
+  ``das_pick_latency_seconds{tenant}`` are served mid-run and
+  ``/readyz`` lists the burning tenant as detail without a 503.
 """
 
 from __future__ import annotations
@@ -606,6 +611,119 @@ def test_tenants_snapshot_surface_and_trace_export(chaos_file_set,
     from das4whales_tpu.telemetry import trace as ttrace
 
     assert not ttrace.enabled()   # per-run enable restored
+
+
+def test_slo_two_tenant_burn_isolation_and_surface(chaos_file_set,
+                                                   second_file_set,
+                                                   batched_refs, tmp_path):
+    """The SLO acceptance drill (ISSUE 14): tenant A is the injected
+    slow tenant — an impossible freshness target (`slo_p95_s` far below
+    any real ingest→pick wall) makes EVERY settled pick a breach
+    without touching scheduling — and flips to ``burning`` in every
+    window; tenant B's generous target stays ``ok`` with zero burn.
+    ``/slo`` and ``das_pick_latency_seconds{tenant}`` are served
+    MID-RUN, ``/readyz`` carries the burning list as detail (still
+    200), and BOTH tenants' picks stay bit-identical to their
+    standalone runs — burn state never touches picks."""
+    cfg = ServiceConfig(
+        tenants=[_spec("a", chaos_file_set, slo_p95_s=1e-4),
+                 _spec("b", second_file_set, slo_p95_s=300.0)],
+        outdir=str(tmp_path / "svc"), persistent_cache=False,
+    )
+    svc = DetectionService(cfg).start()
+    served: list = []
+    stop_poll = threading.Event()
+
+    def poll():
+        while not stop_poll.is_set():
+            for ep in ("/slo", "/metrics"):
+                try:
+                    served.append((ep,) + _get(svc.api.url + ep))
+                except (urllib.error.URLError, OSError) as exc:
+                    served.append((ep, f"error: {exc}", ""))
+            time.sleep(0.01)
+
+    poller = threading.Thread(target=poll, daemon=True,
+                              name="slo-drill-poller")
+    poller.start()
+    try:
+        results = svc.run(until_idle=True)
+        # surfaces read while the API is still up (post-drain, pre-stop)
+        _, slo_body = _get(svc.api.url + "/slo")
+        _, metrics_body = _get(svc.api.url + "/metrics")
+        ready_status, ready_body = _get(svc.api.url + "/readyz")
+        _, tenants_body = _get(svc.api.url + "/tenants")
+    finally:
+        stop_poll.set()
+        poller.join(5)
+        svc.stop()
+
+    # both tenants fully settled
+    assert results["a"].n_done == N_FILES and results["a"].n_failed == 0
+    assert results["b"].n_done == 3 and results["b"].n_failed == 0
+
+    # /slo verdicts: A burning in EVERY window, B ok with zero burn
+    report = json.loads(slo_body)
+    rows = {r["tenant"]: r for r in report["tenants"]}
+    assert rows["a"]["state"] == "burning"
+    assert all(rate >= 1.0 for rate in rows["a"]["burn_rates"].values())
+    assert rows["a"]["n_breached"] == rows["a"]["n_observed"] == N_FILES
+    assert rows["b"]["state"] == "ok"
+    assert all(rate == 0.0 for rate in rows["b"]["burn_rates"].values())
+    assert rows["b"]["n_breached"] == 0
+    assert report["burning"] == ["a"]
+
+    # /readyz: burning is DETAIL, never a 503
+    assert ready_status == 200
+    assert json.loads(ready_body)["slo_burning"] == ["a"]
+
+    # per-tenant latency histogram + burn gauge on /metrics (presence,
+    # not exact counts — the process-wide histogram accumulates across
+    # every service test that settles tenant-"a" picks)
+    assert 'das_pick_latency_seconds_count{tenant="a"}' in metrics_body
+    assert 'das_pick_latency_seconds_count{tenant="b"}' in metrics_body
+    assert 'das_slo_burn_rate{tenant="a",window="60s"}' in metrics_body
+
+    # the /tenants snapshot embeds each tenant's SLO row
+    tenants = json.loads(tenants_body)["tenants"]
+    assert {t["tenant"]: t["slo"]["state"] for t in tenants} == {
+        "a": "burning", "b": "ok"}
+
+    # the poller saw /slo and /metrics answer 200 mid-run
+    assert served
+    bad = [s for s in served if s[1] != 200]
+    assert not bad, f"non-200 SLO surfaces during the run: {bad[:5]}"
+    mid_run_slo = [json.loads(body) for ep, code, body in served
+                   if ep == "/slo"]
+    assert mid_run_slo and all("tenants" in r for r in mid_run_slo)
+
+    # isolation: one tenant burning its budget never touches picks —
+    # BOTH tenants bit-identical to their standalone batched runs
+    _assert_bit_identical(results["a"].records, batched_refs["a"])
+    _assert_bit_identical(results["b"].records, batched_refs["b"])
+
+
+def test_slo_less_tenant_reports_ok_without_windows(chaos_file_set,
+                                                    tmp_path):
+    """No `slo_p95_s` configured: no burn evaluation (state `ok`, no
+    windows) — but the latency histogram still records."""
+    cfg = ServiceConfig(
+        tenants=[_spec("a", chaos_file_set)],
+        outdir=str(tmp_path / "svc"), persistent_cache=False,
+    )
+    svc = DetectionService(cfg).start()
+    try:
+        svc.run(until_idle=True)
+        _, slo_body = _get(svc.api.url + "/slo")
+        _, metrics_body = _get(svc.api.url + "/metrics")
+    finally:
+        svc.stop()
+    report = json.loads(slo_body)
+    assert report["burning"] == []
+    row = report["tenants"][0]
+    assert row == {"tenant": "a", "target_s": None, "state": "ok",
+                   "burn_rates": {}}
+    assert 'das_pick_latency_seconds_count{tenant="a"}' in metrics_body
 
 
 def test_live_block_roundtrip_through_scheduler(tmp_path):

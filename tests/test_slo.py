@@ -1,0 +1,146 @@
+"""Per-tenant serving SLOs (ISSUE 14, ``telemetry/slo.py``).
+
+Contracts pinned here:
+
+* policy: the error budget is the objective's complement (never 0 —
+  a 1.0 objective still divides);
+* burn rate per window = breach fraction over the window / budget
+  (0.0 with no observations in a window);
+* the multi-window rule: ``burning`` only when EVERY window burns
+  >= 1, ``warn`` when any single window does, ``ok`` otherwise — a
+  short spike alone does not page, a slow long-window leak alone does
+  not page immediately;
+* observations trim to the longest window (bounded memory for a
+  week-long service);
+* ``das_pick_latency_seconds{tenant}`` and
+  ``das_slo_burn_rate{tenant,window}`` export through the registry.
+
+The two-tenant SERVICE drill (an injected slow tenant flips its burn
+state without touching the other tenant's SLO or picks, ``/slo``
+served mid-run) lives in tests/test_service.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from das4whales_tpu.telemetry import metrics as tmetrics
+from das4whales_tpu.telemetry import slo
+
+
+def _tslo(name="t", target_s=1.0, objective=0.95, windows=(60.0, 600.0)):
+    return slo.TenantSLO(name, slo.SLOPolicy(
+        target_s=target_s, objective=objective, windows=tuple(windows)))
+
+
+def test_policy_budget_is_objective_complement():
+    assert slo.SLOPolicy(1.0).budget == pytest.approx(0.05)
+    assert slo.SLOPolicy(1.0, objective=0.99).budget == pytest.approx(0.01)
+    assert slo.SLOPolicy(1.0, objective=1.0).budget > 0   # never divide by 0
+
+
+def test_window_label_spelling():
+    assert slo.window_label(60.0) == "60s"
+    assert slo.window_label(599.6) == "600s"
+
+
+def test_no_observations_is_ok_with_zero_burn():
+    t = _tslo()
+    assert t.burn_rates(now=1000.0) == {60.0: 0.0, 600.0: 0.0}
+    assert t.state(now=1000.0) == "ok"
+
+
+def test_all_breaching_burns_every_window_to_burning():
+    t = _tslo(target_s=0.5)
+    for k in range(10):
+        t.observe(2.0, now=1000.0 + k)   # every pick breaches
+    rates = t.burn_rates(now=1010.0)
+    # breach fraction 1.0 / budget 0.05 = 20 in both windows
+    assert rates[60.0] == pytest.approx(20.0)
+    assert rates[600.0] == pytest.approx(20.0)
+    assert t.state(now=1010.0) == "burning"
+
+
+def test_short_spike_alone_is_warn_not_burning():
+    """Old good observations keep the long window under 1: only the
+    short window burns — the classic fast+slow rule says don't page."""
+    t = _tslo(target_s=0.5)
+    for k in range(30):
+        t.observe(0.1, now=500.0 + k)    # good, inside 600s window only
+    t.observe(2.0, now=1000.0)           # one fresh breach
+    rates = t.burn_rates(now=1000.0)
+    assert rates[60.0] == pytest.approx(20.0)         # 1/1 breach
+    assert rates[600.0] == pytest.approx((1 / 31) / 0.05)   # ~0.645
+    assert rates[600.0] < 1.0
+    assert t.state(now=1000.0) == "warn"
+
+
+def test_all_good_is_ok():
+    t = _tslo(target_s=1.0)
+    for k in range(20):
+        t.observe(0.2, now=1000.0 + k)
+    assert t.state(now=1020.0) == "ok"
+    assert all(r == 0.0 for r in t.burn_rates(now=1020.0).values())
+
+
+def test_observations_trim_to_longest_window():
+    t = _tslo(windows=(60.0, 600.0))
+    t.observe(2.0, now=100.0)
+    t.observe(2.0, now=1000.0)   # the first is now > 600 s stale
+    assert len(t._obs) == 1
+    # ...and the stale breach no longer burns any window
+    t2 = _tslo(target_s=0.5)
+    t2.observe(2.0, now=100.0)
+    t2.observe(0.1, now=1000.0)
+    assert t2.state(now=1000.0) == "ok"
+
+
+def test_snapshot_carries_the_slo_row():
+    t = _tslo(name="fin", target_s=0.5)
+    t.observe(2.0, now=1000.0)
+    t.observe(0.1, now=1000.5)
+    snap = t.snapshot(now=1001.0)
+    assert snap["tenant"] == "fin"
+    assert snap["target_s"] == 0.5
+    assert snap["objective"] == 0.95
+    assert snap["budget"] == pytest.approx(0.05)
+    assert snap["windows_s"] == [60.0, 600.0]
+    assert set(snap["burn_rates"]) == {"60s", "600s"}
+    assert snap["state"] in ("ok", "warn", "burning")
+    assert snap["n_observed"] == 2 and snap["n_breached"] == 1
+
+
+def test_burn_gauge_and_latency_histogram_export():
+    t = _tslo(name="export-drill", target_s=0.5)
+    t.observe(2.0, now=1000.0)
+    t.burn_rates(now=1000.0)   # gauges refresh at evaluation, not per pick
+    g = tmetrics.REGISTRY.gauge("das_slo_burn_rate",
+                                labelnames=("tenant", "window"))
+    assert g.value(tenant="export-drill", window="60s") >= 1.0
+    slo.observe_pick_latency("export-drill", 0.25)
+    slo.observe_pick_latency("export-drill", -3.0)   # clamped to 0
+    h = tmetrics.REGISTRY.histogram("das_pick_latency_seconds",
+                                    labelnames=("tenant",))
+    assert h.quantile(1.0, tenant="export-drill") is not None
+    text = tmetrics.prometheus_text()
+    assert 'das_pick_latency_seconds_count{tenant="export-drill"} 2' in text
+    assert 'das_slo_burn_rate{tenant="export-drill",window="60s"}' in text
+
+
+def test_burn_gauge_decays_when_breaches_age_out():
+    """The gauge is as fresh as the last EVALUATION: a tenant that
+    breached and then went idle must read 0 on the next scrape (the
+    ``/metrics`` handler evaluates before rendering), never latch the
+    last per-pick burn forever — a pager on the gauge and ``/slo``
+    must agree."""
+    t = _tslo(name="decay-drill", target_s=0.5)
+    t.observe(2.0, now=1000.0)
+    assert t.burn_rates(now=1000.0)[60.0] == pytest.approx(20.0)
+    g = tmetrics.REGISTRY.gauge("das_slo_burn_rate",
+                                labelnames=("tenant", "window"))
+    assert g.value(tenant="decay-drill", window="60s") == pytest.approx(20.0)
+    # the breach ages out of every window with NO new observations:
+    # re-evaluating (what a scrape does) decays the gauge to 0
+    assert t.burn_rates(now=2000.0) == {60.0: 0.0, 600.0: 0.0}
+    assert g.value(tenant="decay-drill", window="60s") == 0.0
+    assert t.state(now=2000.0) == "ok"

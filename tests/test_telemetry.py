@@ -224,6 +224,68 @@ def test_registry_surfaces_render():
     assert row["count"] == 3 and row["max"] == 5.0
 
 
+def test_exposition_escapes_label_values():
+    """Prometheus text 0.0.4 label escaping (ISSUE 14 satellite):
+    backslash first, then quote and newline — a value holding all
+    three survives as ``\\\\``, ``\\"``, ``\\n`` literals."""
+    c = metrics.counter("das_test_escape_total", "escape drill", ("path",))
+    c.inc(path='a\\b"c\nd')
+    text = metrics.prometheus_text()
+    assert r'das_test_escape_total{path="a\\b\"c\nd"} 1' in text
+    # the raw control characters never leak into the exposition line
+    line = next(l for l in text.splitlines()
+                if l.startswith("das_test_escape_total{"))
+    assert "\n" not in line and line.endswith("} 1")
+
+
+def test_histogram_inf_bucket_and_cumulative_invariant():
+    """The +Inf bucket equals _count, bucket counts are CUMULATIVE and
+    non-decreasing, and _sum is exact — the scrape-side invariants a
+    Prometheus server asserts."""
+    h = metrics.histogram("das_test_cumulative_seconds", "cumulative drill",
+                          buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 2.0, 100.0):   # edge: 0.1 is <= le=0.1
+        h.observe(v)
+    text = metrics.prometheus_text()
+    buckets = {}
+    total = None
+    for line in text.splitlines():
+        if line.startswith("das_test_cumulative_seconds_bucket"):
+            le = line.split('le="')[1].split('"')[0]
+            buckets[le] = int(line.rsplit(" ", 1)[1])
+        elif line.startswith("das_test_cumulative_seconds_count"):
+            total = int(line.rsplit(" ", 1)[1])
+        elif line.startswith("das_test_cumulative_seconds_sum"):
+            assert float(line.rsplit(" ", 1)[1]) == pytest.approx(102.65)
+    assert buckets == {"0.1": 2, "1.0": 3, "10.0": 4, "+Inf": 5}
+    counts = [buckets["0.1"], buckets["1.0"], buckets["10.0"],
+              buckets["+Inf"]]
+    assert counts == sorted(counts)          # cumulative: non-decreasing
+    assert buckets["+Inf"] == total == 5     # +Inf == _count
+
+
+def test_help_and_type_lines_for_cost_and_slo_metrics():
+    """Every ISSUE 14 metric ships HELP+TYPE at registration (the
+    modules register at import, values or not), with the right kind."""
+    from das4whales_tpu.telemetry import costs, slo  # noqa: F401 — register
+
+    text = metrics.prometheus_text()
+    for name, kind in (
+        ("das_compile_seconds", "histogram"),
+        ("das_compiles_total", "counter"),
+        ("das_roofline_frac", "gauge"),
+        ("das_hbm_bytes_in_use", "gauge"),
+        ("das_hbm_bytes_limit", "gauge"),
+        ("das_preflight_pricing_error_ratio", "gauge"),
+        ("das_pick_latency_seconds", "histogram"),
+        ("das_slo_burn_rate", "gauge"),
+    ):
+        assert f"# TYPE {name} {kind}" in text
+        help_line = next((l for l in text.splitlines()
+                          if l.startswith(f"# HELP {name} ")), None)
+        assert help_line and len(help_line) > len(f"# HELP {name} ")
+
+
 # ---------------------------------------------------------------------------
 # Probes: the liveness/readiness truth table
 # ---------------------------------------------------------------------------
