@@ -457,6 +457,7 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
                  "n_dispatches": round(seg.get("dispatches", 0) / repeats, 2),
                  "n_syncs": round(seg.get("syncs", 0) / repeats, 2)}
     cost_info = _cost_card_live_report(det, block, min(times), nx, ns)
+    cost_info.update(_quality_live_report(det, res, block, ns))
     batch_info = _bench_batch(meta, nx, ns, block, wire, peak_block,
                               channel_tile, repeats)
     if os.environ.get("DAS_BENCH_TSWEEP", "") not in ("", "0", "false"):
@@ -508,6 +509,44 @@ def _cost_card_live_report(det, block, wall, nx, ns):
         if frac is not None:
             out["roofline_frac_live"] = round(frac, 5)
         return out
+    except Exception:  # noqa: BLE001 — decorative metadata only
+        return {}
+
+
+def _quality_live_report(det, res, block, ns):
+    """Science-quality wiring (ISSUE 15, opt-in via ``DAS_QUALITY=1``):
+    score the measured file through ``telemetry.quality`` — pick rate,
+    dead-channel fraction, noise floor, SNR percentiles — into a
+    ``quality`` payload block. Opt-in because the health profile here is
+    a host-side numpy pass over the ~GB block, paid after the
+    measurement; decorative: a failure must never cost the JSON line."""
+    try:
+        from das4whales_tpu.telemetry import quality as _quality
+
+        if not _quality.enabled():
+            return {}
+        from das4whales_tpu.ops import health as _health
+
+        stats = _health.host_health_stats(np.asarray(block))
+        design = det.design
+        rec = _quality.file_quality(
+            "bench", res.picks, res.thresholds, stats,
+            duration_s=ns / float(design.fs),
+            thr_factors=_quality.threshold_factor_map(design),
+            thr_scope=det.threshold_scope,
+        )
+        _quality.OBSERVATORY.observe("bench", rec)
+        # the observatory's own snapshot is THE percentile definition —
+        # no second nearest-rank implementation to keep in sync
+        snap = _quality.OBSERVATORY.tenant("bench").snapshot()
+        return {"quality": {
+            "n_picks": rec["n_picks_total"],
+            "pick_rate_hz": rec["pick_rate_hz"],
+            "dead_frac": rec["dead_frac"],
+            "noise_floor_rms": rec["noise_floor_rms"],
+            "snr_db_p50": snap["snr_db_p50"],
+            "snr_db_p95": snap["snr_db_p95"],
+        }}
     except Exception:  # noqa: BLE001 — decorative metadata only
         return {}
 
@@ -1566,6 +1605,12 @@ def main():
         # the full banked/stale provenance (_replay_banked)
         "roofline_frac_live": result.get("roofline_frac_live"),
         "cost_cards": result.get("cost_cards"),
+        # the science-truth block (ISSUE 15, DAS_QUALITY=1): pick rate,
+        # dead-channel fraction, noise floor and SNR percentiles of the
+        # measured file from telemetry.quality — null when the
+        # observatory is off; decorative-on-failure like
+        # roofline_frac_live
+        "quality": result.get("quality"),
         # every successful rung's wall, so the in-path A/Bs (exact vs
         # pow2-pad channel FFT; tiled backup) stay reconstructable from
         # the artifact even though only the fastest rung is the headline

@@ -297,23 +297,59 @@ class DataHealthConfig:
     max_rms: float | None = None
     min_rms: float | None = None
 
+    @staticmethod
+    def _bin_note(stats: Mapping, field: str, worst: str = "max") -> str:
+        """Name the offending channel-bin range when the per-channel
+        profile (``ops.health.health_profile`` fields in the stats
+        dict) is present — quarantine triage on a 22k-channel block
+        should say WHERE the fault lives, not just that it exists.
+        Returns ``""`` on pre-profile stats dicts (back-compat)."""
+        vals = stats.get(field)
+        per = stats.get("bin_channels")
+        n_ch = stats.get("n_channels")
+        if not vals or not per or not n_ch:
+            return ""
+
+        def rank(v: float) -> float:
+            # a NaN bin value (poisoned span) is the worst offender in
+            # either direction: surface it rather than skip it
+            if v != v:
+                return float("-inf") if worst == "min" else float("inf")
+            return v
+
+        idx = range(len(vals))
+        j = (min(idx, key=lambda k: rank(vals[k])) if worst == "min"
+             else max(idx, key=lambda k: rank(vals[k])))
+        lo = j * per
+        hi = min((j + 1) * per, n_ch) - 1
+        label = field[4:] if field.startswith("bin_") else field
+        return (f" (worst channel bin {j}: channels {lo}-{hi}, "
+                f"{label} {vals[j]:.4g})")
+
     def breach(self, stats: Mapping) -> str | None:
         """The first threshold ``stats`` (an ``ops.health`` stats dict)
         breaches, as a human-readable reason — or None when healthy.
         NaN-valued rms (a NaN-poisoned block) reads as unhealthy for any
-        configured rms bound."""
+        configured rms bound. When the stats carry the per-channel-bin
+        profile, the reason also names the worst-offending channel-bin
+        range (``_bin_note``) so triage can tell a dying fiber span
+        from a whole-array fault without replotting."""
+        note = lambda field, worst="max": self._bin_note(stats, field, worst)  # noqa: E731
         if stats["nonfinite"] > self.max_nonfinite:
             return (f"nonfinite samples: {stats['nonfinite']} > "
-                    f"max_nonfinite={self.max_nonfinite}")
+                    f"max_nonfinite={self.max_nonfinite}"
+                    + note("bin_nonfinite"))
         if self.clip_abs is not None and stats["clip_frac"] > self.max_clip_frac:
             return (f"clipped fraction {stats['clip_frac']:.4g} > "
                     f"max_clip_frac={self.max_clip_frac} "
-                    f"(|x| >= {self.clip_abs:g})")
+                    f"(|x| >= {self.clip_abs:g})" + note("bin_clipped"))
         rms = stats["rms"]
         if self.max_rms is not None and not rms <= self.max_rms:
-            return f"rms {rms:.4g} above max_rms={self.max_rms:g}"
+            return (f"rms {rms:.4g} above max_rms={self.max_rms:g}"
+                    + note("bin_rms"))
         if self.min_rms is not None and not rms >= self.min_rms:
-            return f"rms {rms:.4g} below min_rms={self.min_rms:g}"
+            return (f"rms {rms:.4g} below min_rms={self.min_rms:g}"
+                    + note("bin_rms", worst="min"))
         return None
 
 

@@ -529,14 +529,20 @@ def mf_detect_picks_program(
     ``ops.peaks.picks_with_escalation``).
 
     ``with_health=True`` appends the on-device data-health stats
-    (``ops.health.health_stats`` over the INPUT block — raw counts on
-    the narrow wire, strain on the conditioned one; ``cond_n_real``
-    restricts them to a padded record's real samples on either wire) to
-    the return: ``(..., health_counts [2] int32, health_rms f32)``. They
-    ride the program's existing packed fetch — the quarantine gate costs
-    no extra dispatch and no extra device->host round trip
-    (docs/ROBUSTNESS.md). ``health_clip`` is a traced scalar (samples
-    with ``|x| >= health_clip`` count as clipped; None disables).
+    (``ops.health.health_stats_profiled`` over the INPUT block — raw
+    counts on the narrow wire, strain on the conditioned one;
+    ``cond_n_real`` restricts them to a padded record's real samples on
+    either wire) to the return: ``(..., health_counts [2] int32,
+    health_rms f32, health_bin_counts [bins, 3] int32, health_bin_rms
+    [bins] f32)`` — the scalars the quarantine gate always read plus
+    the bounded per-channel-bin profile (~``ops.health.N_BINS`` bins of
+    rms / clipped / non-finite / dead-channel counts, ISSUE 15). All of
+    it rides the program's existing packed fetch — the gate and the
+    science-quality observatory cost no extra dispatch and no extra
+    device->host round trip, and the transfer stays O(bins), never
+    O(channels) (docs/ROBUSTNESS.md, docs/OBSERVABILITY.md).
+    ``health_clip`` is a traced scalar (samples with ``|x| >=
+    health_clip`` count as clipped; None disables).
 
     ``mf_engine``/``fk_engine`` pick the correlate and f-k transform
     engines (``"fft"`` or the MXU matmul recasts — ``ops.mxu``; the
@@ -559,9 +565,11 @@ def mf_detect_picks_program(
     if with_health:
         from ..ops import health as health_ops
 
-        h_counts, h_rms = health_ops.health_stats(
-            trace, jnp.inf if health_clip is None else health_clip,
-            n_real=cond_n_real,
+        h_counts, h_rms, h_bin_counts, h_bin_rms = (
+            health_ops.health_stats_profiled(
+                trace, jnp.inf if health_clip is None else health_clip,
+                n_real=cond_n_real,
+            )
         )
     if condition:
         # narrow-wire prologue: raw counts -> strain, fused ahead of the
@@ -628,7 +636,8 @@ def mf_detect_picks_program(
         sat = jnp.swapaxes(sp.saturated, 0, 1).reshape(nT, -1)[:, :C]
         sat_count = jnp.sum(sat.astype(jnp.int32), axis=-1)
     if with_health:
-        return chan, times, cnt, sat_count, thr, h_counts, h_rms
+        return (chan, times, cnt, sat_count, thr, h_counts, h_rms,
+                h_bin_counts, h_bin_rms)
     return chan, times, cnt, sat_count, thr
 
 
@@ -1244,10 +1253,11 @@ class MatchedFilterDetector:
             outs = jax.device_get(outs)
             faults.count("syncs")
             if with_health:
-                *outs, h_counts, h_rms = outs
+                *outs, h_counts, h_rms, h_binc, h_brms = outs
                 health.update(health_ops.stats_to_dict(
                     h_counts, h_rms,
                     C * int(n_real if pad_real else trace.shape[1]),
+                    bin_counts=h_binc, bin_rms=h_brms, n_channels=C,
                 ))
             return outs
 

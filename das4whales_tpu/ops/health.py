@@ -1,4 +1,4 @@
-"""On-device data-health statistics (the quarantine gate).
+"""On-device data-health statistics (the quarantine gate + triage profile).
 
 A campaign file can read cleanly and still be garbage: a NaN-poisoned
 slab (failed interrogator write), an ADC-saturated recording, a dead
@@ -17,6 +17,22 @@ Counts, not fractions, cross the wire: at the canonical block size
 back to exactly 1.0 — a fraction-typed stat would silently pass the
 default ``max_nonfinite=0`` gate. int32 counts are exact up to 2**31
 samples; the host converts to fractions in float64 for reporting.
+
+Besides the whole-block scalars, :func:`health_profile` computes a
+BOUNDED per-channel-bin profile (ISSUE 15): RMS, clipped/non-finite
+counts and dead-channel counts over ~:data:`N_BINS` channel bins, so a
+dying fiber span or a clipping ADC bank is *locatable* (the quarantine
+verdict names the offending channel range, and the science-quality
+observatory — ``telemetry.quality`` — watches the dead fraction and
+noise floor drift live). The host transfer stays O(bins), never
+O(22k channels): the reduction happens in the detection program and the
+bins ride the same packed fetch as the scalars.
+
+The element-level clip/RMS/validity math exists ONCE
+(:func:`_element_stats`, parameterized over the array namespace), so
+the device path (:func:`health_stats` / :func:`health_profile`, jnp)
+and the host fallback (:func:`host_health_stats`, numpy — detector
+families without a fused program) can never drift apart.
 """
 
 from __future__ import annotations
@@ -26,6 +42,53 @@ import numpy as np
 
 #: Number of scalar slots in the packed health-count vector.
 N_COUNTS = 2
+
+#: Per-bin slots in the packed profile count matrix: non-finite,
+#: clipped, dead-channel counts (int32, exact — the fraction conversion
+#: happens on the host, like the scalar counts).
+N_BIN_COUNTS = 3
+
+#: Default channel-bin budget for :func:`health_profile`. ~256 bins
+#: keeps the host transfer and the manifest's per-record profile O(100)
+#: numbers at the canonical 22050-channel shape (~87 channels/bin)
+#: while still localizing a fault to a ~180 m fiber span.
+N_BINS = 256
+
+
+def channel_bins(n_channels: int, n_bins: int | None = None) -> tuple[int, int]:
+    """Resolve the per-bin layout for ``n_channels``: ``(bins, per)``
+    with ``per = ceil(C / min(n_bins, C))`` channels per bin and
+    ``bins = ceil(C / per)`` bins actually needed (the last bin may be
+    partial — its real channel count is ``C - (bins - 1) * per``).
+    Deterministic per shape, so the profile's program shape is static."""
+    c = int(n_channels)
+    nb = N_BINS if n_bins is None else int(n_bins)
+    nb = max(1, min(nb, max(c, 1)))
+    # per >= 1 even for an empty selection: channel_bins(0) resolves to
+    # the sensible (0 bins, 1 channel/bin) instead of dividing by zero
+    per = max(1, -(-c // nb))
+    return -(-c // per), per
+
+
+def _element_stats(xp, xf, clip_abs, n_real):
+    """THE per-element health definition, shared by the device (jnp)
+    and host (numpy) paths: ``(finite, clipped, sq)`` masks/values over
+    ``xf`` (already float). ``clipped`` is FINITE saturation only (ADC
+    rails) — non-finite samples are counted by the first slot and must
+    not double-report. ``n_real`` (None or a scalar) restricts the
+    stats to the real time samples of a bucket-padded record: pad
+    samples read finite, unclipped, and contribute 0 to the sum of
+    squares, so padding can never dilute a breach below threshold."""
+    finite = xp.isfinite(xf)
+    clipped = (xp.abs(xf) >= clip_abs) & finite
+    if n_real is not None:
+        valid = xp.arange(xf.shape[-1]) < n_real
+        finite = finite | ~valid
+        clipped = clipped & valid
+        sq = xp.where(valid, xf * xf, xp.zeros((), xf.dtype))
+    else:
+        sq = xf * xf
+    return finite, clipped, sq
 
 
 def health_stats(x, clip_abs, n_real=None):
@@ -47,19 +110,13 @@ def health_stats(x, clip_abs, n_real=None):
     signal, since any rms threshold comparison with NaN reads unhealthy).
     """
     xf = x.astype(jnp.float32)
-    finite = jnp.isfinite(xf)
-    # clipping is FINITE saturation (ADC rails); non-finite samples are
-    # already counted by the first slot and must not double-report
-    clipped = (jnp.abs(xf) >= jnp.asarray(clip_abs, jnp.float32)) & finite
+    finite, clipped, sq = _element_stats(
+        jnp, xf, jnp.asarray(clip_abs, jnp.float32), n_real
+    )
     if n_real is not None:
-        valid = jnp.arange(x.shape[-1]) < n_real
         n = jnp.asarray(n_real, jnp.float32) * x.shape[-2]
-        finite = finite | ~valid
-        clipped = clipped & valid
-        sq = jnp.where(valid, xf * xf, jnp.zeros((), jnp.float32))
     else:
         n = jnp.float32(x.shape[-1] * x.shape[-2])
-        sq = xf * xf
     counts = jnp.stack(
         [
             jnp.sum((~finite).astype(jnp.int32), axis=(-2, -1)),
@@ -71,13 +128,79 @@ def health_stats(x, clip_abs, n_real=None):
     return counts, rms
 
 
-def stats_to_dict(counts, rms, n_samples: int) -> dict:
+def health_profile(x, clip_abs, n_real=None, n_bins: int | None = None,
+                   xp=jnp):
+    """Per-channel-bin health profile (inline under any jit with the
+    default ``xp=jnp``; the host fallback passes ``xp=np`` — like
+    :func:`_element_stats`, the binning math exists ONCE so the two
+    paths cannot drift).
+
+    Same inputs as :func:`health_stats`; channels are grouped into
+    :func:`channel_bins` bins of ``per`` consecutive channels. Returns
+    ``(bin_counts int32 [..., bins, 3], bin_rms float32 [..., bins])``
+    with slots non-finite / clipped / dead per bin — a channel is DEAD
+    when its real samples are all exactly zero (the interrogator wrote
+    nothing for that span of fiber; a NaN-poisoned channel is counted
+    non-finite, not dead). Pad channels of the last partial bin
+    contribute nothing; ``bin_rms`` divides by each bin's REAL channel
+    count, so the partial bin's rms is not diluted."""
+    c = x.shape[-2]
+    nb, per = channel_bins(c, n_bins)
+    xf = x.astype(xp.float32)
+    finite, clipped, sq = _element_stats(
+        xp, xf, xp.asarray(clip_abs, xp.float32), n_real
+    )
+    nonfinite_ch = xp.sum((~finite).astype(xp.int32), axis=-1)
+    clipped_ch = xp.sum(clipped.astype(xp.int32), axis=-1)
+    sumsq_ch = xp.sum(sq, axis=-1)
+    dead_ch = (sumsq_ch == 0).astype(xp.int32)
+
+    def binned(a):
+        pad = nb * per - c
+        if pad:
+            a = xp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
+        return xp.sum(a.reshape(a.shape[:-1] + (nb, per)), axis=-1)
+
+    bin_counts = xp.stack(
+        [binned(nonfinite_ch), binned(clipped_ch), binned(dead_ch)], axis=-1
+    )
+    nt = (xp.asarray(n_real, xp.float32) if n_real is not None
+          else xp.float32(x.shape[-1]))
+    # real channels per bin (only the last bin may be partial) — a
+    # static vector, so the rms denominator never counts pad channels
+    ch_in_bin = xp.clip(c - per * xp.arange(nb), 0, per).astype(xp.float32)
+    bin_rms = xp.sqrt(binned(sumsq_ch) / (ch_in_bin * nt))
+    return bin_counts, bin_rms
+
+
+def health_stats_profiled(x, clip_abs, n_real=None, n_bins: int | None = None):
+    """Scalars + per-bin profile for the fused ``with_health`` programs:
+    ``(counts, rms, bin_counts, bin_rms)``. The scalar half reduces
+    exactly like :func:`health_stats` always did (bitwise-stable
+    against pre-profile manifests); the shared element masks are CSE'd
+    by XLA under the one jit."""
+    counts, rms = health_stats(x, clip_abs, n_real=n_real)
+    bin_counts, bin_rms = health_profile(x, clip_abs, n_real=n_real,
+                                         n_bins=n_bins)
+    return counts, rms, bin_counts, bin_rms
+
+
+def stats_to_dict(counts, rms, n_samples: int, bin_counts=None, bin_rms=None,
+                  n_channels: int | None = None) -> dict:
     """One file's fetched health outputs -> the host-side stats dict the
     quarantine gate (:meth:`DataHealthConfig.breach`) and the manifest
-    consume. Fractions are derived in float64 from the exact counts."""
+    consume. Fractions are derived in float64 from the exact counts.
+
+    ``bin_counts``/``bin_rms`` (the :func:`health_profile` outputs, with
+    ``n_channels`` naming the real channel count) extend the dict with
+    the per-bin fields — ``bin_nonfinite`` / ``bin_clipped`` /
+    ``bin_dead`` / ``bin_rms`` lists plus ``n_bins`` / ``bin_channels``
+    / ``dead_channels`` / ``dead_frac`` — while every pre-profile key
+    keeps its exact meaning (back-compat: consumers of the scalar keys
+    never see a difference)."""
     counts = np.asarray(counts)
     n = max(int(n_samples), 1)
-    return {
+    out = {
         "nonfinite": int(counts[0]),
         "clipped": int(counts[1]),
         "nonfinite_frac": float(counts[0]) / n,
@@ -85,25 +208,53 @@ def stats_to_dict(counts, rms, n_samples: int) -> dict:
         "rms": float(rms),
         "n_samples": int(n_samples),
     }
+    if bin_counts is not None and bin_rms is not None and n_channels:
+        bc = np.asarray(bin_counts)
+        nb = int(bc.shape[0])
+        _, per = channel_bins(int(n_channels),
+                              n_bins=nb if nb else None)
+        dead = int(bc[:, 2].sum())
+        out.update({
+            "n_channels": int(n_channels),
+            "n_bins": nb,
+            "bin_channels": per,
+            "bin_nonfinite": [int(v) for v in bc[:, 0]],
+            "bin_clipped": [int(v) for v in bc[:, 1]],
+            "bin_dead": [int(v) for v in bc[:, 2]],
+            "bin_rms": [float(v) for v in np.asarray(bin_rms)],
+            "dead_channels": dead,
+            "dead_frac": dead / max(int(n_channels), 1),
+        })
+    return out
 
 
 def host_health_stats(arr: np.ndarray, clip_abs: float | None = None) -> dict:
     """Host-side fallback for detector families without the fused
-    program (the campaign's generic-adapter path): same stats, numpy,
-    one pass over the already-host-resident block."""
+    program (the campaign's generic-adapter path): the same element
+    definition (:func:`_element_stats`, numpy/float64), one pass over
+    the already-host-resident block — including the per-channel-bin
+    profile when ``arr`` is a ``[C, T]`` block, so host-stats
+    done-records carry the same triage fields as fused ones."""
     x = np.asarray(arr)
     xf = x.astype(np.float64, copy=False)
-    nonfinite = int(np.size(x) - np.count_nonzero(np.isfinite(xf)))
-    clipped = (
-        int(np.count_nonzero(np.isfinite(xf) & (np.abs(xf) >= float(clip_abs))))
-        if clip_abs is not None else 0
-    )
-    rms = float(np.sqrt(np.mean(np.square(xf))))
-    return {
-        "nonfinite": nonfinite,
-        "clipped": clipped,
-        "nonfinite_frac": nonfinite / max(x.size, 1),
-        "clip_frac": clipped / max(x.size, 1),
-        "rms": rms,
-        "n_samples": int(x.size),
-    }
+    clip = float("inf") if clip_abs is None else float(clip_abs)
+    finite, clipped, sq = _element_stats(np, xf, clip, None)
+    counts = (int(x.size - np.count_nonzero(finite)),
+              int(np.count_nonzero(clipped)))
+    # empty input keeps the historical NaN rms (mean of nothing): NaN
+    # reads UNHEALTHY against any configured rms bound — an empty block
+    # must never pass a max_rms gate that a zero would slip through
+    rms = (float(np.sqrt(sq.sum() / x.size)) if x.size
+           else float("nan"))
+    bin_counts = bin_rms = n_channels = None
+    if x.ndim == 2 and x.size:
+        # the SHARED profile definition at xp=np (one extra numpy pass
+        # over the block — host stats accompany host-rung detection, so
+        # the pass is noise next to the detect; the device definition's
+        # float32 cast applies here too, which is what makes the
+        # device==host bin parity exact-by-construction)
+        bin_counts, bin_rms = health_profile(x, clip, xp=np)
+        n_channels = x.shape[0]
+    return stats_to_dict(np.asarray(counts), rms, x.size,
+                         bin_counts=bin_counts, bin_rms=bin_rms,
+                         n_channels=n_channels)

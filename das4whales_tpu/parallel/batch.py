@@ -320,14 +320,14 @@ class BatchedMatchedFilterDetector:
         del stack
 
         def resolve() -> List[tuple | None]:
-            h_counts = h_rms = None
+            h_counts = h_rms = h_binc = h_brms = None
 
             def fetch_payload(outs):
-                nonlocal h_counts, h_rms
+                nonlocal h_counts, h_rms, h_binc, h_brms
                 outs = jax.device_get(outs)
                 faults.count("syncs")
                 if with_health:
-                    *outs, h_counts, h_rms = outs
+                    *outs, h_counts, h_rms, h_binc, h_brms = outs
                 return outs
 
             chan, times, cnt, satc, thr = fetch_payload(state.pop("k0"))
@@ -360,7 +360,9 @@ class BatchedMatchedFilterDetector:
                     ns_b = int(n_reals[b]) if (n_reals is not None
                                                and b < len(n_reals)) else T
                     out.append((picks, thr_out, health_ops.stats_to_dict(
-                        h_counts[b], h_rms[b], C * ns_b
+                        h_counts[b], h_rms[b], C * ns_b,
+                        bin_counts=h_binc[b], bin_rms=h_brms[b],
+                        n_channels=C,
                     )))
                 else:
                     out.append((picks, thr_out))

@@ -14,6 +14,11 @@ just text). Endpoints (docs/SERVICE.md):
     Per-tenant serving-SLO verdicts (``telemetry.slo``): freshness
     target, multi-window burn rates, ``ok``/``warn``/``burning`` state,
     and the service-level burning list (docs/SERVICE.md).
+``GET /quality``
+    Per-tenant science-quality rows (``telemetry.quality``): pick
+    totals, SNR percentiles, noise floor / dead-channel signals and
+    the EWMA drift verdicts, plus the drifting list the ``/readyz``
+    detail embeds — informational, never a 503 (docs/SERVICE.md).
 ``GET /metrics``
     The whole labeled registry as Prometheus text exposition 0.0.4
     (``telemetry.metrics.prometheus_text``).
@@ -283,9 +288,19 @@ class ServiceAPI:
             burning = self.service.slo_burning()
             if burning:
                 payload["slo_burning"] = burning
+            # quality-drift detail rides the same way (ISSUE 15): a
+            # drifting tenant NEVER flips readiness — the process is
+            # healthy, the science may not be — but the operator
+            # polling /readyz sees WHO is drifting without a second
+            # request (docs/SERVICE.md)
+            drifting = self.service.quality_drifting()
+            if drifting:
+                payload["quality_drifting"] = drifting
             h._send_json(200 if res else 503, payload)
         elif url.path == "/slo":
             h._send_json(200, self.service.slo_report())
+        elif url.path == "/quality":
+            h._send_json(200, self.service.quality_report())
         elif url.path == "/metrics":
             # burn gauges refresh at evaluation time, not per pick: a
             # scrape must see the CURRENT window (breaches aging out

@@ -146,6 +146,12 @@ class ServiceConfig:
     #: process: None defers to ``DAS_COST_CARDS``; True enables — cost
     #: cards, live roofline fractions and ``cost_cards.json`` at drain
     cost_cards: bool | None = None
+    #: arm the science-quality observatory (``telemetry.quality``,
+    #: ISSUE 15): None defers to ``DAS_QUALITY``; True enables — pick
+    #: stream/SNR/health telemetry, per-tenant drift baselines,
+    #: ``GET /quality`` rows, and ``quality.json`` at drain. Drift
+    #: never touches readiness, scheduling, or picks (docs/SERVICE.md)
+    quality: bool | None = None
     resume: bool = True
     persistent_cache: bool | str = True
 
@@ -171,7 +177,7 @@ def load_service_config(path: str) -> ServiceConfig:
     if not tenants:
         raise ValueError(f"{path}: no tenants configured")
     known = {"tenants", "outdir", "host", "port", "dispatch_depth", "trace",
-             "cost_cards", "resume", "persistent_cache"}
+             "cost_cards", "quality", "resume", "persistent_cache"}
     unknown = set(raw) - known
     if unknown:
         raise ValueError(f"unknown service keys {sorted(unknown)}; "
@@ -181,6 +187,7 @@ def load_service_config(path: str) -> ServiceConfig:
         host=raw.get("host", "127.0.0.1"), port=int(raw.get("port", 0)),
         dispatch_depth=raw.get("dispatch_depth"),
         trace=raw.get("trace"), cost_cards=raw.get("cost_cards"),
+        quality=raw.get("quality"),
         resume=bool(raw.get("resume", True)),
         persistent_cache=raw.get("persistent_cache", True),
     )
@@ -206,6 +213,13 @@ class DetectionService:
             from ..telemetry import costs as tcosts
 
             tcosts.enable()
+        if config.quality:
+            # same process-switch contract as the cost observatory:
+            # TenantRuntime reads the module flag at construction below,
+            # so the enable must precede the tenant loop
+            from ..telemetry import quality as tquality
+
+            tquality.enable()
         if config.persistent_cache:
             from ..config import enable_persistent_compilation_cache
 
@@ -276,6 +290,24 @@ class DetectionService:
     def slo_burning(self) -> List[str]:
         return self.slo_report()["burning"]
 
+    def quality_report(self) -> Dict:
+        """The ``GET /quality`` surface (``telemetry.quality``): every
+        scored tenant's quality row — pick totals, SNR percentiles,
+        per-signal drift verdicts — plus the drifting list the
+        ``/readyz`` detail embeds. Same records as ``quality.json`` and
+        ``trace_report --quality``, by construction (one observatory)."""
+        from ..telemetry import quality as tquality
+
+        return tquality.OBSERVATORY.snapshot(tenants=list(self.tenants))
+
+    def quality_drifting(self) -> List[str]:
+        """The drifting names alone — ``/readyz`` polls this, so it
+        reads one flag per tenant instead of building the full
+        snapshot (SNR-tail sorts and all) per probe."""
+        from ..telemetry import quality as tquality
+
+        return tquality.OBSERVATORY.drifting_tenants(list(self.tenants))
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "DetectionService":
@@ -344,6 +376,22 @@ class DetectionService:
                             self.config.outdir, "cost_cards.json"))
                     except OSError:
                         pass   # the drain outcome wins
+                from ..telemetry import quality as tquality
+
+                if tquality.enabled():
+                    try:
+                        # the quality observatory's durable artifact,
+                        # next to cost_cards.json (docs/SERVICE.md)
+                        tquality.export_json(
+                            os.path.join(self.config.outdir,
+                                         "quality.json"),
+                            tenants=list(self.tenants),
+                        )
+                    except Exception:  # noqa: BLE001 — decorative export:
+                        # the drain outcome (and _drained below) wins,
+                        # same hardening as the campaign's _flush_quality
+                        log.debug("quality export failed at drain",
+                                  exc_info=True)
                 self._drained.set()
         return {name: t.result() for name, t in self.tenants.items()}
 

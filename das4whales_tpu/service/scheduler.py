@@ -47,6 +47,7 @@ from .. import faults
 from ..parallel.dispatch import PipelinedDispatch, resolve_watchdogged
 from ..telemetry import costs as tcosts
 from ..telemetry import metrics, trace as telemetry
+from ..telemetry import quality as tquality
 from ..telemetry import slo as tslo
 from ..utils import locks
 from ..utils.log import get_logger
@@ -136,6 +137,14 @@ class TenantRuntime:
         # name IS the manifest/retry/artifact identity key, so two
         # pushes must never collide (a timestamp can, within one ms)
         self._live_seq = itertools.count()
+        # science-quality observatory (ISSUE 15, telemetry.quality):
+        # when armed (ServiceConfig.quality / DAS_QUALITY), this
+        # tenant's serving lifetime gets a FRESH drift baseline — one
+        # tenant's regime change flips only its own das_quality_drift
+        # (the SLO isolation contract, verbatim); None = one attribute
+        # check per settled file
+        self.quality = (tquality.OBSERVATORY.fresh(spec.name)
+                        if tquality.enabled() else None)
 
     def next_live_name(self) -> str:
         return f"{self.name}-live-{next(self._live_seq)}"
@@ -229,6 +238,13 @@ class TenantRuntime:
             return {"tenant": self.name, "target_s": None,
                     "state": "ok", "burn_rates": {}}
         return self.slo.snapshot()
+
+    def quality_snapshot(self) -> Optional[Dict]:
+        """This tenant's ``/quality`` row (None when the observatory is
+        not armed — the ``/tenants`` block then reads ``null``)."""
+        if self.quality is None:
+            return None
+        return self.quality.snapshot()
 
     # -- detection side (the batch campaign's per-slab contract) -----------
 
@@ -675,6 +691,13 @@ class TenantRuntime:
                     )
                     _c_files.inc(tenant=self.name, status="done")
                     self._note_pick_settled(path)
+                    if self.quality is not None:
+                        # the campaign's exact derivation, under this
+                        # tenant's own label/baseline
+                        camp._observe_quality(
+                            self.name, bdet.det, path, picks, thresholds,
+                            stats, slab.n_real[k],
+                        )
                     if file_recovered:
                         self.rz.tally("oom_recoveries")
                 except camp.CampaignAborted:
@@ -734,6 +757,7 @@ class TenantRuntime:
                       for k, r in rungs.items()},
             "deficit_msamples": round(deficit, 3),
             "slo": self.slo_snapshot(),
+            "quality": self.quality_snapshot(),
         }
 
 

@@ -33,12 +33,21 @@ Two device-truth surfaces ride on top (ISSUE 14):
   ingest→pick-settled freshness, error budgets, multi-window burn
   rates (the service's ``/slo`` surface).
 
+And one science-truth surface (ISSUE 15):
+
+* :mod:`~das4whales_tpu.telemetry.quality` — the science-quality
+  observatory: pick-stream counters/SNR histograms, fused per-channel
+  health gauges, and per-tenant EWMA drift baselines with hysteresis
+  warn states (``/quality``, ``quality.json``) — fed entirely from the
+  detection program's one packed fetch, never touching readiness,
+  scheduling, or picks.
+
 Import discipline: this package (and everything it imports at module
 level) is pure stdlib — ``faults`` imports it at package init, and the
 disabled-mode fast path must never pay a jax import.
 """
 
-from . import costs, metrics, probes, progress, slo, trace  # noqa: F401
+from . import costs, metrics, probes, progress, quality, slo, trace  # noqa: F401
 from .metrics import (  # noqa: F401
     REGISTRY,
     counter,

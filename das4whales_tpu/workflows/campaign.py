@@ -45,6 +45,7 @@ from ..models.matched_filter import MatchedFilterDetector
 from ..telemetry import costs as tcosts
 from ..telemetry import metrics as tmetrics
 from ..telemetry import probes as tprobes
+from ..telemetry import quality as tquality
 from ..telemetry import trace as telemetry
 from ..utils.log import get_logger
 
@@ -63,6 +64,10 @@ _g_preflight_hwm = tmetrics.gauge(
 )
 
 MANIFEST = "manifest.jsonl"
+
+#: the quality observatory's tenant label for (single-stream) campaign
+#: runs — the service uses real tenant names (service/scheduler.py)
+QUALITY_TENANT = "campaign"
 
 #: statuses that disposition a file for good — resume skips them (a
 #: quarantined file is deterministically unhealthy; re-reading it every
@@ -435,11 +440,20 @@ def run_campaign(
     dispatch_deadline_s: float | None = None,
     dispatch_depth: int | None = None,
     trace: bool | None = None,
+    quality: bool | None = None,
     fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
     """Detect over ``files``, tolerating per-file failures and resuming
     past completed work.
+
+    ``quality`` (None: the ``DAS_QUALITY`` env default) arms the
+    science-quality observatory exactly like
+    :func:`run_campaign_batched` — per-file quality records from the
+    already-fetched payload, a manifest ``quality`` event and
+    ``<outdir>/quality.json`` at campaign end; picks bit-identical and
+    zero extra compiles/dispatches either way (``telemetry.quality``,
+    docs/OBSERVABILITY.md).
 
     ``trace`` (None: the ``DAS_TRACE`` env default) arms the FLIGHT
     RECORDER (``das4whales_tpu.telemetry``): the campaign runs inside a
@@ -498,6 +512,9 @@ def run_campaign(
 
     if dispatch_deadline_s is None:
         dispatch_deadline_s = dispatch_deadline_default()
+    use_quality = tquality.resolve_enabled(quality)
+    if use_quality:
+        tquality.OBSERVATORY.fresh(QUALITY_TENANT)
 
     det_wire = getattr(detector, "wire", "conditioned")
     if detector is not None and det_wire != wire:
@@ -595,6 +612,10 @@ def run_campaign(
         _append_manifest(outdir, rec)
         records.append(rec)
         tprobes.note_file_ok()   # healthy file: readiness quarantine streak resets
+        if use_quality:
+            _observe_quality(QUALITY_TENANT, detector, path, picks,
+                             thresholds, stats,
+                             np.asarray(block.trace).shape[-1])
 
     from ..parallel.dispatch import PipelinedDispatch
 
@@ -680,6 +701,8 @@ def run_campaign(
             del stream
         drain_pipe()   # end of segment: the one remaining sync
         rz.flush_tallies()
+        if use_quality:
+            _flush_quality(outdir, [QUALITY_TENANT])
     return CampaignResult(outdir=outdir, records=records)
 
 
@@ -708,10 +731,23 @@ def run_campaign_batched(
     dispatch_depth: int | None = None,
     trace: bool | None = None,
     cost_cards: bool | None = None,
+    quality: bool | None = None,
     fault_plan=None,
     **detector_kwargs,
 ) -> CampaignResult:
     """Single-chip BATCHED campaign: ``batch`` files per program step.
+
+    ``quality`` (None: the ``DAS_QUALITY`` env default) arms the
+    SCIENCE-QUALITY OBSERVATORY (``telemetry.quality``, ISSUE 15):
+    every done file feeds the pick-stream counters/SNR histograms, the
+    per-channel health gauges and the EWMA drift baselines — derived
+    entirely from the packed fetch the campaign already pays — and the
+    run ends with a manifest ``quality`` event plus
+    ``<outdir>/quality.json`` next to the manifest
+    (``scripts/trace_report.py --quality`` renders it). Picks are
+    bit-identical either way and compile_guard pins zero extra
+    compiles/dispatches: the observatory only READS fetched values;
+    disabled, every hook is one attribute check.
 
     ``trace`` (None: the ``DAS_TRACE`` env default) arms the FLIGHT
     RECORDER exactly like :func:`run_campaign`: a root campaign span,
@@ -820,6 +856,11 @@ def run_campaign_batched(
     if preflight is None:
         preflight = memory_preflight_default()
     use_costs = tcosts.resolve_enabled(cost_cards)
+    use_quality = tquality.resolve_enabled(quality)
+    if use_quality:
+        # one campaign run = one drift baseline: never inherit a
+        # previous run's regime (telemetry.quality.fresh)
+        tquality.OBSERVATORY.fresh(QUALITY_TENANT)
     if persistent_cache:
         enable_persistent_compilation_cache(
             persistent_cache if isinstance(persistent_cache, str) else None
@@ -1251,6 +1292,9 @@ def run_campaign_batched(
                         family=bdet.family,
                         rung=faults.rung_label(exec_rung),
                     )
+                    if use_quality:
+                        _observe_quality(QUALITY_TENANT, det, path, picks,
+                                         thresholds, stats, slab.n_real[k])
                     if file_recovered:
                         rz.tally("oom_recoveries")
                 except Exception as exc:  # noqa: BLE001 — per-file isolation
@@ -1389,6 +1433,8 @@ def run_campaign_batched(
                 tcosts.export_json(os.path.join(outdir, "cost_cards.json"))
             except OSError:
                 pass   # the campaign outcome wins
+        if use_quality:
+            _flush_quality(outdir, [QUALITY_TENANT])
     return CampaignResult(outdir=outdir, records=records)
 
 
@@ -1498,6 +1544,50 @@ def _probe_healthy(pairs, interrogator, fail, expect_shape=None, rz=None):
                     fail(path, exc)
             break
     return healthy, spec0
+
+
+def _observe_quality(tenant, det, path, picks, thresholds, stats,
+                     n_time_samples) -> None:
+    """Feed the science-quality observatory one done file
+    (``telemetry.quality``, ISSUE 15): the record is derived entirely
+    from the artifacts already in hand — pick counts, the fetched
+    thresholds (whose base recovers the envelope peak), and the fused
+    health stats. Shared by the batched/per-file campaigns and the
+    service scheduler (one derivation, every route). Decorative by
+    contract: a telemetry failure must never cost the file record."""
+    try:
+        design = getattr(det, "design", None)
+        fs = float(getattr(design, "fs", 0.0) or 0.0) or float(
+            getattr(getattr(det, "metadata", None), "fs", 0.0) or 0.0
+        )
+        tquality.OBSERVATORY.observe(tenant, tquality.file_quality(
+            path=path, picks=picks, thresholds=thresholds, stats=stats,
+            duration_s=(float(n_time_samples) / fs if fs else None),
+            thr_factors=tquality.threshold_factor_map(design),
+            thr_scope=str(getattr(det, "threshold_scope", "global")),
+        ))
+    except Exception:  # noqa: BLE001 — observability never costs a record
+        log.debug("quality observe failed for %s", path, exc_info=True)
+
+
+def _flush_quality(outdir: str, tenants) -> None:
+    """End-of-run quality surfaces: one manifest ``quality`` event
+    (summary rows — the ledger analog of the ``counters`` event) and
+    the durable ``quality.json`` next to the manifest (the same records
+    ``GET /quality`` and ``trace_report --quality`` render)."""
+    try:
+        snap = tquality.OBSERVATORY.snapshot(tenants=tenants)
+        if not snap["tenants"]:
+            return
+        _append_event(outdir, {"event": "quality",
+                               "tenants": snap["tenants"],
+                               "drifting": snap["drifting"]})
+        tquality.export_json(os.path.join(outdir, "quality.json"),
+                             tenants=tenants)
+    except OSError:
+        pass   # the campaign outcome wins
+    except Exception:  # noqa: BLE001 — decorative surfaces only
+        log.debug("quality flush failed for %s", outdir, exc_info=True)
 
 
 def _file_record(outdir, path, picks, thresholds, wall_s, records,

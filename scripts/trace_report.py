@@ -18,12 +18,20 @@ manifest (``run_campaign*(trace=True)`` / ``DAS_TRACE=1`` →
   walls (``<outdir>/cost_cards.json``, written by a
   ``cost_cards=True`` campaign/service), as a share-of-roofline
   column sorted furthest-from-peak first, so a trace answers "which
-  stage is furthest from peak" directly.
+  stage is furthest from peak" directly;
+* with ``--quality``: the science-quality observatory's export
+  (ISSUE 15, ``<outdir>/quality.json`` — written by a
+  ``quality=True`` campaign / ``ServiceConfig.quality`` service) as
+  per-tenant quality tables (files, picks, rate, noise floor, dead
+  fraction, SNR percentiles, drift verdicts), the drift-transition
+  timeline, and the per-file tail — the SAME records ``GET /quality``
+  serves, rendered offline.
 
 Usage::
 
     python scripts/trace_report.py OUTDIR            # human tables
     python scripts/trace_report.py OUTDIR --costs    # + roofline shares
+    python scripts/trace_report.py OUTDIR --quality  # + quality tables
     python scripts/trace_report.py OUTDIR --json     # machine payload
 
 Pure stdlib — no jax import, safe anywhere the artifacts are.
@@ -180,8 +188,19 @@ def cost_share_table(events: List[Dict], cost_payload: Dict) -> List[Dict]:
     return rows
 
 
+def load_quality(outdir: str, path: str | None = None) -> Dict | None:
+    """The quality observatory's export (``quality.json``), or None."""
+    path = path or os.path.join(outdir, "quality.json")
+    try:
+        with open(path) as fh:
+            payload = json.load(fh)
+        return payload if isinstance(payload, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def build_report(outdir: str, trace_path: str | None = None,
-                 costs: bool = False) -> Dict:
+                 costs: bool = False, quality: bool = False) -> Dict:
     trace_path = trace_path or os.path.join(outdir, "trace.json")
     events = load_trace(trace_path) if os.path.exists(trace_path) else []
     manifest = load_manifest(os.path.join(outdir, "manifest.jsonl"))
@@ -206,7 +225,63 @@ def build_report(outdir: str, trace_path: str | None = None,
         report["cost_share"] = (cost_share_table(events, payload)
                                 if payload else None)
         report["cost_cards"] = payload
+    if quality:
+        report["quality"] = load_quality(outdir)
     return report
+
+
+def print_quality(payload: Dict) -> None:
+    """Render the quality export: per-tenant summary rows, the drift
+    timeline, and each tenant's per-file tail (newest last, capped)."""
+    print("\n  science quality per tenant (telemetry.quality):")
+    print(f"    {'tenant':<12s} {'files':>6s} {'picks':>7s} "
+          f"{'rate/s':>8s} {'noise rms':>10s} {'dead':>6s} "
+          f"{'snr p50':>8s} {'snr p95':>8s}  drift")
+    for row in payload.get("tenants", []):
+        drift = row.get("drift", {})
+        verdicts = ",".join(
+            f"{sig}:{d.get('state', '?')}" for sig, d in sorted(drift.items())
+        ) or "-"
+
+        def num(v, fmt):
+            return format(v, fmt) if isinstance(v, (int, float)) else "-"
+
+        print(f"    {row.get('tenant', '?'):<12s} "
+              f"{row.get('n_files', 0):>6d} {row.get('n_picks', 0):>7d} "
+              f"{num(row.get('pick_rate_hz'), '>8.3f')} "
+              f"{num(row.get('noise_floor_rms'), '>10.4g')} "
+              f"{num(row.get('dead_frac'), '>6.3f')} "
+              f"{num(row.get('snr_db_p50'), '>8.2f')} "
+              f"{num(row.get('snr_db_p95'), '>8.2f')}  {verdicts}")
+    drifting = payload.get("drifting", [])
+    if drifting:
+        print(f"    DRIFTING: {', '.join(drifting)}")
+    for row in payload.get("tenants", []):
+        transitions = row.get("transitions", [])
+        if transitions:
+            print(f"\n  drift timeline [{row.get('tenant', '?')}]:")
+            for ev in transitions:
+                print(f"    file #{ev.get('seq')}  {ev.get('signal')}: "
+                      f"{ev.get('from')} -> {ev.get('to')} "
+                      f"(value {ev.get('value')}, baseline "
+                      f"{ev.get('mean')})  {ev.get('path', '')}")
+        files = row.get("files", [])
+        if files:
+            print(f"\n  per-file quality [{row.get('tenant', '?')}] "
+                  f"(last {min(len(files), 10)} of {len(files)}):")
+            for f in files[-10:]:
+                drift = f.get("drift", {})
+                warn = [s for s, st in drift.items() if st == "warn"]
+                # str-coerce before width-formatting: a truncated or
+                # foreign-schema row (missing seq/counts) must degrade
+                # to "None", never TypeError the whole forensic report
+                print(f"    #{str(f.get('seq', '?')):<4} "
+                      f"picks={str(f.get('n_picks_total', '?')):<5} "
+                      f"rate={f.get('pick_rate_hz')} "
+                      f"rms={f.get('noise_floor_rms')} "
+                      f"dead={f.get('dead_frac')}"
+                      + (f"  WARN[{','.join(warn)}]" if warn else "")
+                      + f"  {os.path.basename(str(f.get('path', '')))}")
 
 
 def print_report(rep: Dict) -> None:
@@ -258,6 +333,11 @@ def print_report(rep: Dict) -> None:
     elif "cost_share" in rep:
         print("\n  (no cost_cards.json next to the manifest — run the "
               "campaign/service with cost_cards=True / DAS_COST_CARDS=1)")
+    if rep.get("quality"):
+        print_quality(rep["quality"])
+    elif "quality" in rep:
+        print("\n  (no quality.json next to the manifest — run the "
+              "campaign/service with quality=True / DAS_QUALITY=1)")
 
 
 def main(argv=None) -> int:
@@ -272,8 +352,13 @@ def main(argv=None) -> int:
                     help="merge cost-card roofline predictions into a "
                          "per-rung share-of-roofline table "
                          "(<outdir>/cost_cards.json)")
+    ap.add_argument("--quality", action="store_true",
+                    help="render the science-quality observatory export "
+                         "(<outdir>/quality.json): per-tenant quality "
+                         "tables with drift timelines")
     args = ap.parse_args(argv)
-    rep = build_report(args.outdir, args.trace, costs=args.costs)
+    rep = build_report(args.outdir, args.trace, costs=args.costs,
+                       quality=args.quality)
     if args.json:
         json.dump(rep, sys.stdout, indent=2)
         print()

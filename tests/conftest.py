@@ -38,6 +38,20 @@ from das4whales_tpu.analysis.pytest_plugin import (  # noqa: F401
 )
 
 
+def load_script(name):
+    """Import a top-level ``scripts/<name>.py`` by path — THE one script
+    loader (test_costs/test_quality both render reports through it; the
+    scripts are deliberately not package modules)."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

@@ -25,7 +25,6 @@ Contracts pinned here:
 
 from __future__ import annotations
 
-import importlib.util
 import json
 import os
 
@@ -40,15 +39,9 @@ from tests.conftest import CHAOS_SEL
 
 SEL = CHAOS_SEL
 
-_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _load_script(name):
-    spec = importlib.util.spec_from_file_location(
-        name, os.path.join(_ROOT, "scripts", f"{name}.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+from tests.conftest import load_script as _load_script  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

@@ -174,10 +174,18 @@ def test_long_record_gabor_strided_selection(campaign):
         assert pk.shape[1] == 0 or pk[0].max() < 8
 
 
+@pytest.mark.slow
 def test_long_record_gabor_family(campaign):
     """family='gabor': the time-sharded image pipeline runs end-to-end on
     a multi-file record (capability smoke; single-channel calls give the
-    oriented Gabor pair little moveout structure to lock onto)."""
+    oriented Gabor pair little moveout structure to lock onto).
+
+    Slow lane (tier-1 wall, ISSUE 15 satellite — move, not delete): the
+    ~25 s full-pipeline smoke rides ``slow``; the quick lane keeps the
+    gabor-family longrecord path covered via
+    ``test_long_record_gabor_strided_selection`` (same
+    ``detect_long_record(family="gabor")`` step, a fraction of the
+    wall)."""
     paths, _ = campaign
     res = detect_long_record(
         paths, [0, NX, 1], family="gabor",

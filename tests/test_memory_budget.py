@@ -142,12 +142,17 @@ def sharded_canonical():
     return design, mesh, c8
 
 
-# the 'full' variant rides the slow lane (ISSUE 12 wall headroom —
-# coverage moved, not deleted): 'picks' is the campaign-mode pin the
-# docstring calls the point, and it alone keeps the canonical design
-# build + per-shard budget assertion in tier-1
+# BOTH variants ride the slow lane (coverage moved, not deleted —
+# verified green standalone). History: ISSUE 12 moved 'full' and kept
+# 'picks' in tier-1; by ISSUE 15 the quick lane's wall (~850-950 s
+# across machine-weather hours) straddled the fixed 870 s driver
+# budget and the gate TIMED OUT intermittently regardless of tree —
+# and this test's ~150 s canonical f-k design build (sharded_canonical,
+# this fixture's ONLY consumer) was the single largest tier-1 item by
+# 6x. A gate that times out enforces nothing; the per-shard budget pin
+# enforces more from the slow lane than from a flaky quick lane.
 @pytest.mark.parametrize("outputs,out_cap_gib", [
-    ("picks", 1 / 32),
+    pytest.param("picks", 1 / 32, marks=pytest.mark.slow),
     pytest.param("full", 1.0, marks=pytest.mark.slow),
 ])
 def test_sharded_step_per_shard_budget(sharded_canonical, outputs, out_cap_gib):

@@ -91,6 +91,23 @@ def test_every_registered_metric_is_documented():
     )
 
 
+def test_quality_metric_family_gated_both_directions():
+    """ISSUE 15 satellite: the new das_quality_* / das_picks_* /
+    das_pick_* registrations are inside the gate's universe — present
+    in the static scan AND in the docs table, so the generic
+    both-direction tests above actually cover them."""
+    need = {
+        "das_picks_total", "das_quality_files_total", "das_pick_snr_db",
+        "das_file_picks", "das_pick_rate_hz",
+        "das_channel_dead_fraction", "das_noise_floor_rms",
+        "das_quality_drift",
+    }
+    registered = _registered_names()
+    documented = _documented_names()
+    assert need <= registered, sorted(need - registered)
+    assert need <= documented, sorted(need - documented)
+
+
 def test_every_documented_metric_is_registered():
     stale = _documented_names() - _registered_names()
     assert not stale, (

@@ -16,7 +16,11 @@ import jax.numpy as jnp
 from das4whales_tpu.ops import peaks as peak_ops
 
 
-def _signals():
+def _signals(start: int = 0, stop: int = 60):
+    """The deterministic signal schedule; ``start``/``stop`` slice it so
+    a quick-lane test and its slow-lane extension split ONE schedule
+    (the PR 11 move-not-delete pattern — same signals, same seeds,
+    nothing dropped)."""
     rng = np.random.default_rng(2024)
     lengths = (16, 64, 128, 384)   # fixed shapes -> 4 jit compiles total
     for k in range(60):
@@ -35,7 +39,8 @@ def _signals():
                 x[i : i + int(rng.integers(1, 5))] = rng.uniform(0.5, 2.0)
         else:                  # plain noise
             x = rng.standard_normal(n)
-        yield k, x.astype(np.float32)
+        if start <= k < stop:
+            yield k, x.astype(np.float32)
 
 
 def test_local_maxima_exact_scipy_parity_fuzz():
@@ -47,10 +52,8 @@ def test_local_maxima_exact_scipy_parity_fuzz():
         np.testing.assert_array_equal(got, want, err_msg=f"signal {k}")
 
 
-def test_find_peaks_sparse_matches_scipy_fuzz():
-    """On nonnegative signals, the sparse route equals
-    scipy.find_peaks(prominence=thr) whenever capacity suffices."""
-    for k, x in _signals():
+def _sparse_scipy_drill(start: int, stop: int) -> None:
+    for k, x in _signals(start, stop):
         env = np.abs(x)
         thr = float(np.quantile(env, 0.7)) + 1e-3
         want = sp.find_peaks(env, prominence=thr)[0]
@@ -60,6 +63,22 @@ def test_find_peaks_sparse_matches_scipy_fuzz():
         assert not bool(np.asarray(res.saturated).any())
         got = res.positions[0][np.asarray(res.selected[0])]
         np.testing.assert_array_equal(np.asarray(got), want, err_msg=f"signal {k}")
+
+
+def test_find_peaks_sparse_matches_scipy_fuzz():
+    """On nonnegative signals, the sparse route equals
+    scipy.find_peaks(prominence=thr) whenever capacity suffices.
+    Quick lane runs the schedule's first 24 signals (every kind × every
+    length appears); the remainder rides the slow extension below —
+    this test's per-signal ``max_peaks=len`` compiles made it the fuzz
+    module's one tier-1 outlier (ISSUE 15 satellite wall note)."""
+    _sparse_scipy_drill(0, 24)
+
+
+@pytest.mark.slow
+def test_find_peaks_sparse_matches_scipy_fuzz_extended():
+    """Signals 24..60 of the SAME schedule (move, not delete)."""
+    _sparse_scipy_drill(24, 60)
 
 
 def test_pack_method_matches_scipy_fuzz():

@@ -1,0 +1,451 @@
+"""Science-quality observatory tests (ISSUE 15, telemetry/quality.py).
+
+Coverage map:
+
+* the fused per-channel-bin health profile (``ops.health``): device ==
+  host bin counts, fault localization, back-compat scalar keys, and the
+  quarantine verdict naming the offending channel-bin range;
+* the quality derivation (``file_quality``): envelope-peak recovery
+  from the fetched threshold (``thr = REL * peak * factor``) into the
+  SNR proxy — the constant mirror is equality-pinned;
+* EWMA drift baselines: warmup, hysteresis enter/exit, single spikes
+  never warn, outliers don't poison the baseline;
+* the observatory registry + export, and the acceptance contract that
+  ``quality.json``, the observatory snapshot, and
+  ``trace_report --quality`` all render from the same records;
+* THE acceptance drill: quality on vs off is picks-bit-identical with
+  zero extra compiles (compile_guard) and zero extra dispatches on
+  every route — file / tiled / batched B∈{1,2}.
+
+All campaign tests ride the session-scoped [24 x 900] chaos fixtures
+(conftest) so compiled programs are shared across modules — the tier-1
+wall pays for these shapes once.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from das4whales_tpu.config import DataHealthConfig  # noqa: E402
+from das4whales_tpu.ops import health as health_ops  # noqa: E402
+from das4whales_tpu.telemetry import metrics as tmetrics  # noqa: E402
+from das4whales_tpu.telemetry import quality  # noqa: E402
+from das4whales_tpu.workflows.campaign import (  # noqa: E402
+    QUALITY_TENANT,
+    load_picks,
+    run_campaign,
+    run_campaign_batched,
+)
+from tests.conftest import CHAOS_N_FILES, CHAOS_SEL, load_script  # noqa: E402
+
+SEL = CHAOS_SEL
+_load_script = load_script
+
+
+# ---------------------------------------------------------------------------
+# Per-channel-bin health profile (ops.health)
+# ---------------------------------------------------------------------------
+
+
+def test_rel_threshold_mirrors_detector_constant():
+    """telemetry.quality must never drift from the detector's in-graph
+    threshold rule it inverts (the costs/roofline mirror pattern)."""
+    from das4whales_tpu.models.matched_filter import REL_THRESHOLD
+
+    assert quality.REL_THRESHOLD == REL_THRESHOLD
+
+
+def test_channel_bins_layout():
+    assert health_ops.channel_bins(22050) == (254, 87)   # canonical scale
+    assert health_ops.channel_bins(8) == (8, 1)          # C < N_BINS
+    for c in (1, 7, 24, 255, 256, 257, 1000, 22050):
+        nb, per = health_ops.channel_bins(c)
+        assert nb * per >= c, (c, nb, per)
+        assert (nb - 1) * per < c, "last bin must hold >= 1 real channel"
+        assert nb <= health_ops.N_BINS
+
+
+def test_health_profile_locates_faults_device_matches_host():
+    """A dead channel, a NaN-poisoned channel and a clipping channel
+    land in THEIR bins; the jnp and numpy paths agree exactly on counts
+    (the shared _element_stats definition); scalar back-compat keys are
+    unchanged; the dict is manifest-JSON-safe."""
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((24, 300)).astype(np.float32)
+    x[3] = 0.0          # dead channel -> bin 3
+    x[5, :4] = np.nan   # poisoned -> bin 5
+    x[7, :9] = 99.0     # clipped -> bin 7
+    outs = health_ops.health_stats_profiled(jnp.asarray(x), 50.0)
+    c, r, bc, br = (np.asarray(a) for a in outs)
+    dev = health_ops.stats_to_dict(c, r, x.size, bin_counts=bc, bin_rms=br,
+                                   n_channels=24)
+    host = health_ops.host_health_stats(x, clip_abs=50.0)
+    for key in ("nonfinite", "clipped", "n_samples", "bin_nonfinite",
+                "bin_clipped", "bin_dead", "dead_channels", "n_bins",
+                "bin_channels"):
+        assert dev[key] == host[key], key
+    np.testing.assert_allclose(dev["bin_rms"], host["bin_rms"], rtol=1e-5)
+    assert dev["bin_dead"][3] == 1 and sum(dev["bin_dead"]) == 1
+    assert dev["bin_nonfinite"][5] == 4 and dev["nonfinite"] == 4
+    assert dev["bin_clipped"][7] == 9 and dev["clipped"] == 9
+    assert dev["dead_frac"] == pytest.approx(1 / 24)
+    # scalar half identical to the pre-profile definition
+    c0, r0 = health_ops.health_stats(jnp.asarray(x), 50.0)
+    assert np.array_equal(np.asarray(c0), c)
+    # NaN rms (the poisoned block's breach signal) on both paths
+    np.testing.assert_array_equal(float(r0), float(r))
+    json.dumps(dev)   # the manifest writer serializes this verbatim
+
+
+def test_health_profile_n_real_masks_pad():
+    """Bucket padding dilutes neither the bin rms nor the dead verdict:
+    a channel whose REAL samples are all zero is dead even when the
+    (zero) pad region dominates."""
+    x = np.zeros((4, 100), np.float32)
+    x[:2, :50] = 2.0                     # live channels, real half only
+    x[2:, :] = np.nan                    # poisoned channels
+    x[2:, 50:] = np.nan                  # (pad region poison is masked)
+    bc, br = (np.asarray(a) for a in health_ops.health_profile(
+        jnp.asarray(x), np.inf, n_real=jnp.int32(50)))
+    assert bc[0, 0] == 0 and bc[1, 0] == 0        # no nonfinite in live
+    assert bc[2, 0] == 50 and bc[3, 0] == 50      # real-half NaNs only
+    np.testing.assert_allclose(br[:2], 2.0, rtol=1e-6)
+    assert bc[0, 2] == 0, "a live channel is not dead"
+
+
+def test_breach_names_offending_channel_bin_range():
+    x = np.full((24, 200), 3.0, np.float32)
+    x[10:12] = 0.0                                # dead span: bins 10-11
+    stats = health_ops.host_health_stats(x)
+    msg = DataHealthConfig(min_rms=1.0).breach(dict(stats, rms=0.5))
+    assert "below min_rms" in msg
+    assert "worst channel bin 10" in msg and "channels 10-10" in msg
+    # pre-profile stats dicts (old manifests) keep the bare message
+    bare = {"nonfinite": 0, "clip_frac": 0.0, "rms": 0.5}
+    assert "worst channel bin" not in DataHealthConfig(
+        min_rms=1.0).breach(bare)
+    # the clip direction names the clipping bin
+    x2 = np.full((24, 200), 1.0, np.float32)
+    x2[20] = 99.0
+    stats2 = health_ops.host_health_stats(x2, clip_abs=50.0)
+    msg2 = DataHealthConfig(clip_abs=50.0, max_clip_frac=0.01).breach(stats2)
+    assert "worst channel bin 20" in msg2
+
+
+# ---------------------------------------------------------------------------
+# file_quality: the zero-cost derivation
+# ---------------------------------------------------------------------------
+
+
+def test_file_quality_recovers_envelope_peak():
+    """thr = REL * peak * factor is inverted exactly: the SNR proxy
+    comes out as the constructed peak says it must — and NO
+    peak-over-threshold margin is emitted (it would cancel to the
+    constant -20*log10(REL*factor): zero signal, review finding)."""
+    peak, fac, rms = 8.0, 0.9, 0.25
+    thr = quality.REL_THRESHOLD * peak * fac
+    rec = quality.file_quality(
+        "f.h5", {"HF": np.zeros((2, 5), np.int64)}, {"HF": thr},
+        {"rms": rms, "dead_frac": 0.0}, duration_s=4.5,
+        thr_factors={"HF": fac},
+    )
+    assert rec["n_picks"] == {"HF": 5} and rec["n_picks_total"] == 5
+    assert rec["pick_rate_hz"] == pytest.approx(5 / 4.5)
+    assert rec["snr_db"]["HF"] == pytest.approx(
+        20 * math.log10(peak / rms), abs=1e-3)
+    assert "prominence_db" not in rec
+    # a template with zero picks contributes no SNR sample
+    rec2 = quality.file_quality("f.h5", {"HF": np.zeros((2, 0))},
+                                {"HF": thr}, {"rms": rms})
+    assert rec2["snr_db"] == {} and rec2["n_picks_total"] == 0
+    # NaN thresholds (families without threshold metadata) are skipped
+    rec3 = quality.file_quality("f.h5", {"HF": np.zeros((2, 3))},
+                                {"HF": float("nan")}, {"rms": rms},
+                                duration_s=2.0)
+    assert rec3["snr_db"] == {} and rec3["pick_rate_hz"] == 1.5
+
+
+# ---------------------------------------------------------------------------
+# Drift baselines: EWMA + hysteresis
+# ---------------------------------------------------------------------------
+
+_POLICY = quality.DriftPolicy(alpha=0.2, warmup=4, enter_sigma=3.0,
+                              exit_sigma=1.5, enter_consecutive=2,
+                              exit_consecutive=3)
+
+
+def test_drift_baseline_warmup_and_hysteresis():
+    bl = quality.DriftBaseline(_POLICY)
+    for _ in range(6):
+        assert bl.observe(1.0) == "ok"        # steady baseline
+    assert bl.observe(50.0) == "ok"           # streak 1 < enter_consecutive
+    assert bl.observe(50.0) == "warn"         # streak 2 -> warn
+    assert bl.state == "warn"
+    # exit needs exit_consecutive files back inside exit_sigma
+    assert bl.observe(1.0) == "warn"
+    assert bl.observe(1.0) == "warn"
+    assert bl.observe(1.0) == "ok"            # 3rd quiet file clears
+    assert bl._enter_streak == 0
+
+
+def test_drift_single_spike_never_warns_and_does_not_poison():
+    bl = quality.DriftBaseline(_POLICY)
+    for _ in range(8):
+        bl.observe(1.0)
+    mean_before = bl.mean
+    assert bl.observe(100.0) == "ok", "one outlier is not a regime"
+    # outliers fold at alpha/8: the baseline barely moves
+    assert abs(bl.mean - mean_before) < _POLICY.alpha * 99.0 / 4
+    assert bl.observe(1.0) == "ok"
+    assert bl._enter_streak == 0, "a quiet file resets the enter streak"
+
+
+def test_drift_warmup_never_judges():
+    bl = quality.DriftBaseline(_POLICY)
+    assert bl.observe(1.0) == "ok"
+    for v in (100.0, 0.001, 55.0):            # wild warmup values
+        assert bl.observe(v) == "ok"
+    assert bl.n == 4 and bl.state == "ok"
+
+
+# ---------------------------------------------------------------------------
+# The observatory registry + export
+# ---------------------------------------------------------------------------
+
+
+def _rec(path, n=3, rms=0.2):
+    thr = quality.REL_THRESHOLD * 4.0
+    return quality.file_quality(path, {"HF": np.zeros((2, n), np.int64)},
+                                {"HF": thr}, {"rms": rms, "dead_frac": 0.0},
+                                duration_s=2.0)
+
+
+def test_observatory_snapshot_filtering_and_fresh(tmp_path):
+    obs = quality.QualityObservatory()
+    for k in range(3):
+        obs.observe("das-test-ta", _rec(f"a{k}.h5"))
+    obs.observe("das-test-tb", _rec("b0.h5", n=1))
+    snap = obs.snapshot()
+    assert {r["tenant"] for r in snap["tenants"]} == {"das-test-ta",
+                                                      "das-test-tb"}
+    only_b = obs.snapshot(tenants=["das-test-tb", "absent"])
+    assert [r["tenant"] for r in only_b["tenants"]] == ["das-test-tb"]
+    row = next(r for r in snap["tenants"] if r["tenant"] == "das-test-ta")
+    assert row["n_files"] == 3 and row["n_picks"] == 9
+    assert row["snr_db_p50"] is not None
+    assert set(row["drift"]) == set(quality.DRIFT_SIGNALS)
+    # "enabled" reports the observatory was ACTIVE for these rows even
+    # when only a per-run quality=True armed it (process switch off) —
+    # an export with scored rows must never read as disabled
+    assert not quality.enabled()
+    assert snap["enabled"] is True
+    assert quality.QualityObservatory().snapshot()["enabled"] is False
+    # the cheap probe-path form agrees with the snapshot's drifting list
+    assert obs.drifting_tenants() == snap["drifting"]
+    # fresh() replaces the baseline (a new run never inherits a regime)
+    # AND zeroes the drift gauges — a prior lifetime's warn=1 must not
+    # keep paging /metrics into a run whose fresh baseline says ok
+    drift_g = tmetrics.REGISTRY.gauge("das_quality_drift",
+                                      labelnames=("tenant", "signal"))
+    drift_g.set(1.0, tenant="das-test-ta", signal="noise_floor")
+    assert obs.fresh("das-test-ta").snapshot()["n_files"] == 0
+    for sig in quality.DRIFT_SIGNALS:
+        assert drift_g.value(tenant="das-test-ta", signal=sig) == 0.0
+    # export -> payload parity, file tails included
+    p = str(tmp_path / "q.json")
+    saved = obs  # module-level export reads OBSERVATORY; test the payload
+    payload = saved.payload(tenants=["das-test-tb"])
+    with open(p, "w") as fh:
+        json.dump(payload, fh)
+    with open(p) as fh:
+        loaded = json.load(fh)
+    assert loaded["tenants"][0]["files"][0]["path"] == "b0.h5"
+
+
+def test_quality_gauges_survive_strain_scale_values():
+    """round(x, 6)-style display must not zero out strain-wire signals
+    (~1e-11): the sig-digit rounding keeps them."""
+    tq = quality.TenantQuality("das-test-strain")
+    tq.observe(_rec("s.h5", rms=6.8e-11))
+    g = tmetrics.REGISTRY.gauge("das_noise_floor_rms",
+                                labelnames=("tenant",))
+    assert g.value(tenant="das-test-strain") == pytest.approx(6.8e-11)
+    snap = tq.snapshot()
+    assert snap["noise_floor_rms"] == pytest.approx(6.8e-11)
+
+
+def test_enabled_switch_and_resolution(monkeypatch):
+    assert quality.resolve_enabled(True) is True
+    assert quality.resolve_enabled(False) is False
+    was = quality.enabled()
+    try:
+        quality.enable()
+        assert quality.resolve_enabled(None) is True
+        quality.disable()
+        assert quality.resolve_enabled(None) is False
+    finally:
+        (quality.enable if was else quality.disable)()
+
+
+# ---------------------------------------------------------------------------
+# Campaign acceptance: surfaces + the on/off contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def quality_run(chaos_file_set, tmp_path_factory):
+    """ONE batched campaign with the observatory armed, shared by the
+    surface tests below (the session chaos shapes keep compiles shared
+    across modules)."""
+    out = str(tmp_path_factory.mktemp("qualrun") / "camp")
+    res = run_campaign_batched(chaos_file_set, SEL, out, batch=2,
+                               bucket="exact", persistent_cache=False,
+                               quality=True)
+    return out, res
+
+
+def test_campaign_quality_event_export_and_metrics(quality_run):
+    out, res = quality_run
+    assert res.n_done == CHAOS_N_FILES and res.n_failed == 0
+    # the durable artifact next to the manifest
+    with open(os.path.join(out, "quality.json")) as fh:
+        payload = json.load(fh)
+    row = payload["tenants"][0]
+    assert row["tenant"] == QUALITY_TENANT
+    assert row["n_files"] == CHAOS_N_FILES and row["n_picks"] > 0
+    assert len(row["files"]) == CHAOS_N_FILES
+    assert row["drifting"] is False and payload["drifting"] == []
+    # every done record carries the per-bin profile the observatory read
+    for rec in res.records:
+        assert rec.health["n_bins"] >= 1
+        assert len(rec.health["bin_rms"]) == rec.health["n_bins"]
+    # manifest quality event (the ledger analog of the counters event)
+    events = []
+    with open(os.path.join(out, "manifest.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("event") == "quality":
+                events.append(rec)
+    assert len(events) == 1 and events[0]["drifting"] == []
+    # the labeled metrics moved
+    assert tmetrics.REGISTRY.counter(
+        "das_quality_files_total", labelnames=("tenant",),
+    ).value(tenant=QUALITY_TENANT) >= CHAOS_N_FILES
+    picks_total = sum(
+        v for (tenant, _t), v in tmetrics.REGISTRY.counter(
+            "das_picks_total", labelnames=("tenant", "template"),
+        ).values().items() if tenant == QUALITY_TENANT
+    )
+    assert picks_total >= row["n_picks"]
+    drift_g = tmetrics.REGISTRY.gauge(
+        "das_quality_drift", labelnames=("tenant", "signal"))
+    for sig in quality.DRIFT_SIGNALS:
+        assert drift_g.value(tenant=QUALITY_TENANT, signal=sig) == 0.0
+
+
+def test_quality_json_snapshot_and_trace_report_agree(quality_run, capsys):
+    """Acceptance: quality.json, the live observatory snapshot, and
+    trace_report --quality all render from the same records."""
+    out, _ = quality_run
+    with open(os.path.join(out, "quality.json")) as fh:
+        exported = json.load(fh)
+    live = quality.OBSERVATORY.snapshot(tenants=[QUALITY_TENANT])
+    exp_row, live_row = exported["tenants"][0], live["tenants"][0]
+    for key in ("tenant", "n_files", "n_picks", "snr_db_p50",
+                "snr_db_p95", "drifting"):
+        assert exp_row[key] == live_row[key], key
+    tr = _load_script("trace_report")
+    rep = tr.build_report(out, quality=True)
+    assert rep["quality"]["tenants"][0]["n_files"] == exp_row["n_files"]
+    tr.print_report(rep)
+    text = capsys.readouterr().out
+    assert "science quality per tenant" in text
+    assert QUALITY_TENANT in text
+    # --quality against a dir without the export says so
+    rep_none = tr.build_report(out + "-nowhere", quality=True)
+    assert rep_none["quality"] is None
+    tr.print_report(rep_none)
+    assert "no quality.json" in capsys.readouterr().out
+
+
+def _picks_of(res):
+    return {r.path: load_picks(r.picks_file)
+            for r in res.records if r.status == "done"}
+
+
+def _assert_same_picks(a, b):
+    assert set(a) == set(b) and a
+    for path, ref in a.items():
+        got = b[path]
+        assert set(got) == set(ref)
+        for name in ref:
+            np.testing.assert_array_equal(got[name], ref[name])
+
+
+def test_quality_on_off_bit_identical_zero_extra_compiles_all_routes(
+        chaos_file_set, chaos_detector, chaos_fault_free, compile_guard,
+        tmp_path):
+    """THE acceptance drill: with the observatory ON, every route's
+    picks are bit-identical to the OFF run, under compile_guard (zero
+    extra compiles) — and the batched route's dispatch/sync counters
+    are exactly the OFF run's (zero extra dispatches). Routes: per-file
+    (session-warmed), batched B∈{1,2}, and the forced channel-tiled
+    detector."""
+    # batched:2 — off (warm) then on (guarded), dispatch-count parity
+    before = tmetrics.resilience_counters()
+    res_off = run_campaign_batched(chaos_file_set, SEL,
+                                   str(tmp_path / "b2-off"), batch=2,
+                                   bucket="exact", persistent_cache=False)
+    delta_off = tmetrics.resilience_delta(before)
+    before = tmetrics.resilience_counters()
+    with compile_guard.forbid_recompile(
+            "quality=True batched campaign at a warmed (bucket, B)"):
+        res_on = run_campaign_batched(chaos_file_set, SEL,
+                                      str(tmp_path / "b2-on"), batch=2,
+                                      bucket="exact",
+                                      persistent_cache=False, quality=True)
+    delta_on = tmetrics.resilience_delta(before)
+    _assert_same_picks(_picks_of(res_off), _picks_of(res_on))
+    assert delta_on["dispatches"] == delta_off["dispatches"]
+    assert delta_on["syncs"] == delta_off["syncs"]
+
+    # batched:1 (the per-file padded route — warmed by the session
+    # fault-free oracle) straight under the guard, vs that oracle
+    with compile_guard.forbid_recompile("quality=True batched:1"):
+        res_b1 = run_campaign_batched(chaos_file_set, SEL,
+                                      str(tmp_path / "b1-on"), batch=1,
+                                      bucket="exact",
+                                      persistent_cache=False, quality=True)
+    _assert_same_picks(chaos_fault_free, _picks_of(res_b1))
+
+    # per-file route with the session detector, vs the same oracle
+    with compile_guard.forbid_recompile("quality=True per-file campaign"):
+        res_file = run_campaign(chaos_file_set, SEL,
+                                str(tmp_path / "file-on"),
+                                detector=chaos_detector, quality=True)
+    _assert_same_picks(chaos_fault_free, _picks_of(res_file))
+    # ... and the per-file RUNNER exports the same surfaces as the
+    # batched one (one run serves both assertions — tier-1 wall)
+    with open(str(tmp_path / "file-on" / "quality.json")) as fh:
+        payload = json.load(fh)
+    assert payload["tenants"][0]["n_files"] == CHAOS_N_FILES
+    assert res_file.records[0].health["n_bins"] >= 1
+
+    # forced channel-tiled detector: off (warms the tiled program) then
+    # on under the guard — tiled picks are bit-identical to the
+    # monolithic route by the repo's cross-route contract
+    tiled = chaos_detector.tiled_view()
+    res_t_off = run_campaign(chaos_file_set, SEL, str(tmp_path / "t-off"),
+                             detector=tiled)
+    with compile_guard.forbid_recompile("quality=True tiled campaign"):
+        res_t_on = run_campaign(chaos_file_set, SEL, str(tmp_path / "t-on"),
+                                detector=tiled, quality=True)
+    _assert_same_picks(_picks_of(res_t_off), _picks_of(res_t_on))
+    _assert_same_picks(chaos_fault_free, _picks_of(res_t_on))

@@ -286,6 +286,81 @@ def test_help_and_type_lines_for_cost_and_slo_metrics():
         assert help_line and len(help_line) > len(f"# HELP {name} ")
 
 
+def test_help_and_type_lines_for_quality_metrics():
+    """Every ISSUE 15 quality metric ships HELP+TYPE at registration,
+    with the right kind (both directions of the docs drift gate lean
+    on these names — tests/test_observability_docs.py)."""
+    from das4whales_tpu.telemetry import quality  # noqa: F401 — register
+
+    text = metrics.prometheus_text()
+    for name, kind in (
+        ("das_picks_total", "counter"),
+        ("das_quality_files_total", "counter"),
+        ("das_pick_snr_db", "histogram"),
+        ("das_file_picks", "histogram"),
+        ("das_pick_rate_hz", "gauge"),
+        ("das_channel_dead_fraction", "gauge"),
+        ("das_noise_floor_rms", "gauge"),
+        ("das_quality_drift", "gauge"),
+    ):
+        assert f"# TYPE {name} {kind}" in text
+        help_line = next((l for l in text.splitlines()
+                          if l.startswith(f"# HELP {name} ")), None)
+        assert help_line and len(help_line) > len(f"# HELP {name} ")
+
+
+def test_quality_snr_histogram_negative_and_overflow_exposition():
+    """The SNR histogram's NEGATIVE first bound and its overflow both
+    obey the scrape-side invariants: samples at and below the first
+    bound land in its le="-20.0" bucket, a 300 dB sample lands only in
+    +Inf, +Inf == _count, and cumulative buckets are non-decreasing
+    (the das_quality_* exposition pin the ISSUE 15 satellite asks for)."""
+    from das4whales_tpu.telemetry import quality  # noqa: F401 — register
+
+    h = metrics.REGISTRY.histogram("das_pick_snr_db",
+                                   labelnames=("tenant",))
+    for v in (-25.0, -20.0, 15.0, 300.0):
+        h.observe(v, tenant="das-test-snr")
+    text = metrics.prometheus_text()
+    buckets = {}
+    total = None
+    for line in text.splitlines():
+        if 'tenant="das-test-snr"' not in line:
+            continue
+        if line.startswith("das_pick_snr_db_bucket"):
+            le = line.split('le="')[1].split('"')[0]
+            buckets[le] = int(line.rsplit(" ", 1)[1])
+        elif line.startswith("das_pick_snr_db_count"):
+            total = int(line.rsplit(" ", 1)[1])
+    assert buckets["-20.0"] == 2          # -25 and the exact -20 edge
+    assert buckets["20.0"] == 3           # +15 dB
+    assert buckets["240.0"] == 3          # 300 dB is past every bound
+    assert buckets["+Inf"] == total == 4
+    cumulative = [buckets[k] for k in sorted(buckets,
+                                             key=lambda s: float("inf")
+                                             if s == "+Inf" else float(s))]
+    assert cumulative == sorted(cumulative)
+
+
+def test_quality_drift_gauge_label_exposition():
+    """das_quality_drift renders one sample per (tenant, signal) with
+    escaped label values — the /metrics surface the two-tenant
+    isolation drill reads."""
+    from das4whales_tpu.telemetry import quality
+
+    g = metrics.REGISTRY.gauge("das_quality_drift",
+                               labelnames=("tenant", "signal"))
+    g.set(1.0, tenant='das-test"q', signal="noise_floor")
+    g.set(0.0, tenant="das-test-ok", signal="noise_floor")
+    text = metrics.prometheus_text()
+    assert ('das_quality_drift{tenant="das-test\\"q",'
+            'signal="noise_floor"} 1.0') in text
+    assert ('das_quality_drift{tenant="das-test-ok",'
+            'signal="noise_floor"} 0.0') in text
+    assert set(quality.DRIFT_SIGNALS) == {"pick_rate", "noise_floor",
+                                          "dead_frac"}
+
+
 # ---------------------------------------------------------------------------
 # Probes: the liveness/readiness truth table
 # ---------------------------------------------------------------------------
