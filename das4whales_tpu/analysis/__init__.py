@@ -1,26 +1,36 @@
 """das4whales_tpu.analysis — JAX/TPU hazard analysis for this codebase.
 
-Two halves, one invariant ("compiled once, on device, in the intended
+Three halves, one invariant ("compiled once, on device, in the intended
 dtype" — docs/STATIC_ANALYSIS.md):
 
-* **Static** (:mod:`.rules`, :mod:`.baseline`): an AST linter with rules
-  R1–R5 over the repo's JAX idioms, gated against a checked-in
-  ``baseline.toml``. CLI: ``python -m das4whales_tpu.analysis``.
-* **Runtime** (:mod:`.runtime`, :mod:`.pytest_plugin`): a compile-count
-  guard over hot entry points, wired into tier-1 via the
-  ``compile_guard`` fixture.
+* **Static** (:mod:`.rules`, :mod:`.concurrency`, :mod:`.baseline`): an
+  AST linter with rules R1–R11 over the repo's JAX and threading
+  idioms, gated against a checked-in ``baseline.toml``. CLI: ``python
+  -m das4whales_tpu.analysis``.
+* **Program** (:mod:`.programs`): the R11–R13 contract lint over the
+  jaxpr/HLO of compiled program variants, captured at the AOT
+  ``lower().compile()`` boundary the memory preflight and cost cards
+  share — zero extra compiles. CLI: ``--programs`` /
+  ``--write-contracts``; snapshot: ``contracts.json``.
+* **Runtime** (:mod:`.runtime`, :mod:`.concurrency_runtime`,
+  :mod:`.pytest_plugin`): compile-count, seeded-interleaving, and
+  retrace-forensics guards wired into tier-1 via the
+  ``compile_guard`` / ``race_guard`` / ``retrace_guard`` fixtures.
 
 This module stays importable without a working JAX backend (the static
 half is pure stdlib); :mod:`.runtime` touches ``jax.monitoring`` only on
-first use.
+first use and :mod:`.programs` imports jax only to compile the canonical
+audit variants.
 """
 
 from .baseline import apply as apply_baseline  # noqa: F401
 from .baseline import dump as dump_baseline  # noqa: F401
 from .baseline import load as load_baseline  # noqa: F401
+from .baseline import stale_keys as stale_baseline_keys  # noqa: F401
 from .rules import (  # noqa: F401
     ALL_RULES,
     FLOAT64_DESIGN_ALLOWLIST,
+    PROGRAM_RULES,
     Finding,
     analyze_file,
     analyze_paths,

@@ -21,13 +21,13 @@ import os
 import sys
 
 from . import DEFAULT_BASELINE, baseline as baseline_mod
-from .rules import ALL_RULES, analyze_paths
+from .rules import ALL_RULES, PROGRAM_RULES, analyze_paths
 
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m das4whales_tpu.analysis",
-        description="daslint: JAX/TPU hazard analyzer (rules R1-R5; see "
+        description="daslint: JAX/TPU hazard analyzer (rules R1-R13; see "
                     "docs/STATIC_ANALYSIS.md)",
     )
     ap.add_argument("paths", nargs="*",
@@ -47,7 +47,16 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--check", action="store_true",
                     help="lint-gate mode (the default behavior, spelled "
                          "explicitly for CI entry points); also prints a "
-                         "summary line")
+                         "summary line and fails on stale baseline entries")
+    ap.add_argument("--programs", action="store_true",
+                    help="also run the R11-R13 program-contract audit over "
+                         "the canonical compiled variants (imports jax, one "
+                         "AOT compile per variant — the full-gate path; "
+                         "omitted by the --changed AST-only fast path)")
+    ap.add_argument("--write-contracts", action="store_true",
+                    help="regenerate analysis/contracts.json (the R13 "
+                         "op-count snapshot) from the canonical variants "
+                         "and exit 0")
     return ap
 
 
@@ -60,10 +69,34 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    if args.write_contracts:
+        from . import programs as programs_mod
+
+        import jax  # deferred: the AST paths never pay this import
+
+        artifacts = programs_mod.canonical_artifacts()
+        snap = programs_mod.build_contracts(
+            artifacts, backend=jax.default_backend(), jax_version=jax.__version__)
+        with open(programs_mod.DEFAULT_CONTRACTS, "w", encoding="utf-8") as fh:
+            fh.write(programs_mod.dump_contracts(snap))
+        print(f"wrote {programs_mod.DEFAULT_CONTRACTS} "
+              f"({len(snap['programs'])} program contracts)", file=sys.stderr)
+        return 0
+
     paths = args.paths or [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
     findings = analyze_paths(paths, rules)
     syntax_errors = [f for f in findings if f.rule == "E0"]
     findings = [f for f in findings if f.rule != "E0"]
+
+    program_rules = tuple(r for r in rules if r in PROGRAM_RULES)
+    if args.programs and program_rules:
+        # the jax-importing half: audit the canonical compiled variants
+        # (one AOT compile each; the audit itself is pure text). The
+        # --changed fast path never passes --programs — documented in
+        # scripts/lint.py and docs/STATIC_ANALYSIS.md.
+        from . import programs as programs_mod
+
+        findings += programs_mod.audit_canonical(program_rules)
 
     baseline_path = args.baseline or DEFAULT_BASELINE
     if args.write_baseline:
@@ -93,6 +126,7 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 0
 
+    stale = []
     if args.no_baseline or not os.path.exists(baseline_path):
         new, suppressed = findings, []
         new = sorted(new, key=lambda f: (f.path, f.line, f.col))
@@ -103,20 +137,38 @@ def main(argv=None) -> int:
             print(f"daslint: {exc}", file=sys.stderr)
             return 2
         new, suppressed = baseline_mod.apply(findings, bl)
+        if args.check:
+            # stale-ledger gate (ISSUE 16 satellite): a baselined key
+            # with no live finding site is a fixed hazard whose entry
+            # can silently mask its return. Scoped to what THIS run
+            # scanned — a --changed/--rules subset judges nothing else.
+            from .rules import canonical_path, iter_python_files
+
+            scanned = {canonical_path(p) for p in iter_python_files(paths)}
+            if args.programs:
+                scanned |= {path for (_r, path, _s) in bl
+                            if path.startswith("program:")}
+            stale = baseline_mod.stale_keys(
+                findings, bl, scanned_paths=scanned, rules=rules)
+            for rule, path, symbol in stale:
+                print(f"{path}: stale baseline entry (remove me): {rule} "
+                      f"for symbol `{symbol}` no longer matches any "
+                      "finding site")
 
     new = syntax_errors + new
     if args.as_json:
         print(json.dumps({
             "findings": [vars(f) for f in new],
             "suppressed": len(suppressed),
+            "stale": [list(k) for k in stale],
         }, indent=1))
     else:
         for f in new:
             print(f.format())
     if args.check or not args.as_json:
         print(f"daslint: {len(new)} finding(s), {len(suppressed)} baselined, "
-              f"rules {','.join(rules)}", file=sys.stderr)
-    return 1 if new else 0
+              f"{len(stale)} stale, rules {','.join(rules)}", file=sys.stderr)
+    return 1 if new or stale else 0
 
 
 if __name__ == "__main__":

@@ -138,6 +138,32 @@ def reasons_of(path) -> Dict[Key, str]:
     return out
 
 
+def stale_keys(findings: List[Finding], baseline: Dict[Key, int], *,
+               scanned_paths: Iterable[str] | None = None,
+               rules: Iterable[str] | None = None) -> List[Key]:
+    """Baselined keys with NO matching live finding site — entries whose
+    hazard was fixed but whose ledger line rotted in place, silently
+    able to mask the hazard's return (ISSUE 16 satellite). Scoped to
+    what this invocation actually saw: a key outside ``scanned_paths``
+    or ``rules`` is unjudgeable in a partial scan (``--changed``, a
+    ``--rules`` subset) and never reported. Program-audit keys
+    (``path="program:<bucket>"``) are judged only when a program path
+    was scanned — i.e. the invocation ran the ``--programs`` audit."""
+    live = {f.key() for f in findings}
+    scanned = None if scanned_paths is None else set(scanned_paths)
+    rule_set = None if rules is None else set(rules)
+    out: List[Key] = []
+    for key in baseline:
+        rule, path, _symbol = key
+        if rule_set is not None and rule not in rule_set:
+            continue
+        if scanned is not None and path not in scanned:
+            continue
+        if key not in live:
+            out.append(key)
+    return sorted(out)
+
+
 def apply(findings: List[Finding], baseline: Dict[Key, int]):
     """Split findings into (new, suppressed) against the baseline.
 

@@ -1,0 +1,639 @@
+"""Program-contract lint: rules R11-R13 over compiled programs (ISSUE 16).
+
+The third half of the analyzer pair. R1-R10 lint *source*; this module
+lints the *lowered program* — the jaxpr and compiled HLO captured at the
+same AOT ``lower().compile()`` boundary the memory preflight and cost
+cards already cross (``utils.memory._batched_program_spec``), so the
+audit prices the exact programs production runs and costs zero extra
+compiles (compile_guard-pinned in tests/test_programs.py).
+
+Three rule families, composed with the R1-R10 plumbing (``--rules``,
+inline ``allow[]`` for the AST half, ``baseline.toml`` for both):
+
+* **R11 dtype-contract** — no f64/c128 ops in a non-f64-wire program, no
+  bf16 outside the content-gated matmul engine (docs/PRECISION.md,
+  machine-checked per compiled variant); plus an AST sibling catching
+  raw f64 builtin dtypes and matmul/contraction calls without
+  ``preferred_element_type`` in ``ops/``.
+* **R12 donation-effectiveness** — every donated operand must appear in
+  the executable's ``input_output_alias`` table; a silently-undonated
+  slab doubles HBM footprint and falsifies the preflight's admission
+  math, so the finding reports the delta against the priced peak.
+* **R13 program-hygiene** — no host callbacks, no f64 transcendentals,
+  and a per-(bucket, rung, engine) ceiling on ``convert``/``transpose``/
+  ``copy`` ops gated against the checked-in ``analysis/contracts.json``
+  snapshot, so dtype-churn regressions fail tier-1 instead of landing
+  silently.
+
+Plus the runtime half: :func:`retrace_guard`, the forensic sibling of
+``runtime.max_compiles`` — on a ceiling breach it names WHICH watched
+argument signature changed (shape / dtype / weak-type / static hash)
+instead of reporting a bare compile count.
+
+Stdlib-only at import (like ``rules``/``concurrency``); jax is imported
+only inside :func:`canonical_artifacts` / the guard helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from .rules import FLOAT64_DESIGN_ALLOWLIST, Finding, _Imports, _in_scope
+
+__all__ = [
+    "CANONICAL_SHAPE", "CANONICAL_VARIANTS", "CONTRACT_OPS",
+    "DEFAULT_CONTRACTS", "ProgramArtifact", "RetraceError", "RetraceGuard",
+    "alias_param_numbers", "analyze", "audit_canonical", "audit_program",
+    "build_contracts", "canonical_artifacts", "contract_ceiling",
+    "contract_key", "dump_contracts", "hlo_op_counts", "load_contracts",
+    "retrace_guard", "signature_diff",
+]
+
+# ---------------------------------------------------------------------------
+# R11 — AST half (what source CAN prove: the call spelled the contract)
+# ---------------------------------------------------------------------------
+
+#: R11's AST sibling is scoped to the kernel library: ``ops/`` is where
+#: contractions are written; everywhere else consumes them.
+_R11_SCOPE = frozenset({"ops"})
+
+#: contraction entry points whose MXU output dtype floats with the input
+#: dtype unless pinned: on TPU a bf16-input dot without
+#: ``preferred_element_type`` accumulates in bf16 (docs/PRECISION.md).
+_CONTRACTION_CALLS = frozenset({
+    "jax.numpy.dot", "jax.numpy.matmul", "jax.numpy.einsum",
+    "jax.numpy.tensordot", "jax.lax.dot", "jax.lax.dot_general",
+    "jax.lax.conv_general_dilated", "jax.lax.conv",
+})
+
+#: ``dtype=float`` / ``dtype=complex`` resolve to float64/complex128 in
+#: numpy — the raw-literal spelling R3's explicit-reference scan misses.
+_BUILTIN_F64_DTYPES = {"float": "float64", "complex": "complex128"}
+
+
+class _ProgramAstPass(ast.NodeVisitor):
+    """R11's source-level checks (run from ``rules.analyze_source``)."""
+
+    def __init__(self, path: str, imports: _Imports):
+        self.path = path
+        self.imports = imports
+        self.findings: List[Finding] = []
+        self._stack: List[str] = []
+
+    def _symbol(self) -> str:
+        return ".".join(self._stack) if self._stack else "<module>"
+
+    def _emit(self, code: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            rule="R11", code=code, path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            symbol=self._symbol(), message=message,
+        ))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = self.imports.resolve(node.func)
+        kw_names = {kw.arg for kw in node.keywords}
+        if dotted in _CONTRACTION_CALLS and "preferred_element_type" not in kw_names:
+            self._emit(
+                "matmul-no-preferred-dtype", node,
+                f"`{dotted.split('.', 1)[1]}` without preferred_element_type "
+                "— a bf16-input contraction accumulates in bf16 on the MXU; "
+                "pin the accumulator dtype (docs/PRECISION.md)",
+            )
+        for kw in node.keywords:
+            if (kw.arg == "dtype" and isinstance(kw.value, ast.Name)
+                    and kw.value.id in _BUILTIN_F64_DTYPES
+                    and not self._design_allowed()):
+                self._emit(
+                    "builtin-f64-dtype", kw.value,
+                    f"dtype={kw.value.id} is "
+                    f"{_BUILTIN_F64_DTYPES[kw.value.id]} on every backend — "
+                    "spell the 32-bit dtype explicitly",
+                )
+        self.generic_visit(node)
+
+    def _design_allowed(self) -> bool:
+        """Host-side f64 *design* files (the R3 allowlist) keep their
+        documented double-precision contract for the raw-literal
+        spellings too."""
+        for suffix, fn in FLOAT64_DESIGN_ALLOWLIST:
+            if self.path.endswith(suffix) and (fn == "*" or fn in self._stack):
+                return True
+        return False
+
+
+def analyze(tree: ast.Module, path: str, lines: Sequence[str],
+            rules: Sequence[str]) -> List[Finding]:
+    """R11's AST half, entered from ``rules.analyze_source`` exactly like
+    ``concurrency.analyze`` (inline ``allow[]`` filtering happens in the
+    caller). The HLO half lives in :func:`audit_program` — source cannot
+    see what XLA lowered, only what the call site promised."""
+    if "R11" not in rules or not _in_scope(path, _R11_SCOPE):
+        return []
+    ast_pass = _ProgramAstPass(path, _Imports(tree))
+    ast_pass.visit(tree)
+    return ast_pass.findings
+
+
+# ---------------------------------------------------------------------------
+# Compiled-program artifacts (captured at the AOT boundary, audited here)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ProgramArtifact:
+    """One compiled program variant's auditable record: the IR text pair
+    from the preflight/cost-card compile plus the identity labels the
+    contract snapshot keys on. ``donated`` lists the flattened parameter
+    indices the jit spec donated (empty: R12 is vacuous); ``donated_bytes``
+    is their total size — the HBM the donation claims to save."""
+
+    bucket: str                    # costs.bucket_label spelling, "CxN/dtype"
+    label: str                     # rung label, e.g. "batched:1"
+    engine: str                    # "mf+fk" engine pair, e.g. "fft+matmul"
+    wire_dtype: str                # the slab dtype the program ingests
+    jaxpr_text: str
+    hlo_text: str
+    donated: Tuple[int, ...] = ()
+    donated_bytes: int = 0
+    peak_bytes: int = 0            # the cost card's priced peak (temps+outputs)
+
+    @property
+    def key(self) -> str:
+        return contract_key(self.bucket, self.label, self.engine)
+
+
+#: the op-count families the R13 contract snapshot pins: each is pure
+#: data movement/dtype churn — growth means a layout or precision
+#: regression crept into the lowering.
+CONTRACT_OPS: Tuple[str, ...] = ("convert", "transpose", "copy")
+
+#: HLO opcodes allowed to carry a bf16-typed result inside the gated
+#: matmul engine: the convert fences plus the contraction itself and
+#: layout/plumbing ops between them. Anything else (an add, an exp, a
+#: reduce) means bf16 escaped the gate into general arithmetic.
+_BF16_ALLOWED_OPS = frozenset({
+    "bitcast", "broadcast", "concatenate", "constant", "convert",
+    "convolution", "copy", "dot", "dot-general", "fusion",
+    "get-tuple-element", "pad", "parameter", "reshape", "slice",
+    "transpose", "tuple",
+})
+
+#: f64 transcendentals R13 names individually (on TPU these lower to
+#: slow multi-pass expansions; on any backend they are evidence a
+#: whole pipeline stage silently promoted).
+_TRANSCENDENTALS = (
+    "atan2", "cosine", "exponential", "exponential-minus-one", "log",
+    "log-plus-one", "power", "rsqrt", "sine", "sqrt", "tanh",
+)
+
+#: jaxpr primitives / HLO custom-call markers that put host Python on
+#: the device-program path.
+_CALLBACK_MARKERS = ("pure_callback", "io_callback", "debug_callback",
+                     "python_callback")
+
+
+def _op_lines(hlo_text: str, op: str) -> List[str]:
+    pat = re.compile(r"=\s*\S+\s+%s\(" % re.escape(op))
+    return [ln for ln in hlo_text.splitlines() if pat.search(ln)]
+
+
+def hlo_op_counts(hlo_text: str,
+                  ops: Sequence[str] = CONTRACT_OPS) -> Dict[str, int]:
+    """Count HLO instructions by opcode (``= <shape> <op>(`` spelling)."""
+    return {op: len(_op_lines(hlo_text, op)) for op in ops}
+
+
+def alias_param_numbers(hlo_text: str) -> Set[int]:
+    """Parameter numbers appearing in the entry computation's
+    ``input_output_alias`` table (empty when XLA aliased nothing — the
+    R12 hazard). The table's value tuples are ``(param_number,
+    param_index, kind)``; braces nest, so scan for balance instead of
+    regexing the blob boundary."""
+    marker = "input_output_alias={"
+    i = hlo_text.find(marker)
+    if i < 0:
+        return set()
+    j = i + len("input_output_alias=")
+    depth, k = 0, j
+    while k < len(hlo_text):
+        ch = hlo_text[k]
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        k += 1
+    blob = hlo_text[j:k + 1]
+    return {int(m) for m in re.findall(r"\(\s*(\d+)\s*,", blob)}
+
+
+def _bf16_result_ops(hlo_text: str) -> Dict[str, int]:
+    """Opcode histogram of instructions with a bf16-typed result."""
+    pat = re.compile(r"^\s*(?:ROOT\s+)?\S+\s*=\s*\(?bf16\[[^\]]*\]\S*\s+(\S+)\(")
+    out: Dict[str, int] = {}
+    for ln in hlo_text.splitlines():
+        m = pat.match(ln)
+        if m:
+            out[m.group(1)] = out.get(m.group(1), 0) + 1
+    return out
+
+
+def _program_finding(art: ProgramArtifact, rule: str, code: str,
+                     message: str) -> Finding:
+    return Finding(
+        rule=rule, code=code, path=f"program:{art.bucket}", line=0, col=0,
+        symbol=f"{art.label}|{art.engine}", message=message,
+    )
+
+
+def audit_program(art: ProgramArtifact, *, snapshot: Dict | None = None,
+                  rules: Sequence[str] = ("R11", "R12", "R13"),
+                  ) -> List[Finding]:
+    """Audit one captured program against the R11-R13 contracts. Pure
+    text analysis over the artifact — zero compiles, callable from the
+    CLI, the cost observatory, and tests alike. Findings carry
+    ``path="program:<bucket>"`` / ``symbol="<rung>|<engine>"`` so
+    ``baseline.toml`` entries compose the same way they do for source
+    findings."""
+    findings: List[Finding] = []
+    hlo, jaxpr = art.hlo_text, art.jaxpr_text
+    f64_wire = art.wire_dtype in ("float64", "complex128")
+
+    if "R11" in rules:
+        if not f64_wire:
+            n64 = sum(ln.count("f64[") + ln.count("c128[")
+                      for ln in hlo.splitlines())
+            if n64:
+                findings.append(_program_finding(
+                    art, "R11", "f64-in-program",
+                    f"{n64} f64/c128-typed value(s) in the compiled HLO of a "
+                    f"{art.wire_dtype}-wire program — a host float or literal "
+                    "promoted a device stage to double (docs/PRECISION.md)",
+                ))
+        bf16_ops = _bf16_result_ops(hlo)
+        mf_engine = art.engine.split("+", 1)[0]
+        if bf16_ops and mf_engine != "matmul-bf16":
+            findings.append(_program_finding(
+                art, "R11", "bf16-outside-gate",
+                f"bf16-typed ops {sorted(bf16_ops)} in a {mf_engine}-engine "
+                "program — bf16 is licensed only inside the content-gated "
+                "matmul engine (ops.mxu.bf16_correlate_gate)",
+            ))
+        else:
+            escaped = sorted(set(bf16_ops) - _BF16_ALLOWED_OPS)
+            if escaped:
+                findings.append(_program_finding(
+                    art, "R11", "bf16-escaped-matmul",
+                    f"bf16-typed {escaped} outside the convert-fenced "
+                    "contraction — general arithmetic is running at bf16 "
+                    "precision, not just the gated matmul",
+                ))
+
+    if "R12" in rules and art.donated:
+        aliased = alias_param_numbers(hlo)
+        missing = sorted(set(art.donated) - aliased)
+        if missing:
+            mb = art.donated_bytes / 1e6
+            findings.append(_program_finding(
+                art, "R12", "donation-ineffective",
+                f"donated parameter(s) {missing} absent from the "
+                f"input_output_alias table — XLA kept the donated buffer(s) "
+                f"({mb:.1f} MB) live alongside the priced peak "
+                f"({art.peak_bytes / 1e6:.1f} MB); the preflight's admission "
+                "math assumes that memory was returned",
+            ))
+
+    if "R13" in rules:
+        cb = [m for m in _CALLBACK_MARKERS if m in jaxpr or m in hlo]
+        if cb or ("custom-call" in hlo and "callback" in hlo):
+            findings.append(_program_finding(
+                art, "R13", "host-callback-in-program",
+                f"host callback on the device-program path ({cb or ['custom-call']}) "
+                "— every dispatch round-trips through Python",
+            ))
+        if not f64_wire:
+            slow = [op for op in _TRANSCENDENTALS if _op_lines(hlo, op)
+                    and any("f64[" in ln for ln in _op_lines(hlo, op))]
+            if slow:
+                findings.append(_program_finding(
+                    art, "R13", "f64-transcendental",
+                    f"f64 transcendental(s) {slow} in a {art.wire_dtype}-wire "
+                    "program — multi-pass soft-float expansions on TPU",
+                ))
+        if snapshot is not None:
+            entry = (snapshot.get("programs") or {}).get(art.key)
+            if entry is not None:
+                counts = hlo_op_counts(hlo)
+                over = {op: (counts[op], contract_ceiling(int(entry.get(op, 0))))
+                        for op in CONTRACT_OPS
+                        if counts[op] > contract_ceiling(int(entry.get(op, 0)))}
+                if over:
+                    detail = ", ".join(
+                        f"{op}: {n} > ceiling {c} (snapshot {entry.get(op, 0)})"
+                        for op, (n, c) in sorted(over.items()))
+                    findings.append(_program_finding(
+                        art, "R13", "op-ceiling-exceeded",
+                        f"data-movement op count above the contract snapshot "
+                        f"({detail}) — dtype/layout churn regression, or an "
+                        "XLA upgrade moved the lowering (regenerate via "
+                        "--write-contracts after triage; docs/TPU_RUNBOOK.md)",
+                    ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# The contract snapshot (analysis/contracts.json, checked in)
+# ---------------------------------------------------------------------------
+
+DEFAULT_CONTRACTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "contracts.json")
+
+
+def contract_key(bucket: str, label: str, engine: str) -> str:
+    return f"{bucket}|{label}|{engine}"
+
+
+def contract_ceiling(snapshot_count: int) -> int:
+    """Allowed live count for a snapshotted op count: raw count plus
+    slack (max(4, 50%)) absorbing benign XLA lowering drift across
+    images — the snapshot stores RAW counts so regeneration is
+    deterministic (the round-trip test) and the slack policy can evolve
+    without rewriting the file."""
+    return snapshot_count + max(4, snapshot_count // 2)
+
+
+def load_contracts(path: str | None = None) -> Dict | None:
+    """The checked-in snapshot, or None when absent/unreadable (an
+    absent snapshot disables only the op-ceiling check — the other
+    audits carry no baseline state)."""
+    path = path or DEFAULT_CONTRACTS
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def build_contracts(artifacts: Iterable[ProgramArtifact], *,
+                    backend: str = "", jax_version: str = "") -> Dict:
+    """Regenerate the snapshot payload from live artifacts: raw
+    CONTRACT_OPS counts per program key plus provenance (which backend
+    and jaxlib produced these lowerings — the first triage question when
+    a new image trips the ceiling)."""
+    return {
+        "version": 1,
+        "backend": backend,
+        "jax": jax_version,
+        "ops": list(CONTRACT_OPS),
+        "programs": {a.key: hlo_op_counts(a.hlo_text)
+                     for a in sorted(artifacts, key=lambda a: a.key)},
+    }
+
+
+def dump_contracts(snapshot: Dict) -> str:
+    return json.dumps(snapshot, indent=1, sort_keys=True) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Canonical variants: the (family, rung, engine) set the tier-1 gate audits
+# ---------------------------------------------------------------------------
+
+#: the canonical audit scene (chaos scale: compiles in ~2 s/variant on CPU)
+CANONICAL_SHAPE = (24, 900)
+
+#: (mf_engine, fk_engine) pairs covering every engine family of the one
+#: program family (`mf`): the FFT route, both matmul routes, and the
+#: bf16 MXU route whose convert fencing R11 checks.
+CANONICAL_VARIANTS: Tuple[Tuple[str, str], ...] = (
+    ("fft", "fft"), ("matmul", "fft"), ("matmul-bf16", "fft"),
+    ("fft", "matmul"),
+)
+
+
+def canonical_artifacts(batch: int = 1, wire: str = "float32",
+                        variants: Sequence[Tuple[str, str]] = CANONICAL_VARIANTS,
+                        donate: bool = False) -> List[ProgramArtifact]:
+    """Compile (once each) and capture the canonical program-variant
+    set: the batched one-program family at ``CANONICAL_SHAPE`` per
+    engine pair. This is the jax-importing entry — the CLI driver and
+    the tier-1 gate share it, so they audit identical programs. One
+    compile per variant; the audit itself adds zero.
+
+    Captured under ``disable_x64`` regardless of the ambient flag: the
+    x64 mode changes the lowering (extra f64 converts), and the
+    contract snapshot must mean the same thing from the CLI (x64 off,
+    the production default) and from tier-1 (x64 on for golden-array
+    parity)."""
+    import contextlib
+
+    import numpy as np
+
+    from ..io.synth import SyntheticScene
+    from ..models.matched_filter import MatchedFilterDetector
+    from ..parallel.batch import BatchedMatchedFilterDetector
+    from ..telemetry.costs import bucket_label
+    from ..utils import memory as memutils
+
+    try:
+        from jax.experimental import disable_x64
+    except ImportError:  # older jax: capture in the ambient mode
+        disable_x64 = contextlib.nullcontext
+
+    nx, ns = CANONICAL_SHAPE
+    md = SyntheticScene(nx=nx, ns=ns).metadata
+    dtype = np.dtype(wire)
+    bucket = bucket_label((nx, ns, dtype.name))
+    out: List[ProgramArtifact] = []
+    with disable_x64():
+        for mf_engine, fk_engine in variants:
+            det = MatchedFilterDetector(
+                md, [0, nx, 1], (nx, ns), pick_mode="sparse",
+                keep_correlograms=False, mf_engine=mf_engine,
+                fk_engine=fk_engine,
+            )
+            bdet = BatchedMatchedFilterDetector(det, donate=False)
+            an = memutils.batched_program_analysis(
+                bdet, batch, dtype, capture_ir=True, donate=donate)
+            if an is None or an.hlo_text is None:
+                continue
+            out.append(ProgramArtifact(
+                bucket=bucket, label=f"batched:{batch}",
+                engine=f"{mf_engine}+{fk_engine}", wire_dtype=dtype.name,
+                jaxpr_text=an.jaxpr_text or "", hlo_text=an.hlo_text,
+                donated=(0,) if donate else (),
+                donated_bytes=int(batch * nx * ns * dtype.itemsize),
+                peak_bytes=int(an.memory.peak if an.memory else 0),
+            ))
+    return out
+
+
+def audit_canonical(rules: Sequence[str] = ("R11", "R12", "R13"), *,
+                    contracts_path: str | None = None,
+                    artifacts: Sequence[ProgramArtifact] | None = None,
+                    ) -> List[Finding]:
+    """The CLI/tier-1 program-audit driver: audit the canonical variant
+    set (or pre-captured ``artifacts``) against the checked-in
+    snapshot."""
+    snapshot = load_contracts(contracts_path)
+    arts = (canonical_artifacts() if artifacts is None else artifacts)
+    findings: List[Finding] = []
+    for art in arts:
+        findings += audit_program(art, snapshot=snapshot, rules=rules)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Retrace forensics: WHICH argument signature changed
+# ---------------------------------------------------------------------------
+
+
+class RetraceError(AssertionError):
+    """A watched region compiled past its ceiling; the message names the
+    argument signature diffs that provoked each retrace."""
+
+
+def _arg_signature(x) -> Tuple:
+    """Stable signature of one call argument, in jit-cache terms: arrays
+    by (shape, dtype, weak_type); Python scalars as weak-typed rank-0
+    entries (that IS their jit identity — the classic silent retrace);
+    everything else (statics) by hash, falling back to identity for
+    unhashables."""
+    if isinstance(x, (bool, int, float, complex)):
+        return ("array", (), f"weak-{type(x).__name__}", True)
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return ("array", tuple(x.shape), str(x.dtype),
+                bool(getattr(x, "weak_type", False)))
+    try:
+        return ("static", type(x).__name__, hash(x))
+    except TypeError:
+        return ("static", type(x).__name__, f"unhashable@{id(x):#x}")
+
+
+def _describe(sig: Tuple) -> str:
+    if sig[0] == "array":
+        _, shape, dtype, weak = sig
+        return f"{dtype}{list(shape)} weak_type={weak}"
+    return f"static {sig[1]} hash={sig[2]}"
+
+
+def signature_diff(prev: Dict[str, Tuple], cur: Dict[str, Tuple]) -> List[str]:
+    """Human-readable per-argument diff between two call signatures —
+    the forensic payload of :class:`RetraceError`."""
+    lines: List[str] = []
+    for name in sorted(set(prev) | set(cur)):
+        a, b = prev.get(name), cur.get(name)
+        if a == b:
+            continue
+        if a is None:
+            lines.append(f"{name}: new argument ({_describe(b)})")
+        elif b is None:
+            lines.append(f"{name}: argument removed (was {_describe(a)})")
+        elif a[0] == "array" and b[0] == "array":
+            parts = []
+            if a[1] != b[1]:
+                parts.append(f"shape {list(a[1])} -> {list(b[1])}")
+            if a[2] != b[2]:
+                parts.append(f"dtype {a[2]} -> {b[2]}")
+            if a[3] != b[3]:
+                parts.append(f"weak_type {a[3]} -> {b[3]}")
+            lines.append(f"{name}: " + ", ".join(parts))
+        elif a[0] == "static" and b[0] == "static":
+            lines.append(f"{name}: static value changed "
+                         f"({a[1]} hash {a[2]} -> {b[1]} hash {b[2]})")
+        else:
+            lines.append(f"{name}: {_describe(a)} -> {_describe(b)}")
+    return lines
+
+
+class _Watched:
+    """Callable wrapper recording per-call argument signatures and the
+    compiles each call triggered."""
+
+    def __init__(self, guard: "RetraceGuard", fn, what: str):
+        self._guard = guard
+        self._fn = fn
+        self.what = what
+
+    def __call__(self, *args, **kwargs):
+        from . import runtime
+
+        sig = {f"arg[{i}]": _arg_signature(a) for i, a in enumerate(args)}
+        sig.update({f"kwarg[{k}]": _arg_signature(v)
+                    for k, v in sorted(kwargs.items())})
+        before = runtime.compile_count()
+        out = self._fn(*args, **kwargs)
+        self._guard._note(self.what, sig, runtime.compile_count() - before)
+        return out
+
+
+class RetraceGuard:
+    """Context manager: ``with retrace_guard(1, what="detect") as g:``
+    then call ``g.watch(fn)(...)`` wrappers inside the block. On exit,
+    more than ``ceiling`` compiles raises :class:`RetraceError` whose
+    message carries the signature diff of every compiling watched call
+    after its first — shape/dtype/weak-type/static-hash, by argument."""
+
+    def __init__(self, ceiling: int, what: str = "guarded region"):
+        self.ceiling = int(ceiling)
+        self.what = what
+        self.forensics: List[Tuple[str, List[str]]] = []
+        self._last: Dict[str, Dict[str, Tuple]] = {}
+        self._start = 0
+
+    def watch(self, fn, what: str | None = None) -> _Watched:
+        return _Watched(self, fn, what or getattr(fn, "__name__", self.what))
+
+    def _note(self, what: str, sig: Dict[str, Tuple], compiled: int) -> None:
+        prev = self._last.get(what)
+        if compiled and prev is not None:
+            diff = signature_diff(prev, sig) or [
+                "no watched argument changed — the retrace came from "
+                "inside (a fresh jit wrapper per call, or an unwatched "
+                "closure input)"]
+            self.forensics.append((what, diff))
+        self._last[what] = sig
+
+    def __enter__(self) -> "RetraceGuard":
+        from . import runtime
+
+        runtime.install()
+        self._start = runtime.compile_count()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            return
+        from . import runtime
+
+        compiled = runtime.compile_count() - self._start
+        if compiled <= self.ceiling:
+            return
+        report = "\n".join(
+            f"  {what}: " + "; ".join(diff) for what, diff in self.forensics
+        ) or "  (no watched call retraced — compiles came from unwatched code)"
+        raise RetraceError(
+            f"{self.what}: {compiled} XLA compiles, ceiling {self.ceiling} "
+            f"— argument signature changes:\n{report}\n"
+            "See docs/STATIC_ANALYSIS.md#the-program-contract-gate."
+        )
+
+
+def retrace_guard(ceiling: int, what: str = "guarded region") -> RetraceGuard:
+    """Factory form matching ``runtime.max_compiles``'s signature (the
+    ``retrace_guard`` pytest fixture returns this function)."""
+    return RetraceGuard(ceiling, what=what)

@@ -37,6 +37,19 @@ def compile_guard() -> CompileGuard:
 
 
 @pytest.fixture
+def retrace_guard():
+    """Retrace forensics (the who-changed sibling of ``compile_guard``):
+    ``with retrace_guard(1, what="detect") as g:`` then call through
+    ``g.watch(fn)`` wrappers — a ceiling breach raises with the
+    shape/dtype/weak-type/static-hash diff of the argument signature
+    that provoked each retrace (analysis/programs.py)."""
+    from . import programs
+
+    runtime.install()
+    return programs.retrace_guard
+
+
+@pytest.fixture
 def race_guard():
     """Seeded-interleaving guard (the concurrency analog of
     ``compile_guard``): ``with race_guard(seed=3): ...`` shrinks the

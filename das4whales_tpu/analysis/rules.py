@@ -77,6 +77,18 @@ R10 thread-hygiene — ``Condition.wait()`` outside a predicate
     ``while``, ``Event.wait()``/``.join()`` without a timeout in
     service modules, threads/pools spawned without a name, and
     ``time.sleep`` polling where a Condition exists.
+R11 dtype-contract — the AST half (``analysis/programs.py``): matmul /
+    contraction calls in ``ops/`` without ``preferred_element_type``
+    and raw builtin f64 dtypes (``dtype=float``). The HLO half audits
+    compiled programs for f64 ops and bf16 outside the gated matmul
+    engine at the AOT compile boundary (CLI ``--programs``).
+R12 donation-effectiveness — compiled-program audit only: every
+    donated operand must appear in the executable's
+    ``input_output_alias`` table, else donation silently saved nothing
+    and the preflight's admission math is wrong.
+R13 program-hygiene — compiled-program audit only: host callbacks on
+    the device path, f64 transcendentals, and ``convert``/``transpose``/
+    ``copy`` op counts gated against ``analysis/contracts.json``.
 
 Suppression: an inline ``# daslint: allow[R2]`` (comma list, or
 ``daslint: ignore`` for all rules) on the finding's line or the line above
@@ -92,7 +104,15 @@ import re
 from pathlib import PurePosixPath
 from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9", "R10")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9", "R10",
+             "R11", "R12", "R13")
+
+#: rules whose primary half runs over COMPILED programs (jax-importing,
+#: one AOT compile per audited variant) rather than source text. R11
+#: also has the AST sibling below; R12/R13 are program-only — selecting
+#: them in a source scan is a no-op by design (`scripts/lint.py
+#: --changed`, the AST-only fast path).
+PROGRAM_RULES = ("R11", "R12", "R13")
 
 #: (path suffix, function name or "*") pairs where explicit float64 is the
 #: documented host-side design contract (masks and filter coefficients are
@@ -830,6 +850,11 @@ def analyze_source(source: str, path: str,
         from . import concurrency
 
         findings += [f for f in concurrency.analyze(tree, cpath, lines, rules)
+                     if not line_allowed(lines, f)]
+    if "R11" in rules:
+        from . import programs
+
+        findings += [f for f in programs.analyze(tree, cpath, lines, rules)
                      if not line_allowed(lines, f)]
     return findings
 

@@ -24,14 +24,20 @@ O(#shapes); on the raw wire the program demeans over the real samples
 only (``ops.conditioning.condition_padded``, per-file ``n_real`` as a
 traced vector — no per-length retrace).
 
-Input donation: the K0 (pack-method) attempt must keep the slab alive
-for the adaptive-K escalation rerun, so it never donates
-(analysis/baseline.toml R5 entry); the full-capacity escalation program
-is the slab's final consumer and donates it
-(``batched_detect_picks_program_donated``) when the caller owns the
-buffer (``BatchedMatchedFilterDetector(donate=True)``, the campaign
-default — overflow fallback re-reads from the assembler's host blocks,
-never from the donated device slab).
+Input donation: neither program donates the slab. The K0 (pack-method)
+attempt must keep it alive for the adaptive-K escalation rerun; the
+escalation program USED to donate it (``donate_argnums=(0,)``), but the
+R12 program-contract audit (analysis/programs.py, ISSUE 16) proved that
+donation a no-op — the program's outputs are pick tables and health
+rows, never a ``[B, C, T]`` buffer, so XLA has nothing to alias the
+slab into and its ``input_output_alias`` table stayed empty (the
+"Some donated buffers were not usable" warning, on every backend;
+measured priced-peak delta exactly 0 bytes, docs/PERF.md). The old
+donation only invalidated the caller's buffer without returning any
+HBM. Slab memory is reclaimed the ordinary way: callers drop their
+reference after :meth:`BatchedMatchedFilterDetector.detect_batch` and
+the assembler's bounded in-flight depth caps resident slabs
+(analysis/baseline.toml R5 entries record both programs).
 """
 
 from __future__ import annotations
@@ -122,12 +128,12 @@ def _batched_body(
 #: across repeats).
 batched_detect_picks_program = jax.jit(_batched_body, static_argnames=_STATIC)
 
-#: Donating variant for the slab's FINAL consumer (the escalation rerun,
-#: or a caller that runs single-shot at full capacity): the narrow-wire
-#: slab is dead the moment picks exist, so hand its HBM back to XLA.
-batched_detect_picks_program_donated = jax.jit(
-    _batched_body, static_argnames=_STATIC, donate_argnums=(0,)
-)
+#: The former donating variant, kept as an alias of the plain program
+#: for import compatibility: the R12 donation-effectiveness audit showed
+#: ``donate_argnums=(0,)`` here could never alias (pick-table outputs
+#: are not slab-shaped), so the donation saved 0 bytes while poisoning
+#: the caller's buffer — see the module docstring and docs/PERF.md.
+batched_detect_picks_program_donated = batched_detect_picks_program
 
 
 def trim_picks(picks: Dict[str, np.ndarray], n_real: int) -> Dict[str, np.ndarray]:
@@ -152,10 +158,12 @@ class BatchedMatchedFilterDetector:
     across the batch: a K0 pack-method program first, escalating to the
     full-capacity topk program only when any file's row saturated —
     bit-identical (``ops.peaks.picks_with_escalation`` semantics).
-    ``donate=True`` donates the slab to the escalation program (its final
-    consumer); the common no-saturation path cannot donate retroactively,
-    so callers drop their slab reference after :meth:`detect_batch` and
-    the bounded in-flight depth of the assembler caps resident slabs.
+    ``donate`` is retained for API compatibility but inert: the R12
+    contract audit proved slab donation un-aliasable here (pick-table
+    outputs are never slab-shaped — module docstring), so no program
+    donates; callers drop their slab reference after
+    :meth:`detect_batch` and the bounded in-flight depth of the
+    assembler caps resident slabs.
     ``serial=None`` resolves the in-program batch execution mode per
     backend (``lax.map`` on CPU, ``vmap`` on accelerators — see
     :func:`_batched_body`); pass a bool to force one.
@@ -251,8 +259,9 @@ class BatchedMatchedFilterDetector:
         escalation from that ALREADY-FETCHED payload (the per-file
         ``sat_count`` rides the packed fetch, so the decision costs no
         extra round trip), reruns at full capacity only when a row
-        saturated (the slab's final consumer — donated when the caller
-        owns the buffer), and assembles :meth:`detect_batch`'s per-file
+        saturated (the slab's final consumer; no donation — the R12
+        audit showed the slab cannot alias into pick-table outputs),
+        and assembles :meth:`detect_batch`'s per-file
         entry list. The handle keeps the slab alive for that potential
         rerun and drops its reference the moment picks exist; dropping
         an UNRESOLVED handle abandons the in-flight program (the
@@ -291,11 +300,9 @@ class BatchedMatchedFilterDetector:
             if int(nr_np.min(initial=T)) < T:
                 nr = jnp.asarray(nr_np)
 
-        def run(k, donate_now, stack_):
-            fn = (batched_detect_picks_program_donated if donate_now
-                  else batched_detect_picks_program)
+        def run(k, stack_):
             faults.count("dispatches")
-            return fn(
+            return batched_detect_picks_program(
                 stack_, det._mask_band_dev, det._gain_dev,
                 det._templates_true, det._template_mu, det._template_scale,
                 thr_in, det._cond_scale, nr, det._fk_dft_dev,
@@ -316,7 +323,7 @@ class BatchedMatchedFilterDetector:
 
         # the K0 launch: async — device-side failures surface at
         # resolve()'s fetch (where the campaign's watchdog/ladder wrap it)
-        state = {"stack": stack, "k0": run(det.pick_k0, False, stack)}
+        state = {"stack": stack, "k0": run(det.pick_k0, stack)}
         del stack
 
         def resolve() -> List[tuple | None]:
@@ -333,11 +340,10 @@ class BatchedMatchedFilterDetector:
             chan, times, cnt, satc, thr = fetch_payload(state.pop("k0"))
             if det.pick_k0 < det.max_peaks and int(satc.sum()):
                 # a row saturated at K0: full-capacity rerun — the slab's
-                # last use, so it is donated when the caller owns the
-                # buffer. The escalation decision came from the packed K0
-                # payload fetched above: no extra sync round trip.
+                # last use. The escalation decision came from the packed
+                # K0 payload fetched above: no extra sync round trip.
                 chan, times, cnt, satc, thr = fetch_payload(
-                    run(det.max_peaks, self.donate, state["stack"])
+                    run(det.max_peaks, state["stack"])
                 )
             # common path: drop the slab reference the moment picks exist
             state.clear()

@@ -40,9 +40,9 @@ from . import metrics
 __all__ = [
     "CPU_FLOPS_DEFAULT", "CPU_HBM_GBS_DEFAULT", "CostCard", "DevicePeaks",
     "F32_FLOPS", "HBM_GBS", "MXU_BF16_FLOPS", "REGISTRY", "bucket_label",
-    "capture_batched", "device_peaks", "enable", "enabled",
-    "ensure_batched_card", "export_json", "note_slab_resolved",
-    "resolve_enabled", "sample_hbm",
+    "capture_batched", "contracts_enabled", "device_peaks", "disable_contracts",
+    "enable", "enable_contracts", "enabled", "ensure_batched_card",
+    "export_json", "note_slab_resolved", "resolve_enabled", "sample_hbm",
 ]
 
 # ---------------------------------------------------------------------------
@@ -98,6 +98,18 @@ _g_pricing = metrics.gauge(
     "program's AOT-priced footprint (peak+args): >1 means the "
     "preflight's admission math underpriced the program",
 )
+_c_contract_audits = metrics.counter(
+    "das_contract_audits_total",
+    "program-contract audits run at cost-card capture (analysis/"
+    "programs.py, ISSUE 16), by verdict (clean/breach)",
+    ("verdict",),
+)
+_c_contract_findings = metrics.counter(
+    "das_contract_findings_total",
+    "R11-R13 findings from program-contract audits at cost-card "
+    "capture, by rule",
+    ("rule",),
+)
 
 
 def _env_truthy(name: str) -> bool:
@@ -132,6 +144,30 @@ def disable() -> None:
 def resolve_enabled(flag: bool | None) -> bool:
     """Per-campaign resolution: None defers to the process switch."""
     return _enabled if flag is None else bool(flag)
+
+
+#: the program-contract gate (ISSUE 16) rides cost-card capture: when
+#: on (the default), every captured card's compile also yields its
+#: jaxpr/HLO text, the R11-R13 audit runs over the text (zero extra
+#: compiles, zero dispatch effect — picks are bit-identical either
+#: way), and the card gains a `contract` verdict. DAS_CONTRACT_GATE=0
+#: opts out (cards then read "unchecked").
+_contracts_enabled = os.environ.get(
+    "DAS_CONTRACT_GATE", "1") not in ("", "0", "false")
+
+
+def contracts_enabled() -> bool:
+    return _contracts_enabled
+
+
+def enable_contracts() -> None:
+    global _contracts_enabled
+    _contracts_enabled = True
+
+
+def disable_contracts() -> None:
+    global _contracts_enabled
+    _contracts_enabled = False
 
 
 # ---------------------------------------------------------------------------
@@ -218,6 +254,11 @@ class CostCard:
     peak_bytes: int        # temps+outputs: the preflight admission figure
     argument_bytes: int
     compile_seconds: float
+    #: program-contract verdict stamped at capture (ISSUE 16):
+    #: "unchecked" (gate off / IR unavailable), "clean", or "breach"
+    contract: str = "unchecked"
+    #: formatted R11-R13 findings behind a "breach" verdict
+    contract_findings: Tuple[str, ...] = ()
 
     @property
     def key(self) -> Tuple[str, str, str]:
@@ -245,6 +286,8 @@ class CostCard:
             "peak_bytes": self.peak_bytes,
             "argument_bytes": self.argument_bytes,
             "compile_seconds": round(self.compile_seconds, 4),
+            "contract": self.contract,
+            "contract_findings": list(self.contract_findings),
             "predicted_wall_s": self.predicted_wall_s(peaks),
             "intensity_flops_per_byte": (
                 self.flops / self.bytes_accessed
@@ -285,6 +328,52 @@ class CostCardRegistry:
 REGISTRY = CostCardRegistry()
 
 
+_contracts_snapshot_lock = threading.Lock()
+_contracts_snapshot: object = False  # False = not loaded yet; None = absent
+
+
+def _contract_snapshot():
+    """The checked-in ``analysis/contracts.json``, loaded once per
+    process (``reset()`` clears the cache)."""
+    global _contracts_snapshot
+    with _contracts_snapshot_lock:
+        if _contracts_snapshot is False:
+            from ..analysis import programs as aprograms
+
+            _contracts_snapshot = aprograms.load_contracts()
+        return _contracts_snapshot
+
+
+def _audit_capture(an, det, *, bucket: str, program: str,
+                   batch: int, stack_dtype):
+    """R11-R13 contract audit over one capture's IR text: pure text
+    analysis (zero compiles), feeding the ``das_contract_*`` counters
+    and the card's verdict. Any failure degrades to "unchecked" — the
+    observatory must never break a capture."""
+    try:
+        import numpy as np
+
+        from ..analysis import programs as aprograms
+
+        art = aprograms.ProgramArtifact(
+            bucket=str(bucket), label=str(program),
+            engine=(f"{getattr(det, 'mf_engine', 'fft') or 'fft'}"
+                    f"+{getattr(det, 'fk_engine', 'fft') or 'fft'}"),
+            wire_dtype=np.dtype(stack_dtype).name,
+            jaxpr_text=an.jaxpr_text or "", hlo_text=an.hlo_text or "",
+            peak_bytes=int(an.memory.peak if an.memory else 0),
+        )
+        findings = aprograms.audit_program(
+            art, snapshot=_contract_snapshot())
+    except Exception:  # noqa: BLE001
+        return "unchecked", ()
+    verdict = "breach" if findings else "clean"
+    _c_contract_audits.inc(verdict=verdict)
+    for f in findings:
+        _c_contract_findings.inc(rule=f.rule)
+    return verdict, tuple(f"{f.rule}[{f.code}] {f.message}" for f in findings)
+
+
 def capture_batched(bdet, batch: int, stack_dtype, *, bucket: str,
                     program: str, with_health: bool = False,
                     health_clip=None):
@@ -294,18 +383,28 @@ def capture_batched(bdet, batch: int, stack_dtype, *, bucket: str,
     Returns the program's ``MemoryStats`` (or None where the backend
     does not support the analyses) so the memory preflight can consume
     this as a drop-in for ``batched_program_memory`` — one compile
-    serves both the admission decision and the cost card."""
+    serves both the admission decision and the cost card. With the
+    program-contract gate on (:func:`contracts_enabled`, the default)
+    the same compile also yields the jaxpr/HLO text and the R11-R13
+    audit stamps the card's ``contract`` verdict — zero extra compiles,
+    no dispatch effect."""
     from ..utils import memory as memutils
 
+    audit = contracts_enabled()
     an = memutils.batched_program_analysis(
         bdet, batch, stack_dtype, with_health=with_health,
-        health_clip=health_clip,
+        health_clip=health_clip, capture_ir=audit,
     )
     if an is None:
         return None
     _c_compiles.inc(program=program)
     _h_compile.observe(an.compile_seconds, program=program)
     det = bdet.det
+    verdict, notes = ("unchecked", ())
+    if audit and an.hlo_text:
+        verdict, notes = _audit_capture(
+            an, det, bucket=bucket, program=program, batch=batch,
+            stack_dtype=stack_dtype)
     REGISTRY.record(CostCard(
         program=str(program), bucket=str(bucket),
         engine=str(getattr(det, "mf_engine", "fft") or "fft"),
@@ -316,6 +415,7 @@ def capture_batched(bdet, batch: int, stack_dtype, *, bucket: str,
         peak_bytes=int(an.memory.peak if an.memory else 0),
         argument_bytes=int(an.memory.argument_bytes if an.memory else 0),
         compile_seconds=an.compile_seconds,
+        contract=verdict, contract_findings=notes,
     ))
     return an.memory
 
@@ -451,8 +551,10 @@ def export_json(path: str, extra: Dict | None = None) -> str:
 
 def reset() -> None:
     """Clear cards + cached device verdicts (tests)."""
-    global _hbm_supported, _peaks
+    global _hbm_supported, _peaks, _contracts_snapshot
     REGISTRY.reset()
     _hbm_supported = None
     with _peaks_lock:
         _peaks = None
+    with _contracts_snapshot_lock:
+        _contracts_snapshot = False

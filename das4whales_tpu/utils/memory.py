@@ -85,6 +85,11 @@ class ProgramAnalysis:
     bytes_accessed: float
     transcendentals: float
     compile_seconds: float
+    #: IR text pair for the program-contract audit (analysis/programs.py,
+    #: ISSUE 16) — populated only under ``capture_ir=True`` so the plain
+    #: preflight never holds megabytes of HLO text per priced rung
+    jaxpr_text: str | None = None
+    hlo_text: str | None = None
 
 
 def _cost_float(cost, name: str) -> float:
@@ -96,8 +101,8 @@ def _cost_float(cost, name: str) -> float:
         return 0.0
 
 
-def aot_program_analysis(fn, *avals,
-                         static_kwargs=None) -> ProgramAnalysis | None:
+def aot_program_analysis(fn, *avals, static_kwargs=None,
+                         capture_ir: bool = False) -> ProgramAnalysis | None:
     """AOT-compile ``fn`` at ``avals`` and return its full
     :class:`ProgramAnalysis` — or None where the backend/jaxlib cannot
     even compile it. ``memory_analysis()``/``cost_analysis()`` fields
@@ -106,8 +111,13 @@ def aot_program_analysis(fn, *avals,
 
     ``fn`` may already be a ``jax.jit`` wrapper (lowered as-is) or a
     plain callable (jitted here with ``static_kwargs`` as
-    ``static_argnames`` values).
+    ``static_argnames`` values). ``capture_ir=True`` additionally
+    records the jaxpr and compiled-HLO text for the program-contract
+    audit — the SAME trace → lower → compile crossing, zero extra
+    compiles (the analysis side is free; only the text retention
+    costs, which is why it is opt-in).
     """
+    jaxpr_text = hlo_text = None
     try:
         # AOT pricing only: lowered+compiled for the analyses, never
         # dispatched — no hot-path compile cache to miss
@@ -115,9 +125,22 @@ def aot_program_analysis(fn, *avals,
             fn, static_argnames=tuple(static_kwargs or ())
         )
         t0 = time.perf_counter()
-        lowered = jitted.lower(*avals, **(static_kwargs or {}))
+        if capture_ir and hasattr(jitted, "trace"):
+            traced = jitted.trace(*avals, **(static_kwargs or {}))
+            try:
+                jaxpr_text = str(traced.jaxpr)
+            except Exception:  # noqa: BLE001 — text capture is best-effort
+                jaxpr_text = None
+            lowered = traced.lower()
+        else:
+            lowered = jitted.lower(*avals, **(static_kwargs or {}))
         compiled = lowered.compile()
         compile_s = time.perf_counter() - t0
+        if capture_ir:
+            try:
+                hlo_text = compiled.as_text()
+            except Exception:  # noqa: BLE001
+                hlo_text = None
     except Exception:  # noqa: BLE001 — unsupported backend/jaxlib: no gate
         return None
     try:
@@ -146,6 +169,8 @@ def aot_program_analysis(fn, *avals,
         bytes_accessed=_cost_float(cost, "bytes accessed"),
         transcendentals=_cost_float(cost, "transcendentals"),
         compile_seconds=compile_s,
+        jaxpr_text=jaxpr_text,
+        hlo_text=hlo_text,
     )
 
 
@@ -168,11 +193,15 @@ def _aval_of(arr) -> jax.ShapeDtypeStruct:
 
 def _batched_program_spec(bdet, batch: int, stack_dtype, *,
                           with_health: bool = False,
-                          health_clip: float | None = None):
+                          health_clip: float | None = None,
+                          donate: bool = False):
     """The batched program's AOT pricing spec — ``(jitted, avals,
     static_kwargs)`` — shared by :func:`batched_program_memory` (the
-    preflight) and :func:`batched_program_analysis` (the cost
-    observatory), so the two can never price different programs."""
+    preflight), :func:`batched_program_analysis` (the cost
+    observatory), and the program-contract audit, so the three can
+    never price different programs. ``donate=True`` prices the
+    slab-donating spelling (``donate_argnums=(0,)``) — the R12
+    donation-effectiveness audit inspects its alias table."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -224,7 +253,10 @@ def _batched_program_spec(bdet, batch: int, stack_dtype, *,
     # batched_detect_picks_program would be equivalent, but keeping the
     # preflight's lowering separate means a preflight failure can never
     # poison the hot path's jit cache
-    jitted = jax.jit(_batched_body, static_argnames=_STATIC)  # daslint: allow[R2] AOT pricing only — see aot_memory_stats
+    jitted = jax.jit(  # daslint: allow[R2] AOT pricing only — see aot_memory_stats
+        _batched_body, static_argnames=_STATIC,
+        donate_argnums=((0,) if donate else ()),
+    )
     return jitted, avals, kwargs
 
 
@@ -249,17 +281,21 @@ def batched_program_memory(
 
 def batched_program_analysis(
     bdet, batch: int, stack_dtype, *, with_health: bool = False,
-    health_clip: float | None = None,
+    health_clip: float | None = None, capture_ir: bool = False,
+    donate: bool = False,
 ) -> ProgramAnalysis | None:
     """:func:`batched_program_memory`'s full-record twin: the SAME
     priced program's :class:`ProgramAnalysis` (memory + XLA cost
     totals + compile wall) for the cost observatory
-    (``telemetry.costs.capture_batched``)."""
+    (``telemetry.costs.capture_batched``). ``capture_ir`` adds the
+    jaxpr/HLO text pair for the program-contract audit; ``donate``
+    prices the slab-donating spelling (the R12 probe)."""
     jitted, avals, kwargs = _batched_program_spec(
         bdet, batch, stack_dtype, with_health=with_health,
-        health_clip=health_clip,
+        health_clip=health_clip, donate=donate,
     )
-    return aot_program_analysis(jitted, *avals, static_kwargs=kwargs)
+    return aot_program_analysis(jitted, *avals, static_kwargs=kwargs,
+                                capture_ir=capture_ir)
 
 
 def first_fitting(price, candidates, budget_bytes: int):

@@ -784,9 +784,9 @@ def run_campaign_batched(
     compiles persist across processes via the on-disk compilation cache
     (``persistent_cache``: True wires ``config.compilation_cache_dir()``,
     a str names the directory, False skips — docs/TPU_RUNBOOK.md).
-    ``donate=True`` hands each slab to XLA at its final use
-    (``parallel.batch`` donation contract); ``in_flight`` bounds slabs
-    resident on device; ``serial`` forces the in-program batch execution
+    ``donate`` is accepted for compatibility but inert — the R12
+    contract audit retired slab donation (``parallel.batch`` module
+    docstring); ``in_flight`` bounds slabs resident on device; ``serial`` forces the in-program batch execution
     mode (``True``: ``lax.map``, ``False``: ``vmap``; ``None`` resolves
     per backend — see ``parallel.batch._batched_body``). ``wire="raw"`` streams stored-dtype counts and
     conditions on device per bucket (padded records demean over real

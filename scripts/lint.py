@@ -17,6 +17,14 @@ files under the repo (the pre-commit fast path); the FULL tree remains
 the tier-1 default — a changed-only pass cannot catch a hazard whose
 trigger lives in an unchanged file (e.g. a baseline entry going stale).
 With no changed Python files it exits 0 without analyzing anything.
+
+The full gate runs **R1–R13**: it passes ``--programs`` so the
+program-contract rules (R11–R13, ``analysis/programs.py``) compile the
+canonical batched variants and audit their jaxpr/HLO against
+``analysis/contracts.json``. ``--changed`` deliberately does NOT — the
+fast path stays AST-only (the R11 AST siblings still run per file; a
+few seconds, no jax compiles), and the compiled-program audit is the
+full gate's job, exactly like the stale-baseline check above.
 """
 
 import os
@@ -83,7 +91,10 @@ def run(argv) -> int:
         if not paths:
             print("daslint: no changed Python files", file=sys.stderr)
             return 0
+        # AST-only fast path: no --programs (see module docstring)
         return main(["--check", *argv, *paths])
+    if "--programs" not in argv:
+        argv.append("--programs")
     return main(["--check", *argv])
 
 

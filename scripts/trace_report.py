@@ -19,6 +19,12 @@ manifest (``run_campaign*(trace=True)`` / ``DAS_TRACE=1`` →
   ``cost_cards=True`` campaign/service), as a share-of-roofline
   column sorted furthest-from-peak first, so a trace answers "which
   stage is furthest from peak" directly;
+* with ``--contracts``: the program-contract verdicts stamped on the
+  cost cards by the R11–R13 gate (``analysis/programs.py``; ISSUE 16)
+  — one row per (bucket, program, engine) with its ``contract``
+  verdict (``clean`` / ``breach`` / ``unchecked``) and any finding
+  codes, so a flight record answers "did every compiled program honor
+  its dtype/donation/hygiene contract" offline;
 * with ``--quality``: the science-quality observatory's export
   (ISSUE 15, ``<outdir>/quality.json`` — written by a
   ``quality=True`` campaign / ``ServiceConfig.quality`` service) as
@@ -31,6 +37,7 @@ Usage::
 
     python scripts/trace_report.py OUTDIR            # human tables
     python scripts/trace_report.py OUTDIR --costs    # + roofline shares
+    python scripts/trace_report.py OUTDIR --contracts  # + contract verdicts
     python scripts/trace_report.py OUTDIR --quality  # + quality tables
     python scripts/trace_report.py OUTDIR --json     # machine payload
 
@@ -188,6 +195,25 @@ def cost_share_table(events: List[Dict], cost_payload: Dict) -> List[Dict]:
     return rows
 
 
+def contract_table(cost_payload: Dict) -> List[Dict]:
+    """Per-(bucket, program, engine) contract verdicts off the cost
+    cards — the R11–R13 gate's runtime stamp (``CostCard.contract``),
+    breaches first so a red verdict tops the table."""
+    rows = []
+    for c in cost_payload.get("cards", []):
+        rows.append({
+            "bucket": c.get("bucket"), "program": c.get("program"),
+            "engine": c.get("engine"),
+            "contract": c.get("contract", "unchecked"),
+            "findings": list(c.get("contract_findings", []) or []),
+        })
+    order = {"breach": 0, "unchecked": 1, "clean": 2}
+    rows.sort(key=lambda r: (order.get(r["contract"], 1),
+                             str(r["bucket"]), str(r["program"]),
+                             str(r["engine"])))
+    return rows
+
+
 def load_quality(outdir: str, path: str | None = None) -> Dict | None:
     """The quality observatory's export (``quality.json``), or None."""
     path = path or os.path.join(outdir, "quality.json")
@@ -200,7 +226,8 @@ def load_quality(outdir: str, path: str | None = None) -> Dict | None:
 
 
 def build_report(outdir: str, trace_path: str | None = None,
-                 costs: bool = False, quality: bool = False) -> Dict:
+                 costs: bool = False, quality: bool = False,
+                 contracts: bool = False) -> Dict:
     trace_path = trace_path or os.path.join(outdir, "trace.json")
     events = load_trace(trace_path) if os.path.exists(trace_path) else []
     manifest = load_manifest(os.path.join(outdir, "manifest.jsonl"))
@@ -225,6 +252,10 @@ def build_report(outdir: str, trace_path: str | None = None,
         report["cost_share"] = (cost_share_table(events, payload)
                                 if payload else None)
         report["cost_cards"] = payload
+    if contracts:
+        payload = load_cost_cards(outdir)
+        report["contracts"] = (contract_table(payload)
+                               if payload else None)
     if quality:
         report["quality"] = load_quality(outdir)
     return report
@@ -333,6 +364,20 @@ def print_report(rep: Dict) -> None:
     elif "cost_share" in rep:
         print("\n  (no cost_cards.json next to the manifest — run the "
               "campaign/service with cost_cards=True / DAS_COST_CARDS=1)")
+    if rep.get("contracts"):
+        print("\n  program contracts (R11-R13 gate verdicts off the "
+              "cost cards; breaches first):")
+        print(f"    {'bucket':<14s} {'program':<12s} {'engine':<14s} "
+              f"{'verdict':<10s} findings")
+        for row in rep["contracts"]:
+            print(f"    {str(row['bucket']):<14s} "
+                  f"{str(row['program']):<12s} {str(row['engine']):<14s} "
+                  f"{row['contract']:<10s} "
+                  f"{', '.join(row['findings']) or '-'}")
+    elif "contracts" in rep:
+        print("\n  (no cost_cards.json next to the manifest — contract "
+              "verdicts ride the cost cards; run with cost_cards=True "
+              "and DAS_CONTRACT_GATE unset/1)")
     if rep.get("quality"):
         print_quality(rep["quality"])
     elif "quality" in rep:
@@ -352,13 +397,17 @@ def main(argv=None) -> int:
                     help="merge cost-card roofline predictions into a "
                          "per-rung share-of-roofline table "
                          "(<outdir>/cost_cards.json)")
+    ap.add_argument("--contracts", action="store_true",
+                    help="render the R11-R13 program-contract verdicts "
+                         "stamped on the cost cards "
+                         "(<outdir>/cost_cards.json)")
     ap.add_argument("--quality", action="store_true",
                     help="render the science-quality observatory export "
                          "(<outdir>/quality.json): per-tenant quality "
                          "tables with drift timelines")
     args = ap.parse_args(argv)
     rep = build_report(args.outdir, args.trace, costs=args.costs,
-                       quality=args.quality)
+                       quality=args.quality, contracts=args.contracts)
     if args.json:
         json.dump(rep, sys.stdout, indent=2)
         print()

@@ -35,6 +35,7 @@ import pytest
 from das4whales_tpu.analysis.pytest_plugin import (  # noqa: F401
     compile_guard,
     race_guard,
+    retrace_guard,
 )
 
 

@@ -410,6 +410,46 @@ def test_report_without_costs_flag_omits_cost_keys(cards_run):
     tr = _load_script("trace_report")
     rep = tr.build_report(out)
     assert "cost_share" not in rep and "cost_cards" not in rep
+    assert "contracts" not in rep
+
+
+def test_trace_report_contracts_renders_gate_verdicts(cards_run, capsys):
+    """scripts/trace_report.py --contracts: the R11-R13 verdicts the
+    contract gate stamped on the cost cards render as a table; a
+    missing export says so instead of silently omitting."""
+    out, _ = cards_run
+    tr = _load_script("trace_report")
+    rep = tr.build_report(out, contracts=True)
+    rows = rep["contracts"]
+    assert rows, "a cost_cards=True campaign must yield contract rows"
+    assert {r["contract"] for r in rows} <= {"clean", "breach", "unchecked"}
+    tr.print_report(rep)
+    text = capsys.readouterr().out
+    assert "program contracts" in text
+    rep_none = tr.build_report(out + "-nowhere", contracts=True)
+    assert rep_none["contracts"] is None
+    tr.print_report(rep_none)
+    assert "contract verdicts ride the cost cards" in \
+        capsys.readouterr().out
+
+
+def test_contract_table_sorts_breaches_first():
+    """A breach must top the table regardless of bucket order, and the
+    findings list must survive the row (that string is the triage)."""
+    tr = _load_script("trace_report")
+    payload = {"cards": [
+        {"bucket": "z", "program": "batched:1", "engine": "fft+fft",
+         "contract": "clean", "contract_findings": []},
+        {"bucket": "a", "program": "batched:1", "engine": "fft+fft",
+         "contract": "breach",
+         "contract_findings": ["R11[f64-in-program] f64 op on f32 wire"]},
+        {"bucket": "m", "program": "batched:1", "engine": "fft+fft"},
+    ]}
+    rows = tr.contract_table(payload)
+    assert [r["contract"] for r in rows] == ["breach", "unchecked", "clean"]
+    assert rows[0]["findings"] == \
+        ["R11[f64-in-program] f64 op on f32 wire"]
+    assert rows[1]["findings"] == []  # missing keys default safely
 
 
 # ---------------------------------------------------------------------------

@@ -1744,3 +1744,112 @@ class TestLintChanged:
         )
         assert lint_mod.run(["--changed", "--no-baseline"]) == 1
         assert "R2[jit-in-function-body]" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: the program-rule CLI surface + the stale-ledger gate
+# ---------------------------------------------------------------------------
+
+class TestProgramRuleCli:
+    def test_rules_r11_r13_ast_subset(self, tmp_path, capsys):
+        """``--rules R11,R12,R13`` without ``--programs`` runs only the
+        AST half (R12/R13 have no source-level checks; R11's fire) —
+        pure stdlib, no jax compiles."""
+        opsdir = tmp_path / "ops"
+        opsdir.mkdir()
+        scratch = opsdir / "scratch_r11.py"
+        scratch.write_text(textwrap.dedent(
+            """
+            import jax.numpy as jnp
+
+            def correlate(a, b):
+                return jnp.dot(a, b)
+            """
+        ))
+        rc = daslint_main(["--rules", "R11,R12,R13", "--no-baseline",
+                           str(scratch)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "R11[matmul-no-preferred-dtype]" in out
+
+        scratch.write_text(textwrap.dedent(
+            """
+            import jax.numpy as jnp
+
+            def correlate(a, b):
+                return jnp.dot(a, b, preferred_element_type=jnp.float32)
+            """
+        ))
+        assert daslint_main(["--rules", "R11,R12,R13", "--no-baseline",
+                             str(scratch)]) == 0
+
+    def test_check_fails_on_stale_baseline_entry(self, tmp_path, capsys):
+        """The stale-ledger gate: a baselined key with no live finding
+        site fails ``--check`` with a remove-me message; deleting the
+        entry turns the run green (the one-time-cleanup contract)."""
+        from das4whales_tpu.analysis.rules import canonical_path
+
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        bl = tmp_path / "baseline.toml"
+        bl.write_text(textwrap.dedent(
+            f"""
+            [[finding]]
+            rule = "R2"
+            path = "{canonical_path(str(clean))}"
+            symbol = "f"
+            code = "jit-in-loop"
+            reason = "fixed long ago"
+            """
+        ))
+        rc = daslint_main(["--check", "--baseline", str(bl), str(clean)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "stale baseline entry (remove me)" in out
+        assert "R2" in out and "`f`" in out
+
+        bl.write_text("")
+        assert daslint_main(["--check", "--baseline", str(bl),
+                             str(clean)]) == 0
+        capsys.readouterr()
+
+    def test_stale_check_scoped_to_scanned_paths(self, tmp_path, capsys):
+        """An entry for an UNSCANNED file is not judged: a --changed
+        subset run cannot declare ledger entries for other files
+        stale."""
+        from das4whales_tpu.analysis.rules import canonical_path
+
+        scanned = tmp_path / "a.py"
+        scanned.write_text("x = 1\n")
+        other = tmp_path / "b.py"
+        other.write_text("y = 2\n")
+        bl = tmp_path / "baseline.toml"
+        bl.write_text(textwrap.dedent(
+            f"""
+            [[finding]]
+            rule = "R2"
+            path = "{canonical_path(str(other))}"
+            symbol = "g"
+            code = "jit-in-loop"
+            reason = "lives in an unscanned file"
+            """
+        ))
+        assert daslint_main(["--check", "--baseline", str(bl),
+                             str(scanned)]) == 0
+        capsys.readouterr()
+
+    def test_full_gate_passes_programs_changed_does_not(self, monkeypatch):
+        """scripts/lint.py's documented split: the full gate appends
+        ``--programs`` (R11-R13 over the canonical compiled variants);
+        ``--changed`` stays AST-only."""
+        import scripts.lint as lint_mod
+
+        calls = []
+        monkeypatch.setattr(lint_mod, "main",
+                            lambda argv: calls.append(list(argv)) or 0)
+        monkeypatch.setattr(lint_mod, "changed_python_files",
+                            lambda *a, **k: ["/tmp/fake.py"])
+        assert lint_mod.run([]) == 0
+        assert "--programs" in calls[0]
+        assert lint_mod.run(["--changed"]) == 0
+        assert "--programs" not in calls[1]

@@ -1,0 +1,376 @@
+"""Program-contract gate (R11-R13) + retrace forensics (ISSUE 16).
+
+Four layers, mirroring das4whales_tpu/analysis/programs.py:
+
+* **R11 AST units** — the source-level siblings (contractions without
+  ``preferred_element_type``, raw builtin f64 dtypes) red on hazard
+  snippets and green on the allowlisted design files, via the same
+  ``analyze_source`` harness test_daslint.py uses;
+* **HLO units** — each R11/R12/R13 finding code provoked from a
+  synthetic :class:`ProgramArtifact` (pure text, zero compiles) and
+  silenced by its contractual counterpart;
+* **the canonical gate** — the real compiled variant set audits clean
+  against the checked-in ``analysis/contracts.json``, the snapshot
+  round-trips bit-for-bit, and the audit itself is compile-free
+  (the zero-extra-compiles pin rides the cost-card capture);
+* **retrace forensics** — ``retrace_guard`` names WHICH argument
+  signature changed (the weak-type flip unit).
+"""
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from das4whales_tpu import analysis
+from das4whales_tpu.analysis import programs
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:Some donated buffers were not usable")
+
+
+def run(source, path="das4whales_tpu/ops/scratch.py", rules=analysis.ALL_RULES):
+    return analysis.analyze_source(textwrap.dedent(source), path, rules)
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# R11 — AST half
+# ---------------------------------------------------------------------------
+
+class TestR11Ast:
+    def test_contraction_without_preferred_dtype(self):
+        fs = run(
+            """
+            import jax.numpy as jnp
+
+            def correlate(a, b):
+                return jnp.dot(a, b)
+            """
+        )
+        assert "matmul-no-preferred-dtype" in codes(fs)
+
+    def test_contraction_with_preferred_dtype_is_green(self):
+        fs = run(
+            """
+            import jax.numpy as jnp
+
+            def correlate(a, b):
+                return jnp.dot(a, b, preferred_element_type=jnp.float32)
+            """
+        )
+        assert "matmul-no-preferred-dtype" not in codes(fs)
+
+    def test_contraction_outside_ops_scope_is_green(self):
+        fs = run(
+            """
+            import jax.numpy as jnp
+
+            def correlate(a, b):
+                return jnp.dot(a, b)
+            """,
+            path="das4whales_tpu/models/scratch.py",
+        )
+        assert "matmul-no-preferred-dtype" not in codes(fs)
+
+    def test_builtin_f64_dtype(self):
+        fs = run(
+            """
+            import jax.numpy as jnp
+
+            def taper(n):
+                return jnp.zeros(n, dtype=float)
+            """
+        )
+        assert "builtin-f64-dtype" in codes(fs)
+        msg = [f for f in fs if f.code == "builtin-f64-dtype"][0].message
+        assert "float64" in msg
+
+    def test_builtin_f64_on_design_allowlist_is_green(self):
+        # filters.py keeps its documented host-side double-precision
+        # design contract (rules.FLOAT64_DESIGN_ALLOWLIST)
+        fs = run(
+            """
+            import numpy as np
+
+            def zero_phase_gain(h):
+                return np.asarray(h, dtype=complex)
+            """,
+            path="das4whales_tpu/ops/filters.py",
+        )
+        assert "builtin-f64-dtype" not in codes(fs)
+
+    def test_r11_respects_rule_selection(self):
+        fs = run(
+            """
+            import jax.numpy as jnp
+
+            def correlate(a, b):
+                return jnp.dot(a, b)
+            """,
+            rules=("R2",),
+        )
+        assert codes(fs) == []
+
+
+# ---------------------------------------------------------------------------
+# HLO units — synthetic artifacts, zero compiles
+# ---------------------------------------------------------------------------
+
+def art(hlo="", jaxpr="", engine="fft+fft", wire="float32", donated=(),
+        bucket="24x900/float32", label="batched:1", **kw):
+    return programs.ProgramArtifact(
+        bucket=bucket, label=label, engine=engine, wire_dtype=wire,
+        jaxpr_text=jaxpr, hlo_text=hlo, donated=tuple(donated), **kw)
+
+
+CLEAN_HLO = """\
+ENTRY %main (p0: f32[24,900]) -> f32[24,900] {
+  %p0 = f32[24,900]{1,0} parameter(0)
+  %c0 = f32[24,900]{1,0} convert(%p0)
+  ROOT %m = f32[24,900]{1,0} multiply(%c0, %c0)
+}
+"""
+
+
+class TestHloAudit:
+    def test_clean_program_is_green(self):
+        assert programs.audit_program(art(hlo=CLEAN_HLO)) == []
+
+    def test_r11_f64_in_f32_wire_program(self):
+        hlo = CLEAN_HLO + "  %d = f64[24,900]{1,0} convert(%p0)\n"
+        fs = programs.audit_program(art(hlo=hlo), rules=("R11",))
+        assert codes(fs) == ["f64-in-program"]
+        assert "program:24x900/float32" == fs[0].path
+        assert fs[0].symbol == "batched:1|fft+fft"
+
+    def test_r11_f64_wire_skips_f64_check(self):
+        hlo = CLEAN_HLO.replace("f32[", "f64[")
+        assert programs.audit_program(art(hlo=hlo, wire="float64",
+                                          bucket="24x900/float64")) == []
+
+    def test_r11_bf16_outside_gate(self):
+        hlo = CLEAN_HLO + "  %b = bf16[24,900]{1,0} convert(%p0)\n"
+        fs = programs.audit_program(art(hlo=hlo, engine="fft+fft"),
+                                    rules=("R11",))
+        assert codes(fs) == ["bf16-outside-gate"]
+
+    def test_r11_bf16_escaped_matmul(self):
+        # an ADD at bf16 inside the bf16 engine: general arithmetic
+        # escaped the convert-fenced contraction
+        hlo = (CLEAN_HLO
+               + "  %b = bf16[24,900]{1,0} convert(%p0)\n"
+               + "  %e = bf16[24,900]{1,0} add(%b, %b)\n")
+        fs = programs.audit_program(art(hlo=hlo, engine="matmul-bf16+fft"),
+                                    rules=("R11",))
+        assert codes(fs) == ["bf16-escaped-matmul"]
+        assert "add" in fs[0].message
+
+    def test_r11_bf16_fenced_contraction_is_green(self):
+        hlo = (CLEAN_HLO
+               + "  %b = bf16[24,900]{1,0} convert(%p0)\n"
+               + "  %d = bf16[24,24]{1,0} dot(%b, %b)\n")
+        assert programs.audit_program(
+            art(hlo=hlo, engine="matmul-bf16+fft"), rules=("R11",)) == []
+
+    def test_r12_donation_ineffective(self):
+        fs = programs.audit_program(
+            art(hlo=CLEAN_HLO, donated=(0,), donated_bytes=86_400 * 4,
+                peak_bytes=1_000_000),
+            rules=("R12",))
+        assert codes(fs) == ["donation-ineffective"]
+        assert "input_output_alias" in fs[0].message
+
+    def test_r12_aliased_donation_is_green(self):
+        hlo = CLEAN_HLO.replace(
+            "ENTRY %main",
+            "ENTRY %main, input_output_alias={ {}: (0, {}, may-alias) }")
+        assert programs.audit_program(
+            art(hlo=hlo, donated=(0,)), rules=("R12",)) == []
+        assert programs.alias_param_numbers(hlo) == {0}
+
+    def test_r12_vacuous_without_donation(self):
+        assert programs.audit_program(art(hlo=CLEAN_HLO),
+                                      rules=("R12",)) == []
+
+    def test_r13_host_callback(self):
+        fs = programs.audit_program(
+            art(hlo=CLEAN_HLO, jaxpr="a = pure_callback[callback=f] b"),
+            rules=("R13",))
+        assert codes(fs) == ["host-callback-in-program"]
+
+    def test_r13_f64_transcendental(self):
+        hlo = CLEAN_HLO + "  %s = f64[24,900]{1,0} sqrt(%p0)\n"
+        fs = programs.audit_program(art(hlo=hlo), rules=("R13",))
+        assert codes(fs) == ["f64-transcendental"]
+        assert "sqrt" in fs[0].message
+
+    def test_r13_op_ceiling(self):
+        key = programs.contract_key("24x900/float32", "batched:1", "fft+fft")
+        snap = {"programs": {key: {"convert": 0, "transpose": 0, "copy": 0}}}
+        # ceiling(0) = 4: five converts breach, four do not
+        extra = "  %c{i} = f32[24,900]{{1,0}} convert(%p0)\n"
+        hlo4 = CLEAN_HLO + "".join(extra.format(i=i) for i in range(3))
+        hlo5 = CLEAN_HLO + "".join(extra.format(i=i) for i in range(4))
+        assert programs.audit_program(art(hlo=hlo4), snapshot=snap,
+                                      rules=("R13",)) == []
+        fs = programs.audit_program(art(hlo=hlo5), snapshot=snap,
+                                    rules=("R13",))
+        assert codes(fs) == ["op-ceiling-exceeded"]
+        assert "convert: 5 > ceiling 4" in fs[0].message
+
+    def test_r13_unsnapshotted_program_skips_ceiling(self):
+        hlo = CLEAN_HLO + "  %c = f32[4]{0} convert(%p0)\n" * 40
+        assert programs.audit_program(
+            art(hlo=hlo, bucket="999x999/float32"),
+            snapshot={"programs": {}}, rules=("R13",)) == []
+
+    def test_contract_ceiling_slack_policy(self):
+        assert programs.contract_ceiling(0) == 4
+        assert programs.contract_ceiling(10) == 15
+        assert programs.contract_ceiling(100) == 150
+
+
+# ---------------------------------------------------------------------------
+# The canonical gate: real compiled variants vs the checked-in snapshot
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def canonical():
+    """The canonical variant set, compiled once for the module (one AOT
+    compile per variant — shared by the gate, round-trip and
+    compile-free-audit pins below)."""
+    arts = programs.canonical_artifacts()
+    assert len(arts) == len(programs.CANONICAL_VARIANTS)
+    return arts
+
+
+class TestCanonicalGate:
+    def test_gate_is_green_against_checked_in_snapshot(self, canonical):
+        fs = programs.audit_canonical(artifacts=canonical)
+        assert fs == [], "\n".join(f.format() for f in fs)
+
+    def test_snapshot_round_trips(self, canonical):
+        """--write-contracts is deterministic: regenerating from live
+        artifacts reproduces analysis/contracts.json exactly (raw counts
+        in the file, slack applied only at check time)."""
+        snap = programs.build_contracts(
+            canonical, backend=jax.default_backend(),
+            jax_version=jax.__version__)
+        with open(programs.DEFAULT_CONTRACTS, encoding="utf-8") as fh:
+            checked_in = json.load(fh)
+        assert snap == checked_in
+        assert (json.loads(programs.dump_contracts(snap)) == snap)
+
+    def test_audit_is_compile_free(self, canonical, compile_guard):
+        """The audit is pure text analysis over already-captured IR —
+        zero compiles on top of the preflight's own."""
+        with compile_guard.max_compiles(0, what="R11-R13 audit"):
+            programs.audit_canonical(artifacts=canonical)
+
+    def test_artifacts_carry_both_ir_texts(self, canonical):
+        for a in canonical:
+            assert "ENTRY" in a.hlo_text
+            assert "lambda" in a.jaxpr_text
+            assert a.peak_bytes > 0
+
+
+def test_capture_ir_adds_no_compiles(chaos_detector, compile_guard):
+    """The zero-extra-compiles acceptance pin: capturing the jaxpr/HLO
+    for the contract audit rides the SAME trace->lower->compile the
+    preflight already pays — after the plain pricing pass compiled the
+    program, the capture_ir pass hits the compilation cache and
+    performs ZERO additional backend compiles."""
+    from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+    from das4whales_tpu.utils import memory as memutils
+
+    bdet = BatchedMatchedFilterDetector(chaos_detector, donate=False)
+    _, n_plain = compile_guard.count_compiles(
+        memutils.batched_program_analysis, bdet, 1, np.float64)
+    an, n_capture = compile_guard.count_compiles(
+        memutils.batched_program_analysis, bdet, 1, np.float64,
+        capture_ir=True)
+    assert n_plain <= 1
+    assert n_capture == 0
+    assert an.hlo_text and an.jaxpr_text
+
+
+def test_cost_card_contract_verdict_on_and_off(chaos_detector):
+    """The runtime stamp: with the gate on (default) the cost card
+    carries a ``clean`` verdict; disabled, ``unchecked`` — and the
+    priced memory stats are identical either way (the gate never
+    touches the program)."""
+    from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+    from das4whales_tpu.telemetry import costs
+
+    bdet = BatchedMatchedFilterDetector(chaos_detector, donate=False)
+    costs.reset()
+    try:
+        assert costs.contracts_enabled()
+        st_on = costs.capture_batched(bdet, 1, np.float64,
+                                      bucket="unit:gate", program="on")
+        costs.disable_contracts()
+        st_off = costs.capture_batched(bdet, 1, np.float64,
+                                       bucket="unit:gate", program="off")
+        card_on = costs.REGISTRY.get("unit:gate", "on", "fft")
+        card_off = costs.REGISTRY.get("unit:gate", "off", "fft")
+        assert card_on.contract == "clean"
+        assert card_on.contract_findings == ()
+        assert card_off.contract == "unchecked"
+        assert (st_on.peak, st_on.argument_bytes) == \
+               (st_off.peak, st_off.argument_bytes)
+        assert "contract" in card_on.as_dict()
+    finally:
+        costs.enable_contracts()
+        costs.reset()
+
+
+# ---------------------------------------------------------------------------
+# Retrace forensics
+# ---------------------------------------------------------------------------
+
+class TestRetraceForensics:
+    def test_signature_diff_names_weak_type_flip(self):
+        prev = {"arg[0]": programs._arg_signature(jnp.float32(1.0))}
+        cur = {"arg[0]": programs._arg_signature(1.0)}
+        (line,) = programs.signature_diff(prev, cur)
+        assert "weak_type False -> True" in line or "weak-" in line
+
+    def test_guard_names_the_flipping_argument(self, retrace_guard):
+        """The forensic acceptance unit: a Python-scalar call after an
+        array call retraces, and the error names arg[1]'s weak-type
+        flip rather than a bare compile count."""
+        def step(x, s):
+            return x * s
+
+        jstep = jax.jit(step)
+        x = jnp.arange(4.0, dtype=jnp.float32)
+        with pytest.raises(programs.RetraceError) as exc:
+            with retrace_guard(1, what="step") as g:
+                w = g.watch(jstep, what="step")
+                w(x, jnp.float32(2.0))
+                w(x, 2.0)   # weak-typed Python float: the silent retrace
+        msg = str(exc.value)
+        assert "arg[1]" in msg
+        assert "weak" in msg
+
+    def test_guard_passes_under_ceiling(self, retrace_guard):
+        jstep = jax.jit(lambda x: x + 1)
+        x = jnp.arange(3.0, dtype=jnp.float32)
+        with retrace_guard(1, what="stable") as g:
+            w = g.watch(jstep)
+            w(x)
+            w(x)   # same signature: no second compile
+
+    def test_static_hash_change_is_named(self):
+        prev = {"kwarg[mode]": programs._arg_signature("pack")}
+        cur = {"kwarg[mode]": programs._arg_signature("topk")}
+        (line,) = programs.signature_diff(prev, cur)
+        assert "static" in line and "kwarg[mode]" in line
