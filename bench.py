@@ -460,6 +460,7 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
     cost_info.update(_quality_live_report(det, res, block, ns))
     batch_info = _bench_batch(meta, nx, ns, block, wire, peak_block,
                               channel_tile, repeats)
+    batch_info.update(_bench_families(meta, nx, ns, block, repeats))
     if os.environ.get("DAS_BENCH_TSWEEP", "") not in ("", "0", "false"):
         # template-bank T-amortization sweep (ISSUE 10): opt-in — it
         # builds its own chirp-grid detectors (T compiles per size)
@@ -619,6 +620,63 @@ def _bench_batch(meta, nx, ns, block, wire, peak_block, channel_tile,
         "batch_n_dispatches": bdisp,
         "batch_n_syncs": bsync,
     }
+
+
+def _bench_families(meta, nx, ns, block, repeats):
+    """Per-family batched headline rows (``DAS_BENCH_FAMILIES=B``):
+    every non-MF family (spectro/gabor/learned) through its batched
+    one-program facade (``parallel.batch.batched_detector_for``) on a
+    ``[B, nx, ns]`` slab — the MF headline's exact measurement protocol,
+    so ``spectro_value``/``gabor_value``/``learned_value`` (ch*samples/
+    s/chip) read on the same axis as ``value``/``batch_value``. Each
+    row carries the per-call dispatch/sync deltas (healthy: 1 + 1 per
+    slab, B files amortized) and the family's resolved engine
+    (``stft_engine``/``gabor_engine`` — the MXU-route decision this
+    payload exists to watch)."""
+    try:
+        b = int(os.environ.get("DAS_BENCH_FAMILIES", "0") or 0)
+    except ValueError:
+        b = 0
+    if b < 1:
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    from das4whales_tpu.parallel.batch import batched_detector_for
+    from das4whales_tpu.telemetry import metrics as _tmetrics
+    from das4whales_tpu.workflows.campaign import family_detector
+
+    out = {"families": ["spectro", "gabor", "learned"]}
+    stack = jax.block_until_ready(
+        jnp.asarray(np.broadcast_to(block, (b,) + block.shape))
+    )
+    for family in out["families"]:
+        try:
+            det = family_detector(family, meta, [0, nx, 1], (nx, ns))
+            bdet = batched_detector_for(det, donate=False,
+                                        trace_shape=(nx, ns))
+            bdet.detect_batch(stack)  # compile + warm
+            walls = []
+            before = _tmetrics.resilience_counters()
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                bdet.detect_batch(stack)
+                walls.append(time.perf_counter() - t0)
+            delta = _tmetrics.resilience_delta(before)
+            wall = min(walls)
+            out[f"{family}_wall_s"] = round(wall, 4)
+            out[f"{family}_per_file_wall_s"] = round(wall / b, 4)
+            out[f"{family}_value"] = round(b * nx * ns / wall, 1)
+            out[f"{family}_n_dispatches"] = round(
+                delta.get("dispatches", 0) / repeats, 2)
+            out[f"{family}_n_syncs"] = round(
+                delta.get("syncs", 0) / repeats, 2)
+            out[f"{family}_engine"] = getattr(bdet, "engine", None)
+        except Exception as exc:  # noqa: BLE001 — a family row must
+            # never kill the flagship payload (e.g. a record too short
+            # for the spectro kernel design)
+            out[f"{family}_error"] = f"{type(exc).__name__}: {exc}"
+    return out
 
 
 def bench_template_sweep(meta, nx, ns, block, wire, repeats=3,
@@ -1545,6 +1603,9 @@ def main():
         "t_unit": "templates*ch*samples/s/chip",
         "n_templates": result.get("n_templates"),
         "bank": result.get("bank"),
+        # which detector family the headline measured (the flagship is
+        # the matched filter; the per-family rows below cover the rest)
+        "family": "mf",
         "vs_baseline": round(vs, 2) if vs == vs else None,
         "wall_s": round(wall, 4),
         "shape": [nx, ns],
@@ -1624,6 +1685,13 @@ def main():
                 "batch_single_file_value", "batch_amortization",
                 "batch_n_dispatches", "batch_n_syncs", "bank_sweep"):
         if key in result:
+            payload[key] = result[key]
+    # per-family batched rows (DAS_BENCH_FAMILIES=B): spectro/gabor/
+    # learned ch*samples/s/chip + dispatch/sync deltas + resolved
+    # engines, on the same axis as the MF headline (_bench_families)
+    for key in sorted(result):
+        if key == "families" or key.split("_", 1)[0] in (
+                "spectro", "gabor", "learned"):
             payload[key] = result[key]
     # service steady-state mode (DAS_BENCH_SERVICE=1): per-tenant rates,
     # scheduler overlap fraction, p95 slab latency (_bench_service)

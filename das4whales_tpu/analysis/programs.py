@@ -424,15 +424,31 @@ CANONICAL_VARIANTS: Tuple[Tuple[str, str], ...] = (
     ("fft", "matmul"),
 )
 
+#: the family facades' canonical scene. The mf chaos shape (24, 900)
+#: is spectro-degenerate (records shorter than the spectral windowing
+#: needs), so the non-mf variants compile at an fs=200 scene long
+#: enough for all three facades' default designs.
+FAMILY_CANONICAL_SHAPE = (16, 2000)
+
+#: the non-mf detector families the batched one-program contract
+#: covers — one artifact each, compiled at FAMILY_CANONICAL_SHAPE with
+#: the facade's auto-resolved engine (the key matches what the cost
+#: observatory records via ``telemetry.costs._contract_engine``).
+FAMILY_VARIANTS: Tuple[str, ...] = ("spectro", "gabor", "learned")
+
 
 def canonical_artifacts(batch: int = 1, wire: str = "float32",
                         variants: Sequence[Tuple[str, str]] = CANONICAL_VARIANTS,
-                        donate: bool = False) -> List[ProgramArtifact]:
+                        donate: bool = False,
+                        families: Sequence[str] = FAMILY_VARIANTS,
+                        ) -> List[ProgramArtifact]:
     """Compile (once each) and capture the canonical program-variant
     set: the batched one-program family at ``CANONICAL_SHAPE`` per
-    engine pair. This is the jax-importing entry — the CLI driver and
-    the tier-1 gate share it, so they audit identical programs. One
-    compile per variant; the audit itself adds zero.
+    engine pair, plus one batched facade program per non-mf family
+    (``FAMILY_VARIANTS``) at ``FAMILY_CANONICAL_SHAPE``. This is the
+    jax-importing entry — the CLI driver and the tier-1 gate share it,
+    so they audit identical programs. One compile per variant; the
+    audit itself adds zero.
 
     Captured under ``disable_x64`` regardless of the ambient flag: the
     x64 mode changes the lowering (extra f64 converts), and the
@@ -479,6 +495,32 @@ def canonical_artifacts(batch: int = 1, wire: str = "float32",
                 donated_bytes=int(batch * nx * ns * dtype.itemsize),
                 peak_bytes=int(an.memory.peak if an.memory else 0),
             ))
+        if families:
+            from ..parallel.batch import batched_detector_for
+            from ..telemetry.costs import _contract_engine
+            from ..workflows.campaign import family_detector
+
+            fnx, fns = FAMILY_CANONICAL_SHAPE
+            fmd = SyntheticScene(nx=fnx, ns=fns).metadata
+            fbucket = bucket_label((fnx, fns, dtype.name))
+            for family in families:
+                det = family_detector(family, fmd, [0, fnx, 1], (fnx, fns))
+                bdet = batched_detector_for(det, donate=False,
+                                            trace_shape=(fnx, fns))
+                if hasattr(bdet, "_resolve_engines"):
+                    bdet._resolve_engines((batch, fnx, fns))
+                an = memutils.batched_program_analysis(
+                    bdet, batch, dtype, capture_ir=True, donate=donate)
+                if an is None or an.hlo_text is None:
+                    continue
+                out.append(ProgramArtifact(
+                    bucket=fbucket, label=f"batched:{batch}",
+                    engine=_contract_engine(bdet), wire_dtype=dtype.name,
+                    jaxpr_text=an.jaxpr_text or "", hlo_text=an.hlo_text,
+                    donated=(0,) if donate else (),
+                    donated_bytes=int(batch * fnx * fns * dtype.itemsize),
+                    peak_bytes=int(an.memory.peak if an.memory else 0),
+                ))
     return out
 
 

@@ -12,6 +12,7 @@ correlation.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Dict, Tuple
 
@@ -53,15 +54,19 @@ def design_gabor(
     return GaborDesign(up, down, theta, bin_factor, threshold1, threshold2)
 
 
-@jax.jit
-def _gabor_score(image: jnp.ndarray, up: jnp.ndarray, down: jnp.ndarray) -> jnp.ndarray:
+@functools.partial(jax.jit, static_argnames=("engine",))
+def _gabor_score(image: jnp.ndarray, up: jnp.ndarray, down: jnp.ndarray,
+                 engine: str = "fft") -> jnp.ndarray:
     """Sum of both-orientation Gabor responses (cv2.filter2D correlation
-    semantics, main_gabordetect.py:109)."""
-    return img_ops.filter2d_same(image, up) + img_ops.filter2d_same(image, down)
+    semantics, main_gabordetect.py:109). ``engine`` is the
+    ``ops.image.filter2d_same`` switch: ``"conv"`` runs the oriented
+    pair as f32-accumulated ``conv_general_dilated`` (MXU on TPU)."""
+    return (img_ops.filter2d_same(image, up, engine=engine)
+            + img_ops.filter2d_same(image, down, engine=engine))
 
 
 def gabor_mask(
-    trf_fk: jnp.ndarray, design: GaborDesign
+    trf_fk: jnp.ndarray, design: GaborDesign, engine: str = "fft"
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Compute the binned Gabor score, binary image, and full-resolution
     smooth mask (main_gabordetect.py:78-169).
@@ -73,9 +78,9 @@ def gabor_mask(
 
     image = img_ops.trace2image(trf_fk)
     imagebin = img_ops.binning(image, design.bin_factor, design.bin_factor)
-    score = _gabor_score(imagebin, up, down)
+    score = _gabor_score(imagebin, up, down, engine=engine)
     binary = (score > design.threshold1).astype(trf_fk.dtype)
-    mask_binned = _gabor_score(binary, up, down) > design.threshold2
+    mask_binned = _gabor_score(binary, up, down, engine=engine) > design.threshold2
     # upsample the mask back to the exact trace shape in one resize
     mask_full = jax.image.resize(
         mask_binned.astype(trf_fk.dtype), trf_fk.shape, method="linear", antialias=False
@@ -118,6 +123,7 @@ class GaborDetector:
         notes: Dict[str, Tuple[float, float, float]] | None = None,
         max_peaks: int = 256,
         ksize: int = 100,
+        gabor_engine: str | None = None,
     ):
         self.metadata = as_metadata(metadata)
         self.design = design_gabor(self.metadata, selected_channels, c0, bin_factor, threshold1, threshold2, ksize=ksize)
@@ -132,17 +138,55 @@ class GaborDetector:
             chirp = np.asarray(gen_hyperbolic_chirp(fmin, fmax, dur, fs))
             self.notes[name] = jnp.asarray(chirp * np.hanning(len(chirp)))
         self.max_peaks = max_peaks
+        # requested oriented-pair correlation engine (None/"auto" defers
+        # to the per-shape A/B router at the first block's binned shape);
+        # the resolved label + reason land on ``gabor_engine`` /
+        # ``gabor_engine_reason`` for planner ledgers and cost cards
+        self._gabor_engine_req = gabor_engine
+        self.gabor_engine: str | None = None
+        self.gabor_engine_reason: str | None = None
 
-    def __call__(self, trf_fk: jnp.ndarray, threshold: float | None = None):
-        """Detect on a filtered block. ``threshold`` overrides the
-        reference's relative 0.5·max policy with an absolute value (same
-        override contract as MatchedFilterDetector — used by
-        eval.threshold_sweep)."""
-        score, mask_binned, masked_tr = gabor_mask(jnp.asarray(trf_fk), self.design)
+    def resolve_engine(self, trace_shape) -> str:
+        """Resolve (once, cached on self) the filter2d engine at the
+        BINNED image shape the oriented pair actually sweeps. Eager-safe
+        only: callers tracing the heavy stage (the batched facade) must
+        resolve before tracing so the A/B never runs under a trace."""
+        if self.gabor_engine is None:
+            from ..ops import mxu
+
+            binned = (
+                max(1, int(trace_shape[-2] * self.design.bin_factor)),
+                max(1, int(trace_shape[-1] * self.design.bin_factor)),
+            )
+            eng, why = mxu.resolve_gabor_engine(
+                self._gabor_engine_req, binned, self.design.gabor_up.shape
+            )
+            self.gabor_engine, self.gabor_engine_reason = eng, why
+        return self.gabor_engine
+
+    def correlograms(self, trf_fk: jnp.ndarray):
+        """Heavy device stage: mask + per-note masked matched filter.
+        Returns ``(score, mask_binned, masked_trace, correlograms)``.
+        The batched facade (``parallel.batch.BatchedGaborDetector``)
+        maps the correlogram subset of exactly this over the B file
+        axis; :meth:`picks_from_correlograms` is the finalize both
+        routes share (bit-identical batched vs per-file picks)."""
+        engine = self.resolve_engine(trf_fk.shape)
+        score, mask_binned, masked_tr = gabor_mask(
+            jnp.asarray(trf_fk), self.design, engine=engine
+        )
         correlograms = {
             name: masked_matched_filter(masked_tr, note.astype(masked_tr.dtype))
             for name, note in self.notes.items()
         }
+        return score, mask_binned, masked_tr, correlograms
+
+    def picks_from_correlograms(
+        self, correlograms: Dict[str, jnp.ndarray],
+        threshold: float | None = None,
+    ):
+        """Finalize stage: relative-threshold policy + per-note envelope
+        picks. Returns ``(picks, thres, thresholds)``."""
         if threshold is None:
             # one device sync for the global max, not one per note
             maxv = float(jnp.max(jnp.stack(
@@ -169,6 +213,17 @@ class GaborDetector:
             peak_ops.warn_saturated(saturated, f"note {name}", self.max_peaks)
             # device-side compaction: only O(picks) ints cross to the host
             picks[name] = peak_ops.pick_times_compacted(pos, sel)
+        return picks, thres, thresholds
+
+    def __call__(self, trf_fk: jnp.ndarray, threshold: float | None = None):
+        """Detect on a filtered block. ``threshold`` overrides the
+        reference's relative 0.5·max policy with an absolute value (same
+        override contract as MatchedFilterDetector — used by
+        eval.threshold_sweep)."""
+        score, mask_binned, masked_tr, correlograms = self.correlograms(trf_fk)
+        picks, thres, thresholds = self.picks_from_correlograms(
+            correlograms, threshold
+        )
         return {
             "score": score,
             "mask": mask_binned,

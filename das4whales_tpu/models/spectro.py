@@ -31,17 +31,20 @@ RFFT_DEFAULT_BATCH = 1024
 
 
 def sliced_spectrogram(
-    trace: jnp.ndarray, fs: float, fmin: float, fmax: float, nperseg: int, nhop: int
+    trace: jnp.ndarray, fs: float, fmin: float, fmax: float, nperseg: int,
+    nhop: int, engine: str = "auto",
 ) -> Tuple[jnp.ndarray, np.ndarray, np.ndarray]:
     """Max-normalized STFT magnitude sliced to [fmin, fmax], batched over
     leading axes.
 
     Parity: reference ``detect.get_sliced_nspectrogram`` (detect.py:334-408)
     — librosa-convention STFT, per-signal global-max normalization, then a
-    frequency slice. Returns ``(p, ff, tt)``. On TPU the magnitudes come
-    from the Pallas MXU-DFT kernel (ops/pallas_stft.py).
+    frequency slice. Returns ``(p, ff, tt)``. ``engine`` is the
+    ``spectral.stft_magnitude`` switch: on TPU the magnitudes come from
+    the Pallas MXU-DFT kernel (ops/pallas_stft.py) or the framed
+    windowed-DFT matmul where the A/B router selects it.
     """
-    mag = spectral.stft_magnitude(trace, nperseg, nhop)
+    mag = spectral.stft_magnitude(trace, nperseg, nhop, engine=engine)
     nf, nt = mag.shape[-2], mag.shape[-1]
     tt = np.linspace(0, trace.shape[-1] / fs, num=nt)
     ff = np.linspace(0, fs / 2, num=nf)
@@ -154,6 +157,7 @@ def compute_cross_correlogram_spectrocorr(
     win_size: float,
     overlap_pct: float,
     batch_channels: int | None = None,
+    stft_engine: str = "auto",
 ) -> jnp.ndarray:
     """Spectrogram-correlation correlogram for all channels.
 
@@ -163,15 +167,20 @@ def compute_cross_correlogram_spectrocorr(
     (optionally channel-chunked) batched computation.
 
     ``batch_channels`` defaults by STFT engine: 4096 under the Pallas
-    kernel (framing stays in VMEM), 1024 under the rFFT fallback — whose
-    overlapped frame tensor costs ~1.8 MB/channel of temps at the
+    kernel (framing stays in VMEM), 1024 under the rFFT/matmul paths —
+    whose overlapped frame tensor costs ~1.8 MB/channel of temps at the
     detector's 95% overlap (7.4 GB at 4096; AOT-measured — the same HBM
     class as the round-2 matched-filter OOM).
+
+    ``stft_engine`` selects the spectrogram transform (resolved exactly
+    like ``spectral.stft_magnitude``; the per-shape A/B router is
+    ``SpectroCorrDetector``'s job — this stage takes the decision).
     """
+    engine = spectral.resolve_stft_engine(stft_engine)
     if batch_channels is None:
         batch_channels = (
             PALLAS_DEFAULT_BATCH
-            if spectral.resolve_stft_engine() == "pallas"
+            if engine == "pallas"
             else RFFT_DEFAULT_BATCH
         )
     nperseg = int(win_size * fs)
@@ -191,23 +200,26 @@ def compute_cross_correlogram_spectrocorr(
     chunks = [
         _chunk_correlogram(norm[i : i + batch_channels], ker_dev,
                            fs=fs, fmin=fmin, fmax=fmax,
-                           nperseg=nperseg, nhop=nhop)
+                           nperseg=nperseg, nhop=nhop, engine=engine)
         for i in range(0, norm.shape[0], batch_channels)
     ]
     return jnp.concatenate(chunks, axis=0) if len(chunks) > 1 else chunks[0]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("fs", "fmin", "fmax", "nperseg", "nhop")
+    jax.jit, static_argnames=("fs", "fmin", "fmax", "nperseg", "nhop",
+                              "engine")
 )
-def _chunk_correlogram(chunk, ker, *, fs, fmin, fmax, nperseg, nhop):
+def _chunk_correlogram(chunk, ker, *, fs, fmin, fmax, nperseg, nhop,
+                       engine="auto"):
     """One channel-chunk's sliced spectrogram + hat-kernel correlation.
 
     Module-level jit (NOT a closure inside the caller): a nested
     ``@jax.jit`` function is a fresh callable per call, so every file of
     a campaign re-traced the whole chunk program; here repeat calls at
     the same shapes/knobs hit the jit cache."""
-    spec, _, _ = sliced_spectrogram(chunk, fs, fmin, fmax, nperseg, nhop)
+    spec, _, _ = sliced_spectrogram(chunk, fs, fmin, fmax, nperseg, nhop,
+                                    engine=engine)
     return xcorr2d(spec, ker)
 
 
@@ -229,6 +241,7 @@ class SpectroCorrDetector:
         threshold: float = 14.0,
         max_peaks: int = 256,
         batch_channels: int | None = None,
+        stft_engine: str | None = None,
     ):
         self.metadata = as_metadata(metadata)
         self.flims = flims
@@ -240,6 +253,30 @@ class SpectroCorrDetector:
         # channel-chunk size of the spectrogram sweep (None: the
         # engine-aware default — compute_cross_correlogram_spectrocorr)
         self.batch_channels = batch_channels
+        # requested STFT engine (None/"auto" defers to the per-shape A/B
+        # router at the first block's shape — resolve_stft_engine_ab);
+        # the resolved label + reason land on ``stft_engine`` /
+        # ``stft_engine_reason`` for the planner ledger and cost cards
+        self._stft_engine_req = stft_engine
+        self.stft_engine: str | None = None
+        self.stft_engine_reason: str | None = None
+
+    def resolve_engine(self, trace_shape) -> str:
+        """Resolve (once, cached on self) the STFT engine at the sweep
+        shape via the PR 8-pattern A/B router. Eager-safe only: callers
+        tracing the heavy stage (the batched facade) must resolve BEFORE
+        tracing so the A/B measurement never runs under a trace."""
+        if self.stft_engine is None:
+            from ..ops import mxu
+
+            nperseg = int(self.win_size * self.metadata.fs)
+            nhop = int(np.floor(nperseg * (1 - self.overlap_pct)))
+            eng, why = mxu.resolve_stft_engine_ab(
+                self._stft_engine_req, trace_shape[-2], trace_shape[-1],
+                nperseg, nhop,
+            )
+            self.stft_engine, self.stft_engine_reason = eng, why
+        return self.stft_engine
 
     def tiled_view(self) -> "SpectroCorrDetector":
         """A shallow view sweeping the spectrogram in smaller channel
@@ -264,15 +301,30 @@ class SpectroCorrDetector:
 
         return cached_shallow_view(self, "_tiled_view_cache", mutate)
 
-    def __call__(self, trf_fk: jnp.ndarray):
+    def correlograms(self, trf_fk: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        """Heavy device stage: per-kernel spectro correlograms
+        ``[..., C, nt]``. Pure function of the block — the batched
+        facade (``parallel.batch.BatchedSpectroDetector``) maps exactly
+        this over the B file axis; :meth:`picks_from_correlograms` is
+        the host-boundary finalize both routes share, which is what
+        keeps batched picks bit-identical to the per-file rung."""
+        engine = self.resolve_engine(trf_fk.shape)
         fs = self.metadata.fs
-        correlograms, picks = {}, {}
-        for name, ker in self.kernels.items():
-            corr = compute_cross_correlogram_spectrocorr(
-                trf_fk, fs, self.flims, ker, self.win_size, self.overlap_pct,
-                batch_channels=self.batch_channels,
+        return {
+            name: compute_cross_correlogram_spectrocorr(
+                trf_fk, fs, self.flims, ker, self.win_size,
+                self.overlap_pct, batch_channels=self.batch_channels,
+                stft_engine=engine,
             )
-            correlograms[name] = corr
+            for name, ker in self.kernels.items()
+        }
+
+    def picks_from_correlograms(self, correlograms: Dict[str, jnp.ndarray]):
+        """Finalize stage: escalation picks per kernel + the correlogram
+        sampling rate. Consumes :meth:`correlograms` output (device or
+        re-uploaded host copies — the math is value-deterministic)."""
+        picks = {}
+        for name, corr in correlograms.items():
             # correlograms are half-wave rectified (nonnegative), so the
             # sparse height-prefiltered route is exact; adaptive K with
             # exact escalation on saturation (ops.peaks)
@@ -288,5 +340,10 @@ class SpectroCorrDetector:
             # (the flagship's boundary-crossing reduction, ops.peaks)
             picks[name] = peak_ops.pick_times_compacted(pos, sel)
         nt = next(iter(correlograms.values())).shape[-1]
-        spectro_fs = nt / (self.metadata.ns / fs)
+        spectro_fs = nt / (self.metadata.ns / self.metadata.fs)
+        return picks, spectro_fs
+
+    def __call__(self, trf_fk: jnp.ndarray):
+        correlograms = self.correlograms(trf_fk)
+        picks, spectro_fs = self.picks_from_correlograms(correlograms)
         return correlograms, picks, spectro_fs

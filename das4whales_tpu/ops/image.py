@@ -88,20 +88,64 @@ def gabor_filt_design(theta_c0: float, ksize: int = 100, sigma: float = 4.0,
     return up, np.flipud(up)
 
 
-@functools.partial(jax.jit, static_argnames=("border",))
-def filter2d_same(img: jnp.ndarray, kernel: jnp.ndarray, border: str = "reflect") -> jnp.ndarray:
+#: 2-D same-correlation engines (resolved static values; the router's
+#: external vocabulary adds "auto"): ``fft`` is the batched-FFT product,
+#: ``conv`` the ``lax.conv_general_dilated`` im2col matmul with f32
+#: accumulation — on TPU it lowers straight onto the MXU (the TINA
+#: recast, arxiv 2408.16551).
+FILTER2D_ENGINES = ("fft", "conv")
+
+
+def _conv2d_corr(img: jnp.ndarray, kernel: jnp.ndarray, pad) -> jnp.ndarray:
+    """Cross-correlation of ``img``'s trailing [H, W] plane with one
+    [m1, m2] kernel via ``conv_general_dilated`` (XLA's im2col matmul;
+    the ML convention does NOT flip — exactly cv2.filter2D), f32
+    accumulation, leading axes folded into the conv batch."""
+    lead = img.shape[:-2]
+    lhs = img.reshape((-1, 1) + img.shape[-2:])      # [batch, feat=1, H, W]
+    rhs = kernel[None, None, :, :]                   # [out=1, in=1, m1, m2]
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1, 1), padding=pad,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(lead + out.shape[-2:]).astype(img.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("border", "engine"))
+def filter2d_same(img: jnp.ndarray, kernel: jnp.ndarray, border: str = "reflect",
+                  engine: str = "fft") -> jnp.ndarray:
     """Correlation (cv2.filter2D semantics: the kernel is NOT flipped) in
-    'same' geometry. FFT-based, batched over leading axes.
+    'same' geometry, batched over leading axes.
 
     ``border='reflect'`` (numpy reflect == cv2's default BORDER_REFLECT_101)
     matches ``cv2.filter2D``'s edge handling; ``border='constant'``
-    zero-pads like scipy's fftconvolve."""
-    flipped = jnp.flip(jnp.flip(kernel, axis=-1), axis=-2)
-    if border == "constant":
-        return fftconvolve2d_same(img, flipped)
+    zero-pads like scipy's fftconvolve.
+
+    ``engine='fft'`` runs the batched-FFT product; ``engine='conv'`` runs
+    the SAME geometry as a ``conv_general_dilated`` im2col matmul with f32
+    accumulation (MXU on TPU). Outputs agree to matmul-vs-FFT rounding;
+    the router (``ops.mxu.resolve_gabor_engine``) decides per shape."""
     m1, m2 = kernel.shape[-2], kernel.shape[-1]
     a1, a2 = (m1 - 1) // 2, (m2 - 1) // 2
     b1, b2 = m1 - 1 - a1, m2 - 1 - a2
+    if engine == "conv":
+        kernel = jnp.asarray(kernel, dtype=img.dtype)
+        if border == "constant":
+            # zero-pad low by b (the FFT path's same-crop anchor for
+            # even kernels) so both engines share one alignment
+            return _conv2d_corr(img, kernel, [(b1, a1), (b2, a2)])
+        pad = [(0, 0)] * (img.ndim - 2) + [(a1, b1), (a2, b2)]
+        return _conv2d_corr(jnp.pad(img, pad, mode=border), kernel,
+                            [(0, 0), (0, 0)])
+    if engine != "fft":
+        raise ValueError(
+            f"unknown filter2d engine {engine!r}; expected one of "
+            f"{FILTER2D_ENGINES}"
+        )
+    flipped = jnp.flip(jnp.flip(kernel, axis=-1), axis=-2)
+    if border == "constant":
+        return fftconvolve2d_same(img, flipped)
     pad = [(0, 0)] * (img.ndim - 2) + [(a1, b1), (a2, b2)]
     x = jnp.pad(img, pad, mode=border)
     out = fftconvolve2d_same(x, flipped)

@@ -393,6 +393,73 @@ def calibrate_fk(C: int, n: int, lo: int, hi: int, *,
     return entry
 
 
+#: Jitted A/B entry for the STFT engines (the detection programs inline
+#: :func:`spectral.stft_magnitude` under their own jit).
+_stft_magnitude_timed = jax.jit(
+    spectral.stft_magnitude, static_argnames=("nfft", "hop", "engine")
+)
+
+
+def calibrate_stft(C: int, n: int, nfft: int, hop: int, *,
+                   table: CalibrationTable | None = None,
+                   backend: str | None = None, repeats: int = 2) -> dict:
+    """A/B the STFT-magnitude engines (batched rFFT vs framed windowed-DFT
+    matmul, plus the Pallas kernel on TPU where it runs) at the given
+    shape; measured once, cached. Linear in channels like the correlate,
+    so the measurement runs at ``min(C, 2048)`` rows."""
+    table = table or default_table()
+    backend = backend or jax.default_backend()
+    key = f"stft|{backend}|C{C}xN{n}|nfft{nfft}h{hop}"
+    hit = table.get(key)
+    if hit is not None:
+        return hit
+    Cc = min(int(C), _CAL_MAX_CHANNELS)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(Cc, int(n))).astype(np.float32))
+    entry = {"cal_channels": Cc}
+    candidates = ("rfft", "matmul") + (("pallas",) if backend == "tpu" else ())
+    for eng in candidates:
+        entry[f"{eng}_s"] = _best_wall(
+            lambda e=eng: _stft_magnitude_timed(
+                x, nfft=int(nfft), hop=int(hop), engine=e
+            ),
+            repeats,
+        )
+    entry["winner"] = min(candidates, key=lambda e: entry[f"{e}_s"])
+    table.put(key, entry)
+    return entry
+
+
+def calibrate_gabor(H: int, W: int, m1: int, m2: int, *,
+                    table: CalibrationTable | None = None,
+                    backend: str | None = None, repeats: int = 2) -> dict:
+    """A/B the 2-D same-correlation engines (batched FFT product vs
+    ``conv_general_dilated`` im2col matmul) at the given binned-image and
+    kernel shape; measured once, cached."""
+    from . import image as image_ops
+
+    table = table or default_table()
+    backend = backend or jax.default_backend()
+    key = f"gabor|{backend}|H{H}xW{W}|k{m1}x{m2}"
+    hit = table.get(key)
+    if hit is not None:
+        return hit
+    rng = np.random.default_rng(0)
+    img = jnp.asarray(rng.normal(size=(int(H), int(W))).astype(np.float32))
+    ker = jnp.asarray(rng.normal(size=(int(m1), int(m2))).astype(np.float32))
+    entry = {
+        "fft_s": _best_wall(
+            lambda: image_ops.filter2d_same(img, ker, engine="fft"), repeats
+        ),
+        "conv_s": _best_wall(
+            lambda: image_ops.filter2d_same(img, ker, engine="conv"), repeats
+        ),
+    }
+    entry["winner"] = "fft" if entry["fft_s"] <= entry["conv_s"] else "conv"
+    table.put(key, entry)
+    return entry
+
+
 # ---------------------------------------------------------------------------
 # bf16 precision gate
 # ---------------------------------------------------------------------------
@@ -632,13 +699,82 @@ def resolve_fk_engine(requested, n_channels, time_samples, band, *,
     )
 
 
+def resolve_stft_engine_ab(requested, n_channels, time_samples, nfft, hop, *,
+                           table: CalibrationTable | None = None,
+                           backend: str | None = None) -> Tuple[str, str]:
+    """Resolve the STFT-magnitude engine at the spectro family's sweep
+    shape. ``requested``: ``"rfft"`` / ``"matmul"`` / ``"pallas"``
+    (forced) / ``"auto"`` / None (defer to ``DAS4WHALES_STFT_ENGINE``,
+    default auto). Auto: the rFFT route off-TPU (no MXU to win); on TPU
+    the per-shape A/B calibration (measured once, cached) picks the
+    fastest of rfft/matmul/pallas. Returns ``(engine, reason)`` — the
+    reason is stamped into bench payloads and planner ledgers, exactly
+    the :func:`resolve_mf_engine` contract."""
+    req = requested or "auto"
+    if req == "auto":
+        req = os.environ.get("DAS4WHALES_STFT_ENGINE", "auto")
+    if req in spectral.STFT_ENGINES:
+        return req, "forced"
+    if req != "auto":
+        raise ValueError(
+            f"unknown stft engine {req!r}; expected one of "
+            f"{spectral.STFT_ENGINES + ('auto',)}"
+        )
+    backend = backend or jax.default_backend()
+    if backend != "tpu":
+        return "rfft", f"auto: backend {backend!r} has no MXU; rFFT route"
+    ab = calibrate_stft(int(n_channels), int(time_samples), int(nfft),
+                        int(hop), table=table, backend=backend)
+    win = ab["winner"]
+    detail = ", ".join(
+        f"{e} {ab[f'{e}_s']:.4g}s"
+        for e in ("rfft", "matmul", "pallas") if f"{e}_s" in ab
+    )
+    return win, f"auto: A/B {win} wins ({detail})"
+
+
+def resolve_gabor_engine(requested, image_shape, kernel_shape, *,
+                         table: CalibrationTable | None = None,
+                         backend: str | None = None) -> Tuple[str, str]:
+    """Resolve the gabor family's 2-D same-correlation engine at the
+    binned-image shape its oriented-kernel pair actually sweeps.
+    ``requested``: ``"fft"`` / ``"conv"`` (forced) / ``"auto"`` / None
+    (defer to ``DAS_GABOR_ENGINE``, default auto). Auto: FFT off-TPU;
+    on TPU the per-shape A/B calibration decides. Returns
+    ``(engine, reason)``."""
+    from . import image as image_ops
+
+    req = requested or os.environ.get("DAS_GABOR_ENGINE", "auto")
+    if req in image_ops.FILTER2D_ENGINES:
+        return req, "forced"
+    if req != "auto":
+        raise ValueError(
+            f"unknown gabor engine {req!r}; expected one of "
+            f"{image_ops.FILTER2D_ENGINES + ('auto',)}"
+        )
+    backend = backend or jax.default_backend()
+    if backend != "tpu":
+        return "fft", f"auto: backend {backend!r} has no MXU; FFT route"
+    H, W = int(image_shape[0]), int(image_shape[1])
+    m1, m2 = int(kernel_shape[0]), int(kernel_shape[1])
+    ab = calibrate_gabor(H, W, m1, m2, table=table, backend=backend)
+    if ab["winner"] == "conv":
+        return "conv", (
+            f"auto: A/B conv {ab['conv_s']:.4g}s < fft {ab['fft_s']:.4g}s"
+        )
+    return "fft", (
+        f"auto: A/B fft {ab['fft_s']:.4g}s <= conv {ab['conv_s']:.4g}s"
+    )
+
+
 def engine_labels(detector) -> Dict[str, str]:
     """The resolved engine labels a detector rides (empty for families
     without engine routing) — stamped into bench payloads and the
     planner's downshift-ledger rung descriptions so every rung's route
     is auditable."""
     out = {}
-    for attr in ("mf_engine", "fk_engine", "pick_engine"):
+    for attr in ("mf_engine", "fk_engine", "pick_engine", "stft_engine",
+                 "gabor_engine"):
         val = getattr(detector, attr, None)
         if val:
             out[attr] = str(val)

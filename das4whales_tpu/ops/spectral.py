@@ -165,19 +165,71 @@ def stft(
     return jnp.swapaxes(spec, -1, -2)  # [..., freq, frame]
 
 
+#: STFT-magnitude engine vocabulary (resolved static values; the routers'
+#: external vocabulary adds "auto"). ``rfft`` is the batched-FFT path,
+#: ``matmul`` the framed ``[frames, tap] @ [tap, 2F]`` MXU contraction
+#: (arxiv 2002.03260), ``pallas`` the fused VMEM-framing TPU kernel.
+STFT_ENGINES = ("rfft", "matmul", "pallas")
+
+
+@functools.lru_cache(maxsize=8)
+def _stft_matmul_matrix(nfft: int) -> np.ndarray:
+    """Windowed real-DFT matrix ``[nfft, 2F]`` with cos|sin halves,
+    ``F = nfft//2 + 1``, periodic Hann folded in: ``frames @ M`` gives
+    (re | -im) of ``rfft(frames * win)`` — the sign of im cancels in the
+    magnitude. Same design math as ``pallas_stft._dft_matrix`` (host,
+    float64 angle grid, cast to f32 once per nfft)."""
+    k = np.arange(nfft)[:, None]
+    f = np.arange(nfft / 2 + 1)[None, :]
+    ang = 2.0 * np.pi * k * f / nfft
+    win = 0.5 - 0.5 * np.cos(2.0 * np.pi * np.arange(nfft) / nfft)
+    cos = np.cos(ang) * win[:, None]
+    sin = np.sin(ang) * win[:, None]
+    return np.concatenate([cos, sin], axis=1).astype(np.float32)
+
+
+def stft_magnitude_matmul(x: jnp.ndarray, nfft: int, hop: int) -> jnp.ndarray:
+    """``|STFT|`` as the framed ``[frames, tap] @ [tap, 2F]`` MXU matmul:
+    librosa framing identical to :func:`stft` (centered, zero-padded),
+    but the window multiply and the DFT fuse into ONE precomputed
+    windowed-DFT matrix so the whole transform is a single f32-accumulated
+    ``dot_general`` per block (the TINA/2002.03260 recast — on TPU it
+    lowers straight onto the MXU). Shapes/conventions identical to
+    ``abs(stft(...))``; values agree to matmul-vs-FFT rounding (~1e-6
+    relative at f32), so the router only selects it where the decision
+    pins hold."""
+    n = x.shape[-1]
+    pad = [(0, 0)] * (x.ndim - 1) + [(nfft // 2, nfft // 2)]
+    xp = jnp.pad(x, pad)
+    n_frames = 1 + n // hop
+    idx = np.arange(n_frames)[:, None] * hop + np.arange(nfft)[None, :]
+    frames = xp[..., idx]  # [..., n_frames, nfft]
+    mat = jnp.asarray(_stft_matmul_matrix(nfft))
+    proj = jax.lax.dot_general(
+        frames, mat, (((frames.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [..., n_frames, 2F]
+    nf = nfft // 2 + 1
+    re, im = proj[..., :nf], proj[..., nf:]
+    mag = jnp.sqrt(re * re + im * im).astype(x.dtype)
+    return jnp.swapaxes(mag, -1, -2)  # [..., freq, frame]
+
+
 def resolve_stft_engine(engine: str = "auto") -> str:
     """Resolve the STFT engine exactly as ``stft_magnitude`` will:
     explicit arg > ``DAS4WHALES_STFT_ENGINE`` env > backend default
     (TPU→pallas, else rfft). Exposed so batch-size heuristics upstream
     (e.g. the spectro detector's channel chunking) can agree with the
-    engine that actually runs."""
+    engine that actually runs. The per-shape A/B router (PR 8 pattern)
+    is ``ops.mxu.resolve_stft_engine_ab``; forced engines and the env
+    override resolve identically through both."""
     import os
 
     if engine == "auto":
         engine = os.environ.get("DAS4WHALES_STFT_ENGINE", "auto")
     if engine == "auto":
         engine = "pallas" if jax.default_backend() == "tpu" else "rfft"
-    if engine not in ("pallas", "rfft"):
+    if engine not in STFT_ENGINES:
         raise ValueError(f"unknown stft engine {engine!r}")
     return engine
 
@@ -187,15 +239,18 @@ def stft_magnitude(
 ) -> jnp.ndarray:
     """``|STFT|`` with an engine switch: the Pallas MXU-DFT kernel
     (ops/pallas_stft.py) on TPU — framing stays in VMEM instead of a
-    ``nfft/hop``-fold HBM materialization — or the batched-rFFT path
-    elsewhere. Shapes/conventions identical to ``abs(stft(...))``.
+    ``nfft/hop``-fold HBM materialization — the framed windowed-DFT
+    matmul (:func:`stft_magnitude_matmul`), or the batched-rFFT path.
+    Shapes/conventions identical to ``abs(stft(...))``.
 
     ``engine``: ``"auto"`` (env ``DAS4WHALES_STFT_ENGINE`` overrides, then
-    TPU→pallas, else rfft), ``"pallas"``, or ``"rfft"``.
+    TPU→pallas, else rfft), ``"pallas"``, ``"matmul"``, or ``"rfft"``.
     """
     engine = resolve_stft_engine(engine)
     if engine == "rfft":
         return jnp.abs(stft(x, nfft, hop))
+    if engine == "matmul":
+        return stft_magnitude_matmul(x, nfft, hop)
 
     from .pallas_stft import stft_power
 

@@ -170,7 +170,8 @@ class BatchedMatchedFilterDetector:
     """
 
     #: detector-family label stamped on campaign records
-    #: (workflows.planner; the batched slab route is MF-only today)
+    #: (workflows.planner; every detector family has a batched facade —
+    #: see :func:`batched_detector_for`)
     family = "mf"
 
     def __init__(self, detector: MatchedFilterDetector, donate: bool = True,
@@ -375,3 +376,305 @@ class BatchedMatchedFilterDetector:
             return out
 
         return InFlightResult(resolve)
+
+
+class _BatchedFamilyDetector:
+    """Shared batched-facade machinery for the non-MF detector families
+    (spectro / gabor / learned): a ``[B, C, T]`` slab in, per-file
+    ``(picks, thresholds[, stats])`` entries out, ONE heavy XLA program
+    per slab.
+
+    The family split mirrors the detectors' own two-stage refactor: the
+    HEAVY stage (prefilter + correlograms/scores — pure function of the
+    block) is jitted once per facade and mapped over the B file axis
+    (``lax.map`` serial on CPU, ``vmap`` on accelerators — the same
+    switch as :func:`_batched_body`); the FINALIZE stage (escalation
+    picks, thresholds) reuses the family's own per-file finalize
+    (``picks_from_correlograms`` / ``picks_from_scores``) on each file's
+    slice of the mapped output. In serial mode each mapped row is
+    bitwise-identical to the per-file composition (the parity suite pins
+    batched picks == per-file picks for every family).
+
+    ``donate`` is accepted for API parity with
+    :class:`BatchedMatchedFilterDetector` but inert for the same R12
+    reason: the heavy outputs (correlograms ``[B, C, nt]`` / scores
+    ``[B, C, n_win]``) are never slab-shaped, so XLA has nothing to
+    alias the slab into. Health stats follow the families' planner
+    route: host-side ``ops.health.host_health_stats`` on each file's
+    host row (``supports_fused_health=False`` — same values, one numpy
+    pass).
+    """
+
+    family = "generic"
+
+    def __init__(self, detector, donate: bool = True,
+                 serial: bool | None = None, trace_shape=None):
+        self.det = detector
+        self.donate = bool(donate)
+        if serial is None:
+            serial = jax.default_backend() == "cpu"
+        self.serial = bool(serial)
+        if trace_shape is None:
+            trace_shape = self._design_shape()
+        self._trace_shape = (None if trace_shape is None
+                             else tuple(int(s) for s in trace_shape))
+        # one jitted heavy program per facade instance: the campaign and
+        # the service cache one facade per bucket, so the compile count
+        # is one per (bucket, B, engine) — the compile_guard pin
+        self._program = jax.jit(self._heavy_body)  # daslint: allow[R2,R5] one facade per bucket (campaign/service cache); donation un-aliasable for these families — class docstring
+
+    # -- family hooks ------------------------------------------------------
+
+    def _design_shape(self):
+        """The bucket ``(C, T)`` this facade serves, when derivable from
+        the wrapped detector (None: accept any shape, one program per
+        distinct shape)."""
+        adapter = self.det
+        design = getattr(getattr(adapter, "prefilter", None), "design", None)
+        return getattr(design, "trace_shape", None)
+
+    def _resolve_engines(self, stack_shape) -> None:
+        """Resolve the family's per-shape engine decision EAGERLY (never
+        under a trace — the A/B router times candidate programs)."""
+
+    @property
+    def engine(self) -> str:
+        """Resolved engine label for cost cards / ledger attribution."""
+        return "fft"
+
+    def _heavy_one(self, tr):
+        """One file's heavy stage (traced; mapped over the B axis)."""
+        raise NotImplementedError
+
+    def _finalize_one(self, heavy, b: int):
+        """One file's ``(picks, thresholds)`` from its slice of the
+        mapped heavy output (host boundary — the family's own per-file
+        finalize, shared with the per-file rung)."""
+        raise NotImplementedError
+
+    # -- shared machinery --------------------------------------------------
+
+    def _heavy_body(self, stack):
+        if self.serial:
+            return jax.lax.map(self._heavy_one, stack)
+        return jax.vmap(self._heavy_one)(stack)
+
+    def program_spec(self, batch: int, stack_dtype, *,
+                     with_health: bool = False,
+                     health_clip: float | None = None,
+                     donate: bool = False):
+        """The facade's AOT pricing spec — ``(jitted, avals,
+        static_kwargs)`` — consumed by ``utils.memory``'s
+        ``_batched_program_spec`` dispatch so family programs ride the
+        same preflight/cost-card/contract-audit ``lower().compile()``
+        boundary as the matched filter. Health stats are host-side for
+        these families, so the priced program is the heavy stage alone
+        regardless of ``with_health``; ``donate`` prices the same
+        program (donation is un-aliasable here — class docstring)."""
+        if self._trace_shape is None:
+            raise ValueError(
+                f"cannot price a {self.family} batched program without a "
+                "bucket shape; construct the facade with trace_shape=(C, T)"
+            )
+        C, T = self._trace_shape
+        self._resolve_engines((int(batch), C, T))
+        avals = (jax.ShapeDtypeStruct((int(batch), C, T),
+                                      np.dtype(stack_dtype)),)
+        # a dedicated jit wrapper (never dispatched): a preflight failure
+        # can never poison the hot path's jit cache
+        jitted = jax.jit(self._heavy_body)  # daslint: allow[R2,R5] AOT pricing only (never dispatched; nothing to donate) — see utils.memory
+        return jitted, avals, {}
+
+    def detect_batch(
+        self, stack, n_real=None, n_valid: int | None = None,
+        with_health: bool = False, health_clip: float | None = None,
+    ) -> List[tuple | None]:
+        """Detect over a ``[B, C, T]`` slab (dispatch + resolve in one
+        call). Same contract as
+        :meth:`BatchedMatchedFilterDetector.detect_batch`: one entry per
+        valid file — ``(picks, thresholds)`` plus the per-file
+        ``ops.health`` stats dict when ``with_health=True``. These
+        families have no packed-capacity overflow (their finalize runs
+        the exact per-file escalation), so entries are never None."""
+        return self.dispatch_batch(
+            stack, n_real=n_real, n_valid=n_valid, with_health=with_health,
+            health_clip=health_clip,
+        ).resolve()
+
+    def dispatch_batch(
+        self, stack, n_real=None, n_valid: int | None = None,
+        with_health: bool = False, health_clip: float | None = None,
+    ) -> InFlightResult:
+        """LAUNCH the heavy batched program without fetching — the
+        pipelined-dispatch half of the one-program batched contract
+        (``handle.resolve()`` is the slab's one device sync; finalize
+        consumes device slices of the already-computed output)."""
+        from .. import faults
+
+        B = int(stack.shape[0])
+        got = tuple(int(s) for s in stack.shape[1:])
+        if self._trace_shape is not None and got != self._trace_shape:
+            raise ValueError(
+                f"slab shape {got} != detector design shape "
+                f"{self._trace_shape}; one batched detector serves one bucket"
+            )
+        self._resolve_engines(tuple(stack.shape))
+        # host rows for the families' host-side health stats (free when
+        # the assembler hands us its numpy stack)
+        host_rows = np.asarray(stack) if with_health else None
+        faults.count("dispatches")
+        state = {"heavy": self._program(jnp.asarray(stack))}
+        del stack
+
+        def resolve() -> List[tuple | None]:
+            heavy = jax.block_until_ready(state.pop("heavy"))
+            faults.count("syncs")
+            out: List[tuple | None] = []
+            for b in range(B if n_valid is None else int(n_valid)):
+                picks, thresholds = self._finalize_one(heavy, b)
+                if with_health:
+                    stats = health_ops.host_health_stats(
+                        host_rows[b], clip_abs=health_clip
+                    )
+                    out.append((picks, thresholds, stats))
+                else:
+                    out.append((picks, thresholds))
+            state.clear()
+            return out
+
+        return InFlightResult(resolve)
+
+
+class BatchedSpectroDetector(_BatchedFamilyDetector):
+    """Batched facade over one ``eval.SpectroEvalAdapter``: the heavy
+    stage is the shared bandpass + f-k prefilter followed by the
+    per-kernel spectro correlograms
+    (``SpectroCorrDetector.correlograms`` — the STFT rides the
+    ``resolve_stft_engine_ab``-selected engine, rFFT or the framed
+    windowed-DFT MXU matmul); finalize is the adapter's own
+    escalation-pick + hop→sample conversion per file."""
+
+    family = "spectro"
+
+    def _resolve_engines(self, stack_shape) -> None:
+        self.det.det.resolve_engine(tuple(stack_shape[-2:]))
+
+    @property
+    def engine(self) -> str:
+        return self.det.det.stft_engine or "rfft"
+
+    def _heavy_one(self, tr):
+        adapter = self.det
+        filt = getattr(adapter.prefilter, "filter_block", adapter.prefilter)
+        return adapter.det.correlograms(filt(tr))
+
+    def _finalize_one(self, heavy, b: int):
+        sdet = self.det.det
+        corr_b = {name: v[b] for name, v in heavy.items()}
+        picks, spectro_fs = sdet.picks_from_correlograms(corr_b)
+        # hop-unit -> sample-unit conversion, exactly the per-file
+        # adapter's (eval.SpectroEvalAdapter.__call__)
+        fs = sdet.metadata.fs
+        out = {}
+        for name, pk in picks.items():
+            pk = np.asarray(pk)
+            t_samples = np.round(pk[1] * (fs / spectro_fs)).astype(int)
+            out[name] = np.asarray([pk[0], t_samples])
+        thr = float(sdet.threshold)
+        return out, {name: thr for name in out}
+
+
+class BatchedGaborDetector(_BatchedFamilyDetector):
+    """Batched facade over one ``eval.GaborEvalAdapter``: the heavy
+    stage is the shared prefilter, the oriented Gabor pair
+    (``resolve_gabor_engine``-selected — FFT correlation or
+    f32-accumulated ``conv_general_dilated``) and the per-note masked
+    matched filter; finalize is the detector's relative-threshold policy
+    + per-note envelope picks per file. Gabor batches over FILES, so the
+    channel-halo seam cost that forbids this family's tiled rung
+    (``workflows.planner.GaborProgram``) never arises."""
+
+    family = "gabor"
+
+    def _resolve_engines(self, stack_shape) -> None:
+        self.det.det.resolve_engine(tuple(stack_shape[-2:]))
+
+    @property
+    def engine(self) -> str:
+        return self.det.det.gabor_engine or "fft"
+
+    def _heavy_one(self, tr):
+        adapter = self.det
+        filt = getattr(adapter.prefilter, "filter_block", adapter.prefilter)
+        _, _, _, correlograms = adapter.det.correlograms(filt(tr))
+        # correlograms only: score/mask/masked-trace are DCE'd from the
+        # program (never fetched) — B x C x T x 3 fewer output bytes
+        return correlograms
+
+    def _finalize_one(self, heavy, b: int):
+        corr_b = {name: v[b] for name, v in heavy.items()}
+        picks, _, thresholds = self.det.det.picks_from_correlograms(corr_b)
+        return ({k: np.asarray(v) for k, v in picks.items()},
+                dict(thresholds))
+
+
+class BatchedLearnedDetector(_BatchedFamilyDetector):
+    """Batched facade over one ``models.learned.LearnedDetector``: the
+    heavy stage is STFT windowing + the CNN's sigmoid scores per file
+    (one ``[B, C, n_win]`` score tensor); finalize is the detector's own
+    host-side threshold + per-channel NMS. The batched rung scores the
+    whole window batch in one program (``row_chunk`` is a per-file-rung
+    knob — when the one-program sweep exhausts, the ladder's tiled rung
+    restores the bounded-activation chunking)."""
+
+    family = "learned"
+
+    def _design_shape(self):
+        return None  # not derivable from the detector; pass trace_shape
+
+    @property
+    def engine(self) -> str:
+        from ..ops import spectral
+
+        return spectral.resolve_stft_engine()
+
+    def _heavy_one(self, tr):
+        from ..models.learned import _score_windows, window_features
+
+        ldet = self.det
+        win, _ = window_features(tr, ldet.cfg)
+        flat = win.reshape(-1, *win.shape[-2:])
+        scores = _score_windows(ldet.params, flat, ldet.cfg.compute_dtype)
+        return scores.reshape(win.shape[0], win.shape[1])
+
+    def _finalize_one(self, heavy, b: int):
+        res = self.det.picks_from_scores(np.asarray(heavy[b]))
+        return dict(res.picks), dict(res.thresholds)
+
+
+def batched_detector_for(detector, *, donate: bool = True,
+                         serial: bool | None = None, trace_shape=None):
+    """The batched-facade registry — ``workflows.planner.program_for``'s
+    batched twin: any campaign detector -> its batched facade. The
+    campaign's slab route and the service scheduler build detectors per
+    bucket and wrap them here; ``trace_shape`` pins the bucket ``(C,
+    T)`` for families that cannot derive it (the learned CNN)."""
+    from ..eval import GaborEvalAdapter, SpectroEvalAdapter
+    from ..models.learned import LearnedDetector
+
+    if isinstance(detector, MatchedFilterDetector):
+        return BatchedMatchedFilterDetector(detector, donate=donate,
+                                            serial=serial)
+    if isinstance(detector, SpectroEvalAdapter):
+        return BatchedSpectroDetector(detector, donate=donate, serial=serial,
+                                      trace_shape=trace_shape)
+    if isinstance(detector, GaborEvalAdapter):
+        return BatchedGaborDetector(detector, donate=donate, serial=serial,
+                                    trace_shape=trace_shape)
+    if isinstance(detector, LearnedDetector):
+        return BatchedLearnedDetector(detector, donate=donate, serial=serial,
+                                      trace_shape=trace_shape)
+    raise TypeError(
+        f"no batched facade for detector type {type(detector).__name__}; "
+        "families with one: matched filter, spectro, gabor, learned"
+    )

@@ -66,6 +66,12 @@ class TenantSpec:
     channels: List[int] | None = None
     batch: int = 4
     bucket: object = "pow2"
+    #: detector family this tenant runs ("mf" | "spectro" | "gabor" |
+    #: "learned" — ``workflows.campaign.FAMILIES``). Non-MF tenants
+    #: require ``wire="conditioned"`` and bucket exactly (coerced, same
+    #: rule as ``run_campaign_batched``: padded records would change
+    #: their data-dependent thresholds/windows).
+    family: str = "mf"
     bank: str | None = None
     wire: str = "conditioned"
     interrogator: str = "optasense"
@@ -103,6 +109,32 @@ class TenantSpec:
     def __post_init__(self):
         if not self.name:
             raise ValueError("tenant name must be non-empty")
+        from ..workflows.campaign import FAMILIES
+
+        if self.family not in FAMILIES:
+            raise ValueError(
+                f"tenant {self.name!r}: unknown detector family "
+                f"{self.family!r}; expected one of {FAMILIES}"
+            )
+        if self.family != "mf":
+            if self.wire != "conditioned":
+                raise ValueError(
+                    f"tenant {self.name!r}: family={self.family!r} requires "
+                    "wire='conditioned' (the family's prefilter consumes "
+                    f"strain, not stored-dtype counts; got {self.wire!r})"
+                )
+            if self.bank is not None:
+                raise ValueError(
+                    f"tenant {self.name!r}: 'bank' is a matched-filter "
+                    f"template grid; family={self.family!r} takes its "
+                    "configuration through detector_kwargs"
+                )
+            if self.bucket != "exact":
+                # the run_campaign_batched rule: non-MF families are not
+                # padding-invariant (data-dependent thresholds/windows)
+                log.info("tenant %s: family=%s buckets exactly (overriding "
+                         "bucket=%r)", self.name, self.family, self.bucket)
+                self.bucket = "exact"
         if self.dispatch_deadline_s is None:
             from ..config import dispatch_deadline_default
 
@@ -205,20 +237,27 @@ class DetectionService:
     def __init__(self, config: ServiceConfig, fault_plans=None):
         self.config = config
         os.makedirs(config.outdir, exist_ok=True)
+        # the cost/quality observatories are process switches (their
+        # consumers — dispatch brackets, scheduler resolves — read the
+        # module flags): a service that asks for them turns them on for
+        # its serving lifetime, and restores whatever it flipped at
+        # stop() — the process may outlive the service (embedded/test
+        # use), and a later campaign must not inherit this service's
+        # switches
+        self._restore_switches: list = []
         if config.cost_cards:
-            # the cost observatory is a process switch (its consumers —
-            # dispatch brackets, scheduler resolves — read the module
-            # flag): a service that asks for cards turns it on for its
-            # whole serving lifetime
             from ..telemetry import costs as tcosts
 
+            if not tcosts.enabled():
+                self._restore_switches.append(tcosts.disable)
             tcosts.enable()
         if config.quality:
-            # same process-switch contract as the cost observatory:
-            # TenantRuntime reads the module flag at construction below,
-            # so the enable must precede the tenant loop
+            # the enable must precede the tenant loop: TenantRuntime
+            # reads the module flag at construction below
             from ..telemetry import quality as tquality
 
+            if not tquality.enabled():
+                self._restore_switches.append(tquality.disable)
             tquality.enable()
         if config.persistent_cache:
             from ..config import enable_persistent_compilation_cache
@@ -396,8 +435,13 @@ class DetectionService:
         return {name: t.result() for name, t in self.tenants.items()}
 
     def stop(self) -> None:
-        """Tear down the API server (after :meth:`run` returned)."""
+        """Tear down the API server (after :meth:`run` returned) and
+        restore any observatory process-switch this service flipped on
+        at construction (end of the serving lifetime)."""
         self.api.stop()
+        for restore in self._restore_switches:
+            restore()
+        self._restore_switches = []
 
     def results(self) -> Dict:
         return {name: t.result() for name, t in self.tenants.items()}

@@ -52,7 +52,12 @@ from ..telemetry import slo as tslo
 from ..utils import locks
 from ..utils.log import get_logger
 from ..workflows import campaign as camp
-from ..workflows.planner import DownshiftLadder, MatchedFilterProgram
+from ..workflows.planner import (
+    DetectorProgram,
+    DownshiftLadder,
+    family_ladder_stages,
+    program_for,
+)
 from .ingest import IngestItem, RingBuffer, SlabSlicer
 
 log = get_logger("service.scheduler")
@@ -100,9 +105,15 @@ class TenantRuntime:
         self.fault_plan = fault_plan
         self.rz = camp._Resilience(outdir, self.records, spec.max_failures,
                                    spec.retry, spec.health)
-        self.rz.family = "mf"
+        # the tenant's detector family (TenantSpec.family; "mf" default
+        # keeps pre-family specs working) — every manifest record,
+        # downshift event and watchdog attribution carries it, and the
+        # ladder is filtered to the family program's declared stages
+        self.family = getattr(spec, "family", "mf")
+        self.rz.family = self.family
         self.ladder = DownshiftLadder(self.rz, outdir, batch=spec.batch,
-                                      family="mf")
+                                      family=self.family,
+                                      stages=family_ladder_stages(self.family))
         self.ring = RingBuffer(spec.name, capacity=spec.ring_capacity,
                                policy=spec.overflow)
         self.slicer = SlabSlicer(spec.batch, bucket=spec.bucket,
@@ -121,7 +132,7 @@ class TenantRuntime:
             self.records.append(rec)
             _c_files.inc(tenant=self.name, status="skipped")
         self._dets: Dict[tuple, object] = {}
-        self._progs: Dict[tuple, MatchedFilterProgram] = {}
+        self._progs: Dict[tuple, DetectorProgram] = {}
         self._skip_buckets: Dict[tuple, str] = {}
         self._finished = False
         # freshness SLO (ISSUE 14, telemetry.slo): ring-admission stamps
@@ -276,7 +287,7 @@ class TenantRuntime:
         while b >= 1:
             cands.append(b)
             b //= 2
-        split = bdet.det.supports_bank_split
+        split = getattr(bdet.det, "supports_bank_split", False)
         rung_cands = []
         for b_ in cands:
             rung_cands.append(("batched", b_))
@@ -325,6 +336,16 @@ class TenantRuntime:
                     f"B={b_} under its {budget / 2**30:.2f} GiB share",
                 )
             return
+        if self.family != "mf":
+            # family facades have no batched-tiled program to price; the
+            # per-file rung starts the family's own ladder (the batch
+            # campaign's preflight_bucket rule, per tenant)
+            self.ladder.pin(key, ("file", 1), (
+                f"admission: no (bucket, B) {self.family} program fits "
+                f"tenant {self.name}'s {budget / 2**30:.2f} GiB share; "
+                "per-file ladder takes over"
+            ))
+            return
         tiled = BatchedMatchedFilterDetector(
             bdet.det.tiled_view(), donate=False, serial=bdet.serial
         )
@@ -364,8 +385,7 @@ class TenantRuntime:
         log.warning("tenant %s bucket %s: %s", self.name, key, reason)
 
     def _detector_for(self, slab):
-        from ..models.matched_filter import MatchedFilterDetector
-        from ..parallel.batch import BatchedMatchedFilterDetector
+        from ..parallel.batch import batched_detector_for
 
         key = self._bucket_key(slab)
         bdet = self._dets.get(key)
@@ -373,18 +393,26 @@ class TenantRuntime:
             kwargs = dict(self.spec.detector_kwargs)
             if self.spec.bank is not None:
                 kwargs.setdefault("templates", self.spec.bank)
-            bdet = BatchedMatchedFilterDetector(
-                MatchedFilterDetector(
-                    slab.blocks[0].metadata, self.spec.channels,
-                    (key[0], slab.bucket_ns), wire=self.spec.wire,
-                    pick_mode="sparse", keep_correlograms=False, **kwargs,
-                ),
-                donate=self.spec.donate, serial=self.spec.serial,
+            per_file_det = camp.family_detector(
+                self.family, slab.blocks[0].metadata, self.spec.channels,
+                (key[0], slab.bucket_ns), wire=self.spec.wire, **kwargs,
             )
+            bdet = batched_detector_for(
+                per_file_det, donate=self.spec.donate,
+                serial=self.spec.serial,
+                trace_shape=(key[0], slab.bucket_ns),
+            )
+            if hasattr(bdet, "_resolve_engines"):
+                # family facades: the per-shape engine decision (A/B
+                # router, ops.mxu) resolves EAGERLY — never under the
+                # admission preflight's trace
+                bdet._resolve_engines(
+                    (self.spec.batch, key[0], slab.bucket_ns)
+                )
             self._dets[key] = bdet
-            self._progs[key] = MatchedFilterProgram(bdet.det)
+            self._progs[key] = program_for(per_file_det)
             self.ladder.set_engines(key, self._progs[key].engines)
-            if bdet.det.supports_bank_split:
+            if getattr(bdet.det, "supports_bank_split", False):
                 self.ladder.enable_bank_split(key)
             if self.spec.admission:
                 with telemetry.span("preflight", bucket=str(key),
@@ -438,7 +466,7 @@ class TenantRuntime:
     def _dispatched(self, paths, rung, fn):
         return resolve_watchdogged(fn, paths, rung,
                                    self.spec.dispatch_deadline_s,
-                                   self.fault_plan, family="mf")
+                                   self.fault_plan, family=self.family)
 
     def _per_file_fallback(self, slab, k, prog, rung=("file", 1)):
         with_health = self.rz.health_cfg is not None
@@ -647,7 +675,7 @@ class TenantRuntime:
             # exact hook): predicted-at-peaks over measured
             tcosts.note_slab_resolved(
                 tcosts.bucket_label(key), faults.rung_label(rung),
-                getattr(bdet.det, "mf_engine", "fft"), wall,
+                tcosts._program_engine(bdet), wall,
             )
         shape = (int(slab.stack.shape[1]), slab.bucket_ns)
         from ..parallel.batch import trim_picks

@@ -344,7 +344,42 @@ def _contract_snapshot():
         return _contracts_snapshot
 
 
-def _audit_capture(an, det, *, bucket: str, program: str,
+def _program_engine(bdet) -> str:
+    """The engine label a batched program's cost cards are keyed by:
+    family facades (``parallel.batch._BatchedFamilyDetector``) carry a
+    resolved ``engine`` label (the STFT/gabor route); the matched
+    filter keys by its correlate engine."""
+    eng = getattr(bdet, "engine", None)
+    if not eng:
+        eng = getattr(bdet.det, "mf_engine", "fft")
+    return str(eng or "fft")
+
+
+def _contract_engine(bdet) -> str:
+    """The program-contract artifact's engine key (analysis/programs.py
+    ``ProgramArtifact.key``): the matched filter's ``mf+fk`` pair, or a
+    family facade's family-qualified engine label — spectro and learned
+    can both resolve ``rfft`` at the same bucket, so the bare engine
+    would collide in the contract snapshot."""
+    det = bdet.det
+    if hasattr(det, "mf_engine"):
+        return (f"{getattr(det, 'mf_engine', 'fft') or 'fft'}"
+                f"+{getattr(det, 'fk_engine', 'fft') or 'fft'}")
+    return f"{getattr(bdet, 'family', 'generic')}-{_program_engine(bdet)}"
+
+
+def _template_count(det) -> int:
+    """Templates/kernels/notes the program sweeps (the card's T axis):
+    the matched filter's bank rows, an eval adapter's template configs,
+    or 1 (the learned family's single classifier head)."""
+    design = getattr(det, "design", None)
+    if design is not None and hasattr(design, "templates"):
+        return int(design.templates.shape[0])
+    cfgs = getattr(det, "template_configs", None)
+    return int(len(cfgs)) if cfgs else 1
+
+
+def _audit_capture(an, engine: str, *, bucket: str, program: str,
                    batch: int, stack_dtype):
     """R11-R13 contract audit over one capture's IR text: pure text
     analysis (zero compiles), feeding the ``das_contract_*`` counters
@@ -357,8 +392,7 @@ def _audit_capture(an, det, *, bucket: str, program: str,
 
         art = aprograms.ProgramArtifact(
             bucket=str(bucket), label=str(program),
-            engine=(f"{getattr(det, 'mf_engine', 'fft') or 'fft'}"
-                    f"+{getattr(det, 'fk_engine', 'fft') or 'fft'}"),
+            engine=str(engine),
             wire_dtype=np.dtype(stack_dtype).name,
             jaxpr_text=an.jaxpr_text or "", hlo_text=an.hlo_text or "",
             peak_bytes=int(an.memory.peak if an.memory else 0),
@@ -403,13 +437,13 @@ def capture_batched(bdet, batch: int, stack_dtype, *, bucket: str,
     verdict, notes = ("unchecked", ())
     if audit and an.hlo_text:
         verdict, notes = _audit_capture(
-            an, det, bucket=bucket, program=program, batch=batch,
-            stack_dtype=stack_dtype)
+            an, _contract_engine(bdet), bucket=bucket, program=program,
+            batch=batch, stack_dtype=stack_dtype)
     REGISTRY.record(CostCard(
         program=str(program), bucket=str(bucket),
-        engine=str(getattr(det, "mf_engine", "fft") or "fft"),
+        engine=_program_engine(bdet),
         batch=int(batch),
-        templates=int(det.design.templates.shape[0]),
+        templates=_template_count(det),
         flops=an.flops, bytes_accessed=an.bytes_accessed,
         transcendentals=an.transcendentals,
         peak_bytes=int(an.memory.peak if an.memory else 0),
@@ -440,7 +474,7 @@ def ensure_batched_card(bdet, batch: int, stack_dtype, *, bucket: str,
     rung's label."""
     from dataclasses import replace
 
-    engine = str(getattr(bdet.det, "mf_engine", "fft") or "fft")
+    engine = _program_engine(bdet)
     if REGISTRY.get(bucket, program, engine) is not None:
         return
     alias = _RUNG_ALIASES.get(str(program))

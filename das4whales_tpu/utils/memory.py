@@ -201,7 +201,19 @@ def _batched_program_spec(bdet, batch: int, stack_dtype, *,
     observatory), and the program-contract audit, so the three can
     never price different programs. ``donate=True`` prices the
     slab-donating spelling (``donate_argnums=(0,)``) — the R12
-    donation-effectiveness audit inspects its alias table."""
+    donation-effectiveness audit inspects its alias table.
+
+    Family facades (``parallel.batch._BatchedFamilyDetector`` —
+    spectro/gabor/learned) carry their own ``program_spec``; dispatching
+    to it here keeps preflight, cost cards, and the contract audit on
+    the SAME ``lower().compile()`` boundary for every family. The
+    matched-filter spelling below stays inline because its spec reads a
+    dozen detector internals this module already documents."""
+    if hasattr(bdet, "program_spec"):
+        return bdet.program_spec(
+            batch, stack_dtype, with_health=with_health,
+            health_clip=health_clip, donate=donate,
+        )
     import jax.numpy as jnp
     import numpy as np
 

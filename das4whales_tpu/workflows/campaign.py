@@ -407,9 +407,11 @@ class _Resilience:
 # agnostic: every detector family inherits the ladder, watchdog, health
 # gate and chaos dispatch hook — not just the matched filter).
 from .planner import (  # noqa: E402
+    DetectorProgram,
     DownshiftLadder,
     MatchedFilterProgram,
     RoutePlanner,
+    family_ladder_stages,
     program_for,
 )
 
@@ -420,6 +422,54 @@ from .planner import (  # noqa: E402
 # artifacts and failure taxonomy are the batch campaign's, by
 # construction (that shared machinery is what makes service picks
 # bit-identical to run_campaign_batched's; tests/test_service.py).
+
+
+FAMILIES = ("mf", "spectro", "gabor", "learned")
+
+
+def family_detector(family: str, metadata, selected_channels, trace_shape,
+                    *, wire: str = "conditioned", **detector_kwargs):
+    """One bucket's PER-FILE detector at the bucket shape — the shared
+    family builder behind :func:`run_campaign_batched` and the service
+    scheduler's ``TenantRuntime._detector_for``. The batched facade
+    (``parallel.batch.batched_detector_for``) wraps the result; the
+    planner program (``workflows.planner.program_for``) serves its
+    per-file/tiled/host rungs.
+
+    ``detector_kwargs`` are the family constructor's: the matched
+    filter's ``MatchedFilterDetector`` kwargs, the spectro/gabor
+    ``campaign_detector`` kwargs, or — for ``"learned"`` — either
+    ``params=``/``cfg=`` or ``pretrained=`` (default ``"fin_cnn"``,
+    ``models.learned.load_pretrained``) plus ``LearnedDetector``
+    kwargs."""
+    if family == "mf":
+        return MatchedFilterDetector(
+            metadata, selected_channels, trace_shape, wire=wire,
+            pick_mode="sparse", keep_correlograms=False,
+            **detector_kwargs,
+        )
+    if family == "spectro":
+        from .spectrodetect import campaign_detector
+
+        return campaign_detector(metadata, selected_channels, trace_shape,
+                                 **detector_kwargs)
+    if family == "gabor":
+        from .gabordetect import campaign_detector
+
+        return campaign_detector(metadata, selected_channels, trace_shape,
+                                 **detector_kwargs)
+    if family != "learned":
+        raise ValueError(
+            f"unknown detector family {family!r}; expected one of {FAMILIES}"
+        )
+    from ..models.learned import LearnedDetector, load_pretrained
+
+    kw = dict(detector_kwargs)
+    if "params" in kw and "cfg" in kw:
+        params, cfg = kw.pop("params"), kw.pop("cfg")
+    else:
+        params, cfg = load_pretrained(kw.pop("pretrained", "fin_cnn"))
+    return LearnedDetector(params, cfg, **kw)
 
 
 def run_campaign(
@@ -719,6 +769,7 @@ def run_campaign_batched(
     prefetch: int = 2,
     engine: str = "h5py",
     wire: str = "conditioned",
+    family: str = "mf",
     in_flight: int = 2,
     donate: bool = True,
     serial: bool | None = None,
@@ -736,6 +787,19 @@ def run_campaign_batched(
     **detector_kwargs,
 ) -> CampaignResult:
     """Single-chip BATCHED campaign: ``batch`` files per program step.
+
+    ``family`` selects the detector family riding the slab route —
+    ``"mf"`` (default), ``"spectro"``, ``"gabor"``, or ``"learned"``.
+    Every family runs the full one-program batched contract
+    (``parallel.batch.batched_detector_for``): one heavy program per
+    slab, AOT-priced admission, ``("batched", B)`` downshift rungs,
+    pipelined dispatch, cost cards — with per-file picks pinned
+    bit-identical to that family's per-file rung. Non-MF families
+    require ``wire="conditioned"`` (their prefilter consumes strain);
+    ``detector_kwargs`` go to the family's campaign builder
+    (``spectrodetect/gabordetect.campaign_detector``; the learned
+    family takes ``params``/``cfg`` or ``pretrained="fin_cnn"`` plus
+    ``LearnedDetector`` knobs).
 
     ``quality`` (None: the ``DAS_QUALITY`` env default) arms the
     SCIENCE-QUALITY OBSERVATORY (``telemetry.quality``, ISSUE 15):
@@ -848,9 +912,36 @@ def run_campaign_batched(
         memory_preflight_default,
     )
     from ..io.stream import SlabReadError, stream_batched_slabs, subdivide_slab
-    from ..parallel.batch import BatchedMatchedFilterDetector, trim_picks
+    from ..parallel.batch import (
+        BatchedMatchedFilterDetector,
+        batched_detector_for,
+        trim_picks,
+    )
     from ..parallel.dispatch import PipelinedDispatch, resolve_watchdogged
 
+    if family not in FAMILIES:
+        raise ValueError(
+            f"unknown detector family {family!r}; batched campaigns serve "
+            f"{', '.join(FAMILIES)}"
+        )
+    if family != "mf" and wire != "conditioned":
+        raise ValueError(
+            f"family={family!r} requires wire='conditioned': the family's "
+            "prefilter consumes strain, not stored-dtype counts (got "
+            f"wire={wire!r})"
+        )
+    if family != "mf" and bucket != "exact":
+        # The non-MF families are NOT padding-invariant: spectro/gabor
+        # derive thresholds from the record's own max and learned
+        # windows the full time axis, so a pow2-padded record changes
+        # picks. Exact-length buckets keep every rung's math (batched,
+        # per-file fallback, host blocks) on the same samples — the
+        # bit-identity guarantee. Same-length files still share one
+        # bucket, so batching is intact for uniform acquisitions.
+        log.info("family=%s campaigns bucket exactly (overriding "
+                 "bucket=%r): padded records would change data-dependent "
+                 "thresholds/windows", family, bucket)
+        bucket = "exact"
     if dispatch_deadline_s is None:
         dispatch_deadline_s = dispatch_deadline_default()
     if preflight is None:
@@ -871,15 +962,26 @@ def run_campaign_batched(
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
     pend_metas = [metas[j] for j in pend_idx]
     rz = _Resilience(outdir, records, max_failures, retry, health)
-    rz.family = "mf"   # the batched slab route is the MF family's
+    rz.family = family
     fail = rz.fail
     with_health = rz.health_cfg is not None
     clip = rz.health_cfg.clip_abs if with_health else None
-    ladder = DownshiftLadder(rz, outdir, batch=batch, family="mf")
+    # Ladder stages: "batched" plus whatever the family's per-file
+    # program declares — spectro/gabor/learned do not support every MF
+    # rung (no timeshard math), so downshifts must skip straight to the
+    # rungs their planner program can actually serve.
+    ladder = DownshiftLadder(rz, outdir, batch=batch, family=family,
+                             stages=family_ladder_stages(family))
 
-    dets: Dict[tuple, BatchedMatchedFilterDetector] = {}
-    progs: Dict[tuple, MatchedFilterProgram] = {}   # per-file-rung programs
+    dets: Dict[tuple, object] = {}       # bucket -> batched facade
+    progs: Dict[tuple, DetectorProgram] = {}   # per-file-rung programs
     skip_buckets: Dict[tuple, str] = {}   # preflight: nothing fits
+
+    def build_family_detector(key, slab):
+        return family_detector(
+            family, slab.blocks[0].metadata, selected_channels,
+            (key[0], slab.bucket_ns), wire=wire, **detector_kwargs,
+        )
 
     def _bucket_key(slab) -> tuple:
         return (slab.stack.shape[1], slab.bucket_ns,
@@ -924,7 +1026,7 @@ def run_campaign_batched(
         # (the T axis is priced before B is sacrificed); the fitting
         # policy itself (unpriceable-reads-as-fitting) lives in ONE
         # place, utils.memory.first_fitting
-        split = bdet.det.supports_bank_split
+        split = getattr(bdet.det, "supports_bank_split", False)
         rung_cands = []
         for b_ in cands:
             rung_cands.append(("batched", b_))
@@ -952,6 +1054,15 @@ def run_campaign_batched(
                     f"preflight: largest fitting batch B={b_} under "
                     f"{budget / 2**30:.2f} GiB",
                 )
+            return
+        if family != "mf":
+            # family facades have no batched-tiled program to price; the
+            # per-file rung starts the family's own ladder (per-file ->
+            # tiled/host), whose programs the ladder protects un-priced
+            ladder.pin(key, ("file", 1), (
+                f"preflight: no (bucket, B) {family} program fits "
+                f"{budget / 2**30:.2f} GiB; per-file ladder takes over"
+            ))
             return
         # not even B=1 fits the monolithic program: price the tiled one
         tiled = BatchedMatchedFilterDetector(
@@ -987,26 +1098,28 @@ def run_campaign_batched(
         _append_event(outdir, event)
         log.warning("bucket %s: %s", key, reason)
 
-    def detector_for(slab) -> BatchedMatchedFilterDetector:
+    def detector_for(slab):
         key = _bucket_key(slab)
         bdet = dets.get(key)
         if bdet is None:
-            bdet = BatchedMatchedFilterDetector(
-                MatchedFilterDetector(
-                    slab.blocks[0].metadata, selected_channels,
-                    (key[0], slab.bucket_ns), wire=wire, pick_mode="sparse",
-                    keep_correlograms=False, **detector_kwargs,
-                ),
-                donate=donate, serial=serial,
+            per_file_det = build_family_detector(key, slab)
+            bdet = batched_detector_for(
+                per_file_det, donate=donate, serial=serial,
+                trace_shape=(key[0], slab.bucket_ns),
             )
+            if hasattr(bdet, "_resolve_engines"):
+                # family facades: resolve the per-shape engine decision
+                # EAGERLY (the A/B router times candidates — never under
+                # the preflight's trace)
+                bdet._resolve_engines((batch, key[0], slab.bucket_ns))
             dets[key] = bdet
-            progs[key] = MatchedFilterProgram(bdet.det)
+            progs[key] = program_for(per_file_det)
             # each bucket's detector resolved its own engines (per-shape
             # A/B, ops.mxu router) — register them so that bucket's
             # downshift events describe ITS routes, not the first
             # bucket's
             ladder.set_engines(key, progs[key].engines)
-            if bdet.det.supports_bank_split:
+            if getattr(bdet.det, "supports_bank_split", False):
                 # splittable template bank: this bucket's ladder gains
                 # the bank-split rung (T/2 sub-banks before B shrinks)
                 ladder.enable_bank_split(key)
@@ -1040,7 +1153,7 @@ def run_campaign_batched(
         (``parallel.dispatch.resolve_watchdogged`` — shared with the
         planner's per-file executor)."""
         return resolve_watchdogged(fn, paths, rung, dispatch_deadline_s,
-                                   fault_plan, family="mf")
+                                   fault_plan, family=family)
 
     def per_file_fallback(slab, k, prog, rung=("file", 1)):
         """The unbatched per-file route on the assembler's host block
@@ -1248,7 +1361,7 @@ def run_campaign_batched(
             # the rung -> no-op; never touches picks)
             tcosts.note_slab_resolved(
                 tcosts.bucket_label(key), faults.rung_label(rung),
-                getattr(det, "mf_engine", "fft"), wall,
+                tcosts._program_engine(bdet), wall,
             )
         shape = (int(slab.stack.shape[1]), slab.bucket_ns)
         for k in range(slab.n_valid):
@@ -1384,7 +1497,7 @@ def run_campaign_batched(
 
     with telemetry.campaign_trace(outdir, trace, kind="batched",
                                   n_files=len(files), batch=batch,
-                                  family="mf"):
+                                  family=family):
         i = 0
         while i < len(pending):
             slabs = stream_batched_slabs(

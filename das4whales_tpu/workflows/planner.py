@@ -88,8 +88,12 @@ class DetectorProgram:
       already-host-resident block (same values, one numpy pass).
     * ``supports_dispatch`` — :meth:`dispatch` can launch the program
       asynchronously (the depth-D pipelined campaign dispatch).
-    * ``supports_batched`` — a batched (B files per program) builder
-      exists (``run_campaign_batched``; matched filter only today).
+    * ``supports_batched`` — a batched (B files per program) facade
+      exists (``parallel.batch.batched_detector_for`` — the slab routes
+      of ``run_campaign_batched`` and the service scheduler). Every
+      campaign family has one: the matched filter's packed-pick program,
+      and the spectro/gabor/learned heavy-stage facades (one mapped
+      heavy program per slab, the family's own per-file finalize).
     """
 
     family = "generic"
@@ -108,10 +112,16 @@ class DetectorProgram:
         ``pick_engine``; empty for families without engine routing).
         Family-agnostic by construction: every family inherits engine
         attribution in the ladder's rung descriptions the moment its
-        detector grows engine attributes."""
+        detector grows engine attributes. Eval adapters (spectro/gabor)
+        carry their engine attributes on the wrapped detector — both
+        levels are consulted."""
         from ..ops import mxu
 
-        return mxu.engine_labels(self.det)
+        labels = mxu.engine_labels(self.det)
+        inner = getattr(self.det, "det", None)
+        if inner is not None:
+            labels = {**mxu.engine_labels(inner), **labels}
+        return labels
 
     # -- the per-rung program ---------------------------------------------
 
@@ -263,10 +273,14 @@ class SpectroProgram(DetectorProgram):
     per-file, channel-chunk-tiled (smaller spectrogram sweep chunks —
     ``models.spectro.SpectroCorrDetector.tiled_view``) and host rungs.
     Every stage is per-channel math, so the tiled rung's picks are
-    bit-identical to the per-file rung's."""
+    bit-identical to the per-file rung's. The batched slab route
+    (``parallel.batch.BatchedSpectroDetector``) maps the family's heavy
+    stage over the B file axis — the STFT rides the A/B-routed rFFT or
+    framed windowed-DFT MXU matmul engine (``ops.spectral``)."""
 
     family = "spectro"
     stages = ("file", "tiled", "host")
+    supports_batched = True
 
     def _det_at(self, stage):
         if stage != "tiled":
@@ -282,23 +296,58 @@ class GaborProgram(DetectorProgram):
     """Gabor/image family (``eval.GaborEvalAdapter``): per-file and host
     rungs only — the oriented Gabor pair couples ~1000 channels of the
     t-x image, so a channel-tiled rung would change the detection math
-    at tile seams (``parallel/gabor.py`` documents the halo cost)."""
+    at tile seams (``parallel/gabor.py`` documents the halo cost). The
+    batched slab route (``parallel.batch.BatchedGaborDetector``)
+    batches over FILES, so the halo seam problem never arises there —
+    the oriented pair rides the A/B-routed FFT or f32-accumulated
+    ``conv_general_dilated`` engine (``ops.image.filter2d_same``)."""
 
     family = "gabor"
     stages = ("file", "host")
+    supports_batched = True
 
 
 class LearnedProgram(DetectorProgram):
     """Learned CNN family (``models.learned.LearnedDetector``):
     per-file, window-row-chunked tiled
     (``LearnedDetector.tiled_view`` — caps the classifier's activation
-    memory) and host rungs."""
+    memory) and host rungs. The batched slab route
+    (``parallel.batch.BatchedLearnedDetector``) scores B files' window
+    batches in one program; host-side threshold + NMS per file."""
 
     family = "learned"
     stages = ("file", "tiled", "host")
+    supports_batched = True
 
     def _det_at(self, stage):
         return self.det.tiled_view() if stage == "tiled" else self.det
+
+
+#: family name -> the family's program class (the batched campaign and
+#: the service scheduler resolve ladder stages and per-file-rung
+#: programs through this table; ``program_for`` stays the
+#: detector-instance registry)
+FAMILY_PROGRAMS = {
+    "mf": MatchedFilterProgram,
+    "spectro": SpectroProgram,
+    "gabor": GaborProgram,
+    "learned": LearnedProgram,
+}
+
+
+def family_ladder_stages(family: str) -> Tuple[str, ...]:
+    """The downshift-ladder stages a BATCHED route may visit for one
+    family: ``"batched"`` plus whatever the family's per-file program
+    declares. Spectro/gabor/learned do not support every MF rung (no
+    timeshard math), so their ladders must skip straight to the rungs
+    their planner program can actually serve — a downshift onto an
+    undeclared rung would silently run the plain per-file program under
+    the wrong label."""
+    cls = FAMILY_PROGRAMS[family]
+    return tuple(
+        s for s in faults.DOWNSHIFT_STAGES
+        if s == "batched" or s in cls.stages
+    )
 
 
 def program_for(detector) -> DetectorProgram:

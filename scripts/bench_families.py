@@ -1,7 +1,9 @@
 """Canonical-shape on-chip walls for the non-flagship detector families.
 
-bench.py's ladder covers only the matched-filter flagship; this script
-records what VERDICT r4 next-6 asked for — the spectro-correlation and
+bench.py's ladder headlines the matched-filter flagship and (with
+``DAS_BENCH_FAMILIES=B``) the per-family batched-facade rows at the
+quick shape; this script is the deeper per-stage record VERDICT r4
+next-6 asked for — the spectro-correlation and
 Gabor families' end-to-end detection walls at the canonical OOI shape
 ([22050 x 12000], tutorial.md:56-62), plus the learned-CNN scoring wall
 from the packaged pretrained artifact. The spectro family runs under

@@ -167,6 +167,48 @@ def model(c=C, n=N, fs=FS, band_hz=BAND_HZ, nt=NT, fused=False,
     return rows
 
 
+def model_families(c=C, n=N, fs=FS, nperseg=160, hop=8, ksize=100,
+                   bin_factor=0.1, n_kernels=2):
+    """Roofline rows for the non-MF families' MXU recasts
+    (``ops/spectral.py`` STFT-as-matmul, ``ops/image.py``
+    conv-as-matmul): both rows are charged at the MXU f32 matmul peak
+    (``F32_FLOPS``) — the point of the recast is that these stages stop
+    being VPU/gather-bound and get judged against the same peak the MF
+    matmul correlate targets.
+
+    * STFT-matmul (spectro): per channel, ``[frames, nperseg] @
+      [nperseg, 2F]`` with ``F = nperseg//2 + 1`` (cos|sin halves,
+      window folded into the matrix) — defaults are the
+      ``SpectroCorrDetector`` design (win 0.8 s, 95% overlap at 200 Hz:
+      tap 160, hop 8).
+    * gabor-conv: the oriented kernel pair as ``conv_general_dilated``
+      over the BINNED [c*bf, n*bf] image, f32 accumulation —
+      ``2 * ksize^2`` MACs per output pixel per kernel.
+    """
+    rows = []
+    frames = 1 + n // hop                # centered framing, librosa pad
+    fbins = nperseg // 2 + 1
+    fl = c * 2.0 * frames * nperseg * (2 * fbins)
+    by = B * (c * n                      # read
+              + c * frames * nperseg     # framed view materialized
+              + nperseg * 2 * fbins      # windowed-DFT matrix read
+              + c * frames * fbins)      # magnitude out
+    rows.append(stage(
+        f"spectro STFT-matmul [{frames}x{nperseg}]@[{nperseg}x{2 * fbins}]",
+        fl, by, flops_peak=F32_FLOPS,
+    ))
+    cb, nb = max(1, int(c * bin_factor)), max(1, int(n * bin_factor))
+    fl = n_kernels * 2.0 * cb * nb * ksize * ksize
+    by = B * (cb * nb                    # binned image read
+              + n_kernels * ksize * ksize  # kernel pair read
+              + n_kernels * cb * nb)     # correlogram out
+    rows.append(stage(
+        f"gabor conv-matmul x{n_kernels} ({ksize}x{ksize} over "
+        f"[{cb}x{nb}])", fl, by, flops_peak=F32_FLOPS,
+    ))
+    return rows
+
+
 def model_sharded(p=8, c=C, n=N, fs=FS, band_hz=BAND_HZ, nt=NT, fused=False):
     """Per-chip rows for the channel-sharded step over ``p`` chips.
 
@@ -243,8 +285,14 @@ def main():
                          "filter-once/correlate-many, ops/xcorr+mxu)")
     ap.add_argument("--taps", type=int, default=MF_TAPS,
                     help="true template tap count of the matmul correlate")
+    ap.add_argument("--families", action="store_true",
+                    help="also print the non-MF families' MXU rows "
+                         "(spectro STFT-matmul, gabor conv-matmul)")
     args = ap.parse_args()
 
+    if args.families:
+        print_rows(model_families(), C, N,
+                   "family MXU recasts (per-file, single v5e chip)")
     t1 = print_rows(
         model(fused=args.fused, mf_engine=args.mf_engine,
               fk_engine=args.fk_engine, nt=args.templates,

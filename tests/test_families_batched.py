@@ -1,0 +1,343 @@
+"""Every detector family on the batched fast path (ISSUE 17).
+
+The acceptance pins for the one-program batched contract beyond the
+matched filter:
+
+* **facade parity matrix** — for each non-mf family (spectro, gabor,
+  learned), batched ``detect_batch`` picks/thresholds at B ∈ {1, 2, 4}
+  are BIT-identical to the per-file rung (``program.detect(("file",
+  1))``), and re-invoking a warm facade at its design shape compiles
+  nothing (one program per (bucket, B, engine));
+* **engine decision identity** — the STFT/gabor engine routers resolve
+  identically standalone and through the facade (off-TPU: rfft/fft),
+  forced engines are honored, the STFT matmul recast agrees with the
+  rFFT route numerically, and the per-detector decision is cached;
+* **campaign parity** — ``run_campaign_batched(family="spectro")`` is
+  bit-identical to the per-file ``run_campaign`` over the same files,
+  including under a non-exact ``bucket`` request (coerced: non-mf
+  thresholds are data-dependent, padding would change them);
+* **two-tenant service drill** — a spectro tenant and an mf tenant
+  served concurrently through the scheduler each produce picks
+  bit-identical to their standalone batched campaigns, ride the
+  batched rung, and get per-tenant cost cards;
+* **AOT pricing** — every family facade prices through the shared
+  ``program_spec`` path (admission maths needs a priced peak).
+
+Scene scale is tier-1 CPU budget: 4 files at (16 ch, 2000 samples),
+fs=200 so the spectral designs (win 0.8 s) are non-degenerate.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from das4whales_tpu.io.stream import stream_strain_blocks
+from das4whales_tpu.io.synth import (
+    SyntheticCall,
+    SyntheticScene,
+    write_synthetic_file,
+)
+from das4whales_tpu.ops import mxu, spectral
+from das4whales_tpu.parallel.batch import batched_detector_for
+from das4whales_tpu.workflows.campaign import (
+    FAMILIES,
+    family_detector,
+    run_campaign,
+    run_campaign_batched,
+)
+from das4whales_tpu.workflows.planner import family_ladder_stages, program_for
+
+NX, NS, FS = 16, 2000, 200.0
+SEL = [0, NX, 1]
+N_FILES = 4
+#: spectro default threshold is tuned for long records; at this scene
+#: 2.0 yields a real (nonzero) pick stream to pin
+SPECTRO_KW = {"threshold": 2.0}
+
+FAMILY_KW = {"spectro": SPECTRO_KW, "gabor": {}, "learned": {}}
+
+
+@pytest.fixture(scope="module")
+def scene_files(tmp_path_factory):
+    root = tmp_path_factory.mktemp("famfiles")
+    paths = []
+    for i in range(N_FILES):
+        p = str(root / f"f{i}.h5")
+        write_synthetic_file(p, SyntheticScene(
+            fs=FS, nx=NX, ns=NS, noise_rms=0.05, seed=i,
+            calls=[SyntheticCall(t0=1.0 + 0.5 * i, x0_m=16.0,
+                                 amplitude=3.0)],
+        ))
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def scene_blocks(scene_files):
+    blocks = list(stream_strain_blocks(scene_files, SEL, engine="h5py"))
+    assert len(blocks) == N_FILES
+    return blocks
+
+
+def _assert_entry_matches_ref(entry, ref, ctx):
+    got_picks, got_thr = entry[0], entry[1]
+    ref_picks, ref_thr = ref[0], ref[1]
+    assert set(got_picks) == set(ref_picks), ctx
+    for name in ref_picks:
+        np.testing.assert_array_equal(
+            np.asarray(got_picks[name]), np.asarray(ref_picks[name]),
+            err_msg=f"{ctx}: picks[{name}]")
+    for name in ref_thr:
+        assert float(got_thr[name]) == float(ref_thr[name]), \
+            f"{ctx}: threshold[{name}]"
+
+
+@pytest.mark.parametrize("family", ["spectro", "gabor", "learned"])
+def test_facade_parity_matrix(family, scene_blocks, compile_guard):
+    """Batched B ∈ {1, 2, 4} picks/thresholds bit-identical to the
+    per-file rung, and a warm facade re-invokes compile-free."""
+    meta = scene_blocks[0].metadata
+    det = family_detector(family, meta, SEL, (NX, NS), **FAMILY_KW[family])
+    prog = program_for(det)
+    refs = [prog.detect(("file", 1), np.asarray(b.trace))
+            for b in scene_blocks]
+    # the scene must exercise a real pick stream for at least one file
+    # in at least one family (gabor's absolute thresholds stay above
+    # this scene's SNR — its zero-pick output is still compared bitwise)
+    if family != "gabor":
+        assert any(np.asarray(v).size for r in refs for v in r[0].values())
+
+    bdet = None
+    for B in (1, 2, 4):
+        bdet = batched_detector_for(det, donate=False, trace_shape=(NX, NS))
+        stack = np.stack([np.asarray(b.trace) for b in scene_blocks[:B]])
+        entries = bdet.detect_batch(stack)
+        assert len(entries) == B
+        for k in range(B):
+            _assert_entry_matches_ref(entries[k], refs[k],
+                                      f"{family} B={B} file={k}")
+
+    # warm-facade pin: one program per (bucket, B, engine) — the same
+    # slab shape through the same facade compiles nothing new
+    stack = np.stack([np.asarray(b.trace) for b in scene_blocks])
+    with compile_guard.forbid_recompile(f"warm {family} facade B=4"):
+        bdet.detect_batch(stack)
+
+
+def test_stft_engine_decision_identity(scene_blocks, monkeypatch):
+    """The STFT engine router: auto resolves rfft off-TPU, env/arg
+    forcing is honored, the facade reports the detector's cached
+    decision, and the matmul recast agrees with the rFFT numerics."""
+    nperseg, hop = 160, 8
+    eng, why = mxu.resolve_stft_engine_ab(None, NX, NS, nperseg, hop)
+    assert eng == "rfft" and "no MXU" in why
+
+    monkeypatch.setenv("DAS4WHALES_STFT_ENGINE", "matmul")
+    eng, why = mxu.resolve_stft_engine_ab(None, NX, NS, nperseg, hop)
+    assert (eng, why) == ("matmul", "forced")
+    monkeypatch.delenv("DAS4WHALES_STFT_ENGINE")
+
+    # decision identity + caching through the facade
+    meta = scene_blocks[0].metadata
+    det = family_detector("spectro", meta, SEL, (NX, NS), **SPECTRO_KW)
+    bdet = batched_detector_for(det, donate=False, trace_shape=(NX, NS))
+    bdet._resolve_engines((2, NX, NS))
+    assert bdet.engine == "rfft"
+    sdet = bdet.det.det
+    first = sdet.stft_engine
+    sdet.resolve_engine((NX, NS))      # second resolve: cached, no re-A/B
+    assert sdet.stft_engine is first
+
+    # matmul-vs-rfft numerics: the framed [frames, tap] @ [tap, 2F]
+    # contraction is the same |STFT| to matmul rounding
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal(NS).astype(np.float32)
+    m_rfft = np.asarray(spectral.stft_magnitude(x, nperseg, hop,
+                                                engine="rfft"))
+    m_mm = np.asarray(spectral.stft_magnitude(x, nperseg, hop,
+                                              engine="matmul"))
+    assert m_rfft.shape == m_mm.shape
+    np.testing.assert_allclose(m_mm, m_rfft, rtol=2e-4, atol=2e-5)
+
+
+def test_gabor_engine_decision():
+    eng, why = mxu.resolve_gabor_engine(None, (64, 200), (100, 100))
+    assert eng == "fft" and "no MXU" in why
+    assert mxu.resolve_gabor_engine("conv", (64, 200), (100, 100)) \
+        == ("conv", "forced")
+    with pytest.raises(ValueError, match="unknown gabor engine"):
+        mxu.resolve_gabor_engine("bogus", (64, 200), (100, 100))
+
+
+def _picks_npz(picks_file):
+    with np.load(picks_file) as z:
+        return {k: np.asarray(z[k]) for k in z.files}
+
+
+def _campaign_picks(result):
+    out = {}
+    for r in result.records:
+        assert r.status == "done", (r.path, r.error)
+        out[os.path.basename(r.path)] = _picks_npz(r.picks_file)
+    return out
+
+
+@pytest.fixture(scope="module")
+def spectro_batched_ref(scene_files, tmp_path_factory):
+    """One spectro batched campaign (B=2), shared as the parity
+    baseline by the campaign test and the service drill. The non-exact
+    bucket request pins the coercion: non-mf families bucket exactly."""
+    out = str(tmp_path_factory.mktemp("spectro_b2"))
+    res = run_campaign_batched(
+        scene_files, SEL, out, batch=2, family="spectro", bucket="pow2",
+        resume=False, persistent_cache=False, **SPECTRO_KW)
+    assert res.n_failed == 0, [r.error for r in res.records]
+    return res
+
+
+def test_campaign_batched_parity_spectro(scene_files, spectro_batched_ref,
+                                         tmp_path):
+    """run_campaign_batched(family="spectro") picks bit-identical to
+    the per-file run_campaign over the same files — threshold arrays
+    included (the npz carries them) — with batched-rung records."""
+    det_out = str(tmp_path / "perfile")
+    meta = next(iter(
+        stream_strain_blocks(scene_files[:1], SEL, engine="h5py"))).metadata
+    perfile_det = family_detector("spectro", meta, SEL, (NX, NS),
+                                  **SPECTRO_KW)
+    ref = run_campaign(scene_files, SEL, det_out,
+                       detector=perfile_det, resume=False)
+    assert ref.n_failed == 0, [r.error for r in ref.records]
+
+    got = _campaign_picks(spectro_batched_ref)
+    want = _campaign_picks(ref)
+    assert set(got) == set(want)
+    for fname in want:
+        assert set(got[fname]) == set(want[fname]), fname
+        for key in want[fname]:
+            np.testing.assert_array_equal(got[fname][key], want[fname][key],
+                                          err_msg=f"{fname}:{key}")
+
+    for rec in spectro_batched_ref.records:
+        assert rec.family == "spectro"
+        assert rec.rung == "batched:2", rec.rung
+    total = sum(sum(r.n_picks.values()) for r in spectro_batched_ref.records)
+    assert total > 0  # the scene produces a real pick stream
+
+
+def test_two_tenant_service_drill(scene_files, spectro_batched_ref,
+                                  tmp_path_factory):
+    """Spectro + mf tenants served concurrently: picks bit-identical to
+    each family's standalone batched campaign, both on the batched
+    rung, per-tenant cost cards on disk."""
+    from das4whales_tpu.service import (
+        DetectionService,
+        ServiceConfig,
+        TenantSpec,
+    )
+
+    mf_out = str(tmp_path_factory.mktemp("mf_b2"))
+    mf_ref = run_campaign_batched(
+        scene_files, SEL, mf_out, batch=2, bucket="exact",
+        resume=False, persistent_cache=False)
+    assert mf_ref.n_failed == 0, [r.error for r in mf_ref.records]
+    refs = {"sa": _campaign_picks(spectro_batched_ref),
+            "ma": _campaign_picks(mf_ref)}
+
+    svc_out = str(tmp_path_factory.mktemp("svc"))
+    cfg = ServiceConfig(
+        tenants=[
+            TenantSpec(name="sa", files=scene_files, channels=SEL, batch=2,
+                       family="spectro", admission=True,
+                       detector_kwargs=dict(SPECTRO_KW)),
+            TenantSpec(name="ma", files=scene_files, channels=SEL, batch=2,
+                       bucket="exact", admission=True),
+        ],
+        outdir=svc_out, persistent_cache=False, cost_cards=True,
+    )
+    svc = DetectionService(cfg).start()
+    try:
+        results = svc.run(until_idle=True)
+    finally:
+        svc.stop()
+
+    families = {"sa": "spectro", "ma": "mf"}
+    for name in ("sa", "ma"):
+        res = results[name]
+        assert res.n_done == N_FILES and res.n_failed == 0, (
+            name, [(r.status, r.error) for r in res.records])
+        for rec in res.records:
+            assert rec.family == families[name]
+            assert rec.rung == "batched:2", (name, rec.rung)
+            got = _picks_npz(rec.picks_file)
+            want = refs[name][os.path.basename(rec.path)]
+            assert set(got) == set(want), (name, rec.path)
+            for key in want:
+                np.testing.assert_array_equal(
+                    got[key], want[key],
+                    err_msg=f"{name}:{os.path.basename(rec.path)}:{key}")
+
+    cards_path = os.path.join(svc_out, "cost_cards.json")
+    assert os.path.exists(cards_path)
+    with open(cards_path, encoding="utf-8") as fh:
+        cards = json.load(fh)
+    rows = cards["cards"] if isinstance(cards, dict) else cards
+    batched = {(c.get("engine"), c.get("program")) for c in rows
+               if "batched" in str(c.get("program", ""))}
+    engines = {e for e, _ in batched}
+    assert "rfft" in engines, batched   # the spectro tenant's program
+    assert "fft" in engines, batched    # the mf tenant's program
+
+
+def test_tenant_spec_family_contract(scene_files):
+    from das4whales_tpu.service import TenantSpec
+
+    with pytest.raises(ValueError, match="family"):
+        TenantSpec(name="x", files=scene_files, channels=SEL,
+                   family="sonar")
+    with pytest.raises(ValueError, match="conditioned"):
+        TenantSpec(name="x", files=scene_files, channels=SEL,
+                   family="spectro", wire="float32")
+    with pytest.raises(ValueError, match="bank"):
+        TenantSpec(name="x", files=scene_files, channels=SEL,
+                   family="gabor", bank={"f0": [20.0]})
+    # non-exact buckets are coerced, not rejected: data-dependent
+    # thresholds make padding a numerics change for these families
+    spec = TenantSpec(name="x", files=scene_files, channels=SEL,
+                      family="learned", bucket="pow2")
+    assert spec.bucket == "exact"
+
+
+def test_family_ladder_stages_contract():
+    assert family_ladder_stages("mf") == (
+        "batched", "file", "tiled", "timeshard", "host")
+    assert family_ladder_stages("spectro") == (
+        "batched", "file", "tiled", "host")
+    assert family_ladder_stages("gabor") == ("batched", "file", "host")
+    assert family_ladder_stages("learned") == (
+        "batched", "file", "tiled", "host")
+    assert set(FAMILIES) == set(("mf", "spectro", "gabor", "learned"))
+
+
+@pytest.mark.parametrize("family", ["spectro", "gabor", "learned"])
+def test_program_spec_prices_every_family(family, scene_blocks):
+    """Admission needs a priced peak: every facade's batched program
+    prices through the shared AOT preflight path."""
+    from das4whales_tpu.utils import memory as memutils
+
+    meta = scene_blocks[0].metadata
+    det = family_detector(family, meta, SEL, (NX, NS), **FAMILY_KW[family])
+
+    bare = batched_detector_for(det, donate=False) \
+        if family == "learned" else None
+    if bare is not None:
+        with pytest.raises(ValueError, match="trace_shape"):
+            bare.program_spec(2, np.float32)
+
+    bdet = batched_detector_for(det, donate=False, trace_shape=(NX, NS))
+    an = memutils.batched_program_analysis(bdet, 2, np.dtype("float32"),
+                                           capture_ir=True)
+    assert an is not None and an.hlo_text
+    assert an.memory is not None and an.memory.peak > 0

@@ -248,7 +248,8 @@ def canonical():
     compile per variant — shared by the gate, round-trip and
     compile-free-audit pins below)."""
     arts = programs.canonical_artifacts()
-    assert len(arts) == len(programs.CANONICAL_VARIANTS)
+    assert len(arts) == (len(programs.CANONICAL_VARIANTS)
+                         + len(programs.FAMILY_VARIANTS))
     return arts
 
 
