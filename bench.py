@@ -417,9 +417,26 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
     seg = tmetrics.resilience_delta(seg_before)
     n_picks = sum(int(v.shape[1]) for v in res.picks.values())
     stages = bench_stages(det, x, repeats=repeats) if with_stages else {}
+    slab_rows, slab_info = {}, {}
+    if with_stages:
+        # the A/B needs the one-program route; when the headline
+        # detector resolved another pick engine (the CPU backend's
+        # scipy default) or keeps correlograms, build a sparse twin —
+        # same shape/wire/route knobs, campaign pick configuration
+        ab_det = det
+        if det.pick_mode != "sparse" or det.keep_correlograms:
+            ab_det = MatchedFilterDetector(
+                meta, [0, nx, 1], (nx, ns), peak_block=peak_block,
+                channel_tile=channel_tile, wire=wire,
+                fused_bandpass=det.fused_bandpass,
+                channel_pad=os.environ.get("DAS_BENCH_CHANNEL_PAD")
+                or channel_pad,
+                pick_mode="sparse", keep_correlograms=False,
+            )
+        slab_rows, slab_info = _slab_ab(ab_det, x, repeats=repeats)
     # h2d rides in the stage table even on no-stage rungs: the acceptance
     # contract is that the transfer is ATTRIBUTED, not inferred
-    stages = dict(stages or {}, h2d=round(h2d_best, 4))
+    stages = dict(stages or {}, h2d=round(h2d_best, 4), **slab_rows)
     route = det._route()
     if route == "tiled":
         route = f"tiled(tile={det.effective_channel_tile})"
@@ -455,7 +472,11 @@ def bench_tpu(nx, ns, fs, dx, repeats=3, peak_block=2048, with_stages=True,
                  # per-FILE (per measured call) dispatch/sync counts for
                  # the single-file segment
                  "n_dispatches": round(seg.get("dispatches", 0) / repeats, 2),
-                 "n_syncs": round(seg.get("syncs", 0) / repeats, 2)}
+                 "n_syncs": round(seg.get("syncs", 0) / repeats, 2),
+                 # the one-program slab's dispatch/sync story (ISSUE 18):
+                 # counted on a single fused detect + the staged chain's
+                 # structural program count next to it (_slab_ab)
+                 **slab_info}
     cost_info = _cost_card_live_report(det, block, min(times), nx, ns)
     cost_info.update(_quality_live_report(det, res, block, ns))
     batch_info = _bench_batch(meta, nx, ns, block, wire, peak_block,
@@ -497,7 +518,7 @@ def _cost_card_live_report(det, block, wall, nx, ns):
         from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
 
         dt = np.asarray(block).dtype
-        bdet = BatchedMatchedFilterDetector(det, donate=False)
+        bdet = BatchedMatchedFilterDetector(det)
         bucket = _costs.bucket_label((nx, ns, str(dt)))
         _costs.capture_batched(bdet, 1, dt, bucket=bucket,
                                program="batched:1")
@@ -585,7 +606,7 @@ def _bench_batch(meta, nx, ns, block, wire, peak_block, channel_tile,
         fused_bandpass=os.environ.get("DAS_BENCH_FUSED", "1") == "1",
         pick_mode="sparse", keep_correlograms=False,
     )
-    bdet = BatchedMatchedFilterDetector(det, donate=False)  # stack reused
+    bdet = BatchedMatchedFilterDetector(det)  # stack reused
 
     from das4whales_tpu.telemetry import metrics as _tmetrics
 
@@ -653,7 +674,7 @@ def _bench_families(meta, nx, ns, block, repeats):
     for family in out["families"]:
         try:
             det = family_detector(family, meta, [0, nx, 1], (nx, ns))
-            bdet = batched_detector_for(det, donate=False,
+            bdet = batched_detector_for(det,
                                         trace_shape=(nx, ns))
             bdet.detect_batch(stack)  # compile + warm
             walls = []
@@ -761,6 +782,65 @@ def bench_template_sweep(meta, nx, ns, block, wire, repeats=3,
             "picks_identical": bool(identical),
         }
     return out
+
+
+def _slab_ab(det, x, repeats=3):
+    """Staged-vs-fused end-to-end slab A/B (ISSUE 18): time the SAME
+    detection twice — ``slab[fused]`` is the one-program route
+    (``detect_picks``: filter -> correlate -> envelope -> pick ->
+    compact in ONE XLA program, one packed fetch) and ``slab[staged]``
+    is the exact multi-program chain (``_call_tiled``/``_call_full``:
+    one program + sync per stage) — so the dispatch/sync tax the fusion
+    removes is a recorded pair of walls in ``stage_wall_s``, not an
+    inference. Also measures the fused route's per-slab dispatch/sync
+    counters (``faults.counters``; healthy = 1 + 1, an adaptive-K
+    escalation adds one pair) for the ``dispatches_per_slab`` /
+    ``syncs_per_slab`` / ``slab_programs`` payload fields.
+
+    Best-of-``repeats`` on BOTH variants: the CPU quick-shape walls sit
+    within a few percent of each other, so fewer than three samples
+    lets a scheduler blip flip the A/B sign."""
+    import jax
+
+    from das4whales_tpu.telemetry import metrics as tmetrics
+    from das4whales_tpu.telemetry import trace as telemetry
+
+    def fused():
+        return det.detect_picks(x)
+
+    def staged():
+        res = (det._call_tiled(x) if det._route() == "tiled"
+               else det._call_full(x))
+        if res.trf_fk is not None:
+            jax.block_until_ready(res.trf_fk)
+        return res
+
+    fused()   # warm both variants OUTSIDE the counter window
+    staged()
+    before = tmetrics.resilience_counters()
+    fused()
+    seg = tmetrics.resilience_delta(before)
+    t_f, _ = telemetry.timed_best(fused, repeats=repeats,
+                                  name="bench.slab[fused]")
+    t_s, _ = telemetry.timed_best(staged, repeats=repeats,
+                                  name="bench.slab[staged]")
+    rows = {"slab[fused]": round(t_f, 4), "slab[staged]": round(t_s, 4)}
+    info = {
+        "dispatches_per_slab": int(seg.get("dispatches", 0)),
+        "syncs_per_slab": int(seg.get("syncs", 0)),
+        "slab_programs": {
+            "fused": int(seg.get("dispatches", 0)),
+            # the staged chain's launches predate the dispatch counters
+            # (its syncs are uncounted block_until_ready — itself the
+            # finding), so its program count is structural: filter +
+            # correlate + pick + compact on the tiled route; filter +
+            # correlate + envelope + one peak program per template on
+            # the monolithic route
+            "staged": (4 if det._route() == "tiled"
+                       else 3 + int(det.design.templates.shape[0])),
+        },
+    }
+    return rows, info
 
 
 def bench_stages(det, x, repeats=3):
@@ -1657,6 +1737,13 @@ def main():
         # number next to the stage walls it explains
         "n_dispatches": result.get("n_dispatches"),
         "n_syncs": result.get("n_syncs"),
+        # the one-program slab (ISSUE 18): fused-route dispatch/sync
+        # counters for ONE slab (healthy = 1 + 1) and the fused-vs-
+        # staged program counts the slab[fused]/slab[staged] stage rows
+        # explain — null on no-stage or non-sparse rungs
+        "dispatches_per_slab": result.get("dispatches_per_slab"),
+        "syncs_per_slab": result.get("syncs_per_slab"),
+        "slab_programs": result.get("slab_programs"),
         "roofline_pred_ms": roofline_pred,
         "roofline_frac": roofline_frac,
         # the device-truth twins (ISSUE 14, DAS_COST_CARDS=1): live

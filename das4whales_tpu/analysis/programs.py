@@ -417,11 +417,14 @@ def dump_contracts(snapshot: Dict) -> str:
 CANONICAL_SHAPE = (24, 900)
 
 #: (mf_engine, fk_engine) pairs covering every engine family of the one
-#: program family (`mf`): the FFT route, both matmul routes, and the
-#: bf16 MXU route whose convert fencing R11 checks.
+#: program family (`mf`): the FFT route, both matmul routes, the
+#: bf16 MXU route whose convert fencing R11 checks, and the fused-tap
+#: route (``matmul-fused``: bandpass folded into the template taps, so
+#: the program carries no per-channel FFT filter pass — its precision
+#: gate passes at this shape, ``ops.mxu.fused_correlate_gate``).
 CANONICAL_VARIANTS: Tuple[Tuple[str, str], ...] = (
     ("fft", "fft"), ("matmul", "fft"), ("matmul-bf16", "fft"),
-    ("fft", "matmul"),
+    ("matmul-fused", "fft"), ("fft", "matmul"),
 )
 
 #: the family facades' canonical scene. The mf chaos shape (24, 900)

@@ -5,8 +5,13 @@ TPU-native rebuild of the reference's canonical workflow
 per-template normalized cross-correlograms -> envelope SNR -> prominence
 peak picking. The reference runs three per-channel Python hot loops
 (detect.py:163, detect.py:191) and a monolithic numpy fft2; here the whole
-detection step is two jitted XLA programs (filter+correlate, then blocked
-peak picking) operating on an HBM-resident ``[channel x time]`` tensor.
+detection step is ONE jitted XLA program (``mf_detect_picks_program``:
+filter -> correlate -> threshold -> envelope -> pick -> compact, tiled
+over channels via ``lax.map`` so per-tile correlograms never round-trip
+HBM between programs) operating on an HBM-resident ``[channel x time]``
+tensor — one dispatch and one packed fetch per slab. The staged
+multi-program chain (``_call_tiled``) remains as the exact
+full-artifact route and the fused program's A/B baseline.
 
 Design (host, once per shape) and detection (device, per file) are split so
 filters and templates are reused across a recording campaign — the
@@ -223,6 +228,20 @@ def mf_filter_and_correlate(
     return trf_fk, corr
 
 
+def _fk_apply_padded(x, mask_band, band_lo, band_hi, pad_rows, fk_engine,
+                     fk_dft, crop_to):
+    """THE band-slice + pad-row epilogue shared by every filter variant
+    (``mf_filter_only`` / ``mf_filter_fused`` / the fused-tap program's
+    gainless mask apply): pad ``pad_rows`` virtual silent channels, run
+    the banded f-k applier, crop back to the real channels. One
+    implementation so the variants cannot drift."""
+    if pad_rows:
+        x = jnp.pad(x, ((0, pad_rows), (0, 0)))
+    out = mxu.fk_apply_body(x, mask_band, band_lo, band_hi, fk_engine,
+                            fk_dft)
+    return out[:crop_to] if pad_rows else out
+
+
 @functools.partial(
     jax.jit, static_argnames=("band_lo", "band_hi", "pad_rows", "fk_engine")
 )
@@ -252,10 +271,8 @@ def mf_filter_fused(
     ``fk_engine="matmul"`` routes the channel-axis transform pair through
     the MXU DFT-matrix matmul (``ops.mxu.fk_apply_dft_matmul``;
     ``fk_dft`` is the detector's ``(wr, wi)`` device pair)."""
-    x = jnp.pad(trace, ((0, pad_rows), (0, 0))) if pad_rows else trace
-    out = mxu.fk_apply_body(x, fused_mask_band, band_lo, band_hi,
-                            fk_engine, fk_dft)
-    return out[: trace.shape[0]] if pad_rows else out
+    return _fk_apply_padded(trace, fused_mask_band, band_lo, band_hi,
+                            pad_rows, fk_engine, fk_dft, trace.shape[0])
 
 
 @functools.partial(
@@ -289,14 +306,13 @@ def mf_filter_only(
     from ..ops.filters import _fft_zero_phase_jit
 
     tr_bp = _fft_zero_phase_jit(trace, bp_gain, bp_padlen)
-    if pad_rows:
-        tr_bp = jnp.pad(tr_bp, ((0, pad_rows), (0, 0)))
-    out = mxu.fk_apply_body(tr_bp, fk_mask_band, band_lo, band_hi,
-                            fk_engine, fk_dft)
-    return out[: trace.shape[0]] if pad_rows else out
+    return _fk_apply_padded(tr_bp, fk_mask_band, band_lo, band_hi,
+                            pad_rows, fk_engine, fk_dft, trace.shape[0])
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "mf_engine"))
+@functools.partial(
+    jax.jit, static_argnames=("tile", "mf_engine", "fir_half")
+)
 def mf_correlate_tiled(
     trf_fk: jnp.ndarray,
     templates_true: jnp.ndarray,
@@ -304,6 +320,8 @@ def mf_correlate_tiled(
     scale,
     tile: int,
     mf_engine: str = "fft",
+    fused=None,
+    fir_half: int = 0,
 ):
     """Cross-correlograms over channel tiles: the HBM-fitting correlate.
 
@@ -326,7 +344,10 @@ def mf_correlate_tiled(
     (models/templates.py). ``mf_engine`` picks the per-tile correlate
     transform: the rFFT product or the MXU banded-Toeplitz matmul
     (``ops.mxu.correlograms_body`` — identical normalization/correction
-    math either way).
+    math either way). ``fused``/``fir_half`` are the tap-folded device
+    pair + FIR half-length the gated ``"matmul-fused"`` engine needs
+    (``ops.mxu.fused_template_taps`` — the one-program slab's caller
+    threads them; staged callers leave the defaults).
     """
     C, n = trf_fk.shape
     n_tiles = -(-C // tile)
@@ -338,7 +359,8 @@ def mf_correlate_tiled(
     def per_tile(args):
         x, v = args                                      # [tile, n], [tile]
         corr = mxu.correlograms_body(
-            x, templates_true, mu, scale, mf_engine
+            x, templates_true, mu, scale, mf_engine,
+            fused=fused, fir_half=fir_half,
         )
         tmax = jnp.max(jnp.where(v[None, :, None], corr, neg_inf),
                        axis=(1, 2))                      # [nT]
@@ -457,7 +479,7 @@ def reference_threshold_factors(n_templates: int, dtype=None) -> jnp.ndarray:
         "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp",
         "tile", "max_peaks", "capacity", "use_threshold", "pick_method",
         "condition", "cond_demean", "with_health", "pick_engine",
-        "mf_engine", "fk_engine", "thr_scope",
+        "mf_engine", "fk_engine", "thr_scope", "fir_half",
     ),
 )
 def mf_detect_picks_program(
@@ -490,6 +512,8 @@ def mf_detect_picks_program(
     fk_dft=None,
     thr_factors=None,
     thr_scope: str = "global",
+    mf_fused=None,
+    fir_half: int = 0,
 ):
     """The WHOLE detection step as ONE XLA program: [optional narrow-wire
     conditioning prologue ->] bandpass -> f-k filter
@@ -519,7 +543,25 @@ def mf_detect_picks_program(
     result.
 
     ``tile=None`` correlates monolithically (small shapes); an int walks
-    channel tiles via ``lax.map`` (the HBM-fitting canonical route).
+    channel tiles via ``lax.map`` (the HBM-fitting canonical route):
+    one correlate sweep, the in-graph threshold off the grid's masked
+    max, then a pick sweep over the already-correlated tiles — all
+    inside THIS one jit (the one-program slab, ISSUE 18), so the tile
+    correlograms are an intra-program intermediate XLA schedules
+    freely and never round-trip HBM across a program boundary, and the
+    slab still costs exactly one dispatch + one sync. (Correlating
+    once and keeping the grid beat a remat two-sweep spelling — a
+    max-only pass plus a pick pass recomputing each tile's correlate —
+    on both compile time and wall across the CPU suite; revisit only
+    if a TPU shape's grid exceeds HBM headroom.)
+
+    ``mf_fused`` is the ``(folded_taps, tcum)`` device pair from
+    ``ops.mxu.fused_template_taps`` and ``fir_half`` its FIR
+    half-length — required by (and only by) the precision-gated
+    ``mf_engine="matmul-fused"``, whose correlate applies the bandpass
+    inside the tap contraction; the caller then hands this program the
+    GAINLESS f-k mask and ``staged_bp=False`` so the bandpass is not
+    applied twice (``MatchedFilterDetector._program_mask_dev``).
 
     Returns ``(chan [nT, capacity], times [nT, capacity], count [nT],
     sat_count [nT], thr [nT])``; ``count > capacity`` signals compaction
@@ -606,30 +648,46 @@ def mf_detect_picks_program(
             return (REL_THRESHOLD * gmax_vec) * fac
         return (REL_THRESHOLD * jnp.max(gmax_vec)) * fac
 
-    if tile is None:
-        corr = mxu.correlograms_body(trf, templates_true, mu, scale, mf_engine)
-        thr = resolve_thr(jnp.max(corr, axis=(1, 2)))
+    def correlate(x):
+        return mxu.correlograms_body(x, templates_true, mu, scale,
+                                     mf_engine, fused=mf_fused,
+                                     fir_half=fir_half)
+
+    def pick(corr, thr):
         if pick_engine == "pallas":
             from ..ops import pallas_picks
 
-            sp = pallas_picks.analytic_envelope_peaks(
+            return pallas_picks.analytic_envelope_peaks(
                 corr, thr[:, None], max_peaks=max_peaks, method=pick_method
             )
-        else:
-            env = spectral.envelope_sqrt(corr, axis=-1)
-            sp = peak_ops.find_peaks_sparse_batched(
-                env, thr[:, None], max_peaks=max_peaks, method=pick_method
-            )
+        env = spectral.envelope_sqrt(corr, axis=-1)
+        return peak_ops.find_peaks_sparse_batched(
+            env, thr[:, None], max_peaks=max_peaks, method=pick_method
+        )
+
+    if tile is None:
+        corr = correlate(trf)
+        thr = resolve_thr(jnp.max(corr, axis=(1, 2)))
+        sp = pick(corr, thr)
         chan, times, cnt = peak_ops.compact_picks_rowmajor(
             sp.positions, sp.selected, capacity
         )
         sat_count = jnp.sum(sp.saturated.astype(jnp.int32), axis=-1)
     else:
+        # the one-program slab's tiled flow: the SAME correlate sweep
+        # the staged chain runs (shared helper — the routes cannot
+        # drift), the in-graph threshold off its masked per-tile
+        # maxima, then the pick sweep over the grid — all inside this
+        # jit, so the [n_tiles, nT, tile, n] correlograms are an
+        # intra-program intermediate (no HBM round trip across a
+        # program boundary, no extra dispatch/sync; when the caller
+        # fixed the threshold XLA dead-code-eliminates the max fold).
         corr_tiles, gmax = mf_correlate_tiled(
-            trf, templates_true, mu, scale, tile, mf_engine
+            trf, templates_true, mu, scale, tile, mf_engine,
+            fused=mf_fused, fir_half=fir_half,
         )
         thr = resolve_thr(gmax)
-        sp = mf_pick_tiled(corr_tiles, thr, max_peaks, pick_method, pick_engine)
+        sp = jax.lax.map(lambda c: pick(c, thr), corr_tiles)
         chan, times, cnt = mf_compact_tiled_picks(
             sp.positions, sp.selected, C, capacity
         )
@@ -639,6 +697,26 @@ def mf_detect_picks_program(
         return (chan, times, cnt, sat_count, thr, h_counts, h_rms,
                 h_bin_counts, h_bin_rms)
     return chan, times, cnt, sat_count, thr
+
+
+def mf_detect_picks_tiled_program(trace, mask_band, bp_gain, templates_true,
+                                  mu, scale, thr_in, *, tile: int, **kw):
+    """The one-program TILED slab by name: ``mf_detect_picks_program``
+    with ``tile`` required (an int — the ``lax.map`` channel-tile walk
+    whose per-tile correlate -> envelope -> pick chain never
+    materializes the correlogram grid). A thin alias into the SAME
+    jitted callable — not a second jit — so staged<->fused switches and
+    callers arriving via either name share one compile per
+    (shape, statics) and the compile-guard pins hold across both."""
+    if not isinstance(tile, int) or tile <= 0:
+        raise ValueError(
+            f"mf_detect_picks_tiled_program needs a positive int tile, "
+            f"got {tile!r}; use mf_detect_picks_program for the "
+            "monolithic (tile=None) route"
+        )
+    return mf_detect_picks_program(trace, mask_band, bp_gain,
+                                   templates_true, mu, scale, thr_in,
+                                   tile=tile, **kw)
 
 
 @functools.partial(jax.jit, static_argnames=("thr_scope",))
@@ -813,15 +891,24 @@ class MatchedFilterDetector:
         # multiply instead of bandpass rfft/irfft + f-k rfft/irfft) —
         # see mf_filter_fused for the numerics contract
         self.fused_bandpass = fused_bandpass
-        if fused_bandpass:
-            from ..ops.filters import butter_zero_phase_gain
+        from ..ops.filters import butter_zero_phase_fir, butter_zero_phase_gain
 
-            gain_n = butter_zero_phase_gain(
-                self.design.trace_shape[1], self.design.fs, self.design.bp_band,
-                order=self.design.bp_order,
-            )
+        gain_n = butter_zero_phase_gain(
+            self.design.trace_shape[1], self.design.fs, self.design.bp_band,
+            order=self.design.bp_order,
+        )
+        mask_band_raw = mask_band
+        if fused_bandpass:
             mask_band = mask_band * gain_n[self._band_lo : self._band_hi][None, :]
         self._mask_band_dev = jnp.asarray(mask_band)
+        # tap-fold design pair (ops.mxu.resolve_mf_engine fused_design):
+        # the truncated zero-phase FIR to fold into the correlate taps
+        # and the record-length circular gain its precision gate
+        # references (ops.filters.butter_zero_phase_fir)
+        self._bp_fir, _ = butter_zero_phase_fir(
+            self.design.fs, self.design.bp_band, order=self.design.bp_order
+        )
+        self._fused_design = (self._bp_fir, gain_n.astype(np.float32))
         self._gain_dev = jnp.asarray(self.design.bp_gain)
         self._templates_dev = jnp.asarray(self.design.templates)
         # ONE host decomposition; the device triple is its placement
@@ -842,7 +929,8 @@ class MatchedFilterDetector:
         self._mf_engine_requested = mf_engine
         self._fk_engine_requested = fk_engine
         self.mf_engine, self.mf_engine_reason = mxu.resolve_mf_engine(
-            mf_engine, self.design.trace_shape, t_true, t_mu, t_scale
+            mf_engine, self.design.trace_shape, t_true, t_mu, t_scale,
+            fused_design=self._fused_design,
         )
         self.fk_engine, self.fk_engine_reason = mxu.resolve_fk_engine(
             fk_engine, self.design.fk_channels, self.design.trace_shape[1],
@@ -853,6 +941,66 @@ class MatchedFilterDetector:
             self._fk_dft_dev = (jnp.asarray(wr), jnp.asarray(wi))
         else:
             self._fk_dft_dev = None
+        # tap-folded correlate (mf_engine="matmul-fused"): the bandpass
+        # lives INSIDE the correlate contraction, so the one-program
+        # route applies the GAINLESS f-k mask (else the gain would apply
+        # twice) and skips the staged bandpass pass entirely — see
+        # _program_mask_dev / _program_staged_bp
+        if self.mf_engine == "matmul-fused":
+            self._mask_band_fused_dev = (
+                self._mask_band_dev if not fused_bandpass
+                else jnp.asarray(mask_band_raw)
+            )
+            self._mf_fused_dev, self._mf_fir_half = self._fused_tap_arrays(
+                t_true
+            )
+        else:
+            self._mask_band_fused_dev = None
+            self._mf_fused_dev = None
+            self._mf_fir_half = 0
+
+    def _fused_tap_arrays(self, templates_true):
+        """Fold this detector's bandpass FIR into a template stack's
+        correlate taps (``ops.mxu.fused_template_taps``): returns the
+        ``((folded, tcum) device pair, FIR half-length)`` the
+        ``matmul-fused`` engine's programs consume. Views with their own
+        template slice (``bank_view``) or backend (``host_view``)
+        rebuild through here rather than slicing the parent's arrays —
+        the folded stack carries an extra impulse-response row."""
+        folded, tcum, L = mxu.fused_template_taps(
+            np.asarray(templates_true), self._bp_fir
+        )
+        return (jnp.asarray(folded), jnp.asarray(tcum)), L
+
+    def _gainless_mask_band(self) -> np.ndarray:
+        """The banded half-spectrum f-k mask WITHOUT the |H(f)|^2 gain
+        fold — what the tap-folded route applies (its bandpass is in the
+        taps). Recomputed from the host-side design mask on demand."""
+        return fk_ops.banded_mask_half(self.design.fk_mask)[0]
+
+    @property
+    def _program_mask_dev(self):
+        """The banded mask the ONE-PROGRAM routes apply: gainless when
+        the correlate engine is ``matmul-fused`` (bandpass folded into
+        the taps), else the constructor's (possibly gain-folded) mask."""
+        if self.mf_engine == "matmul-fused":
+            return self._mask_band_fused_dev
+        return self._mask_band_dev
+
+    @property
+    def _program_staged_bp(self) -> bool:
+        """Whether the one-program routes run the staged bandpass pass:
+        never on the tap-folded engine (its bandpass rides the
+        correlate contraction), else the ``fused_bandpass`` choice."""
+        return (not self.fused_bandpass) and self.mf_engine != "matmul-fused"
+
+    @property
+    def _staged_mf_engine(self) -> str:
+        """The correlate engine for STAGED routes, which correlate an
+        already-bandpassed block — the tap-folded engine would apply
+        the bandpass twice there, so it degrades to the f32 matmul
+        (same contraction, unfolded taps)."""
+        return "matmul" if self.mf_engine == "matmul-fused" else self.mf_engine
 
     def tiled_view(self) -> "MatchedFilterDetector":
         """A shallow view of this detector with the channel-TILED
@@ -902,7 +1050,22 @@ class MatchedFilterDetector:
                     np.asarray(self._templates_true),
                     np.asarray(self._template_mu),
                     np.asarray(self._template_scale), backend="cpu",
+                    fused_design=self._fused_design,
                 )
+                if det.mf_engine == "matmul-fused":
+                    det._mask_band_fused_dev = (
+                        det._mask_band_dev if not self.fused_bandpass
+                        else jnp.asarray(self._gainless_mask_band())
+                    )
+                    det._mf_fused_dev, det._mf_fir_half = (
+                        det._fused_tap_arrays(
+                            np.asarray(self._templates_true)
+                        )
+                    )
+                else:
+                    det._mask_band_fused_dev = None
+                    det._mf_fused_dev = None
+                    det._mf_fir_half = 0
                 det.fk_engine, det.fk_engine_reason = _mxu.resolve_fk_engine(
                     self._fk_engine_requested, self.design.fk_channels,
                     self.design.trace_shape[1],
@@ -981,20 +1144,38 @@ class MatchedFilterDetector:
         for attr in ("_templates_dev", "_templates_true", "_template_mu",
                      "_template_scale", "_thr_factors_dev"):
             setattr(view, attr, getattr(self, attr)[lo:hi])
-        if self.mf_engine == "matmul-bf16":
-            # the bf16 gate verdict is CONTENT-keyed (ops.mxu.gate_key):
-            # the sub-bank is a different template set at a different T,
-            # so the parent's eligibility must not launder onto it —
-            # re-resolve (gate + A/B, cached per sliced bank). The f32
-            # engines stay inherited: they are decision-identical by the
-            # f32 precision contract (docs/PRECISION.md), no gate to
-            # earn.
+        if self.mf_engine in ("matmul-bf16", "matmul-fused"):
+            # gate verdicts are CONTENT-keyed (ops.mxu.gate_key /
+            # fused_gate_key): the sub-bank is a different template set
+            # at a different T, so the parent's eligibility must not
+            # launder onto it — re-resolve (gate + A/B, cached per
+            # sliced bank; fused_design rides along so a tap-folded
+            # parent's sub-bank re-earns or loses the fold on its own
+            # record). The f32 engines stay inherited: they are
+            # decision-identical by the f32 precision contract
+            # (docs/PRECISION.md), no gate to earn.
             view.mf_engine, view.mf_engine_reason = mxu.resolve_mf_engine(
                 self._mf_engine_requested, self.design.trace_shape,
                 np.asarray(view._templates_true),
                 np.asarray(view._template_mu),
                 np.asarray(view._template_scale),
+                fused_design=self._fused_design,
             )
+            if view.mf_engine == "matmul-fused":
+                # the folded stack carries an extra impulse-response row
+                # and per-template prefix sums — rebuild from the SLICE,
+                # never slice the parent's fold
+                view._mf_fused_dev, view._mf_fir_half = (
+                    self._fused_tap_arrays(view._templates_true)
+                )
+                if view._mask_band_fused_dev is None:
+                    view._mask_band_fused_dev = (
+                        view._mask_band_dev if not self.fused_bandpass
+                        else jnp.asarray(self._gainless_mask_band())
+                    )
+            else:
+                view._mf_fused_dev = None
+                view._mf_fir_half = 0
         cache[key] = view
         return view
 
@@ -1220,12 +1401,12 @@ class MatchedFilterDetector:
         def run(k):
             faults.count("dispatches")
             return mf_detect_picks_program(
-                trace, self._mask_band_dev, self._gain_dev,
+                trace, self._program_mask_dev, self._gain_dev,
                 self._templates_true, self._template_mu, self._template_scale,
                 thr_in,
                 band_lo=self._band_lo, band_hi=self._band_hi,
                 bp_padlen=self.design.bp_padlen, pad_rows=self.fk_pad_rows,
-                staged_bp=not self.fused_bandpass,
+                staged_bp=self._program_staged_bp,
                 tile=tile, max_peaks=k, capacity=cap,
                 use_threshold=use_thr,
                 pick_method=peak_ops.escalation_method(k, self.max_peaks),
@@ -1241,6 +1422,8 @@ class MatchedFilterDetector:
                 fk_dft=self._fk_dft_dev,
                 thr_factors=self._thr_factors_dev,
                 thr_scope=self.threshold_scope,
+                mf_fused=self._mf_fused_dev,
+                fir_half=self._mf_fir_half,
             )
 
         # the K0 launch: async — errors of the device computation itself
@@ -1375,7 +1558,7 @@ class MatchedFilterDetector:
         trf_fk = self.filter_block(trace)
         corr_tiles, gmax = mf_correlate_tiled(
             trf_fk, self._templates_true, self._template_mu,
-            self._template_scale, tile, self.mf_engine
+            self._template_scale, tile, self._staged_mf_engine
         )
         # bank threshold policy (main_mfdetect.py:94-99 generalized) via
         # the design's per-template factors; gmax is the per-template max

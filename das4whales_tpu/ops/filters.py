@@ -80,6 +80,71 @@ def butter_zero_phase_gain_full(
     return zero_phase_gain(freqs_cps, sos).astype(np.float32)
 
 
+def butter_zero_phase_fir(
+    fs: float, band: Tuple[float, float], order: int = 8, *,
+    tol: float = 1e-7, max_half: int = 512, design_n: int = 8192,
+) -> Tuple[np.ndarray, int]:
+    """Memoized front door for ``_butter_zero_phase_fir_design`` — every
+    detector construction asks for the same few (fs, band, order)
+    designs, so the ~3 ms f64 design grid is paid once per design, not
+    per detector. The cached taps are returned read-only (callers only
+    convolve against them)."""
+    return _butter_zero_phase_fir_design(
+        float(fs), (float(band[0]), float(band[1])), int(order),
+        tol=float(tol), max_half=int(max_half), design_n=int(design_n),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _butter_zero_phase_fir_design(
+    fs: float, band: Tuple[float, float], order: int = 8, *,
+    tol: float = 1e-7, max_half: int = 512, design_n: int = 8192,
+) -> Tuple[np.ndarray, int]:
+    """Symmetric zero-phase FIR truncation of the Butterworth ``|H(f)|^2``
+    impulse response — the TAP-FOLDING half of the one-program slab
+    (ops/mxu.py ``fused_template_taps``): convolving a template with this
+    kernel folds the bandpass INTO the correlate contraction, so the
+    per-channel filter pass over ``[C, time]`` data disappears and its
+    cost moves into ``2L`` extra taps inside the existing MXU matmul
+    (TINA, arxiv 2408.16551).
+
+    Designed on the host in float64 (the ``dft_matrices`` precedent): the
+    gain is sampled on a ``design_n``-point grid (>=40 s at fs=200 —
+    far past the Butterworth-8 ring-down), inverse-transformed, and
+    truncated to the smallest half-length ``L`` whose discarded tail
+    holds ``<= tol`` of the impulse energy (capped at ``max_half``).
+    Exact symmetry is enforced (zero phase is the contract the fold's
+    correlation-vs-convolution identity rests on). Returns
+    ``(h [2L+1] float32, L)``.
+
+    The truncation and the linear (zero-padded) edge handling are WHY
+    the folded route is precision-gated (ops/mxu.py
+    ``fused_correlate_gate``) rather than declared bit-identical: away
+    from the record edges it matches the circular ``|H|^2`` gain to
+    ~``sqrt(tol)`` relative; within ~``L`` samples of either edge the
+    two differ by the wrap-vs-zero-pad transient (docs/PRECISION.md).
+    """
+    sos = sp.butter(order, [band[0] / (fs / 2), band[1] / (fs / 2)], "bp",
+                    output="sos")
+    n = int(design_n)
+    # f64 design grid (host, once per design), cast to f32 on return
+    gain = zero_phase_gain(np.fft.rfftfreq(n), sos)
+    h = np.fft.fftshift(np.fft.irfft(gain, n=n))
+    c = n // 2
+    total = float(np.sum(h * h))
+    L = int(max_half)
+    for cand in range(1, int(max_half) + 1):
+        seg = h[c - cand: c + cand + 1]
+        if total - float(np.sum(seg * seg)) <= tol * total:
+            L = cand
+            break
+    out = h[c - L: c + L + 1]
+    out = 0.5 * (out + out[::-1])  # exact evenness: h[-k] == h[k]
+    out = out.astype(np.float32)
+    out.flags.writeable = False    # lru_cache shares this array
+    return out, int(L)
+
+
 def zero_phase_gain(freqs: np.ndarray, sos: np.ndarray) -> np.ndarray:
     """``|H(f)|^2`` of an SOS filter evaluated at ``freqs`` (cycles/sample
     units handled by the caller). Computed per-section for stability."""

@@ -58,8 +58,11 @@ from . import peaks as peak_ops
 from . import spectral, xcorr
 
 #: Matched-filter correlate engines (resolved static values; the router's
-#: external vocabulary adds "auto").
-MF_ENGINES = ("fft", "matmul", "matmul-bf16")
+#: external vocabulary adds "auto"). ``matmul-fused`` is the tap-folded
+#: variant: the zero-phase bandpass rides INSIDE the correlate taps
+#: (:func:`fused_template_taps`), eliminating the per-channel filter pass
+#: — precision-gated like bf16, falling back to the plain f32 matmul.
+MF_ENGINES = ("fft", "matmul", "matmul-bf16", "matmul-fused")
 
 #: f-k apply engines. The DFT-matmul stays f32: the mask multiply sits
 #: between two C-length transforms whose bf16 rounding would compound,
@@ -73,7 +76,8 @@ FK_ENGINES = ("fft", "matmul")
 
 
 def correlate_taps(xn: jnp.ndarray, templates_true: jnp.ndarray,
-                   bf16: bool = False) -> jnp.ndarray:
+                   bf16: bool = False,
+                   pad: Tuple[int, int] | None = None) -> jnp.ndarray:
     """Positive-lag raw correlation ``sum_j xn[..., k+j] * y[t, j]`` as an
     MXU contraction: ``conv_general_dilated`` in the ML (no-flip)
     convention IS the ``[frames, tap] @ [tap, template]`` im2col matmul,
@@ -81,7 +85,11 @@ def correlate_taps(xn: jnp.ndarray, templates_true: jnp.ndarray,
     exactly as the FFT route's truncated linear correlation. ``xn`` is
     ``[..., n]`` with arbitrary leading axes; returns ``[nT, ..., n]``
     in f32 accumulation (bf16 inputs only when ``bf16`` — the precision
-    gate's domain)."""
+    gate's domain). ``pad`` overrides the ``(0, m - 1)`` edge padding —
+    the tap-folded engine correlates against ``m + 2L``-tap rows whose
+    lag origin sits ``L`` taps in (:func:`fused_template_taps`), so it
+    pads ``(L, m - 1 + L)`` to keep lag ``k == 0`` aligned with the
+    staged route's."""
     n = xn.shape[-1]
     nT, m = templates_true.shape
     lead = xn.shape[:-1]
@@ -91,11 +99,12 @@ def correlate_taps(xn: jnp.ndarray, templates_true: jnp.ndarray,
         lhs = lhs.astype(jnp.bfloat16)
         rhs = rhs.astype(jnp.bfloat16)
     out = jax.lax.conv_general_dilated(
-        lhs, rhs, window_strides=(1,), padding=[(0, m - 1)],
+        lhs, rhs, window_strides=(1,),
+        padding=[(0, m - 1) if pad is None else (int(pad[0]), int(pad[1]))],
         dimension_numbers=("NCH", "OIH", "NCH"),
         preferred_element_type=jnp.float32,
-    )                                               # [batch, nT, n]
-    return jnp.moveaxis(out, 1, 0).reshape((nT,) + lead + (n,))
+    )                                               # [batch, nT, lags]
+    return jnp.moveaxis(out, 1, 0).reshape((nT,) + lead + (out.shape[-1],))
 
 
 def _matmul_correlograms_body(data, templates_true, mu, scale, bf16: bool):
@@ -123,14 +132,156 @@ def compute_cross_correlograms_matmul(
     return _matmul_correlograms_body(data, templates_true, mu, scale, bf16)
 
 
-def correlograms_body(data, templates_true, mu, scale, engine: str):
+# ---------------------------------------------------------------------------
+# Tap folding: the bandpass INSIDE the correlate contraction (TINA-style)
+# ---------------------------------------------------------------------------
+
+
+def fused_template_taps(templates_true, fir) -> Tuple[np.ndarray, np.ndarray,
+                                                      int]:
+    """Fold the zero-phase bandpass FIR ``h`` (half-length ``L``,
+    ``ops.filters.butter_zero_phase_fir``) into each template's correlate
+    taps: because the staged route correlates the FILTERED block against
+    the raw template, ``sum_j (h * x)[k+j] y[t, j] ==
+    sum_u x[k+u] (h conv y_t)[u]`` with ``u in [-L, m-1+L]`` — so the
+    per-channel filter pass folds into ``2L`` extra taps per template.
+
+    Returns ``(folded [nT+1, m+2L] f32, tcum [nT, m+1] f32, L)``. The
+    EXTRA last row is ``h`` itself (centered on the lag origin), so the
+    same contraction also emits the bandpassed block ``g = h * x`` — the
+    normalization prologue (``mean``/``max|.|``/suffix of ``g``) is then
+    derived in-graph from row ``nT`` instead of a separate filter program
+    (:func:`fused_correlograms_body`). ``tcum[t, r] = sum_{j < r}
+    y[t, j]`` (template tap prefix sums) feeds the demean term of the
+    fold's closed form — the PREFIX vector, not just the total, because
+    at partial-overlap lags ``k > n - m`` the staged route's zero-padded
+    ``xn`` truncates the sum at ``j < n - k`` taps. Host design in
+    float64 (the ``dft_matrices`` precedent), cast to f32 on return."""
+    tt = np.atleast_2d(np.asarray(templates_true, dtype=np.float64))  # daslint: allow[R3] f64 design fold, cast to f32 below
+    h = np.asarray(fir, dtype=np.float64)  # daslint: allow[R3] f64 design fold, cast to f32 below
+    L = (int(h.shape[0]) - 1) // 2
+    nT, m = tt.shape
+    P = m + 2 * L
+    folded = np.zeros((nT + 1, P))
+    for i in range(nT):
+        folded[i] = np.convolve(h, tt[i])           # length m + 2L
+    folded[nT, : 2 * L + 1] = h                     # the IR row: recovers g
+    tcum = np.concatenate(
+        [np.zeros((nT, 1)), np.cumsum(tt, axis=-1)], axis=-1
+    )
+    return folded.astype(np.float32), tcum.astype(np.float32), L
+
+
+def fused_correlograms_body(data, templates_true, folded_taps, tcum, mu,
+                            scale, fir_half: int):
+    """Corrected correlograms from the RAW (unfiltered) block with the
+    bandpass folded into the taps — the whole
+    ``_fft_zero_phase_jit -> normalized_block_and_suffix ->
+    correlate_taps -> corrected_from_raw`` chain as ONE ``m + 2L``-tap
+    MXU contraction plus an elementwise epilogue.
+
+    Let ``g = h * x`` (row ``nT`` of the contraction, extended ``m - 1``
+    lags past the record so its ring-down tail is available),
+    ``mg = mean(g[:n])``, ``Mg = max|g[:n]|`` (tiny-guarded like
+    ``_demean_peak_normalize``), ``suffix_g[k] = sum_{n > i >= k} g[i]``.
+    The staged route zero-pads its normalized block past the record, so
+    at lag ``k`` only the first ``w(k) = min(m, n - k)`` template taps
+    contribute; its corrected correlogram is then exactly::
+
+        corr[t, c, k] = (raw[t, c, k] - tail[t, c, k]
+                         - mg tcum[t, w(k)]
+                         - mu_t (suffix_g[k] - (n - k) mg)) / (Mg s_t)
+
+    where ``raw`` is rows ``0..nT-1`` of the same contraction (which
+    integrate the FULL overlap, including ``j >= n - k``) and ``tail``
+    re-correlates the ``m - 1`` ring-down samples ``g[n:]`` against the
+    template tails — a second, tiny ``[.., m-1] x [nT, m]`` contraction
+    — to subtract exactly the terms the staged truncation never sees.
+    Matches the staged route on a LINEARLY-filtered block to f32
+    rounding at every lag; the remaining deviation vs the shipping
+    routes is the bandpass edge spelling (circular/odd-extension vs
+    zero-padded) plus the FIR truncation tail, which is why this engine
+    is precision-gated (:func:`fused_correlate_gate`), never assumed
+    bit-identical. f32 throughout; cast to ``data.dtype`` on return."""
+    L = int(fir_half)
+    P = int(folded_taps.shape[-1])
+    nT = int(folded_taps.shape[0]) - 1
+    m = int(tcum.shape[-1]) - 1
+    n = data.shape[-1]
+    x32 = data.astype(jnp.float32)
+    # one contraction, extended m-1 lags right: rows 0..nT-1 are the raw
+    # full-overlap correlations, row nT is g with its ring-down tail
+    out = correlate_taps(
+        x32, folded_taps.astype(jnp.float32),
+        pad=(L, P - 1 - L + m - 1),
+    )                                               # [nT+1, ..., n+m-1]
+    g_ext = out[-1]
+    g = g_ext[..., :n]                              # bandpassed block
+    raw = out[:-1][..., :n]
+    mg = jnp.mean(g, axis=-1, keepdims=True)
+    tiny = jnp.asarray(np.finfo(np.float32).tiny, jnp.float32)
+    big = jnp.maximum(jnp.max(jnp.abs(g), axis=-1, keepdims=True), tiny)
+    suffix_g = jnp.flip(jnp.cumsum(jnp.flip(g, -1), axis=-1), -1)
+    nd = raw.ndim - 1
+    mu_b = mu.astype(jnp.float32).reshape((nT,) + (1,) * nd)
+    sc_b = scale.astype(jnp.float32).reshape((nT,) + (1,) * nd)
+    # tail correction: T[t, c, n - r] = sum_i g[c, n + i] y[t, r + i]
+    # (the template LEADS the ring-down by r = 1..m-1 taps) — exactly
+    # the j >= n - k terms `raw` integrated but the staged route never
+    # sees. Left-padding m-1 puts that negative-lag family at output
+    # index m - 1 - r, so the slice assigns in increasing-k order.
+    tail_corr = correlate_taps(g_ext[..., n:],
+                               templates_true.astype(jnp.float32),
+                               pad=(m - 1, 0))      # [nT, ..., m-1]
+    tail = jnp.zeros(raw.shape, jnp.float32).at[..., n - m + 1:].set(
+        tail_corr
+    )
+    # staged truncation of the demean term: w(k) = min(m, n - k) taps
+    w = jnp.clip(n - jnp.arange(n), 0, m)
+    coeff = jnp.take_along_axis(
+        tcum.astype(jnp.float32), w[None, :].astype(jnp.int32), axis=-1
+    ).reshape((nT,) + (1,) * (nd - 1) + (n,))
+    remaining = jnp.arange(n, 0, -1, dtype=jnp.float32)   # n - k
+    corr = (raw - tail - mg[None] * coeff
+            - mu_b * (suffix_g[None] - remaining * mg[None]))
+    return (corr / (big[None] * sc_b)).astype(data.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("fir_half",))
+def compute_cross_correlograms_fused(
+    data: jnp.ndarray, templates_true: jnp.ndarray,
+    folded_taps: jnp.ndarray, tcum: jnp.ndarray,
+    mu: jnp.ndarray, scale: jnp.ndarray, fir_half: int,
+) -> jnp.ndarray:
+    """Standalone jitted entry for the tap-folded engine (gate, A/B
+    calibration, tests); the detection programs inline
+    :func:`fused_correlograms_body` under their own jit."""
+    return fused_correlograms_body(data, templates_true, folded_taps, tcum,
+                                   mu, scale, fir_half)
+
+
+def correlograms_body(data, templates_true, mu, scale, engine: str,
+                      fused=None, fir_half: int = 0):
     """Engine dispatch for the correlate stage, usable INSIDE a caller's
     jit (the detection programs thread ``mf_engine`` as a static and
-    call this; compilation belongs to the outer program)."""
+    call this; compilation belongs to the outer program). ``fused`` is
+    the ``(folded_taps, tcum)`` device pair for the ``matmul-fused``
+    engine (None elsewhere — the ``fk_dft`` operand pattern); on that
+    engine ``data`` must be the UNFILTERED block (the bandpass rides the
+    taps)."""
     if engine == "fft":
         return xcorr.compute_cross_correlograms_corrected(
             data, templates_true, mu, scale
         )
+    if engine == "matmul-fused":
+        if fused is None:
+            raise ValueError(
+                "matmul-fused engine needs the (folded_taps, tcum) pair "
+                "from fused_template_taps"
+            )
+        folded_taps, tcum = fused
+        return fused_correlograms_body(data, templates_true, folded_taps,
+                                       tcum, mu, scale, fir_half)
     if engine not in ("matmul", "matmul-bf16"):
         raise ValueError(
             f"unknown mf_engine {engine!r}; expected one of {MF_ENGINES}"
@@ -589,6 +740,149 @@ def bf16_correlate_gate(trace_shape, templates_true, mu, scale, *,
     return eligible, reason
 
 
+def fused_gate_key(backend, trace_shape, templates_true, mu, scale,
+                   fir) -> str:
+    """The fused-tap gate's calibration-table key: the bf16 key's
+    content-digest discipline (two banks with equal shapes can gate
+    differently) PLUS the FIR in the digest and its half-length in the
+    key — a re-designed bandpass re-gates even at identical shapes."""
+    tt = np.ascontiguousarray(np.atleast_2d(np.asarray(templates_true)),
+                              dtype=np.float32)
+    h = np.ascontiguousarray(np.asarray(fir), dtype=np.float32)
+    digest = hashlib.sha1(
+        tt.tobytes()
+        + np.ascontiguousarray(mu, np.float32).tobytes()
+        + np.ascontiguousarray(scale, np.float32).tobytes()
+        + h.tobytes()
+    ).hexdigest()[:10]
+    nT, m = tt.shape
+    C, n = int(trace_shape[0]), int(trace_shape[1])
+    L = (int(h.shape[0]) - 1) // 2
+    return f"fusedgate|{backend}|C{C}xN{n}|m{m}T{nT}|L{L}|t{digest}"
+
+
+def fused_correlate_gate(trace_shape, templates_true, mu, scale, fir,
+                         gain_n, *,
+                         table: CalibrationTable | None = None,
+                         backend: str | None = None,
+                         record=None) -> Tuple[bool, str]:
+    """Eligibility of the tap-folded correlate at ``trace_shape``: picks
+    from the fused route (raw record -> folded-tap contraction) must be
+    BIT-IDENTICAL on the calibration record to the staged route's
+    (circular ``|H|^2`` gain ``gain_n`` at the record length — the
+    fused-mask program's own bandpass spelling — then the f32 FFT
+    correlate). The two differ by the FIR truncation tail and by
+    linear-vs-circular edge handling within ~``L`` samples of the record
+    ends (docs/PRECISION.md), so eligibility is a measured verdict per
+    (backend, shape, template set, FIR), cached with its reason exactly
+    like :func:`bf16_correlate_gate`; ``record`` pins both outcomes in
+    tests and bypasses the cache."""
+    from . import filters as filt_ops
+
+    table = table or default_table()
+    backend = backend or jax.default_backend()
+    tt = np.atleast_2d(np.asarray(templates_true))
+    C, n = int(trace_shape[0]), int(trace_shape[1])
+    key = fused_gate_key(backend, trace_shape, tt, mu, scale, fir)
+    cached = record is None
+    if cached:
+        hit = table.get(key)
+        if hit is not None:
+            return bool(hit["eligible"]), str(hit["reason"])
+        record = calibration_record((min(C, _GATE_MAX_CHANNELS), n), tt)
+    x = jnp.asarray(np.asarray(record, np.float32))
+    tt_d = jnp.asarray(tt.astype(np.float32))
+    mu_d = jnp.asarray(np.asarray(mu, np.float32))
+    sc_d = jnp.asarray(np.asarray(scale, np.float32))
+    gain_d = jnp.asarray(np.asarray(gain_n, np.float32))
+    folded, tcum, L = fused_template_taps(tt, fir)
+    g_ref = filt_ops._fft_zero_phase_jit(x, gain_d, 0)
+    ref = _gate_picks(
+        xcorr.compute_cross_correlograms_corrected(g_ref, tt_d, mu_d, sc_d)
+    )
+    got = _gate_picks(
+        compute_cross_correlograms_fused(
+            x, tt_d, jnp.asarray(folded), jnp.asarray(tcum), mu_d, sc_d, L
+        )
+    )
+    ref_sel = np.asarray(ref.selected, bool)
+    got_sel = np.asarray(got.selected, bool)
+    ref_pos = np.asarray(ref.positions)
+    got_pos = np.asarray(got.positions)
+    sel_same = bool(np.array_equal(ref_sel, got_sel))
+    pos_same = bool(np.array_equal(ref_pos[ref_sel], got_pos[ref_sel])) \
+        if sel_same else False
+    if sel_same and pos_same:
+        eligible, reason = True, (
+            f"picks bit-identical to the staged f32 route on the "
+            f"[{x.shape[0]}x{n}] calibration record ({int(ref_sel.sum())} "
+            f"picks; L={L})"
+        )
+    else:
+        n_diff = (
+            int((ref_sel != got_sel).sum()) if not sel_same
+            else int((ref_pos[ref_sel] != got_pos[ref_sel]).sum())
+        )
+        what = "pick slots" if not sel_same else "pick positions"
+        eligible, reason = False, (
+            f"{n_diff} {what} differ from the staged f32 route on the "
+            f"[{x.shape[0]}x{n}] calibration record "
+            f"({int(ref_sel.sum())} staged picks; L={L})"
+        )
+    if cached:
+        table.put(key, {"eligible": eligible, "reason": reason})
+    return eligible, reason
+
+
+def calibrate_correlate_fused(C: int, n: int, m: int, nT: int, L: int, *,
+                              table: CalibrationTable | None = None,
+                              backend: str | None = None,
+                              repeats: int = 2) -> dict:
+    """A/B the STAGED chain (circular-gain bandpass program + f32 FFT
+    correlate) against the tap-folded single contraction at the given
+    shape; measured once on the live backend, cached. Synthetic taps at
+    the real (m, L) — the verdict is a wall comparison, eligibility is
+    the gate's job."""
+    from . import filters as filt_ops
+
+    table = table or default_table()
+    backend = backend or jax.default_backend()
+    key = f"correlate-fused|{backend}|C{C}xN{n}|m{m}T{nT}|L{L}"
+    hit = table.get(key)
+    if hit is not None:
+        return hit
+    Cc = min(int(C), _CAL_MAX_CHANNELS)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(Cc, n)).astype(np.float32))
+    tt = jnp.asarray(rng.normal(size=(nT, m)).astype(np.float32))
+    mu = jnp.zeros((nT,), jnp.float32)
+    sc = jnp.ones((nT,), jnp.float32)
+    gain = jnp.asarray(
+        rng.uniform(size=(n // 2 + 1,)).astype(np.float32)
+    )
+    h = rng.normal(size=(2 * int(L) + 1,)).astype(np.float32)
+    folded, tcum, _ = fused_template_taps(np.asarray(tt), h)
+    folded_d, tcum_d = jnp.asarray(folded), jnp.asarray(tcum)
+
+    def staged():
+        g = filt_ops._fft_zero_phase_jit(x, gain, 0)
+        return xcorr.compute_cross_correlograms_corrected(g, tt, mu, sc)
+
+    entry = {"cal_channels": Cc}
+    entry["staged_s"] = _best_wall(staged, repeats)
+    entry["fused_s"] = _best_wall(
+        lambda: compute_cross_correlograms_fused(
+            x, tt, folded_d, tcum_d, mu, sc, int(L)
+        ),
+        repeats,
+    )
+    entry["winner"] = (
+        "matmul-fused" if entry["fused_s"] < entry["staged_s"] else "staged"
+    )
+    table.put(key, entry)
+    return entry
+
+
 # ---------------------------------------------------------------------------
 # Engine router
 # ---------------------------------------------------------------------------
@@ -596,18 +890,25 @@ def bf16_correlate_gate(trace_shape, templates_true, mu, scale, *,
 
 def resolve_mf_engine(requested, trace_shape, templates_true, mu, scale, *,
                       table: CalibrationTable | None = None,
-                      backend: str | None = None) -> Tuple[str, str]:
+                      backend: str | None = None,
+                      fused_design=None) -> Tuple[str, str]:
     """Resolve the correlate engine for a detector at ``trace_shape``.
 
     ``requested`` is ``"fft"`` / ``"matmul"`` (forced) /
-    ``"matmul-bf16"`` (forced but still precision-gated — an ineligible
-    shape falls back to the f32 matmul with the gate's recorded reason) /
-    ``"auto"`` / None (defer to ``DAS_MF_ENGINE``, default auto). Auto:
-    the FFT route off-TPU (no MXU to win); on TPU the per-shape A/B
-    calibration (measured once, cached) picks the faster of fft/matmul,
-    and bf16 additionally requires the precision gate AND a faster
-    calibrated wall than f32 matmul. Returns ``(engine, reason)`` —
-    the reason is stamped into bench payloads and planner ledgers."""
+    ``"matmul-bf16"`` / ``"matmul-fused"`` (forced but still
+    precision-gated — an ineligible shape falls back to the f32 matmul
+    with the gate's recorded reason) / ``"auto"`` / None (defer to
+    ``DAS_MF_ENGINE``, default auto). Auto: the FFT route off-TPU (no
+    MXU to win); on TPU the per-shape A/B calibration (measured once,
+    cached) picks the faster of fft/matmul, bf16 additionally requires
+    the precision gate AND a faster calibrated wall than f32 matmul, and
+    the tap-folded engine (considered only when the caller supplies
+    ``fused_design``) requires its gate AND a staged-vs-fused A/B win.
+    ``fused_design`` is the ``(fir, gain_n)`` pair from the detector's
+    bandpass design — the FIR to fold and the record-length circular
+    gain the gate references; without it ``matmul-fused`` cannot gate
+    and falls back. Returns ``(engine, reason)`` — the reason is
+    stamped into bench payloads and planner ledgers."""
     req = requested or config.mf_engine_default()
     if req in ("fft", "matmul"):
         return req, "forced"
@@ -620,6 +921,20 @@ def resolve_mf_engine(requested, trace_shape, templates_true, mu, scale, *,
         if ok:
             return "matmul-bf16", f"forced; precision gate passed: {why}"
         return "matmul", f"bf16 ineligible, f32 matmul fallback: {why}"
+    if req == "matmul-fused":
+        if fused_design is None:
+            return "matmul", (
+                "matmul-fused unavailable without the bandpass FIR "
+                "(fused_design); f32 matmul fallback"
+            )
+        fir, gain_n = fused_design
+        ok, why = fused_correlate_gate(
+            trace_shape, tt, mu, scale, fir, gain_n,
+            table=table, backend=backend,
+        )
+        if ok:
+            return "matmul-fused", f"forced; precision gate passed: {why}"
+        return "matmul", f"fused-taps ineligible, f32 matmul fallback: {why}"
     if req != "auto":
         raise ValueError(
             f"unknown mf_engine {req!r}; expected one of "
@@ -648,6 +963,25 @@ def resolve_mf_engine(requested, trace_shape, templates_true, mu, scale, *,
             f"auto: A/B {ab['winner']} wins at f32 (fft {ab['fft_s']:.4g}s,"
             f" matmul {ab['matmul_s']:.4g}s); bf16 ineligible: {why}"
         )
+    if fused_design is not None:
+        # the fused A/B compares whole CHAINS (bandpass+correlate vs the
+        # single folded contraction), not correlate-only walls — its own
+        # calibration entry decides, gated exactly like a forced request
+        fir, gain_n = fused_design
+        L = (int(np.asarray(fir).shape[0]) - 1) // 2
+        abf = calibrate_correlate_fused(
+            C, n, m, nT, L, table=table, backend=backend
+        )
+        if abf["winner"] == "matmul-fused":
+            ok, why = fused_correlate_gate(
+                trace_shape, tt, mu, scale, fir, gain_n,
+                table=table, backend=backend,
+            )
+            if ok:
+                return "matmul-fused", (
+                    f"auto: A/B fused {abf['fused_s']:.4g}s < staged "
+                    f"{abf['staged_s']:.4g}s; precision gate passed: {why}"
+                )
     if ab["winner"] == "fft":
         return "fft", (
             f"auto: A/B fft {ab['fft_s']:.4g}s <= matmul "

@@ -60,19 +60,19 @@ _STATIC = (
     "band_lo", "band_hi", "bp_padlen", "pad_rows", "staged_bp", "tile",
     "max_peaks", "capacity", "use_threshold", "pick_method", "condition",
     "serial", "with_health", "pick_engine", "mf_engine", "fk_engine",
-    "thr_scope",
+    "thr_scope", "fir_half",
 )
 
 
 def _batched_body(
     trace_batch, mask_band, bp_gain, templates_true, mu, scale, thr_in,
-    cond_scale, n_real, fk_dft=None, thr_factors=None, *,
+    cond_scale, n_real, fk_dft=None, thr_factors=None, mf_fused=None, *,
     band_lo: int, band_hi: int, bp_padlen: int, pad_rows: int,
     staged_bp: bool, tile: int | None, max_peaks: int, capacity: int,
     use_threshold: bool, pick_method: str, condition: bool,
     serial: bool = False, with_health: bool = False, health_clip=None,
     pick_engine: str = "jnp", mf_engine: str = "fft", fk_engine: str = "fft",
-    thr_scope: str = "global",
+    thr_scope: str = "global", fir_half: int = 0,
 ):
     """The one-program route over a leading file axis, in ONE program.
 
@@ -99,9 +99,10 @@ def _batched_body(
       vmap mode's 4x working set loses to the cache (docs/PERF.md).
     """
     def one(tr, nr):
-        # fk_dft (the DFT-matmul pair) and the bank's thr_factors are
-        # closed over, not batched: one matrix pair / factor vector
-        # serves every file of the slab
+        # fk_dft (the DFT-matmul pair), the bank's thr_factors and the
+        # tap-fold pair mf_fused are closed over, not batched: one
+        # matrix pair / factor vector / folded-tap stack serves every
+        # file of the slab
         return mf_detect_picks_program(
             tr, mask_band, bp_gain, templates_true, mu, scale, thr_in,
             band_lo, band_hi, bp_padlen, pad_rows, staged_bp, tile,
@@ -111,6 +112,7 @@ def _batched_body(
             pick_engine=pick_engine, mf_engine=mf_engine,
             fk_engine=fk_engine, fk_dft=fk_dft,
             thr_factors=thr_factors, thr_scope=thr_scope,
+            mf_fused=mf_fused, fir_half=fir_half,
         )
 
     if n_real is None:
@@ -304,13 +306,13 @@ class BatchedMatchedFilterDetector:
         def run(k, stack_):
             faults.count("dispatches")
             return batched_detect_picks_program(
-                stack_, det._mask_band_dev, det._gain_dev,
+                stack_, det._program_mask_dev, det._gain_dev,
                 det._templates_true, det._template_mu, det._template_scale,
                 thr_in, det._cond_scale, nr, det._fk_dft_dev,
-                det._thr_factors_dev,
+                det._thr_factors_dev, det._mf_fused_dev,
                 band_lo=det._band_lo, band_hi=det._band_hi,
                 bp_padlen=det.design.bp_padlen, pad_rows=det.fk_pad_rows,
-                staged_bp=not det.fused_bandpass, tile=tile, max_peaks=k,
+                staged_bp=det._program_staged_bp, tile=tile, max_peaks=k,
                 capacity=cap, use_threshold=False,
                 pick_method=peak_ops.escalation_method(k, det.max_peaks),
                 condition=det.wire == "raw", serial=self.serial,
@@ -319,7 +321,7 @@ class BatchedMatchedFilterDetector:
                              else jnp.float32(health_clip)),
                 pick_engine=det.pick_engine,
                 mf_engine=det.mf_engine, fk_engine=det.fk_engine,
-                thr_scope=det.threshold_scope,
+                thr_scope=det.threshold_scope, fir_half=det._mf_fir_half,
             )
 
         # the K0 launch: async — device-side failures surface at

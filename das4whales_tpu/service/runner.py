@@ -102,7 +102,6 @@ class TenantSpec:
     max_failures: int | None = None
     read_deadline_s: float | None = None
     dispatch_deadline_s: float | None = None
-    donate: bool = True
     serial: bool | None = None
     detector_kwargs: Dict = field(default_factory=dict)
 

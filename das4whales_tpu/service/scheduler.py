@@ -347,7 +347,7 @@ class TenantRuntime:
             ))
             return
         tiled = BatchedMatchedFilterDetector(
-            bdet.det.tiled_view(), donate=False, serial=bdet.serial
+            bdet.det.tiled_view(), serial=bdet.serial
         )
         with_health = self.rz.health_cfg is not None
         clip = (self.rz.health_cfg.clip_abs
@@ -398,8 +398,7 @@ class TenantRuntime:
                 (key[0], slab.bucket_ns), wire=self.spec.wire, **kwargs,
             )
             bdet = batched_detector_for(
-                per_file_det, donate=self.spec.donate,
-                serial=self.spec.serial,
+                per_file_det, serial=self.spec.serial,
                 trace_shape=(key[0], slab.bucket_ns),
             )
             if hasattr(bdet, "_resolve_engines"):

@@ -225,10 +225,12 @@ def _batched_program_spec(bdet, batch: int, stack_dtype, *,
     nT = det.design.templates.shape[0]
     cap = int(min(C * det.max_peaks, det.pick_pack_cap))
     tile = det.effective_channel_tile if det._route() == "tiled" else None
+    program_mask = getattr(det, "_program_mask_dev", det._mask_band_dev)
+    mf_fused = getattr(det, "_mf_fused_dev", None)
     compute_dtype = det._mask_band_dev.dtype
     avals = (
         jax.ShapeDtypeStruct((int(batch), C, T), np.dtype(stack_dtype)),
-        _aval_of(det._mask_band_dev),
+        _aval_of(program_mask),
         _aval_of(det._gain_dev),
         _aval_of(det._templates_true),
         _aval_of(det._template_mu),
@@ -244,11 +246,16 @@ def _batched_program_spec(bdet, batch: int, stack_dtype, *,
         # is part of the priced program (a T=32 bank's correlate /
         # envelope / pick temps all scale with it)
         jax.ShapeDtypeStruct((nT,), compute_dtype),       # thr_factors
+        # mf_fused: the tap-folded engine's (folded_taps, tcum) pair —
+        # priced so the preflight sees the widened-tap operand residency
+        (tuple(_aval_of(a) for a in mf_fused)
+         if mf_fused is not None else None),
     )
     static = dict(
         band_lo=det._band_lo, band_hi=det._band_hi,
         bp_padlen=det.design.bp_padlen, pad_rows=det.fk_pad_rows,
-        staged_bp=not det.fused_bandpass, tile=tile,
+        staged_bp=getattr(det, "_program_staged_bp",
+                          not det.fused_bandpass), tile=tile,
         max_peaks=det.max_peaks, capacity=cap, use_threshold=False,
         pick_method=peak_ops.escalation_method(det.max_peaks,
                                                det.max_peaks),
@@ -257,6 +264,7 @@ def _batched_program_spec(bdet, batch: int, stack_dtype, *,
         mf_engine=getattr(det, "mf_engine", "fft"),
         fk_engine=getattr(det, "fk_engine", "fft"),
         thr_scope=getattr(det, "threshold_scope", "global"),
+        fir_half=getattr(det, "_mf_fir_half", 0),
     )
     kwargs = {k: v for k, v in static.items() if k in _STATIC}
     if with_health and health_clip is not None:

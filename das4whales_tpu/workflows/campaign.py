@@ -771,7 +771,6 @@ def run_campaign_batched(
     wire: str = "conditioned",
     family: str = "mf",
     in_flight: int = 2,
-    donate: bool = True,
     serial: bool | None = None,
     persistent_cache: bool | str = True,
     retry=None,
@@ -848,9 +847,9 @@ def run_campaign_batched(
     compiles persist across processes via the on-disk compilation cache
     (``persistent_cache``: True wires ``config.compilation_cache_dir()``,
     a str names the directory, False skips — docs/TPU_RUNBOOK.md).
-    ``donate`` is accepted for compatibility but inert — the R12
-    contract audit retired slab donation (``parallel.batch`` module
-    docstring); ``in_flight`` bounds slabs resident on device; ``serial`` forces the in-program batch execution
+    Slab donation is retired (the R12 contract audit —
+    ``parallel.batch`` module docstring), so there is no ``donate``
+    knob; ``in_flight`` bounds slabs resident on device; ``serial`` forces the in-program batch execution
     mode (``True``: ``lax.map``, ``False``: ``vmap``; ``None`` resolves
     per backend — see ``parallel.batch._batched_body``). ``wire="raw"`` streams stored-dtype counts and
     conditions on device per bucket (padded records demean over real
@@ -1066,7 +1065,7 @@ def run_campaign_batched(
             return
         # not even B=1 fits the monolithic program: price the tiled one
         tiled = BatchedMatchedFilterDetector(
-            bdet.det.tiled_view(), donate=False, serial=bdet.serial
+            bdet.det.tiled_view(), serial=bdet.serial
         )
         if use_costs:
             tstats = tcosts.capture_batched(
@@ -1104,7 +1103,7 @@ def run_campaign_batched(
         if bdet is None:
             per_file_det = build_family_detector(key, slab)
             bdet = batched_detector_for(
-                per_file_det, donate=donate, serial=serial,
+                per_file_det, serial=serial,
                 trace_shape=(key[0], slab.bucket_ns),
             )
             if hasattr(bdet, "_resolve_engines"):
@@ -1157,7 +1156,7 @@ def run_campaign_batched(
 
     def per_file_fallback(slab, k, prog, rung=("file", 1)):
         """The unbatched per-file route on the assembler's host block
-        (the device slab may already be donated — never touch it here):
+        (never the device slab — the host copy is the stable source):
         the packed-overflow exact path AND the degradation ladder's
         second rung. ``rung`` honors a stickier ladder placement (a
         bucket already downshifted to tiled/host retries there, not at

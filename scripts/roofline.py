@@ -44,6 +44,14 @@ PMAX_LATENCY_S = 20e-6   # scalar pmax across the slice (latency-bound)
 #: 0.78 s x 200 Hz (the longer of the canonical HF/LF pair)
 MF_TAPS = 157
 
+#: FIR half-length of the canonical 14-30 Hz order-8 zero-phase
+#: bandpass (ops/filters.py butter_zero_phase_fir at tol=1e-7): the
+#: fused-tap route (ops/mxu.py fused_template_taps) pre-convolves this
+#: impulse response into every template, so each folded tap row is
+#: ``m + 2*FIR_HALF`` long and the per-channel bandpass FFT pass
+#: disappears from the program entirely.
+FIR_HALF = 198
+
 # canonical OOI working selection (BASELINE.md; 22050 = 2*3^2*5^2*7^2)
 C, N = 22050, 12000
 FS = 200.0
@@ -89,7 +97,8 @@ def _derived(c, n, fs, band_hz):
 
 
 def model(c=C, n=N, fs=FS, band_hz=BAND_HZ, nt=NT, fused=False,
-          mf_engine="fft", fk_engine="fft", m_taps=MF_TAPS):
+          mf_engine="fft", fk_engine="fft", m_taps=MF_TAPS,
+          fir_half=FIR_HALF):
     """Single-chip per-stage roofline rows for a [c x n] block.
 
     ``mf_engine``/``fk_engine`` model the MXU matmul recasts
@@ -98,11 +107,19 @@ def model(c=C, n=N, fs=FS, band_hz=BAND_HZ, nt=NT, fused=False,
     for the gated bf16 route — instead of the VPU-bound FFT cost model,
     so ``bench.py``'s ``roofline_frac`` judges the matmul route against
     the peak it actually targets. ``m_taps`` is the true template length
-    of the banded-Toeplitz correlate."""
+    of the banded-Toeplitz correlate.
+
+    ``mf_engine="matmul-fused"`` models the fused-tap route (ISSUE 18,
+    ``ops/mxu.py fused_template_taps``): the bandpass row vanishes —
+    its FFT flops fold into a LONGER-tap correlate contraction
+    (``m_taps + 2*fir_half`` taps over ``nt + 1`` rows; the extra row
+    reconstructs the filtered block for the normalization epilogue) —
+    and the whole hot path is one MXU-resident program."""
     nf_bp, f_half, band = _derived(c, n, fs, band_hz)
     nf_xc = nf_bp
+    fused_taps = mf_engine == "matmul-fused"
     rows = []
-    if not fused:
+    if not fused and not fused_taps:
         # 1. bandpass: rfft -> gain mul -> irfft per channel (ops/filters.py)
         fl = c * (2 * rfft_flops(nf_bp) + 6 * (nf_bp / 2 + 1))
         by = B * c * (n + 2 * (nf_bp / 2 + 1) * 2 + n)  # in, spec rw (c64), out
@@ -132,7 +149,27 @@ def model(c=C, n=N, fs=FS, band_hz=BAND_HZ, nt=NT, fused=False,
                   + c * n)                    # out
         rows.append(stage("f-k apply (banded)" + (" +fusedbp" if fused else ""), fl, by))
 
-    if mf_engine in ("matmul", "matmul-bf16"):
+    if fused_taps:
+        # 3f. fused-tap correlate (ops/mxu.py fused_correlograms_body):
+        # ONE conv of the raw block against the folded taps — nt + 1
+        # rows (templates + the bare-FIR row that reconstructs the
+        # filtered block g for the normalization epilogue), each
+        # m_taps + 2*fir_half long — plus the closed-form mean/tail
+        # corrections (elementwise + one cumulative pass). FLOP-bound
+        # at the MXU f32 peak; the bandpass row above is GONE.
+        p_taps = m_taps + 2 * fir_half
+        fl = c * (2.0 * n * p_taps * (nt + 1)    # folded contraction
+                  + 10 * n                       # g stats + suffix sums
+                  + 8 * n * nt)                  # tail/mean epilogue
+        by = B * (c * n                          # raw read (only once!)
+                  + (nt + 1) * p_taps            # folded tap read
+                  + c * n                        # g row materialized
+                  + nt * c * n)                  # correlogram out
+        rows.append(stage(
+            f"correlate x{nt} (fused-tap matmul P={p_taps})", fl, by,
+            flops_peak=F32_FLOPS,
+        ))
+    elif mf_engine in ("matmul", "matmul-bf16"):
         # 3m. correlate as banded-Toeplitz matmul: norm + suffix cumsum
         # + the [frames, tap] @ [tap, template] contraction on the MXU
         # (ops/mxu.py, arxiv 2408.16551) — FLOP-bound by design, judged
@@ -275,8 +312,18 @@ def main():
     ap.add_argument("--fused", action="store_true",
                     help="model the fused-bandpass route (bench default)")
     ap.add_argument("--mf-engine", default="fft",
-                    choices=("fft", "matmul", "matmul-bf16"),
+                    choices=("fft", "matmul", "matmul-bf16",
+                             "matmul-fused"),
                     help="correlate engine to model (ops/mxu.py routes)")
+    ap.add_argument("--fused-taps", action="store_true",
+                    help="model the fused-tap route (mf-engine "
+                         "matmul-fused): the bandpass FFT rows fold "
+                         "into a longer-tap correlate contraction "
+                         f"(+2*{FIR_HALF} taps/row) and drop out as a "
+                         "separate stage")
+    ap.add_argument("--fir-half", type=int, default=FIR_HALF,
+                    help="FIR half-length L of the folded zero-phase "
+                         "bandpass (fused-tap rows are m + 2L long)")
     ap.add_argument("--fk-engine", default="fft", choices=("fft", "matmul"),
                     help="f-k apply engine to model")
     ap.add_argument("--templates", type=int, default=NT,
@@ -289,6 +336,8 @@ def main():
                     help="also print the non-MF families' MXU rows "
                          "(spectro STFT-matmul, gabor conv-matmul)")
     args = ap.parse_args()
+    if args.fused_taps:
+        args.mf_engine = "matmul-fused"
 
     if args.families:
         print_rows(model_families(), C, N,
@@ -296,7 +345,7 @@ def main():
     t1 = print_rows(
         model(fused=args.fused, mf_engine=args.mf_engine,
               fk_engine=args.fk_engine, nt=args.templates,
-              m_taps=args.taps),
+              m_taps=args.taps, fir_half=args.fir_half),
         C, N, f"single v5e chip (per-file, T={args.templates})",
     )
     rows8, c_pad = model_sharded(args.chips, fused=args.fused,

@@ -1,0 +1,206 @@
+"""One-program slab (ISSUE 18): tap folding + end-to-end tiled fusion.
+
+Two contracts, both against the STAGED route as ground truth:
+
+* ``mf_engine="matmul-fused"`` (the tap-folded correlate) is
+  decision-identical to the staged f32 FFT detector behind its cached
+  precision gate — pick parity pinned on mono, tiled and batched
+  routes, both wires (the gate matrix itself lives in
+  ``test_precision.py``).
+* the TILED one-program route (``mf_detect_picks_program`` with an int
+  ``tile``: correlate → envelope → threshold → pick → compact chained
+  inside ONE jitted program) costs exactly ONE dispatch + ONE
+  sync per slab (``faults.counters``), compiles once, and the
+  ``mf_detect_picks_tiled_program`` name enters the SAME jit cache —
+  a staged↔fused switch never recompiles either side.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from das4whales_tpu.models.matched_filter import (
+    MatchedFilterDetector,
+    mf_detect_picks_tiled_program,
+)
+from das4whales_tpu.ops import peaks as peak_ops
+from das4whales_tpu.telemetry import metrics as tmetrics
+
+NX, NS = 24, 900
+SEL = [0, NX, 1]
+META = {"fs": 200.0, "dx": 4.0, "nx": NX, "ns": NS}
+KW = dict(pick_mode="sparse", keep_correlograms=False, max_peaks=64)
+
+
+def _det(mf_engine, **kw):
+    merged = dict(KW, **kw)
+    return MatchedFilterDetector(META, SEL, (NX, NS), mf_engine=mf_engine,
+                                 **merged)
+
+
+def _record(det, seed=3, noise=0.02):
+    """A noise record with strong injected template calls — parity over
+    an empty pick set proves nothing."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0.0, noise, size=(NX, NS)).astype(np.float32)
+    tt = np.asarray(det._templates_true)
+    m = tt.shape[1]
+    for k, c in enumerate((3, 11, 19)):
+        t0 = 120 + 210 * k
+        x[c, t0 : t0 + m] += 0.8 * tt[k % tt.shape[0]] / np.abs(tt).max()
+    return x
+
+
+def _assert_picks_identical(res_a, res_b):
+    assert set(res_a.picks) == set(res_b.picks)
+    n_total = 0
+    for name in res_a.picks:
+        np.testing.assert_array_equal(res_a.picks[name], res_b.picks[name])
+        n_total += res_a.picks[name].shape[1]
+    assert n_total > 0, "parity over an empty pick set proves nothing"
+
+
+@pytest.mark.slow
+def test_fused_engine_pick_parity_mono():
+    """Forced ``matmul-fused`` at the canonical gate-passing shape: the
+    gate passes (clean calibration record at 24x900), the engine
+    resolves fused, and detector picks are IDENTICAL to the staged f32
+    FFT detector's on a real-ish injected-call record. (Slow tier: the
+    tiled parity test below is the tier-1 representative — it runs the
+    same fused engine through the tentpole one-program route.)"""
+    det_f = _det("matmul-fused")
+    assert det_f.mf_engine == "matmul-fused", det_f.mf_engine_reason
+    assert "precision gate passed" in det_f.mf_engine_reason
+    det_s = _det("fft")
+    x = _record(det_f)
+    _assert_picks_identical(det_f(x), det_s(x))
+
+
+def test_fused_engine_pick_parity_tiled():
+    """Same parity through the TILED one-program route (``lax.map``
+    correlate + pick sweeps in one jit; the fused engine's bandpass
+    rides inside the folded taps, the staged side's inside
+    ``filter_block``)."""
+    det_f = _det("matmul-fused", channel_tile=8)
+    assert det_f.mf_engine == "matmul-fused", det_f.mf_engine_reason
+    det_s = _det("fft", channel_tile=8)
+    assert det_s._route() == "tiled"
+    x = _record(det_f)
+    _assert_picks_identical(det_f(x), det_s(x))
+
+
+@pytest.mark.slow
+def test_fused_engine_pick_parity_raw_wire():
+    """The raw int16 wire composes with the fold: on-device conditioning
+    feeds the folded contraction, picks identical to the staged raw-wire
+    detector AND to the conditioned-wire fused detector."""
+    meta = dict(META, scale_factor=3.25e-9)
+    det_f = MatchedFilterDetector(meta, SEL, (NX, NS), wire="raw",
+                                  mf_engine="matmul-fused", **KW)
+    assert det_f.mf_engine == "matmul-fused", det_f.mf_engine_reason
+    det_s = MatchedFilterDetector(meta, SEL, (NX, NS), wire="raw",
+                                  mf_engine="fft", **KW)
+    cond = _record(det_f)
+    counts = np.clip(cond / 3.25e-9, -3e4, 3e4).astype(np.int16)
+    _assert_picks_identical(det_f(counts), det_s(counts))
+
+
+def test_tiled_one_program_one_dispatch_one_sync():
+    """THE dispatch-budget drill (docs/PERF.md "One-program slab"): a
+    warm tiled sparse detect is exactly 1 dispatch + 1 sync — the tile
+    walk, threshold resolution, pick and compaction never split into
+    extra programs or fetches (``max_peaks=64`` pins ``pick_k0`` at
+    capacity so adaptive-K escalation cannot add its pair)."""
+    det = _det("fft", channel_tile=8)
+    assert det._route() == "tiled"
+    x = _record(det)
+    det.detect_picks(x)  # compile + warm OUTSIDE the counter window
+    before = tmetrics.resilience_counters()
+    res = det.detect_picks(x)
+    seg = tmetrics.resilience_delta(before)
+    assert seg.get("dispatches", 0) == 1, seg
+    assert seg.get("syncs", 0) == 1, seg
+    assert sum(v.shape[1] for v in res.picks.values()) > 0
+
+
+def test_staged_fused_switch_zero_recompiles(compile_guard):
+    """The fused one-program route and the staged multi-program chain
+    coexist warm: after one warm call each, switching back and forth
+    compiles NOTHING — the fusion is a new program, not a cache-thrash
+    of the old ones."""
+    det = _det("fft", channel_tile=8)
+    x = _record(det)
+    det.detect_picks(x)      # fused one-program route, warm
+    det._call_tiled(x)       # staged chain, warm
+    with compile_guard.max_compiles(0, what="staged<->fused switch"):
+        det.detect_picks(x)
+        det._call_tiled(x)
+        det.detect_picks(x)
+
+
+def test_tiled_program_wrapper_same_jit_cache(compile_guard):
+    """``mf_detect_picks_tiled_program`` is a NAME, not a second jit:
+    calling it with the exact operands ``dispatch_picks`` warmed adds
+    zero compiles, and a non-positive/non-int tile is rejected before
+    any trace."""
+    det = _det("fft", channel_tile=8)
+    x = _record(det)
+    det.detect_picks(x)  # warms mf_detect_picks_program at tile=8
+    nT = det.design.templates.shape[0]
+    cap = int(min(NX * det.max_peaks, det.pick_pack_cap))
+    kw = dict(
+        band_lo=det._band_lo, band_hi=det._band_hi,
+        bp_padlen=det.design.bp_padlen, pad_rows=det.fk_pad_rows,
+        staged_bp=det._program_staged_bp,
+        max_peaks=det.pick_k0, capacity=cap, use_threshold=False,
+        pick_method=peak_ops.escalation_method(det.pick_k0, det.max_peaks),
+        condition=False, cond_scale=det._cond_scale, cond_n_real=None,
+        with_health=False, health_clip=None,
+        pick_engine=det.pick_engine, mf_engine=det.mf_engine,
+        fk_engine=det.fk_engine, fk_dft=det._fk_dft_dev,
+        thr_factors=det._thr_factors_dev, thr_scope=det.threshold_scope,
+        mf_fused=det._mf_fused_dev, fir_half=det._mf_fir_half,
+    )
+    thr_in = jnp.zeros((nT,), det._mask_band_dev.dtype)
+    args = (jnp.asarray(x), det._program_mask_dev, det._gain_dev,
+            det._templates_true, det._template_mu, det._template_scale,
+            thr_in)
+    with compile_guard.max_compiles(0, what="tiled-program wrapper"):
+        out = mf_detect_picks_tiled_program(*args, tile=8, **kw)
+        jax.block_until_ready(out)
+    for bad in (0, -4, None, 8.0):
+        with pytest.raises(ValueError, match="positive int tile"):
+            mf_detect_picks_tiled_program(*args, tile=bad, **kw)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("batch", [1, 2, 4])
+def test_fused_batched_pick_parity(batch):
+    """Batched slabs (B files per program step): the fused engine's
+    batched program picks match the staged f32 FFT batched program's,
+    file for file, at every campaign batch size."""
+    from das4whales_tpu.parallel.batch import BatchedMatchedFilterDetector
+
+    det_f = _det("matmul-fused")
+    assert det_f.mf_engine == "matmul-fused", det_f.mf_engine_reason
+    det_s = _det("fft")
+    base = _record(det_f)
+    rng = np.random.default_rng(9)
+    stack = np.stack([
+        base + rng.normal(0.0, 1e-4, base.shape).astype(np.float32)
+        for _ in range(batch)
+    ])
+    bf = BatchedMatchedFilterDetector(det_f)
+    bs = BatchedMatchedFilterDetector(det_s)
+    for (pf, _), (ps, _) in zip(bf.detect_batch(stack),
+                                bs.detect_batch(stack)):
+        assert set(pf) == set(ps)
+        n_total = 0
+        for name in pf:
+            np.testing.assert_array_equal(pf[name], ps[name])
+            n_total += np.asarray(pf[name]).shape[-1]
+        assert n_total > 0

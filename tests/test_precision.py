@@ -154,3 +154,104 @@ def test_bf16_matmul_error_bound():
     )
     rel = np.abs(f32 - b16).max() / np.abs(f32).max()
     assert 0 < rel < 2e-2, rel
+
+
+# ---------------------------------------------------------------------------
+# Fused-tap engine (ISSUE 18, ops/mxu.py + docs/PRECISION.md)
+# ---------------------------------------------------------------------------
+
+
+def _fused_design():
+    from das4whales_tpu.ops import filters
+
+    fir, _ = filters.butter_zero_phase_fir(FS, (14.0, 30.0))
+    gain_n = filters.butter_zero_phase_gain(NS, FS, (14.0, 30.0))
+    return fir, gain_n.astype(np.float32)
+
+
+def test_fused_fold_exact_vs_linear_staged():
+    """The tap-fold algebra is EXACT: the fused route (raw block against
+    folded taps + closed-form normalization) matches a LINEARLY
+    zero-phase-filtered staged correlate to f32 rounding at EVERY lag —
+    including the ring-down tail lags the fold's tail correction covers.
+    The gate exists for the linear-vs-circular edge spelling, never for
+    the fold itself (docs/PRECISION.md fused-tap row)."""
+    from das4whales_tpu.ops import filters
+
+    fir, _ = _fused_design()
+    L = (fir.shape[0] - 1) // 2
+    rng = np.random.default_rng(0)
+    C, n, m = 6, 900, 137
+    x = rng.normal(0.0, 0.02, size=(C, n)).astype(np.float32)
+    tt, mu, sc = (np.asarray(a) for a in xcorr.padded_template_stats(
+        np.pad(_templates(), ((0, 0), (0, n - m)))))
+    tt_true = _templates().astype(np.float32)
+    g_lin = np.stack([
+        np.convolve(fir.astype(np.float64), x[c].astype(np.float64))[L:L + n]
+        for c in range(C)
+    ]).astype(np.float32)
+    ref = np.asarray(xcorr.compute_cross_correlograms_corrected(
+        jnp.asarray(g_lin), jnp.asarray(tt_true), jnp.asarray(mu),
+        jnp.asarray(sc)))
+    folded, tcum, L2 = mxu.fused_template_taps(tt_true, fir)
+    assert L2 == L
+    got = np.asarray(mxu.compute_cross_correlograms_fused(
+        jnp.asarray(x), jnp.asarray(tt_true), jnp.asarray(folded),
+        jnp.asarray(tcum), jnp.asarray(mu), jnp.asarray(sc), L))
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 5e-5, rel
+
+
+@pytest.mark.parametrize(
+    "record_kind,expect_eligible",
+    [("noisy-marginal", False), ("clean-strong", True)],
+)
+def test_fused_gate_matrix(tmp_path, record_kind, expect_eligible):
+    """The fused-tap eligibility matrix (docs/PRECISION.md), verdicts
+    PINNED per record kind exactly like the bf16 matrix above: a noisy
+    record with edge-hugging near-threshold picks must REJECT the fold
+    (linear vs circular bandpass edges flip marginal picks — the gate's
+    whole domain), a clean strong scene must pass; the reason names the
+    calibration evidence, and a rejection resolves the engine to the
+    f32 matmul — never a silently-different edge spelling."""
+    fir, gain_n = _fused_design()
+    table = mxu.CalibrationTable(str(tmp_path / f"{record_kind}.json"))
+    tt, mu, sc = _triple()
+    tt_true = _templates().astype(np.float32)
+    rng = np.random.default_rng(5)
+    if record_kind == "noisy-marginal":
+        rec = rng.normal(0.0, 1.0, size=(32, NS)).astype(np.float32)
+    else:
+        rec = rng.normal(0.0, 0.01, size=(32, NS)).astype(np.float32)
+        rec[5, 800 : 800 + 137] += 2.0 * tt_true[0]
+        rec[20, 3000 : 3000 + 137] += 2.0 * tt_true[1]
+    ok, why = mxu.fused_correlate_gate((32, NS), tt_true, mu, sc, fir,
+                                       gain_n, table=table, record=rec)
+    assert ok == expect_eligible, why
+    assert "calibration record" in why
+    if not ok:
+        assert "differ from the staged f32 route" in why
+    # the router honors the cached verdict bit-for-bit
+    key = mxu.fused_gate_key("cpu", (32, NS), tt_true, mu, sc, fir)
+    table.put(key, {"eligible": ok, "reason": why})
+    eng, reason = mxu.resolve_mf_engine(
+        "matmul-fused", (32, NS), tt_true, mu, sc, table=table,
+        backend="cpu", fused_design=(fir, gain_n),
+    )
+    assert eng == ("matmul-fused" if ok else "matmul")
+    if not ok:
+        assert "fused-taps ineligible" in reason
+
+
+def test_fused_unavailable_without_design():
+    """A forced ``matmul-fused`` request without the bandpass FIR pair
+    cannot gate — the router must fall back to f32 matmul with a reason,
+    never run an ungated fold."""
+    tt_true = _templates().astype(np.float32)
+    _, mu, sc = _triple()
+    eng, reason = mxu.resolve_mf_engine(
+        "matmul-fused", (32, NS), tt_true, mu, sc, backend="cpu",
+        fused_design=None,
+    )
+    assert eng == "matmul"
+    assert "fused_design" in reason
