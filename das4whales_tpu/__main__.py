@@ -49,6 +49,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = ap.add_subparsers(dest="workflow", required=True)
     sub.add_parser("list", help="list available workflows")
+    pf = sub.add_parser(
+        "fsck",
+        help="verify (and with --repair fix) campaign/service artifact "
+             "state after an unclean death: orphan tmps, torn or "
+             "checksum-failed manifest records, truncated JSON exports, "
+             "manifest<->picks mismatches (docs/ROBUSTNESS.md "
+             "\"Durability contract\")",
+    )
+    pf.add_argument("outdir", help="campaign outdir or service root")
+    pf.add_argument("--repair", action="store_true",
+                    help="fix what was found: truncate torn tails, "
+                         "quarantine corrupt lines into "
+                         "manifest.corrupt.jsonl, remove orphans")
+    pf.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
     pe = sub.add_parser(
         "evaluate",
         help="detection-quality sweep: injection recall/precision vs SNR "
@@ -188,6 +203,19 @@ def main(argv=None) -> int:
         for name, help_text in WORKFLOWS.items():
             print(f"{name:15s} {help_text}")
         return 0
+    if args.workflow == "fsck":
+        # host-only verify/repair: dispatched before any jax/runtime
+        # setup so a corrupt outdir can be inspected from anywhere
+        import json as _json
+
+        from das4whales_tpu.fsck import fsck_outdir, render_findings
+
+        findings = fsck_outdir(args.outdir, repair=args.repair)
+        if args.as_json:
+            print(_json.dumps([f.as_dict() for f in findings], indent=1))
+        else:
+            print(render_findings(findings))
+        return 1 if any(not f.repaired for f in findings) else 0
     # honor JAX_PLATFORMS through the live config too: some environments
     # register an accelerator plugin from sitecustomize that the env var
     # alone cannot keep jax off (see tests/conftest.py) — a CLI run pinned
@@ -280,8 +308,9 @@ def main(argv=None) -> int:
 
         payload = _no_nan(out if args.family == "all" else out[args.family])
         if args.out:
-            with open(args.out, "w") as fh:
-                json.dump(payload, fh, indent=1)
+            from das4whales_tpu.utils.artifacts import atomic_json
+
+            atomic_json(args.out, payload, indent=1)
             print("wrote", args.out, file=sys.stderr)
         if args.figure:
             import matplotlib
@@ -321,8 +350,6 @@ def main(argv=None) -> int:
                   f"{res.n_timeout} timeout -> {res.outdir}")
         return 0 if n_failed == 0 else 3
     if args.workflow == "longrecord":
-        import json as _json
-
         import numpy as np
 
         from das4whales_tpu.io.interrogators import get_acquisition_parameters
@@ -348,12 +375,16 @@ def main(argv=None) -> int:
             interrogator=args.interrogator,
             family_kwargs=fam_kw,
         )
+        from das4whales_tpu.utils.artifacts import atomic_file, atomic_json
+
         os.makedirs(args.outdir, exist_ok=True)
-        np.savez(
-            os.path.join(args.outdir, "picks.npz"),
-            **{f"picks_{k}": v for k, v in res.picks.items()},
-            **{f"times_s_{k}": v for k, v in res.pick_times_s.items()},
-        )
+        with atomic_file(os.path.join(args.outdir, "picks.npz"),
+                         "wb") as fh:
+            np.savez(
+                fh,
+                **{f"picks_{k}": v for k, v in res.picks.items()},
+                **{f"times_s_{k}": v for k, v in res.pick_times_s.items()},
+            )
         summary = {
             "files": list(args.files), "family": args.family,
             "n_files": res.n_files, "n_samples": res.n_samples,
@@ -361,8 +392,8 @@ def main(argv=None) -> int:
             "thresholds": res.thresholds,
             "n_picks": {k: int(v.shape[1]) for k, v in res.picks.items()},
         }
-        with open(os.path.join(args.outdir, "summary.json"), "w") as fh:
-            _json.dump(summary, fh, indent=1)
+        atomic_json(os.path.join(args.outdir, "summary.json"), summary,
+                    indent=1)
         for name, pk in res.picks.items():
             span = (f" [{res.pick_times_s[name].min():.1f}, "
                     f"{res.pick_times_s[name].max():.1f}] s"
@@ -496,8 +527,7 @@ def main(argv=None) -> int:
             if _jax.process_index() != 0:
                 return 0 if res.n_failed == 0 else 3
         if res.n_done:
-            import json as _json
-
+            from das4whales_tpu.utils.artifacts import atomic_json
             from das4whales_tpu.workflows.campaign import (
                 plot_campaign_density,
                 summarize_campaign,
@@ -507,8 +537,8 @@ def main(argv=None) -> int:
             fig = plot_campaign_density(summary)
             fig.savefig(os.path.join(args.outdir, "density.png"), dpi=120)
             slim = {k: v for k, v in summary.items() if k != "density"}
-            with open(os.path.join(args.outdir, "summary.json"), "w") as fh:
-                _json.dump(slim, fh, indent=1)
+            atomic_json(os.path.join(args.outdir, "summary.json"), slim,
+                        indent=1)
             print(f"campaign: report -> {args.outdir}/summary.json, density.png")
         return 0 if res.n_failed == 0 else 3
     mod = importlib.import_module(f"das4whales_tpu.workflows.{args.workflow}")

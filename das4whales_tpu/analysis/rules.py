@@ -89,6 +89,15 @@ R12 donation-effectiveness — compiled-program audit only: every
 R13 program-hygiene — compiled-program audit only: host callbacks on
     the device path, f64 transcendentals, and ``convert``/``transpose``/
     ``copy`` op counts gated against ``analysis/contracts.json``.
+R14 non-durable-artifact-write — a direct ``open(.., "w"/"a")`` or
+    ``np.savez``/``np.save`` on an artifact-suffixed path literal
+    (``.json``/``.jsonl``/``.npz``) outside ``utils/artifacts.py``:
+    durable state must flow through the one crash-only write layer
+    (atomic tmp+fsync+rename, checksummed bounded-fsync appends —
+    docs/ROBUSTNESS.md "Durability contract"), or a SIGKILL mid-write
+    strands a torn artifact. Literal-suffix heuristic: a path built
+    purely from variables escapes (``json.dump`` sites are caught
+    through the ``open(...)`` that feeds them).
 
 Suppression: an inline ``# daslint: allow[R2]`` (comma list, or
 ``daslint: ignore`` for all rules) on the finding's line or the line above
@@ -105,7 +114,7 @@ from pathlib import PurePosixPath
 from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
 ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9", "R10",
-             "R11", "R12", "R13")
+             "R11", "R12", "R13", "R14")
 
 #: rules whose primary half runs over COMPILED programs (jax-importing,
 #: one AOT compile per audited variant) rather than source text. R11
@@ -121,6 +130,16 @@ FLOAT64_DESIGN_ALLOWLIST: Tuple[Tuple[str, str], ...] = (
     ("das4whales_tpu/ops/fk.py", "*"),
     ("das4whales_tpu/ops/filters.py", "*"),
 )
+
+#: R14: file suffixes that mark a path literal as a durable artifact,
+#: the open() modes that mutate one, and the files exempt from the rule
+#: (the durable-write layer itself is where the raw idiom must live).
+_ARTIFACT_SUFFIXES = (".json", ".jsonl", ".npz")
+_ARTIFACT_WRITE_MODES = frozenset({
+    "w", "a", "x", "wt", "at", "xt", "wb", "ab", "xb",
+    "w+", "a+", "x+", "w+b", "a+b", "wb+", "ab+",
+})
+_R14_EXEMPT_SUFFIXES = ("das4whales_tpu/utils/artifacts.py",)
 
 #: Attribute reads that yield Python metadata, not device values — a
 #: tracer's ``.shape`` is a static tuple, so ``float(x.shape[0])`` is host
@@ -463,6 +482,7 @@ class _Analyzer(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call):
         self._check_sync_in_loop(node)
+        self._check_artifact_write(node)
         kws = _jit_call_info(self.imports, node)
         if kws is not None:
             if self._loop_depth and "R2" in self.rules:
@@ -483,6 +503,54 @@ class _Analyzer(ast.NodeVisitor):
         self.generic_visit(node)
 
     # -- rule bodies -------------------------------------------------------
+
+    def _check_artifact_write(self, node: ast.Call):
+        """R14: direct writes to artifact-suffixed path LITERALS must
+        flow through ``utils.artifacts`` (the one crash-only write
+        layer). Heuristic by design: a path assembled purely from
+        variables escapes — the rule funnels the common literal idioms
+        (``open(os.path.join(outdir, "x.json"), "w")``,
+        ``np.savez(f"{outdir}/picks.npz", ...)``) without chasing
+        dataflow; ``json.dump`` sites are caught through the ``open``
+        that feeds them."""
+        if ("R14" not in self.rules
+                or self.path.endswith(_R14_EXEMPT_SUFFIXES)):
+            return
+        dotted = self.imports.resolve(node.func) or ""
+        if dotted == "open":
+            mode = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords if kw.arg == "mode"),
+                None)
+            if not (isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and mode.value in _ARTIFACT_WRITE_MODES):
+                return
+            verb = f"open(.., {mode.value!r})"
+        elif dotted in ("numpy.savez", "numpy.savez_compressed",
+                        "numpy.save"):
+            verb = f"{dotted.replace('numpy', 'np', 1)}(..)"
+        else:
+            return
+        path_arg = node.args[0] if node.args else None
+        suffix = None
+        if path_arg is not None:
+            for nd in ast.walk(path_arg):
+                if (isinstance(nd, ast.Constant)
+                        and isinstance(nd.value, str)):
+                    suffix = next((s for s in _ARTIFACT_SUFFIXES
+                                   if nd.value.endswith(s)), None)
+                    if suffix:
+                        break
+        if suffix is None:
+            return
+        self._emit("R14", "non-durable-artifact-write", node,
+                   f"direct `{verb}` on a `{suffix}` artifact path — "
+                   "durable state must go through utils.artifacts "
+                   "(atomic_json/atomic_file/append_record: atomic "
+                   "tmp+fsync+rename, checksummed appends), or a crash "
+                   "mid-write strands a torn artifact the resume/"
+                   "report paths then choke on (docs/ROBUSTNESS.md "
+                   "\"Durability contract\")")
 
     def _check_sync_in_loop(self, node: ast.Call):
         """R6: host-side device syncs inside a for/while body. Runs only

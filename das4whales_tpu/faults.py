@@ -51,6 +51,7 @@ from typing import Dict, Mapping
 
 import numpy as np
 
+from . import crashpoints  # noqa: F401  (re-export: faults.crashpoints)
 from .telemetry import metrics, probes, trace
 
 FAULT_CLASSES = ("transient", "corrupt", "data", "resource", "fatal")
@@ -541,6 +542,12 @@ class FaultPlan:
       the detector boundary after N successful detects: the mid-run
       crash of the crash-resume drill.
 
+    ``crash_point=`` / ``crash_mode=`` / ``crash_skip=`` arm a
+    durability crash point (:mod:`das4whales_tpu.crashpoints`) at plan
+    construction — the SIGKILL / injected-ENOSPC unclean-death matrix
+    of the crash-only durability contract (docs/ROBUSTNESS.md
+    "Durability contract").
+
     Injection sites are the hooks ``io.stream`` and
     ``workflows.campaign`` call: :meth:`on_read` / :meth:`poison_read`
     (reader boundary, runs on the prefetch worker), :meth:`on_transfer`
@@ -551,10 +558,18 @@ class FaultPlan:
     def __init__(self, seed: int, rate: float = 0.4,
                  kinds=FAULT_KINDS, hang_s: float = 0.25,
                  max_transient_repeats: int = 2,
-                 crash_after: int | None = None):
+                 crash_after: int | None = None,
+                 crash_point: str | None = None,
+                 crash_mode: str = "kill",
+                 crash_skip: int = 0):
         for k in kinds:
             if k not in _KIND_SITE or k == "crash":
                 raise ValueError(f"unknown fault kind {k!r}")
+        if crash_point is not None:
+            # arm the durability crash-point matrix (crashpoints module)
+            # from the plan, so chaos schedules and unclean-death drills
+            # compose in one object
+            crashpoints.arm(crash_point, crash_mode, crash_skip)
         self.seed = int(seed)
         self.rate = float(rate)
         self.kinds = tuple(kinds)

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import spectral
+from ..utils import artifacts
 
 
 @dataclass(frozen=True)
@@ -267,9 +268,13 @@ def save_params(path: str, params, cfg: LearnedConfig) -> str:
     cfg_arr = np.asarray([
         cfg.nfft, cfg.hop, cfg.win_frames, cfg.win_stride, cfg.fmax_bin,
     ], np.int64)
-    np.savez(path, __cfg__=cfg_arr,
-             __features__=np.asarray(cfg.features, np.int64),
-             __compute_dtype__=np.asarray(cfg.compute_dtype), **flat)
+    if not path.endswith(".npz"):
+        path += ".npz"   # np.savez(str) appended it; the durable writer
+        # takes a file handle, so preserve that contract explicitly
+    with artifacts.atomic_file(path, "wb") as fh:
+        np.savez(fh, __cfg__=cfg_arr,
+                 __features__=np.asarray(cfg.features, np.int64),
+                 __compute_dtype__=np.asarray(cfg.compute_dtype), **flat)
     return path
 
 

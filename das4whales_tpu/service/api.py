@@ -58,7 +58,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from ..telemetry import metrics, probes
-from ..utils import locks
+from ..utils import artifacts, locks
 from ..utils.log import get_logger
 from .ingest import IngestItem, LiveBlock
 
@@ -178,6 +178,7 @@ def _manifest_since(outdir: str, cursor: int, limit: int, wait_s: float):
         idx = _extend_index(path)
         n_complete = len(idx) - 1
         recs = []
+        consumed = 0
         if cursor < n_complete:
             stop = min(cursor + limit, n_complete)
             try:
@@ -185,11 +186,19 @@ def _manifest_since(outdir: str, cursor: int, limit: int, wait_s: float):
                     fh.seek(idx[cursor])
                     chunk = fh.read(idx[stop] - idx[cursor])
                 for line in chunk.splitlines():
-                    recs.append(json.loads(line))
-            except (OSError, json.JSONDecodeError):
-                recs = []   # raced a rewrite: retry/poll below
-        if recs or time.monotonic() >= deadline:
-            return recs, cursor + len(recs)
+                    consumed += 1
+                    # the shared checksum-verifying ledger parser:
+                    # accepts plain and CRC-suffixed lines; a corrupt
+                    # line is skipped but still advances the cursor
+                    # (a poisoned record must not wedge the stream)
+                    rec, _verdict = artifacts.parse_record(
+                        line.decode("utf-8", errors="replace"))
+                    if rec is not None:
+                        recs.append(rec)
+            except OSError:
+                recs, consumed = [], 0   # raced a rewrite: retry below
+        if recs or consumed or time.monotonic() >= deadline:
+            return recs, cursor + consumed
         time.sleep(0.05)
 
 

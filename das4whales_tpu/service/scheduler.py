@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import faults
+from .. import fsck
 from ..parallel.dispatch import PipelinedDispatch, resolve_watchdogged
 from ..telemetry import costs as tcosts
 from ..telemetry import metrics, trace as telemetry
@@ -101,6 +102,9 @@ class TenantRuntime:
         self.name = spec.name
         self.outdir = outdir
         os.makedirs(outdir, exist_ok=True)
+        # crash-only startup: sweep orphan tmps, heal a torn manifest
+        # tail, refuse to resume over deeper corruption (fsck module)
+        fsck.startup_check(outdir, label=f"tenant {spec.name}")
         self.records: List[camp.FileRecord] = []
         self.fault_plan = fault_plan
         self.rz = camp._Resilience(outdir, self.records, spec.max_failures,

@@ -573,14 +573,14 @@ def cards_payload() -> Dict:
 def export_json(path: str, extra: Dict | None = None) -> str:
     """Write the card registry (plus ``extra`` fields, e.g. bench
     provenance) as JSON next to the manifest; returns ``path``."""
+    # local import: utils/__init__ imports telemetry.progress, so a
+    # module-level import here would cycle at package-init time
+    from ..utils import artifacts
+
     payload = cards_payload()
     if extra:
         payload.update(extra)
-    tmp = f"{path}.tmp-{os.getpid()}"
-    with open(tmp, "w") as fh:
-        json.dump(payload, fh, indent=1)
-    os.replace(tmp, path)
-    return path
+    return artifacts.atomic_json(path, payload, indent=1)
 
 
 def reset() -> None:

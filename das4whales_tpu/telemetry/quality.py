@@ -585,13 +585,14 @@ OBSERVATORY = QualityObservatory()
 
 def export_json(path: str, tenants=None, extra: Dict | None = None) -> str:
     """Write the observatory payload as JSON next to the manifest
-    (atomic tmp + replace; the state is snapshotted before any IO —
-    no lock is held across the write). Returns ``path``."""
+    (durably, via ``utils.artifacts.atomic_json``; the state is
+    snapshotted before any IO — no lock is held across the write).
+    Returns ``path``."""
+    # local import: utils/__init__ imports telemetry.progress, so a
+    # module-level import here would cycle at package-init time
+    from ..utils import artifacts
+
     payload = OBSERVATORY.payload(tenants)
     if extra:
         payload.update(extra)
-    tmp = f"{path}.tmp-{os.getpid()}"
-    with open(tmp, "w") as fh:
-        json.dump(payload, fh, indent=1)
-    os.replace(tmp, path)
-    return path
+    return artifacts.atomic_json(path, payload, indent=1)

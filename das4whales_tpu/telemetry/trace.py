@@ -240,13 +240,13 @@ def chrome_trace_events(records=None) -> List[Dict]:
 def export_chrome_trace(path: str, records=None) -> str:
     """Write the recorded spans as Chrome-trace JSON (Perfetto- and
     ``chrome://tracing``-loadable); returns ``path``."""
+    # local import: utils/__init__ imports telemetry.progress, so a
+    # module-level import here would cycle at package-init time
+    from ..utils import artifacts
+
     payload = {"traceEvents": chrome_trace_events(records),
                "displayTimeUnit": "ms"}
-    tmp = f"{path}.tmp-{os.getpid()}"
-    with open(tmp, "w") as fh:
-        json.dump(payload, fh)
-    os.replace(tmp, path)
-    return path
+    return artifacts.atomic_json(path, payload)
 
 
 @contextlib.contextmanager

@@ -1,7 +1,8 @@
 """Cross-cutting utilities: audio export, profiling/progress, logging,
 design checkpointing."""
 
-from . import audio, checkpoint, locks, log, profiling, views  # noqa: F401
+from . import artifacts, audio, checkpoint, locks, log, profiling, views  # noqa: F401
+from .artifacts import append_record, atomic_bytes, atomic_json, read_records  # noqa: F401
 from .audio import export_audio, read_audio  # noqa: F401
 from .checkpoint import load_design, register_design, save_design  # noqa: F401
 from .log import get_logger, log_metadata  # noqa: F401

@@ -16,6 +16,8 @@ from typing import Any, Dict, Type
 
 import numpy as np
 
+from . import artifacts
+
 _REGISTRY: Dict[str, Type] = {}
 
 
@@ -50,7 +52,12 @@ def save_design(path: str, design: Any) -> str:
         else:
             static[f.name] = _builtin(value)
     header = json.dumps({"type": type(design).__name__, "static": static})
-    np.savez(path, __header__=np.frombuffer(header.encode(), dtype=np.uint8), **arrays)
+    if not path.endswith(".npz"):
+        path += ".npz"   # np.savez(str) appended it; the durable writer
+        # takes a file handle, so preserve that contract explicitly
+    with artifacts.atomic_file(path, "wb") as fh:
+        np.savez(fh, __header__=np.frombuffer(header.encode(),
+                                              dtype=np.uint8), **arrays)
     return path
 
 

@@ -39,6 +39,7 @@ from typing import Dict, List, Sequence
 import numpy as np
 
 from .. import faults
+from .. import fsck
 from ..config import as_health_config
 from ..io.stream import stream_strain_blocks
 from ..models.matched_filter import MatchedFilterDetector
@@ -47,6 +48,7 @@ from ..telemetry import metrics as tmetrics
 from ..telemetry import probes as tprobes
 from ..telemetry import quality as tquality
 from ..telemetry import trace as telemetry
+from ..utils import artifacts
 from ..utils.log import get_logger
 
 log = get_logger("campaign")
@@ -142,23 +144,22 @@ def _load_settled(outdir: str) -> set:
     attempt reads settled, and one whose artifact was superseded by a
     fresh failure record does not)."""
     last: Dict[str, str] = {}
-    try:
-        with open(_manifest_path(outdir)) as fh:
-            for line in fh:
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue  # torn final line from a killed run
-                if "path" in rec:
-                    last[rec["path"]] = rec.get("status", "")
-    except OSError:
-        pass
+
+    def _warn_bad(lineno: int, verdict: str, _line: str) -> None:
+        # torn final line / CRC-failed record from an unclean death:
+        # tolerate (the file re-runs) but never silently
+        log.warning("manifest %s line %d: %s record skipped by resume",
+                    _manifest_path(outdir), lineno, verdict)
+
+    for rec in artifacts.read_records(_manifest_path(outdir),
+                                      on_bad=_warn_bad):
+        if "path" in rec:
+            last[rec["path"]] = rec.get("status", "")
     return {p for p, status in last.items() if status in _SETTLED_STATUSES}
 
 
 def _append_manifest(outdir: str, rec: FileRecord) -> None:
-    with open(_manifest_path(outdir), "a") as fh:
-        fh.write(json.dumps(rec.__dict__) + "\n")
+    artifacts.append_record(_manifest_path(outdir), rec.__dict__)
 
 
 def _append_event(outdir: str, event: Dict) -> None:
@@ -167,8 +168,7 @@ def _append_event(outdir: str, event: Dict) -> None:
     ledger (``event="downshift"``), elastic-mesh rebuilds
     (``event="mesh_downshift"``) and the end-of-run resilience counters
     (``event="counters"``) — ``summarize_campaign`` aggregates them."""
-    with open(_manifest_path(outdir), "a") as fh:
-        fh.write(json.dumps(dict(event)) + "\n")
+    artifacts.append_record(_manifest_path(outdir), dict(event))
 
 
 def _picks_path(outdir: str, path: str) -> str:
@@ -201,30 +201,13 @@ def _save_picks(outdir: str, path: str, picks: Dict[str, np.ndarray],
         [float(thresholds.get(name, float("nan"))) for name in picks]
     )
     arrays["template_names"] = np.asarray(list(picks), dtype="U")
-    tmp = f"{out}.tmp-{os.getpid()}"
-    try:
-        with open(tmp, "wb") as fh:
-            np.savez(fh, **arrays)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, out)
-        # fsync the DIRECTORY too: the rename must be durable before the
-        # manifest's done record is appended, or a power loss could keep
-        # the manifest line while dropping the directory entry — the
-        # exact torn-artifact-under-done-record state this function
-        # exists to prevent. Best-effort: some filesystems refuse
-        # directory fsync.
-        try:
-            dirfd = os.open(os.path.dirname(out), os.O_RDONLY)
-            try:
-                os.fsync(dirfd)
-            finally:
-                os.close(dirfd)
-        except OSError:
-            pass
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    # tmp + fsync + replace + directory fsync, via the one durable-write
+    # layer (utils.artifacts — this function's original body is where
+    # that layer came from): the rename must be durable before the
+    # manifest's done record is appended, or a power loss could keep the
+    # manifest line while dropping the directory entry.
+    with artifacts.atomic_file(out, "wb") as fh:
+        np.savez(fh, **arrays)
     return out
 
 
@@ -575,6 +558,7 @@ def run_campaign(
             "demean/scale) and silently mis-detect"
         )
     os.makedirs(outdir, exist_ok=True)
+    fsck.startup_check(outdir, label="campaign")
     metas = _normalize_metas(metadata, list(files))
     records: List[FileRecord] = []
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
@@ -956,6 +940,7 @@ def run_campaign_batched(
             persistent_cache if isinstance(persistent_cache, str) else None
         )
     os.makedirs(outdir, exist_ok=True)
+    fsck.startup_check(outdir, label="campaign")
     metas = _normalize_metas(metadata, list(files))
     records: List[FileRecord] = []
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
@@ -1795,6 +1780,7 @@ def run_campaign_sharded(
     from ..parallel.pipeline import make_sharded_mf_step
 
     os.makedirs(outdir, exist_ok=True)
+    fsck.startup_check(outdir, label="campaign")
     metas = _normalize_metas(metadata, list(files))
     records: List[FileRecord] = []
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
@@ -2103,6 +2089,10 @@ def run_campaign_multiprocess(
     batch = int(mesh.shape["file"])
 
     os.makedirs(outdir, exist_ok=True)
+    if is_writer:
+        # only process 0 repairs (truncates a torn tail / sweeps tmps);
+        # non-writer readers tolerate the torn state they might glimpse
+        fsck.startup_check(outdir, label="campaign")
     metas = _normalize_metas(metadata, list(files))
     records: List[FileRecord] = []
     pending, pend_idx = _split_resume(list(files), outdir, resume, records)
@@ -2287,13 +2277,7 @@ def summarize_campaign(outdir: str) -> dict:
     ``[file x channel]`` detection-count matrix (the campaign-scale
     analog of the reference's single-file detection scatter,
     plot.py:373-415)."""
-    recs = []
-    with open(_manifest_path(outdir)) as fh:
-        for line in fh:
-            try:
-                recs.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
+    recs = artifacts.read_records(_manifest_path(outdir))
     # non-file EVENT records (no "path"): the downshift ledger, elastic
     # mesh rebuilds and the end-of-run resilience counters (_append_event)
     events = [r for r in recs if "path" not in r and "event" in r]

@@ -50,6 +50,7 @@ import argparse
 import json
 import os
 import sys
+import zlib
 from typing import Dict, List
 
 
@@ -61,14 +62,31 @@ def load_trace(path: str) -> List[Dict]:
 
 
 def load_manifest(path: str) -> List[Dict]:
+    # self-contained mirror of utils.artifacts.parse_record (this script
+    # is deliberately stdlib-only): strip an optional per-line
+    # "\t#crc32:<8 hex>" suffix (DAS_MANIFEST_CRC=1 manifests), verify
+    # it, and skip torn/corrupt lines instead of raising
     recs = []
     try:
         with open(path) as fh:
             for line in fh:
+                text = line.rstrip("\r\n")
+                if "\t" in text:
+                    body, _, tag = text.rpartition("\t")
+                    if tag.startswith("#crc32:"):
+                        try:
+                            want = int(tag[len("#crc32:"):], 16)
+                        except ValueError:
+                            continue
+                        if zlib.crc32(body.encode("utf-8")) != want:
+                            continue
+                        text = body
                 try:
-                    recs.append(json.loads(line))
+                    rec = json.loads(text)
                 except json.JSONDecodeError:
                     continue
+                if isinstance(rec, dict):
+                    recs.append(rec)
     except OSError:
         pass
     return recs

@@ -1,0 +1,76 @@
+"""Subprocess target for the SIGKILL crash-point matrix.
+
+Launched by ``tests/test_durability.py`` with a crash point armed via
+``DAS_CRASHPOINT`` / ``DAS_CRASHPOINT_MODE`` in the environment (read by
+``das4whales_tpu.crashpoints`` at import).  In ``kill`` mode the process
+dies by SIGKILL mid-artifact-write — no atexit, no drain, no flush —
+which is exactly the discipline the durability layer claims to survive.
+The parent then restarts the same run in-process with ``resume=True``
+and asserts convergence.
+
+Mirrors ``multiprocess_worker.py``: the platform pin and the host-device
+split must be in the environment BEFORE jax is imported, and must match
+``tests/conftest.py`` (8 CPU host devices, x64) so picks produced here
+are bit-comparable with the parent's fault-free oracle.
+
+Usage::
+
+    python durability_worker.py campaign <outdir> <file>...
+    python durability_worker.py service  <outdir> <file>...
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+# must match CHAOS_SEL in tests/conftest.py
+SEL = [0, 24, 1]
+
+
+def main(argv):
+    kind, outdir, files = argv[0], argv[1], list(argv[2:])
+    if kind == "campaign":
+        from das4whales_tpu.workflows.campaign import run_campaign_batched
+
+        res = run_campaign_batched(
+            files, SEL, outdir, batch=2, bucket="exact",
+            persistent_cache=False, resume=True,
+        )
+        print(f"done={res.n_done} skipped={res.n_skipped}")
+        return 0
+    if kind == "service":
+        from das4whales_tpu.service.runner import (
+            DetectionService, ServiceConfig, TenantSpec,
+        )
+
+        def spec(name, tenant_files):
+            return TenantSpec(name=name, files=tenant_files, channels=SEL,
+                              batch=2, bucket="exact", admission=False)
+
+        cfg = ServiceConfig(
+            tenants=[spec("a", files[:2]), spec("b", files[2:])],
+            outdir=outdir, persistent_cache=False, resume=True,
+        )
+        svc = DetectionService(cfg).start()
+        try:
+            results = svc.run(until_idle=True)
+        finally:
+            svc.stop()
+        print({n: r.n_done for n, r in results.items()})
+        return 0
+    print(f"unknown worker kind {kind!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1853,3 +1853,73 @@ class TestProgramRuleCli:
         assert "--programs" in calls[0]
         assert lint_mod.run(["--changed"]) == 0
         assert "--programs" not in calls[1]
+
+
+# ---------------------------------------------------------------------------
+# R14 — non-durable artifact writes must funnel through utils.artifacts
+# ---------------------------------------------------------------------------
+
+class TestR14DurableWrites:
+    def test_open_write_on_artifact_literal_flagged(self):
+        f = run(
+            """
+            import json
+            import os
+
+            def export(outdir, payload):
+                with open(os.path.join(outdir, "summary.json"), "w") as fh:
+                    json.dump(payload, fh)
+            """,
+        )
+        assert codes(f) == ["non-durable-artifact-write"]
+
+    def test_append_mode_and_savez_flagged(self):
+        f = run(
+            """
+            import numpy as np
+
+            def persist(outdir, rec, arrays):
+                with open(f"{outdir}/manifest.jsonl", "ab") as fh:
+                    fh.write(rec)
+                np.savez(f"{outdir}/picks.npz", **arrays)
+            """,
+        )
+        assert codes(f) == ["non-durable-artifact-write"] * 2
+
+    def test_reads_variable_paths_and_foreign_suffixes_unflagged(self):
+        f = run(
+            """
+            import numpy as np
+
+            def fine(path, tmp, payload):
+                with open(path, "w") as fh:          # variable path: escapes
+                    fh.write(payload)
+                with open("summary.json") as fh:     # read: not a write
+                    fh.read()
+                with open("notes.txt", "w") as fh:   # not an artifact suffix
+                    fh.write(payload)
+                np.savez(tmp, x=np.zeros(1))         # variable path: escapes
+            """,
+        )
+        assert codes(f) == []
+
+    def test_artifacts_module_itself_is_exempt(self):
+        f = run(
+            """
+            def atomic_bytes(path, data):
+                with open(path + ".json", "wb") as fh:
+                    fh.write(data)
+            """,
+            path="das4whales_tpu/utils/artifacts.py",
+        )
+        assert codes(f) == []
+
+    def test_inline_allow_suppresses(self):
+        f = run(
+            """
+            def quarantine(sidecar, raw):
+                with open(sidecar + ".jsonl", "ab") as fh:  # daslint: allow[R14] raw quarantine
+                    fh.write(raw)
+            """,
+        )
+        assert codes(f) == []

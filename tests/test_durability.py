@@ -1,0 +1,461 @@
+"""Crash-only durability: the SIGKILL crash-point matrix and fsck.
+
+The claim under test (docs/ROBUSTNESS.md "Durability contract"): a
+campaign or service killed by SIGKILL at ANY instruction of an artifact
+write converges after restart-with-resume — every file settles exactly
+once across the runs, picks are bit-identical to a fault-free run, no
+orphan tmps survive, and ``fsck`` finds the tree clean. The matrix
+drives a REAL subprocess (``durability_worker.py``) with a crash point
+armed via ``DAS_CRASHPOINT`` and kills it mid-write; raise-mode
+injections (ENOSPC/EIO/short write) exercise the in-process recovery
+paths instead.
+
+Tier-1 runs the representative quick subset (one campaign kill point,
+one service kill point, one ENOSPC point, plus the format/fsck unit
+tests); the full every-point matrix rides under ``slow``.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from das4whales_tpu import crashpoints, fsck
+from das4whales_tpu.utils import artifacts
+from das4whales_tpu.workflows.campaign import (
+    load_picks,
+    load_settled,
+    run_campaign,
+    run_campaign_batched,
+)
+from tests.conftest import CHAOS_N_FILES, CHAOS_SEL
+
+SEL = CHAOS_SEL
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "durability_worker.py")
+
+
+# ---------------------------------------------------------------- helpers
+
+def _worker_env(point=None, mode="kill"):
+    pythonpath = ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=pythonpath.rstrip(os.pathsep))
+    for k in ("DAS_CRASHPOINT", "DAS_CRASHPOINT_MODE", "DAS_CRASHPOINT_SKIP",
+              "DAS_MANIFEST_CRC", "DAS_FSCK_AUTOREPAIR"):
+        env.pop(k, None)
+    if point is not None:
+        env["DAS_CRASHPOINT"] = point
+        env["DAS_CRASHPOINT_MODE"] = mode
+    return env
+
+
+def _run_worker(kind, outdir, files, point=None, mode="kill", timeout=420):
+    return subprocess.run(
+        [sys.executable, WORKER, kind, outdir, *files],
+        capture_output=True, text=True, timeout=timeout,
+        env=_worker_env(point, mode), cwd=ROOT,
+    )
+
+
+def _orphan_tmps(outdir):
+    return [p for p in glob.glob(os.path.join(outdir, "**", "*"),
+                                 recursive=True)
+            if artifacts.TMP_MARKER in os.path.basename(p)]
+
+
+def _assert_converged(outdir, files, oracle):
+    """The convergence contract after any kill + resume sequence:
+    exactly one ``done`` record per file across ALL runs, picks
+    bit-identical to the fault-free oracle, no orphan tmps, fsck clean.
+    """
+    manifest = os.path.join(outdir, "manifest.jsonl")
+    recs = artifacts.read_records(manifest)
+    done_counts, picks_by_path = {}, {}
+    for r in recs:
+        if r.get("status") == "done" and "path" in r:
+            done_counts[r["path"]] = done_counts.get(r["path"], 0) + 1
+            picks_by_path[r["path"]] = r["picks_file"]
+    assert set(done_counts) == set(files), (done_counts, recs)
+    assert all(n == 1 for n in done_counts.values()), (
+        f"a file settled more than once: {done_counts}")
+    assert load_settled(outdir) == set(files)
+    for path in files:
+        got = load_picks(picks_by_path[path])
+        want = oracle[path]
+        assert set(got) == set(want)
+        for key in sorted(want):
+            np.testing.assert_array_equal(got[key], want[key], err_msg=(
+                f"picks for {path}/{key} differ from the fault-free run"))
+    assert _orphan_tmps(outdir) == []
+    findings = fsck.fsck_outdir(outdir, repair=False)
+    assert findings == [], [f.as_dict() for f in findings]
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """No crash point leaks across tests, whatever the outcome."""
+    crashpoints.disarm()
+    yield
+    crashpoints.disarm()
+
+
+# ------------------------------------------------- quick matrix (tier-1)
+
+def test_sigkill_campaign_mid_write_then_resume(chaos_file_set,
+                                                chaos_fault_free, tmp_path):
+    """Kill the batched campaign between tmp-fsync and rename of its
+    first picks artifact: the orphan tmp survives the kill, the restart
+    sweeps it, and the resumed campaign converges."""
+    out = str(tmp_path / "camp")
+    proc = _run_worker("campaign", out, chaos_file_set, point="pre-rename")
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode,
+                                                proc.stderr[-2000:])
+    assert _orphan_tmps(out), (
+        "a kill between tmp write and rename must leave the tmp behind")
+
+    res = run_campaign_batched(chaos_file_set, SEL, out, batch=2,
+                               bucket="exact", persistent_cache=False,
+                               resume=True)
+    assert res.n_done + res.n_skipped == CHAOS_N_FILES, res.records
+    _assert_converged(out, chaos_file_set, chaos_fault_free)
+
+
+def test_sigkill_service_mid_append_then_resume(chaos_file_set,
+                                                chaos_fault_free, tmp_path):
+    """Kill the two-tenant service halfway through a manifest append
+    (the torn-tail case: the picks artifact is already renamed, its
+    ``done`` record is half a line). The restarted service truncates the
+    torn tail at startup, re-runs the unsettled file, and both tenant
+    trees converge."""
+    from das4whales_tpu.service.runner import (
+        DetectionService, ServiceConfig, TenantSpec,
+    )
+
+    out = str(tmp_path / "svc")
+    proc = _run_worker("service", out, chaos_file_set,
+                       point="append-mid-line")
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode,
+                                                proc.stderr[-2000:])
+
+    def spec(name, files):
+        return TenantSpec(name=name, files=files, channels=SEL, batch=2,
+                          bucket="exact", admission=False)
+
+    tenants = {"a": list(chaos_file_set[:2]), "b": list(chaos_file_set[2:])}
+    svc = DetectionService(ServiceConfig(
+        tenants=[spec(n, f) for n, f in tenants.items()],
+        outdir=out, persistent_cache=False, resume=True,
+    )).start()
+    try:
+        results = svc.run(until_idle=True)
+    finally:
+        svc.stop()
+    for name, files in tenants.items():
+        assert results[name].n_failed == 0, results[name].records
+        _assert_converged(os.path.join(out, name), files, chaos_fault_free)
+
+
+def test_enospc_disposes_then_resume_rehabilitates(chaos_file_set,
+                                                   chaos_detector,
+                                                   chaos_fault_free,
+                                                   tmp_path):
+    """An injected ENOSPC on the first picks write walks the real
+    failure path — OSError classified ``corrupt``, file disposed
+    ``failed`` (NOT settled) — and the resume run rehabilitates it."""
+    out = str(tmp_path / "camp")
+    crashpoints.arm("pre-write", "enospc")
+    res = run_campaign(chaos_file_set, SEL, out, detector=chaos_detector)
+    assert crashpoints.armed() is None, "injection must be single-shot"
+    assert res.n_failed == 1 and res.n_done == CHAOS_N_FILES - 1, res.records
+
+    res2 = run_campaign(chaos_file_set, SEL, out, detector=chaos_detector)
+    assert res2.n_done == 1 and res2.n_skipped == CHAOS_N_FILES - 1, (
+        res2.records)
+    _assert_converged(out, chaos_file_set, chaos_fault_free)
+
+
+def test_durability_layer_invisible_when_disabled(chaos_file_set,
+                                                  chaos_detector,
+                                                  chaos_fault_free,
+                                                  tmp_path, compile_guard,
+                                                  monkeypatch):
+    """The acceptance pin: with crash points disarmed and CRC off
+    (defaults), the durability layer adds ZERO compiles/dispatches at
+    warmed shapes and the manifest stays bitwise-plain — every line is
+    exactly ``json.dumps(rec) + "\\n"``, no CRC suffix."""
+    monkeypatch.delenv("DAS_MANIFEST_CRC", raising=False)
+    out = str(tmp_path / "camp")
+    with compile_guard.forbid_recompile(
+            "the durability layer must not add programs or dispatches "
+            "at shapes the fault-free campaign already warmed"):
+        res = run_campaign(chaos_file_set, SEL, out, detector=chaos_detector)
+    assert res.n_done == CHAOS_N_FILES
+    _assert_converged(out, chaos_file_set, chaos_fault_free)
+    with open(os.path.join(out, "manifest.jsonl"), "rb") as fh:
+        raw_lines = fh.readlines()
+    assert raw_lines, "campaign must have written a manifest"
+    for raw in raw_lines:
+        line = raw.decode("utf-8")
+        assert artifacts.CRC_TAG not in line
+        assert line == json.dumps(json.loads(line)) + "\n", (
+            "manifest line is not the bitwise-plain pre-durability format")
+
+
+# --------------------------------------------- ledger format + readers
+
+def test_crc_roundtrip_and_flip_detection(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    recs = [{"path": f"f{i}.h5", "status": "done", "i": i} for i in range(3)]
+    for rec in recs:
+        artifacts.append_record(path, rec, crc=True)
+    assert artifacts.read_records(path) == recs
+
+    # flip one byte inside the middle record's JSON body: its CRC fails,
+    # the reader skips exactly that record, fsck quarantines exactly it
+    with open(path, "rb") as fh:
+        lines = fh.readlines()
+    assert all(artifacts.CRC_TAG.encode() in ln for ln in lines)
+    lines[1] = lines[1].replace(b'"done"', b'"dome"', 1)
+    with open(path, "wb") as fh:
+        fh.writelines(lines)
+
+    bad = []
+    got = artifacts.read_records(
+        path, on_bad=lambda no, verdict, _ln: bad.append((no, verdict)))
+    assert got == [recs[0], recs[2]]
+    assert bad == [(2, "crc-mismatch")]
+    scan = artifacts.scan_ledger(path)
+    assert [v for _o, _r, v in scan.bad] == ["crc-mismatch"]
+    assert scan.torn_tail is None
+
+
+def test_plain_and_crc_lines_interoperate(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    artifacts.append_record(path, {"a": 1}, crc=False)
+    artifacts.append_record(path, {"b": 2}, crc=True)
+    assert artifacts.read_records(path) == [{"a": 1}, {"b": 2}]
+
+
+def test_load_settled_tolerates_torn_tail(tmp_path):
+    """Satellite 1: a SIGKILL-torn final line (half a record, no
+    newline) must not break resume — the complete records still settle,
+    the torn file re-runs."""
+    out = str(tmp_path)
+    manifest = os.path.join(out, "manifest.jsonl")
+    artifacts.append_record(manifest, {"path": "a.h5", "status": "done"})
+    artifacts.append_record(manifest, {"path": "b.h5", "status": "done"})
+    with open(manifest, "ab") as fh:
+        fh.write(b'{"path": "c.h5", "sta')   # SIGKILL landed here
+    assert load_settled(out) == {"a.h5", "b.h5"}
+    # and a torn CRC line is equally tolerable
+    torn_crc = artifacts.format_record({"path": "d.h5", "status": "done"},
+                                       crc=True)[:-3]
+    with open(manifest, "ab") as fh:
+        fh.write(b"\n" + torn_crc.encode())
+    assert load_settled(out) == {"a.h5", "b.h5"}
+
+
+def test_append_after_torn_tail_does_not_concatenate(tmp_path):
+    """The next process's first append to a torn ledger must terminate
+    the stranded half-line first — otherwise BOTH records corrupt."""
+    path = str(tmp_path / "ledger.jsonl")
+    artifacts.append_record(path, {"path": "a.h5", "status": "done"})
+    with open(path, "ab") as fh:
+        fh.write(b'{"path": "b.h5", "sta')
+    artifacts._tail_checked.discard(os.path.abspath(path))  # "new process"
+    artifacts.append_record(path, {"path": "c.h5", "status": "done"})
+    scan = artifacts.scan_ledger(path)
+    assert [r["path"] for r in scan.records] == ["a.h5", "c.h5"]
+    assert scan.torn_tail is None and len(scan.bad) == 1
+
+
+def test_failed_append_truncates_to_record_boundary(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    artifacts.append_record(path, {"path": "a.h5", "status": "done"})
+    size = os.path.getsize(path)
+    crashpoints.arm("append-mid-line", "enospc")
+    with pytest.raises(crashpoints.InjectedDiskFull):
+        artifacts.append_record(path, {"path": "b.h5", "status": "done"})
+    assert os.path.getsize(path) == size, (
+        "a raised mid-append must rewind to the record boundary")
+    artifacts.append_record(path, {"path": "b.h5", "status": "done"})
+    assert [r["path"] for r in artifacts.read_records(path)] == ["a.h5",
+                                                                 "b.h5"]
+
+
+# ------------------------------------------------------------------ fsck
+
+def _fake_tree(root):
+    """A tiny settled campaign tree with real npz picks (no jax)."""
+    os.makedirs(os.path.join(root, "picks"), exist_ok=True)
+    manifest = os.path.join(root, "manifest.jsonl")
+    for name in ("a", "b"):
+        picks = os.path.join(root, "picks", f"{name}.npz")
+        with artifacts.atomic_file(picks, "wb") as fh:
+            np.savez(fh, times=np.arange(3.0), score=np.ones(3))
+        artifacts.append_record(manifest, {
+            "path": f"/data/{name}.h5", "status": "done",
+            "picks_file": picks,
+        })
+    return manifest
+
+
+def test_fsck_detects_and_repairs_all_corruption_classes(tmp_path):
+    root = str(tmp_path / "out")
+    manifest = _fake_tree(root)
+
+    # 1. orphan tmp            2. interior corrupt record
+    open(os.path.join(root, "picks", f"x.npz{artifacts.TMP_MARKER}123"),
+         "wb").close()
+    with open(manifest, "ab") as fh:
+        fh.write(b"garbage not json\n")
+    # 3. missing-artifact: settle a path whose picks never made it
+    artifacts.append_record(manifest, {
+        "path": "/data/c.h5", "status": "done",
+        "picks_file": os.path.join(root, "picks", "c.npz")})
+    # 4. unreferenced artifact  5. truncated export  6. torn tail
+    np.savez(os.path.join(root, "picks", "stray.npz"), t=np.zeros(1))
+    with open(os.path.join(root, "summary.json"), "w") as fh:
+        fh.write('{"n_done": 2, "files": [')
+    with open(manifest, "ab") as fh:
+        fh.write(b'{"path": "/data/d.h5", "sta')
+
+    findings = fsck.fsck_outdir(root, repair=False)
+    kinds = sorted({f.kind for f in findings})
+    assert kinds == sorted(fsck.FINDING_KINDS), [f.as_dict() for f in findings]
+    assert not any(f.repaired for f in findings)
+
+    repaired = fsck.fsck_outdir(root, repair=True)
+    assert {f.kind for f in repaired} == set(fsck.FINDING_KINDS)
+    assert all(f.repaired for f in repaired), [f.as_dict() for f in repaired]
+
+    # the tree is clean now; the quarantine sidecar holds the evidence;
+    # the missing-artifact path unsettled so resume will re-run it
+    assert fsck.fsck_outdir(root, repair=False) == []
+    assert os.path.isfile(os.path.join(root, fsck.CORRUPT_SIDECAR))
+    assert os.path.isfile(os.path.join(root, "summary.json.corrupt"))
+    assert load_settled(root) == {"/data/a.h5", "/data/b.h5"}
+
+
+def test_startup_check_heals_tail_refuses_interior_corruption(tmp_path):
+    root = str(tmp_path / "out")
+    manifest = _fake_tree(root)
+    open(os.path.join(root, f"old.json{artifacts.TMP_MARKER}99"),
+         "wb").close()
+    with open(manifest, "ab") as fh:
+        fh.write(b'{"path": "/data/c.h5", "sta')
+
+    summary = fsck.startup_check(root, label="test")
+    assert summary == {"orphan_tmps": 1, "torn_tail": 1,
+                       "corrupt_records": 0}
+    assert _orphan_tmps(root) == []
+    assert artifacts.scan_ledger(manifest).torn_tail is None
+    # idempotent: a second startup over the healed tree is a no-op
+    assert fsck.startup_check(root, label="test") == {
+        "orphan_tmps": 0, "torn_tail": 0, "corrupt_records": 0}
+
+    with open(manifest, "ab") as fh:
+        fh.write(b"garbage not json\n")
+    with pytest.raises(RuntimeError, match="fsck"):
+        fsck.startup_check(root, label="test")
+    # ... unless auto-repair is on: the bad line quarantines, resume runs
+    summary = fsck.startup_check(root, auto_repair=True, label="test")
+    assert summary["corrupt_records"] == 1
+    assert os.path.isfile(os.path.join(root, fsck.CORRUPT_SIDECAR))
+    assert fsck.startup_check(root, label="test") == {
+        "orphan_tmps": 0, "torn_tail": 0, "corrupt_records": 0}
+
+
+def test_fsck_cli(tmp_path, capsys):
+    from das4whales_tpu.__main__ import main
+
+    root = str(tmp_path / "out")
+    _fake_tree(root)
+    assert main(["fsck", root]) == 0
+    assert "clean" in capsys.readouterr().out
+
+    with open(os.path.join(root, "manifest.jsonl"), "ab") as fh:
+        fh.write(b"garbage not json\n")
+    assert main(["fsck", root]) == 1
+    assert "corrupt-record" in capsys.readouterr().out
+
+    assert main(["fsck", root, "--repair", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert [f["kind"] for f in payload] == ["corrupt-record"]
+    assert all(f["repaired"] for f in payload)
+    assert main(["fsck", root]) == 0
+
+
+# ------------------------------------------- full matrix (slow lane)
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point", crashpoints.POINTS)
+def test_crash_matrix_campaign(point, chaos_file_set, chaos_fault_free,
+                               tmp_path):
+    """SIGKILL the batched campaign at EVERY registered crash point;
+    restart-with-resume must converge from each."""
+    out = str(tmp_path / "camp")
+    proc = _run_worker("campaign", out, chaos_file_set, point=point)
+    assert proc.returncode == -signal.SIGKILL, (point, proc.returncode,
+                                                proc.stderr[-2000:])
+    res = run_campaign_batched(chaos_file_set, SEL, out, batch=2,
+                               bucket="exact", persistent_cache=False,
+                               resume=True)
+    assert res.n_done + res.n_skipped == CHAOS_N_FILES, (point, res.records)
+    _assert_converged(out, chaos_file_set, chaos_fault_free)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point", crashpoints.POINTS)
+def test_crash_matrix_service(point, chaos_file_set, chaos_fault_free,
+                              tmp_path):
+    """SIGKILL the two-tenant service at EVERY registered crash point;
+    a restarted service resumes both tenants to convergence."""
+    from das4whales_tpu.service.runner import (
+        DetectionService, ServiceConfig, TenantSpec,
+    )
+
+    out = str(tmp_path / "svc")
+    proc = _run_worker("service", out, chaos_file_set, point=point)
+    assert proc.returncode == -signal.SIGKILL, (point, proc.returncode,
+                                                proc.stderr[-2000:])
+    tenants = {"a": list(chaos_file_set[:2]), "b": list(chaos_file_set[2:])}
+    svc = DetectionService(ServiceConfig(
+        tenants=[TenantSpec(name=n, files=f, channels=SEL, batch=2,
+                            bucket="exact", admission=False)
+                 for n, f in tenants.items()],
+        outdir=out, persistent_cache=False, resume=True,
+    )).start()
+    try:
+        results = svc.run(until_idle=True)
+    finally:
+        svc.stop()
+    for name, files in tenants.items():
+        assert results[name].n_failed == 0, (point, results[name].records)
+        _assert_converged(os.path.join(out, name), files, chaos_fault_free)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ("enospc", "eio", "short"))
+@pytest.mark.parametrize("point", ("pre-write", "append-mid-line"))
+def test_injected_fault_matrix(point, mode, chaos_file_set, chaos_detector,
+                               chaos_fault_free, tmp_path):
+    """Raise-mode injections at the write boundaries: EIO/short-write
+    classify transient (in-run retry heals), ENOSPC classifies corrupt
+    (disposed failed, resume rehabilitates). Either way the sequence
+    converges."""
+    out = str(tmp_path / "camp")
+    crashpoints.arm(point, mode)
+    res = run_campaign(chaos_file_set, SEL, out, detector=chaos_detector)
+    assert crashpoints.armed() is None
+    if res.n_done < CHAOS_N_FILES:
+        res = run_campaign(chaos_file_set, SEL, out, detector=chaos_detector)
+        assert res.n_failed == 0, (point, mode, res.records)
+    _assert_converged(out, chaos_file_set, chaos_fault_free)
