@@ -1224,6 +1224,105 @@ def _bench_service(nx, ns, fs, dx, n_files: int = 6, n_tenants: int = 2,
     }
 
 
+def _bench_fleet(nx, ns, fs, dx, workers: int = 2, n_tenants: int = 2,
+                 n_files: int = 3, batch: int = 2, n_migrations: int = 6,
+                 n_probe: int = 20):
+    """Fleet-posture mode (``DAS_BENCH_FLEET=1``): bring up a real
+    supervised fleet (``das4whales_tpu.fleet`` — N worker subprocesses,
+    one router), settle a small backfill, then price the control
+    plane itself:
+
+    * migration wall p50/p95 — ``FleetSupervisor.migrate`` round-trips
+      (graceful drain on the source + fsck'd adopt on the destination),
+      measured tenant-idle so the number is the control plane's own
+      overhead, not replay wall;
+    * router added latency p50 — ``GET /picks`` through the router
+      minus the same request against the owning worker directly (the
+      one-hop proxy tax a subscriber pays for migration transparency);
+    * fleet spin-up wall (spawn + /livez ready + place + adopt for all
+      workers/tenants).
+
+    Decorative-on-failure like every other opt-in payload: errors cost
+    the ``fleet_*`` keys, never the JSON line.
+    """
+    import statistics
+    import tempfile
+    import urllib.request
+
+    from das4whales_tpu.fleet import FleetConfig, FleetRouter, FleetSupervisor
+    from das4whales_tpu.io.synth import (
+        SyntheticCall,
+        SyntheticScene,
+        write_synthetic_file,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="das_bench_fleet_")
+    tenants = []
+    for t in range(n_tenants):
+        files = []
+        for k in range(n_files):
+            scene = SyntheticScene(
+                nx=nx, ns=ns, dx=dx, fs=fs, noise_rms=0.05,
+                seed=2000 * t + k,
+                calls=[SyntheticCall(t0=ns / fs / 3, x0_m=nx / 2 * dx,
+                                     amplitude=2.0)],
+            )
+            p = os.path.join(tmp, f"t{t}f{k}.h5")
+            write_synthetic_file(p, scene)
+            files.append(p)
+        tenants.append({"name": f"t{t}", "files": files,
+                        "channels": [0, nx, 1], "batch": batch,
+                        "bucket": "exact", "admission": False})
+    cfg = FleetConfig(tenants=tenants, root=os.path.join(tmp, "fleet"),
+                      workers=workers, cost_cards=False,
+                      spawn_timeout_s=240.0)
+    sup = FleetSupervisor(cfg)
+    router = None
+    try:
+        t0 = time.perf_counter()
+        sup.start()
+        spinup = time.perf_counter() - t0
+        router = FleetRouter(sup, host=cfg.host).start()
+        if not sup.wait_until_settled(timeout_s=300.0):
+            raise RuntimeError("fleet backfill did not settle in 300s")
+
+        mig_walls = []
+        for _ in range(n_migrations):
+            t0 = time.perf_counter()
+            sup.migrate("t0", trigger="rebalance")
+            mig_walls.append(time.perf_counter() - t0)
+        mig_walls.sort()
+
+        def _time_get(url):
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(url, timeout=10.0) as resp:
+                resp.read()
+            return time.perf_counter() - t0
+
+        routed, direct = [], []
+        for _ in range(n_probe):
+            w = sup.owner("t0")
+            routed.append(_time_get(
+                f"{router.url}/picks/t0?cursor=0&limit=1"))
+            direct.append(_time_get(f"{w.url}/picks/t0?cursor=0&limit=1"))
+        added = statistics.median(routed) - statistics.median(direct)
+        return {
+            "fleet_workers": workers,
+            "fleet_tenants": n_tenants,
+            "fleet_spinup_s": round(spinup, 3),
+            "fleet_migration_p50_s": round(
+                mig_walls[len(mig_walls) // 2], 4),
+            "fleet_migration_p95_s": round(
+                mig_walls[min(len(mig_walls) - 1,
+                              int(0.95 * len(mig_walls)))], 4),
+            "fleet_router_added_latency_p50_s": round(added, 5),
+        }
+    finally:
+        if router is not None:
+            router.stop()
+        sup.stop()
+
+
 def _run_rung_child(spec: dict) -> int:
     """Child-process entry (``--run-rung``): execute exactly one ladder rung
     (or the CPU reference baseline) and print its result as the last stdout
@@ -1247,6 +1346,13 @@ def _run_rung_child(spec: dict) -> int:
         out = _bench_service(
             spec["nx"], spec["ns"], spec["fs"], spec["dx"],
             n_files=spec.get("n_files", 6),
+            n_tenants=spec.get("n_tenants", 2),
+            batch=spec.get("batch", 2),
+        )
+    elif spec.get("fleet"):
+        out = _bench_fleet(
+            spec["nx"], spec["ns"], spec["fs"], spec["dx"],
+            workers=spec.get("workers", 2),
             n_tenants=spec.get("n_tenants", 2),
             batch=spec.get("batch", 2),
         )
@@ -1603,6 +1709,20 @@ def main():
                            if k.startswith("service_")})
         else:
             errors.append(f"service: {serr}")
+    if os.environ.get("DAS_BENCH_FLEET", "") not in ("", "0", "false"):
+        # fleet-posture mode (DAS_BENCH_FLEET=1): one dedicated child
+        # brings up a real supervised fleet at the QUICK shape and
+        # prices the control plane — migration wall p50/p95 and the
+        # router's one-hop latency tax (docs/FLEET.md); decorative-on-
+        # failure like the service payload above
+        fspec = {"fleet": True, "nx": quick_shape[0], "ns": quick_shape[1],
+                 "fs": fs, "dx": dx}
+        fres, ferr = _spawn_rung(fspec, args.rung_timeout, cpu=ran_cpu)
+        if fres is not None:
+            result.update({k: v for k, v in fres.items()
+                           if k.startswith("fleet_")})
+        else:
+            errors.append(f"fleet: {ferr}")
     wall, n_picks = result["wall"], result["n_picks"]
     device, stages, route = result["device"], result["stages"], result["route"]
     if fallback:

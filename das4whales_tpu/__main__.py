@@ -158,6 +158,28 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("--trace", action="store_true", default=None,
                     help="arm the flight recorder for the whole service "
                          "run (exports <outdir>/trace.json at drain)")
+    pf = sub.add_parser(
+        "fleet",
+        help="run a supervised multi-worker fleet: N DetectionService "
+             "subprocesses, cost-card placement, failure detection, "
+             "migration-as-recovery, and the tenant-keyed router "
+             "(das4whales_tpu.fleet; docs/FLEET.md)",
+    )
+    pf.add_argument("config",
+                    help="JSON fleet registry (tenants, workers, root — "
+                         "schema in docs/FLEET.md)")
+    pf.add_argument("--port", type=int, default=None,
+                    help="override the router port (0: ephemeral)")
+    pf.add_argument("--root", default=None,
+                    help="override the fleet root directory")
+    pf.add_argument("--workers", type=int, default=None,
+                    help="override the worker count")
+    pf.add_argument("--until-settled", action="store_true",
+                    help="exit once every tenant's file list is "
+                         "manifest-settled fleet-wide (backfill mode) "
+                         "instead of serving until SIGTERM")
+    pf.add_argument("--settle-timeout", type=float, default=600.0,
+                    help="--until-settled deadline in seconds")
     pl = sub.add_parser(
         "longrecord",
         help="continuous detection across file boundaries: consecutive "
@@ -349,6 +371,43 @@ def main(argv=None) -> int:
                   f"{res.n_quarantined} quarantined, "
                   f"{res.n_timeout} timeout -> {res.outdir}")
         return 0 if n_failed == 0 else 3
+    if args.workflow == "fleet":
+        import signal as _signal
+        import threading as _threading
+
+        from das4whales_tpu.fleet import (FleetRouter, FleetSupervisor,
+                                          load_fleet_config)
+
+        fcfg = load_fleet_config(args.config)
+        if args.root is not None:
+            fcfg.root = args.root
+        if args.workers is not None:
+            fcfg.workers = args.workers
+        if args.port is not None:
+            fcfg.port = args.port
+        sup = FleetSupervisor(fcfg)
+        router = None
+        stop_ev = _threading.Event()
+        try:
+            sup.start()
+            router = FleetRouter(sup, host=fcfg.host,
+                                 port=fcfg.port).start()
+            print(f"fleet: router at {router.url} "
+                  f"({fcfg.workers} workers)", file=sys.stderr)
+            if args.until_settled:
+                ok = sup.wait_until_settled(timeout_s=args.settle_timeout)
+                if not ok:
+                    print("fleet: settle timeout", file=sys.stderr)
+                    return 3
+                return 0
+            for sig in (_signal.SIGTERM, _signal.SIGINT):
+                _signal.signal(sig, lambda *_a: stop_ev.set())
+            stop_ev.wait()
+            return 0
+        finally:
+            if router is not None:
+                router.stop()
+            sup.stop()
     if args.workflow == "longrecord":
         import numpy as np
 

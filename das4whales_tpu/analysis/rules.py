@@ -98,6 +98,12 @@ R14 non-durable-artifact-write — a direct ``open(.., "w"/"a")`` or
     strands a torn artifact. Literal-suffix heuristic: a path built
     purely from variables escapes (``json.dump`` sites are caught
     through the ``open(...)`` that feeds them).
+R15 unbounded-subprocess-wait — ``Popen.wait()`` with no timeout, or
+    ``.communicate()`` without ``timeout=``: a wedged child blocks the
+    caller forever (the fleet supervisor must never hang on a wedged
+    worker — ISSUE 20). ``.wait()`` is flagged only on receivers whose
+    name reads process-ish (``proc``/``popen``/``child``/``worker``),
+    so ``Event.wait()``/``Condition.wait()`` stay R10's business.
 
 Suppression: an inline ``# daslint: allow[R2]`` (comma list, or
 ``daslint: ignore`` for all rules) on the finding's line or the line above
@@ -114,7 +120,7 @@ from pathlib import PurePosixPath
 from typing import Iterable, List, Optional, Sequence, Set, Tuple
 
 ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9", "R10",
-             "R11", "R12", "R13", "R14")
+             "R11", "R12", "R13", "R14", "R15")
 
 #: rules whose primary half runs over COMPILED programs (jax-importing,
 #: one AOT compile per audited variant) rather than source text. R11
@@ -140,6 +146,11 @@ _ARTIFACT_WRITE_MODES = frozenset({
     "w+", "a+", "x+", "w+b", "a+b", "wb+", "ab+",
 })
 _R14_EXEMPT_SUFFIXES = ("das4whales_tpu/utils/artifacts.py",)
+
+#: R15: receiver names that read as a child process — ``proc.wait()``
+#: flags, ``event.wait()`` doesn't (that's R10's business); and the
+#: ``.communicate()`` method, which is unambiguously Popen.
+_R15_PROC_RECEIVER = re.compile(r"(proc|popen|child|worker)", re.I)
 
 #: Attribute reads that yield Python metadata, not device values — a
 #: tracer's ``.shape`` is a static tuple, so ``float(x.shape[0])`` is host
@@ -483,6 +494,7 @@ class _Analyzer(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call):
         self._check_sync_in_loop(node)
         self._check_artifact_write(node)
+        self._check_subprocess_wait(node)
         kws = _jit_call_info(self.imports, node)
         if kws is not None:
             if self._loop_depth and "R2" in self.rules:
@@ -551,6 +563,39 @@ class _Analyzer(ast.NodeVisitor):
                    "mid-write strands a torn artifact the resume/"
                    "report paths then choke on (docs/ROBUSTNESS.md "
                    "\"Durability contract\")")
+
+    def _check_subprocess_wait(self, node: ast.Call):
+        """R15: a child-process wait with no deadline. ``communicate``
+        is unambiguously ``Popen``; bare ``wait`` is gated on a
+        process-ish receiver name so the threading primitives' waits
+        (R10's domain) never double-report. A positional or keyword
+        ``timeout`` argument satisfies the rule."""
+        if "R15" not in self.rules or not isinstance(node.func,
+                                                     ast.Attribute):
+            return
+        method = node.func.attr
+        if method not in ("wait", "communicate"):
+            return
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            return
+        recv = node.func.value
+        name = (recv.id if isinstance(recv, ast.Name)
+                else recv.attr if isinstance(recv, ast.Attribute) else "")
+        if method == "wait":
+            # Popen.wait(timeout) is positional-or-keyword
+            if node.args:
+                return
+            if not _R15_PROC_RECEIVER.search(name):
+                return
+        elif method == "communicate" and len(node.args) > 1:
+            # communicate(input, timeout): a second positional IS one
+            return
+        self._emit("R15", "unbounded-subprocess-wait", node,
+                   f"`{name or '<expr>'}.{method}()` with no timeout — a "
+                   "wedged child process blocks this caller forever; pass "
+                   "`timeout=` and handle subprocess.TimeoutExpired (the "
+                   "supervisor must outlive any worker it watches, "
+                   "docs/FLEET.md)")
 
     def _check_sync_in_loop(self, node: ast.Call):
         """R6: host-side device syncs inside a for/while body. Runs only

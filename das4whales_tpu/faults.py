@@ -278,6 +278,60 @@ def classify_failure(exc: BaseException) -> str:
 
 
 @dataclass(frozen=True)
+class Backoff:
+    """One reusable exponential-backoff schedule (ISSUE 20 satellite).
+
+    The repo grew three ad-hoc copies of "sleep a growing, jittered
+    delay until a deadline" — the campaign's transient retries
+    (:class:`RetryPolicy`), the fleet router's forward retries and the
+    fleet supervisor's health/adopt loops. This is the one definition
+    they all delegate to. Delay for 1-based ``attempt`` is
+    ``min(base_s * factor**(attempt-1), cap_s)`` scaled by a
+    DETERMINISTIC seeded jitter in ``[1-jitter, 1+jitter]`` (seeded by
+    ``(seed, key, attempt)`` exactly like :meth:`RetryPolicy.delay_s`,
+    so reruns sleep the same schedule while distinct keys decorrelate —
+    no thundering herd against a recovering worker). ``deadline_s``
+    TRUNCATES: a delay never overshoots the schedule's total budget,
+    and :meth:`delays` stops yielding once the budget is spent.
+    """
+
+    base_s: float = 0.05
+    factor: float = 2.0
+    jitter: float = 0.25
+    cap_s: float = 2.0
+    deadline_s: float | None = None
+    seed: int = 0
+
+    def delay_s(self, attempt: int, key: str = "",
+                elapsed_s: float = 0.0) -> float:
+        """The jittered delay before attempt ``attempt + 1``, truncated
+        so ``elapsed_s + delay`` never exceeds ``deadline_s``."""
+        base = min(self.base_s * self.factor ** max(attempt - 1, 0),
+                   self.cap_s)
+        rng = random.Random(f"{self.seed}|{key}|{attempt}")
+        delay = max(0.0, base * (1.0 + self.jitter * rng.uniform(-1.0, 1.0)))
+        if self.deadline_s is not None:
+            delay = min(delay, max(0.0, self.deadline_s - elapsed_s))
+        return delay
+
+    def delays(self, key: str = ""):
+        """Generator of successive delays (attempt 1, 2, ...) until the
+        deadline budget is spent; unbounded when ``deadline_s`` is None
+        — the CALLER owns any attempt ceiling. The yielded values sum
+        to at most ``deadline_s``, so ``for d in b.delays(): sleep(d)``
+        is a bounded wait loop by construction."""
+        elapsed = 0.0
+        attempt = 0
+        while True:
+            attempt += 1
+            if self.deadline_s is not None and elapsed >= self.deadline_s:
+                return
+            d = self.delay_s(attempt, key, elapsed_s=elapsed)
+            yield d
+            elapsed += d
+
+
+@dataclass(frozen=True)
 class RetryPolicy:
     """Config-driven retry for transient-class failures.
 
@@ -304,10 +358,15 @@ class RetryPolicy:
     )
 
     def delay_s(self, key: str, attempt: int) -> float:
-        base = min(self.base_delay_s * 2 ** max(attempt - 1, 0),
-                   self.max_delay_s)
-        rng = random.Random(f"{self.seed}|{key}|{attempt}")
-        return max(0.0, base * (1.0 + self.jitter * rng.uniform(-1.0, 1.0)))
+        # delegate to the shared Backoff schedule (same seeding string,
+        # so pre-Backoff campaigns sleep bit-identical walls)
+        return self.backoff().delay_s(attempt, key)
+
+    def backoff(self) -> Backoff:
+        """This policy's schedule as the shared :class:`Backoff`."""
+        return Backoff(base_s=self.base_delay_s, factor=2.0,
+                       jitter=self.jitter, cap_s=self.max_delay_s,
+                       seed=self.seed)
 
     @classmethod
     def from_env(cls) -> "RetryPolicy":

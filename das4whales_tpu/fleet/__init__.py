@@ -1,0 +1,17 @@
+"""Self-healing multi-worker serving (ISSUE 20, docs/FLEET.md).
+
+``supervisor`` owns the control plane — spawn/place/watch/recover over
+N shared-nothing ``DetectionService`` worker subprocesses, with a
+crash-only desired-state ledger; ``router`` is the tenant-keyed HTTP
+front door. Import-light like ``service/``: stdlib only at module
+import (workers own the jax runtime in their own processes).
+"""
+
+from .supervisor import (   # noqa: F401
+    FleetConfig,
+    FleetSupervisor,
+    free_port,
+    load_fleet_config,
+    settled_files,
+)
+from .router import FleetRouter   # noqa: F401

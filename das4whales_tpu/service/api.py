@@ -43,6 +43,18 @@ just text). Endpoints (docs/SERVICE.md):
     backpressure the interrogator can act on; under ``drop_oldest``
     the push always lands (202) and the evicted block is counted as
     ``das_ingest_dropped_total{tenant}``.
+``POST /drain/<tenant>?timeout_s=S``
+    Gracefully drain ONE tenant (ISSUE 20: migration's sending verb):
+    source stops, ring closes, buffered work resolves, counters and
+    ``cost_card.json`` flush, settled manifest left complete — 200
+    with final counts + outdir; 404 unknown tenant; 503 +
+    ``Retry-After`` when the drain missed its deadline.
+``POST /adopt``
+    Register a tenant from an existing outdir (migration's receiving
+    verb). JSON body: a tenant-registry spec, optionally wrapped as
+    ``{"spec": {...}, "outdir": "..."}``. ``fsck.startup_check`` runs
+    FIRST — 409 when the directory refuses (corruption), 400 on a bad
+    spec, 200 with ``{pending, settled}`` counts on success.
 """
 
 from __future__ import annotations
@@ -356,7 +368,14 @@ class ServiceAPI:
                 extra={"X-DAS-Cursor": cursor})
 
     def _post(self, h) -> None:
-        parts = [p for p in urlparse(h.path).path.split("/") if p]
+        url = urlparse(h.path)
+        parts = [p for p in url.path.split("/") if p]
+        if len(parts) == 2 and parts[0] == "drain":
+            self._post_drain(h, parts[1], parse_qs(url.query))
+            return
+        if len(parts) == 1 and parts[0] == "adopt":
+            self._post_adopt(h)
+            return
         if len(parts) != 2 or parts[0] != "ingest":
             h._send_json(404, {"error": f"no route {h.path}"})
             return
@@ -393,3 +412,43 @@ class ServiceAPI:
                 if not t.ring.closed else "service draining",
                 "ring_depth": len(t.ring),
             }, extra={"Retry-After": RETRY_AFTER_S})
+
+    # -- fleet verbs (ISSUE 20) -------------------------------------------
+
+    def _post_drain(self, h, tenant: str, q) -> None:
+        timeout_s = float(q.get("timeout_s", ["30"])[0])
+        try:
+            summary = self.service.drain_tenant(tenant, timeout_s=timeout_s)
+        except KeyError:
+            h._send_json(404, {"error": f"unknown tenant {tenant!r}"})
+            return
+        except TimeoutError as exc:
+            # the drain is still in progress (retire stays queued): the
+            # caller should retry, NOT conclude the tenant moved
+            h._send_json(503, {"error": str(exc)},
+                         extra={"Retry-After": RETRY_AFTER_S})
+            return
+        h._send_json(200, summary)
+
+    def _post_adopt(self, h) -> None:
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            body = json.loads(h.rfile.read(n) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("adopt body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as exc:
+            h._send_json(400, {"error": f"bad adopt body: {exc}"})
+            return
+        spec = body.get("spec", body)
+        outdir = body.get("outdir") if "spec" in body else None
+        try:
+            summary = self.service.adopt_tenant(spec, outdir=outdir)
+        except (TypeError, ValueError) as exc:
+            h._send_json(400, {"error": str(exc)})
+            return
+        except RuntimeError as exc:
+            # fsck.startup_check refused the directory: adopting it
+            # would resume over corruption — surface, do not register
+            h._send_json(409, {"error": str(exc)})
+            return
+        h._send_json(200, summary)

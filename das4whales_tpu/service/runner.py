@@ -63,6 +63,12 @@ class TenantSpec:
 
     name: str
     files: List[str] = field(default_factory=list)
+    #: explicit manifest/picks directory (default
+    #: ``<service outdir>/<name>``). The fleet supervisor (ISSUE 20)
+    #: pins every tenant to a STABLE fleet-level directory so the
+    #: manifest — and with it every ``/picks`` cursor — survives
+    #: migration between workers unchanged.
+    outdir: str | None = None
     channels: List[int] | None = None
     batch: int = 4
     bucket: object = "pow2"
@@ -205,10 +211,13 @@ def load_service_config(path: str) -> ServiceConfig:
                 f"{t.get('name', '?')!r}; known: {sorted(_TENANT_KEYS)}"
             )
         tenants.append(TenantSpec(**t))
-    if not tenants:
+    if not tenants and not raw.get("allow_empty"):
+        # a fleet spare worker (ISSUE 20) starts empty on purpose and
+        # receives its tenants via POST /adopt — it opts in explicitly
         raise ValueError(f"{path}: no tenants configured")
     known = {"tenants", "outdir", "host", "port", "dispatch_depth", "trace",
-             "cost_cards", "quality", "resume", "persistent_cache"}
+             "cost_cards", "quality", "resume", "persistent_cache",
+             "allow_empty"}
     unknown = set(raw) - known
     if unknown:
         raise ValueError(f"unknown service keys {sorted(unknown)}; "
@@ -270,7 +279,7 @@ class DetectionService:
         self.sources: Dict[str, FileReplaySource] = {}
         for spec in config.tenants:
             t = TenantRuntime(
-                spec, os.path.join(config.outdir, spec.name),
+                spec, spec.outdir or os.path.join(config.outdir, spec.name),
                 resume=config.resume, fault_plan=fault_plans.get(spec.name),
             )
             self.tenants[spec.name] = t
@@ -296,6 +305,11 @@ class DetectionService:
         self.api = ServiceAPI(self, host=config.host, port=config.port)
         self._stop = threading.Event()
         self._drained = threading.Event()
+        self._started = False
+        # brackets tenant-registry mutation from HTTP admin verbs
+        # (/drain, /adopt): two concurrent adopts of the same name must
+        # serialize through the registry check (ISSUE 20)
+        self._admin_lock = threading.Lock()
 
     # -- the API's view ----------------------------------------------------
 
@@ -311,14 +325,16 @@ class DetectionService:
             "drained": self._drained.is_set(),
             "probes": probes.snapshot(),
             "in_flight_slabs": self.scheduler.pipe.in_flight(),
-            "tenants": [t.snapshot() for t in self.tenants.values()],
+            # list(...) snapshots the registry: /drain and /adopt mutate
+            # it from other HTTP threads (ISSUE 20)
+            "tenants": [t.snapshot() for t in list(self.tenants.values())],
         }
 
     def slo_report(self) -> Dict:
         """The ``/slo`` surface: every tenant's SLO verdict (targets,
         multi-window burn rates, state) plus the burning list the
         ``/readyz`` detail embeds (docs/SERVICE.md)."""
-        tenants = [t.slo_snapshot() for t in self.tenants.values()]
+        tenants = [t.slo_snapshot() for t in list(self.tenants.values())]
         return {
             "tenants": tenants,
             "burning": [s["tenant"] for s in tenants
@@ -359,7 +375,10 @@ class DetectionService:
         # otherwise
         probes.reset()
         self.api.start()
-        for src in self.sources.values():
+        with self._admin_lock:
+            self._started = True
+            sources = list(self.sources.values())
+        for src in sources:
             src.start()
         log.info("service up: %d tenant(s), api %s",
                  len(self.tenants), self.api.url)
@@ -373,9 +392,12 @@ class DetectionService:
             return
         log.info("drain requested: stopping sources, closing rings")
         self._stop.set()
-        for src in self.sources.values():
+        with self._admin_lock:
+            sources = list(self.sources.values())
+            tenants = list(self.tenants.values())
+        for src in sources:
             src.stop()
-        for t in self.tenants.values():
+        for t in tenants:
             t.ring.close()
 
     def run(self, until_idle: bool = True) -> Dict:
@@ -404,7 +426,7 @@ class DetectionService:
                 # the drain half that must happen on EVERY exit path:
                 # finish in-flight slabs, flush per-tenant counters
                 self.scheduler.drain()
-                for t in self.tenants.values():
+                for t in list(self.tenants.values()):
                     t.finish()
                 from ..telemetry import costs as tcosts
 
@@ -431,7 +453,99 @@ class DetectionService:
                         log.debug("quality export failed at drain",
                                   exc_info=True)
                 self._drained.set()
-        return {name: t.result() for name, t in self.tenants.items()}
+        return {name: t.result() for name, t in list(self.tenants.items())}
+
+    # -- fleet verbs (ISSUE 20: the two sides of one migration) -----------
+
+    def drain_tenant(self, name: str, timeout_s: float = 30.0) -> Dict:
+        """Gracefully drain ONE tenant (migration's sending verb, the
+        ``POST /drain/<tenant>`` body). Its source stops and its ring
+        closes (new ingest answers 429), buffered work resolves through
+        the scheduler, the counters event and ``cost_card.json`` flush,
+        and the settled manifest is left complete on disk — then the
+        tenant leaves the rotation. Returns its final counts + outdir
+        (everything the adopting worker needs)."""
+        import time
+
+        with self._admin_lock:
+            t = self.tenants.get(name)
+            if t is None:
+                raise KeyError(name)
+            src = self.sources.pop(name, None)
+        if src is not None:
+            src.stop()
+        t.ring.close()
+        done = threading.Event()
+        self.scheduler.retire_when_idle(name, done)
+        deadline = time.monotonic() + timeout_s
+        while not done.wait(0.05):
+            if self._drained.is_set():
+                break   # the run loop's own drain already finished it
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"tenant {name!r} did not drain within {timeout_s:.0f}s"
+                )
+        with self._admin_lock:
+            self.tenants.pop(name, None)
+        res = t.result()
+        return {
+            "tenant": name, "outdir": t.outdir,
+            "n_done": res.n_done, "n_failed": res.n_failed,
+            "n_skipped": res.n_skipped,
+            "n_quarantined": res.n_quarantined, "n_timeout": res.n_timeout,
+        }
+
+    def adopt_tenant(self, spec, outdir: str | None = None,
+                     fault_plan=None) -> Dict:
+        """Adopt a tenant from an existing outdir (migration's
+        receiving verb, the ``POST /adopt`` body). ``spec`` is a
+        :class:`TenantSpec` or registry dict. The outdir gets an
+        EXPLICIT ``fsck.startup_check`` before the runtime touches it —
+        a dead worker's directory must prove itself safe to resume —
+        then the tenant joins the scheduler rotation and its un-settled
+        files start replaying (settled ones skip at the source, so
+        nothing re-runs: exactly the crash-resume semantics)."""
+        from .. import fsck
+
+        if isinstance(spec, dict):
+            unknown = set(spec) - _TENANT_KEYS
+            if unknown:
+                raise ValueError(
+                    f"unknown tenant keys {sorted(unknown)} for "
+                    f"{spec.get('name', '?')!r}; known: "
+                    f"{sorted(_TENANT_KEYS)}"
+                )
+            spec = TenantSpec(**spec)
+        outdir = (outdir or spec.outdir
+                  or os.path.join(self.config.outdir, spec.name))
+        os.makedirs(outdir, exist_ok=True)
+        fsck.startup_check(outdir, label=f"adopt {spec.name}")
+        with self._admin_lock:
+            if spec.name in self.tenants:
+                raise ValueError(
+                    f"tenant {spec.name!r} already registered")
+            t = TenantRuntime(spec, outdir, resume=True,
+                              fault_plan=fault_plan)
+            self.tenants[spec.name] = t
+            files = t.replay_files()
+            if files:
+                src = FileReplaySource(
+                    t.ring, files, spec.channels, spec.metadata,
+                    interrogator=spec.interrogator, engine=spec.engine,
+                    wire=spec.wire, realtime_factor=spec.realtime_factor,
+                    read_deadline_s=spec.read_deadline_s,
+                    fault_plan=fault_plan,
+                )
+                self.sources[spec.name] = src
+                if self._started:
+                    src.start()
+            elif spec.files:
+                # every file already settled elsewhere: close the ring
+                # so idle checks (and until_idle runs) terminate
+                t.ring.close()
+        self.scheduler.add_tenant(t)
+        return {"tenant": spec.name, "outdir": outdir,
+                "pending": len(files), "settled": len(t.settled)}
 
     def stop(self) -> None:
         """Tear down the API server (after :meth:`run` returned) and
@@ -443,7 +557,7 @@ class DetectionService:
         self._restore_switches = []
 
     def results(self) -> Dict:
-        return {name: t.result() for name, t in self.tenants.items()}
+        return {name: t.result() for name, t in list(self.tenants.items())}
 
 
 def serve(config: ServiceConfig | str, until_idle: bool = False,

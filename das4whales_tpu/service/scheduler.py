@@ -50,7 +50,7 @@ from ..telemetry import costs as tcosts
 from ..telemetry import metrics, trace as telemetry
 from ..telemetry import quality as tquality
 from ..telemetry import slo as tslo
-from ..utils import locks
+from ..utils import artifacts, locks
 from ..utils.log import get_logger
 from ..workflows import campaign as camp
 from ..workflows.planner import (
@@ -200,7 +200,7 @@ class TenantRuntime:
         """The tenant's file list minus manifest-settled paths (crash
         resume: settled files are skipped at the SOURCE, so a restarted
         service never re-reads them)."""
-        return [f for f in self.spec.files if f not in self.settled]
+        return camp.pending_files(self.spec.files, settled=self.settled)
 
     def pump(self) -> None:
         """Move ring items through the slicer into the ready queue."""
@@ -750,11 +750,48 @@ class TenantRuntime:
                     self._drop_ingest_stamp(path)   # terminal, not done
                 break
 
+    def cost_summary(self) -> Dict:
+        """This tenant's placement footprint for the fleet supervisor
+        (ISSUE 20): max priced HBM peak and roofline-predicted wall
+        across the cost cards of every bucket this tenant dispatched.
+        ``priced=False`` means the cost observatory was off or nothing
+        dispatched yet — the supervisor then falls back to the declared
+        ``hbm_share_gb``, never a guess."""
+        labels = {tcosts.bucket_label(k) for k in list(self._dets)}
+        peak, wall, n = 0, 0.0, 0
+        if tcosts.enabled() and labels:
+            peaks = tcosts.device_peaks()
+            for card in tcosts.REGISTRY.cards():
+                if card.bucket not in labels:
+                    continue
+                n += 1
+                peak = max(peak, int(card.peak_bytes + card.argument_bytes))
+                wall = max(wall, float(card.predicted_wall_s(peaks)))
+        return {
+            "tenant": self.name,
+            "priced": n > 0,
+            "n_cards": n,
+            "peak_bytes": peak,
+            "predicted_wall_s": round(wall, 6),
+            "hbm_share_gb": self.spec.hbm_share_gb,
+        }
+
     def finish(self) -> None:
-        """Flush the end-of-run counters event (idempotent)."""
+        """Flush the end-of-run counters event (idempotent), and leave
+        the tenant's placement footprint next to its manifest — the
+        fleet supervisor's bin-packing input when this outdir is later
+        adopted by another worker (ISSUE 20)."""
         if not self._finished:
             self._finished = True
             self.rz.flush_tallies()
+            try:
+                artifacts.atomic_json(
+                    os.path.join(self.outdir, "cost_card.json"),
+                    self.cost_summary(),
+                )
+            except OSError as exc:
+                log.warning("tenant %s: cost_card.json not written: %s",
+                            self.name, exc)
 
     # -- reporting ---------------------------------------------------------
 
@@ -812,6 +849,12 @@ class StreamScheduler:
         self.pipe = PipelinedDispatch(dispatch_depth)
         self._rotation = deque(self.tenants)
         self._base_quantum = 1.0   # megasamples; adapts to the largest slab
+        # fleet admin (ISSUE 20): HTTP threads enqueue add/retire ops;
+        # the scheduler thread applies them at the top of each round, so
+        # the tenants dict and rotation stay scheduler-thread-confined
+        # (the R8 discipline — handlers never mutate them directly)
+        self._admin: deque = deque()
+        self._retiring: Dict[str, object] = {}   # name -> threading.Event
 
     @staticmethod
     def _cost(slab) -> float:
@@ -864,11 +907,58 @@ class StreamScheduler:
             for token in self.pipe.submit((t.name, slab), infl):
                 self._finalize(*token)
 
+    # -- fleet admin (ISSUE 20) -------------------------------------------
+
+    def add_tenant(self, t: TenantRuntime) -> None:
+        """Enqueue a freshly adopted tenant; it joins the rotation at
+        the top of the next :meth:`step` (never mid-round)."""
+        self._admin.append(("add", t))
+
+    def retire_when_idle(self, name: str, done) -> None:
+        """Enqueue a tenant's retirement: once its source is exhausted
+        and none of its slabs ride the pipe, it is ``finish()``-ed,
+        removed from the rotation, and ``done`` (a threading.Event) is
+        set — the ``/drain`` verb's completion gate."""
+        self._admin.append(("retire", (name, done)))
+
+    def _apply_admin(self) -> None:
+        while self._admin:
+            op, payload = self._admin.popleft()
+            if op == "add":
+                t = payload
+                self.tenants[t.name] = t
+                if t.name not in self._rotation:
+                    self._rotation.append(t.name)
+            else:
+                name, done = payload
+                self._retiring[name] = done
+
+    def _check_retiring(self) -> None:
+        if not self._retiring:
+            return
+        busy = {tok[0] for tok in self.pipe.pending()}
+        for name in list(self._retiring):
+            t = self.tenants.get(name)
+            if t is None:
+                self._retiring.pop(name).set()
+                continue
+            t.pump()
+            if (t.idle() or t.aborted) and name not in busy:
+                t.finish()
+                del self.tenants[name]
+                try:
+                    self._rotation.remove(name)
+                except ValueError:
+                    pass
+                self._retiring.pop(name).set()
+
     def step(self) -> bool:
         """One DRR round: credit each tenant, serve what the deficits
         cover. Returns True when any slab or error item was served (the
         runner idles briefly on False)."""
         any_work = False
+        self._apply_admin()
+        self._check_retiring()
         for _ in range(len(self._rotation)):
             name = self._rotation[0]
             self._rotation.rotate(-1)
@@ -916,6 +1006,7 @@ class StreamScheduler:
                 if self.pipe.in_flight():
                     self._drain_pipe()
                     continue
-                if all(t.idle() or t.aborted for t in self.tenants.values()):
+                if all(t.idle() or t.aborted
+                       for t in list(self.tenants.values())):
                     return
                 time.sleep(idle_sleep_s)

@@ -226,6 +226,22 @@ def load_settled(outdir: str) -> set:
     return _load_settled(outdir)
 
 
+def pending_files(files, outdir: str | None = None, *,
+                  settled: set | None = None) -> list:
+    """Resume-single-tenant (ISSUE 20): the work-list that REMAINS for
+    one tenant/campaign outdir — ``files`` minus the manifest-settled
+    set, in the original order. This is the primitive fleet migration
+    composes: a worker adopting a tenant from a dead (or drained) peer
+    replays exactly this list, so a file settles done exactly once
+    fleet-wide no matter how many workers served the tenant. Pass a
+    pre-loaded ``settled`` set to skip the manifest re-read."""
+    if settled is None:
+        if outdir is None:
+            raise ValueError("pending_files needs outdir or settled")
+        settled = _load_settled(outdir)
+    return [f for f in files if f not in settled]
+
+
 def _normalize_metas(metadata, files):
     """The stream's metadata convention (None / one-for-all / aligned
     sequence) as an explicit per-file list."""

@@ -1,0 +1,50 @@
+"""Subprocess fleet driver for the chaos matrix (tests/test_fleet.py).
+
+Runs a whole fleet — supervisor + router + N DetectionService worker
+subprocesses — from a JSON fleet registry, prints one JSON status line
+(router URL) to stdout once the fleet is up, then blocks until every
+tenant's file list is manifest-settled fleet-wide.
+
+The parent kills THIS process with SIGKILL to exercise supervisor death:
+the worker subprocesses survive as orphans, and the next driver run over
+the same root must fence them via the replayed ledger before respawning
+(the crash-only supervisor contract, docs/FLEET.md).
+
+The supervisor/router processes never import jax; the worker
+subprocesses inherit the environment, so the parent pins
+JAX_PLATFORMS/XLA_FLAGS/JAX_ENABLE_X64 there (must match
+tests/conftest.py for picks bit-comparable with the oracle).
+
+Usage::
+
+    python fleet_worker.py <fleet-config.json> [settle_timeout_s]
+"""
+
+import json
+import sys
+
+
+def main(argv):
+    from das4whales_tpu.fleet import (
+        FleetRouter,
+        FleetSupervisor,
+        load_fleet_config,
+    )
+
+    cfg = load_fleet_config(argv[0])
+    timeout_s = float(argv[1]) if len(argv) > 1 else 600.0
+    sup = FleetSupervisor(cfg).start()
+    router = FleetRouter(sup, host=cfg.host, port=cfg.port).start()
+    print(json.dumps({"router": router.url,
+                      "status": sup.status()}), flush=True)
+    try:
+        ok = sup.wait_until_settled(timeout_s=timeout_s)
+    finally:
+        router.stop()
+        sup.stop()
+    print(json.dumps({"settled": ok}), flush=True)
+    return 0 if ok else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

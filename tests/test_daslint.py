@@ -1923,3 +1923,44 @@ class TestR14DurableWrites:
             """,
         )
         assert codes(f) == []
+
+
+class TestR15UnboundedSubprocessWait:
+    def test_bare_wait_and_communicate_flagged(self):
+        f = run(
+            """
+            import subprocess
+
+            def reap(proc, worker):
+                proc.wait()
+                worker.proc.communicate()
+            """,
+            rules=("R15",),
+        )
+        assert codes(f) == ["unbounded-subprocess-wait"] * 2
+
+    def test_bounded_and_non_proc_receivers_unflagged(self):
+        f = run(
+            """
+            import subprocess
+
+            def fine(proc, child, event, done):
+                proc.wait(timeout=5)          # bounded: keyword
+                child.wait(5)                 # bounded: positional
+                proc.communicate(timeout=10)  # bounded
+                event.wait()                  # not a process receiver
+                done.wait()                   # not a process receiver
+            """,
+            rules=("R15",),
+        )
+        assert codes(f) == []
+
+    def test_inline_allow_suppresses(self):
+        f = run(
+            """
+            def reap(proc):
+                proc.wait()  # daslint: allow[R15] terminal teardown
+            """,
+            rules=("R15",),
+        )
+        assert codes(f) == []

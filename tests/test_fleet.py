@@ -1,0 +1,566 @@
+"""Self-healing fleet: supervised multi-worker serving (ISSUE 20).
+
+Contracts pinned here:
+
+* ``faults.Backoff`` — deterministic seeded jitter inside declared
+  bounds, cap, deadline truncation, and ``RetryPolicy`` delegating to
+  the SAME schedule bit-identically (the PR 4 sleep walls are frozen);
+* the two worker-side migration verbs — ``POST /drain/<tenant>``
+  (graceful single-tenant drain, settled manifest left complete) and
+  ``POST /adopt`` (register from an existing outdir, fsck first; a
+  corrupt directory answers 409 and is NOT registered);
+* THE quick chaos drill (tier-1's representative subset): a real
+  2-worker × 2-tenant fleet of subprocess workers under replay ingest
+  survives one graceful rebalance AND one worker SIGKILL — every file
+  settles done exactly once fleet-wide, per-tenant picks bit-identical
+  to standalone ``run_campaign_batched``, a client cursor stream
+  through the router sees no gaps and no duplicates across both
+  migrations, no orphan tmps, fsck clean on every outdir;
+* supervisor death (SIGKILL of the control plane itself) rides the
+  slow matrix with the worker wedge (SIGSTOP) and the per-worker kill
+  sweep — ``tests/fleet_worker.py`` is the driver;
+* with the fleet layer unused, the single-process service path runs
+  under ``compile_guard.forbid_recompile`` at zero extra compiles/
+  dispatches with bit-identical picks (the invisibility pin).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from das4whales_tpu import faults, fsck
+from das4whales_tpu.fleet import (
+    FleetConfig,
+    FleetRouter,
+    FleetSupervisor,
+    settled_files,
+)
+from das4whales_tpu.service import DetectionService, ServiceConfig, TenantSpec
+from das4whales_tpu.utils import artifacts
+from das4whales_tpu.workflows import campaign as camp
+from das4whales_tpu.workflows.campaign import load_picks, run_campaign_batched
+
+from tests.conftest import CHAOS_N_FILES, CHAOS_NS, CHAOS_NX, CHAOS_SEL
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(ROOT, "tests", "fleet_worker.py")
+
+NX, NS, SEL = CHAOS_NX, CHAOS_NS, CHAOS_SEL
+
+
+def _make_files(tmp_path_factory, n, seed0, tag):
+    from das4whales_tpu.io.synth import (
+        SyntheticCall,
+        SyntheticScene,
+        write_synthetic_file,
+    )
+
+    d = tmp_path_factory.mktemp(tag)
+    paths = []
+    for k in range(n):
+        scene = SyntheticScene(
+            nx=NX, ns=NS, noise_rms=0.05, seed=seed0 + k,
+            calls=[SyntheticCall(t0=1.0 + 0.4 * k, x0_m=NX / 2 * 2.042,
+                                 amplitude=2.0)],
+        )
+        p = str(d / f"{tag}{k}.h5")
+        write_synthetic_file(p, scene)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def fleet_second_files(tmp_path_factory):
+    return _make_files(tmp_path_factory, 3, 700, "ff")
+
+
+@pytest.fixture(scope="module")
+def fleet_refs(chaos_file_set, fleet_second_files, tmp_path_factory):
+    """Standalone run_campaign_batched picks per tenant — the
+    bit-identity oracle (and the compile warm-up for the invisibility
+    pin)."""
+    base = tmp_path_factory.mktemp("fleetref")
+    refs = {}
+    for name, files in (("a", chaos_file_set), ("b", fleet_second_files)):
+        res = run_campaign_batched(files, SEL, str(base / name), batch=2,
+                                   bucket="exact", persistent_cache=False)
+        assert res.n_failed == 0
+        refs[name] = {r.path: load_picks(r.picks_file)
+                      for r in res.records if r.status == "done"}
+    return refs
+
+
+def _assert_bit_identical(outdir, files, reference):
+    done = {}
+    for rec in artifacts.read_records(
+            os.path.join(outdir, "manifest.jsonl")):
+        if rec.get("status") == "done" and "path" in rec:
+            done.setdefault(rec["path"], []).append(rec)
+    assert set(done) == set(files)
+    for path, recs in done.items():
+        assert len(recs) == 1, (
+            f"{path} settled done {len(recs)} times — fleet-wide "
+            "exactly-once violated")
+        got = load_picks(recs[0]["picks_file"])
+        ref = reference[path]
+        assert set(got) == set(ref)
+        for name in ref:
+            np.testing.assert_array_equal(got[name], ref[name])
+
+
+def _assert_fsck_clean(outdir):
+    report = fsck.startup_check(outdir, label=f"verify {outdir}")
+    assert report == {"orphan_tmps": 0, "torn_tail": 0,
+                      "corrupt_records": 0}, (outdir, report)
+
+
+def _worker_env():
+    """Worker-subprocess environment: the conftest device/x64 pins so
+    picks are bit-comparable with the in-process oracle, chaos vars
+    stripped."""
+    pythonpath = ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               JAX_ENABLE_X64="true",
+               PYTHONPATH=pythonpath.rstrip(os.pathsep))
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    for k in ("DAS_CRASHPOINT", "DAS_CRASHPOINT_MODE", "DAS_CRASHPOINT_SKIP",
+              "DAS_MANIFEST_CRC", "DAS_FSCK_AUTOREPAIR", "DAS_COST_CARDS",
+              "DAS_QUALITY"):
+        env.pop(k, None)
+    return env
+
+
+def _tenant(name, files, **kw):
+    t = {"name": name, "files": files, "channels": SEL, "batch": 2,
+         "bucket": "exact", "admission": False}
+    t.update(kw)
+    return t
+
+
+# --------------------------------------------------------- Backoff units
+
+class TestBackoff:
+    def test_jitter_bounds_and_growth(self):
+        bo = faults.Backoff(base_s=0.1, factor=2.0, jitter=0.25,
+                            cap_s=10.0, seed=3)
+        for attempt in range(1, 8):
+            base = min(0.1 * 2.0 ** (attempt - 1), 10.0)
+            d = bo.delay_s(attempt, key="k")
+            assert base * 0.75 <= d <= base * 1.25, (attempt, d)
+        # deterministic: same (seed, key, attempt) -> same delay
+        assert bo.delay_s(3, key="k") == bo.delay_s(3, key="k")
+        # a different key draws different jitter
+        assert bo.delay_s(3, key="k") != bo.delay_s(3, key="other")
+
+    def test_cap_bounds_base_not_jitter(self):
+        bo = faults.Backoff(base_s=1.0, factor=4.0, jitter=0.5, cap_s=2.0)
+        for attempt in (3, 6, 12):
+            assert bo.delay_s(attempt, key="x") <= 2.0 * 1.5
+
+    def test_deadline_truncates_delay(self):
+        bo = faults.Backoff(base_s=1.0, factor=1.0, jitter=0.0,
+                            cap_s=5.0, deadline_s=2.5)
+        assert bo.delay_s(1, "k", elapsed_s=0.0) == 1.0
+        assert bo.delay_s(3, "k", elapsed_s=2.0) == pytest.approx(0.5)
+        assert bo.delay_s(4, "k", elapsed_s=3.0) == 0.0
+
+    def test_delays_generator_respects_deadline(self):
+        bo = faults.Backoff(base_s=0.5, factor=2.0, jitter=0.2,
+                            cap_s=4.0, deadline_s=3.0, seed=11)
+        seq = list(bo.delays(key="g"))
+        assert seq, "at least one attempt before the deadline"
+        assert sum(seq) <= 3.0 + 1e-9
+        # no deadline -> unbounded generator (sample a prefix)
+        unbounded = faults.Backoff(base_s=0.01, cap_s=0.02).delays()
+        assert len([next(unbounded) for _ in range(50)]) == 50
+
+    def test_retry_policy_delegates_bit_identical(self):
+        """RetryPolicy.delay_s now rides Backoff — same seeding string,
+        so every pre-Backoff campaign sleeps the exact same walls."""
+        pol = faults.RetryPolicy(max_attempts=4, base_delay_s=0.05,
+                                 max_delay_s=2.0, jitter=0.5, seed=42)
+        bo = pol.backoff()
+        for key in ("file-a", "file-b"):
+            for attempt in (1, 2, 3, 7):
+                assert pol.delay_s(key, attempt) == bo.delay_s(
+                    attempt, key)
+
+
+# ------------------------------------------- drain/adopt worker verbs
+
+def _post(url, payload=None, timeout=30.0):
+    body = json.dumps(payload).encode() if payload is not None else b""
+    req = urllib.request.Request(url, data=body, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read() or b"{}")
+
+
+def test_drain_and_adopt_verbs(chaos_file_set, fleet_refs, tmp_path):
+    """Migration's two worker-side verbs, in-process: drain tenant 'a'
+    off a live service mid-replay (settled manifest flushed, tenant
+    gone from the registry), adopt the SAME outdir on a second service
+    which finishes ONLY the pending files — exactly-once fleet-wide,
+    picks bit-identical."""
+    outdir_a = str(tmp_path / "tenants" / "a")
+    spec = dict(_tenant("a", chaos_file_set, realtime_factor=8.0),
+                outdir=outdir_a)
+    svc = DetectionService(ServiceConfig(
+        tenants=[TenantSpec(**spec)], outdir=str(tmp_path / "w0"),
+        persistent_cache=False))
+    svc.start()
+    run_t = threading.Thread(target=svc.run, kwargs={"until_idle": False},
+                             daemon=True)
+    run_t.start()
+    try:
+        # unknown tenant answers 404
+        code, _ = _post(f"{svc.api.url}/drain/nope")
+        assert code == 404
+        # wait for at least one settled record, then drain mid-replay
+        deadline = time.monotonic() + 60
+        while not settled_files(outdir_a):
+            assert time.monotonic() < deadline, "no file settled"
+            time.sleep(0.1)
+        code, summary = _post(f"{svc.api.url}/drain/a?timeout_s=60")
+        assert code == 200, summary
+        assert summary["tenant"] == "a"
+        assert summary["outdir"] == outdir_a
+        assert svc.tenant("a") is None
+        n_first = len(settled_files(outdir_a))
+        assert 1 <= n_first, "drain must leave settled work behind"
+        # the drained tenant's footprint card is flushed for placement
+        assert os.path.exists(os.path.join(outdir_a, "cost_card.json"))
+    finally:
+        svc.request_stop()
+        run_t.join(timeout=60)
+        svc.stop()
+
+    # adopt on a second service: fsck first, resume pending only
+    svc2 = DetectionService(ServiceConfig(
+        tenants=[], outdir=str(tmp_path / "w1"), persistent_cache=False,
+    ))
+    svc2.start()
+    run2 = threading.Thread(target=svc2.run, kwargs={"until_idle": False},
+                            daemon=True)
+    run2.start()
+    try:
+        # a corrupt outdir answers 409 and is NOT registered
+        bad = str(tmp_path / "tenants" / "bad")
+        os.makedirs(bad)
+        with open(os.path.join(bad, "manifest.jsonl"), "w") as fh:
+            fh.write('{"path": "x", "status": "done"}\n')
+            fh.write("garbage-interior-line\n")
+            fh.write('{"path": "y", "status": "done"}\n')
+        code, body = _post(f"{svc2.api.url}/adopt", {
+            "spec": _tenant("bad", []), "outdir": bad})
+        assert code == 409, body
+        assert svc2.tenant("bad") is None
+        # bad spec answers 400
+        code, body = _post(f"{svc2.api.url}/adopt",
+                           {"spec": {"name": "x", "bogus_key": 1}})
+        assert code == 400, body
+        # the real adoption
+        code, body = _post(f"{svc2.api.url}/adopt",
+                           {"spec": _tenant("a", chaos_file_set),
+                            "outdir": outdir_a})
+        assert code == 200, body
+        assert body["settled"] == n_first
+        assert body["pending"] == CHAOS_N_FILES - n_first
+        deadline = time.monotonic() + 120
+        while len(settled_files(outdir_a)) < CHAOS_N_FILES:
+            assert time.monotonic() < deadline, "adopted tenant stalled"
+            time.sleep(0.1)
+    finally:
+        svc2.request_stop()
+        run2.join(timeout=60)
+        svc2.stop()
+    _assert_bit_identical(outdir_a, chaos_file_set, fleet_refs["a"])
+    _assert_fsck_clean(outdir_a)
+
+
+def test_settled_statuses_mirror_campaign():
+    """The control plane's import-light settled definition must track
+    the campaign's — a drift here silently re-runs (or skips) files."""
+    from das4whales_tpu.fleet import supervisor as fsup
+
+    assert tuple(fsup.SETTLED_STATUSES) == tuple(camp._SETTLED_STATUSES)
+
+
+# ------------------------------------------------- the quick chaos drill
+
+def _stream_picks(url, tenant, n_expect, out, errors):
+    """Client-side cursor stream through the router: long-poll /picks,
+    resume from the last cursor, retry 503/conn per Retry-After — the
+    subscriber contract docs/FLEET.md documents."""
+    cursor = 0
+    deadline = time.monotonic() + 300
+    try:
+        while time.monotonic() < deadline:
+            done = sum(1 for r in out if r.get("status") == "done")
+            if done >= n_expect:
+                return
+            try:
+                with urllib.request.urlopen(
+                        f"{url}/picks/{tenant}?cursor={cursor}&wait_s=1",
+                        timeout=15) as r:
+                    body = r.read().decode()
+            except urllib.error.HTTPError as exc:
+                exc.read()
+                if exc.code == 503:
+                    time.sleep(0.2)
+                    continue
+                raise
+            except (urllib.error.URLError, OSError, TimeoutError):
+                time.sleep(0.2)
+                continue
+            for line in body.splitlines():
+                rec = json.loads(line)
+                out.append(rec)
+                cursor = rec["cursor"]
+        errors.append(f"stream timed out at cursor {cursor}")
+    except Exception as exc:  # noqa: BLE001 — surfaces in the test
+        errors.append(f"stream died: {exc!r}")
+
+
+@pytest.mark.chaos
+def test_fleet_quick_drill(chaos_file_set, fleet_second_files, fleet_refs,
+                           tmp_path):
+    """Tier-1's representative fleet subset: 2 subprocess workers × 2
+    tenants under paced replay; one GRACEFUL rebalance migration of
+    tenant 'a' while a client streams its picks through the router,
+    then SIGKILL of the worker holding both tenants; the fleet
+    converges — exactly-once, bit-identical, cursor stream gap/dup
+    free, fsck clean everywhere."""
+    cfg = FleetConfig(
+        tenants=[
+            _tenant("a", chaos_file_set, realtime_factor=6.0),
+            _tenant("b", fleet_second_files, realtime_factor=6.0),
+        ],
+        root=str(tmp_path / "fleet"), workers=2,
+        health_interval_s=0.25, probe_timeout_s=1.5, dead_after=3,
+        spawn_timeout_s=240.0, drain_timeout_s=60.0,
+        cost_cards=False, worker_env=_worker_env(),
+    )
+    sup = FleetSupervisor(cfg)
+    router = None
+    recs_a: list = []
+    errors: list = []
+    try:
+        sup.start()
+        st = sup.status()
+        assert len(st["workers"]) == 2
+        owners = st["assignments"]
+        assert set(owners) == {"a", "b"}
+        assert owners["a"] != owners["b"], "bin-packing must balance"
+        router = FleetRouter(sup, host=cfg.host, port=0).start()
+
+        streamer = threading.Thread(
+            target=_stream_picks,
+            args=(router.url, "a", CHAOS_N_FILES, recs_a, errors),
+            daemon=True)
+        streamer.start()
+
+        # trigger 1: graceful rebalance of tenant 'a' mid-replay
+        mig = sup.migrate("a", trigger="rebalance")
+        assert mig["dst"] != mig["src"]
+        dst = mig["dst"]
+
+        # move 'b' onto the same worker, then SIGKILL it: trigger 2
+        if sup.status()["assignments"]["b"] != dst:
+            sup.migrate("b", dst=dst, trigger="rebalance")
+        victim = next(w for w in sup.workers() if w.name == dst)
+        os.kill(victim.pid, signal.SIGKILL)
+
+        assert sup.wait_until_settled(timeout_s=300), (
+            sup.status(), errors)
+        streamer.join(timeout=60)
+        assert not errors, errors
+
+        st = sup.status()
+        dead_events = [r for r in artifacts.read_records(
+            os.path.join(cfg.root, "fleet.jsonl"))
+            if r.get("event") == "dead"]
+        assert any(d["worker"] == dst for d in dead_events)
+    finally:
+        if router is not None:
+            router.stop()
+        sup.stop()
+
+    # convergence: exactly-once + bit-identical per tenant
+    for name, files in (("a", chaos_file_set), ("b", fleet_second_files)):
+        outdir = os.path.join(cfg.root, "tenants", name)
+        _assert_bit_identical(outdir, files, fleet_refs[name])
+        _assert_fsck_clean(outdir)
+    # cursor stream: strictly-increasing cursors, no duplicate paths,
+    # every file seen exactly once
+    cursors = [r["cursor"] for r in recs_a]
+    assert cursors == sorted(cursors) and len(set(cursors)) == len(cursors)
+    done_paths = [r["path"] for r in recs_a if r.get("status") == "done"]
+    assert sorted(done_paths) == sorted(chaos_file_set), (
+        "gap or duplicate in the streamed cursor window")
+    # no orphan tmps anywhere under the fleet root
+    assert artifacts.sweep_orphan_tmps(cfg.root, remove=False) == []
+    # the ledger records both triggers
+    migrations = [r for r in artifacts.read_records(
+        os.path.join(cfg.root, "fleet.jsonl"))
+        if r.get("event") == "migrate"]
+    triggers = {m["trigger"] for m in migrations}
+    assert "rebalance" in triggers and "failure" in triggers
+
+
+# ------------------------------------------------- invisibility pin
+
+def test_fleet_layer_invisible_when_unused(chaos_file_set, fleet_refs,
+                                           tmp_path, compile_guard):
+    """The acceptance pin: a single-process service with NO fleet verbs
+    used runs at zero extra compiles/dispatches at warmed shapes and
+    produces bit-identical picks — the admin queue and retire table
+    cost one truthiness check per scheduler round."""
+    def serve(tag):
+        svc = DetectionService(ServiceConfig(
+            tenants=[TenantSpec(**_tenant("a", chaos_file_set))],
+            outdir=str(tmp_path / tag), persistent_cache=False))
+        svc.start()
+        try:
+            return svc.run(until_idle=True)
+        finally:
+            svc.stop()
+
+    warm = serve("warm")           # compiles the service-path programs
+    assert warm["a"].n_failed == 0
+    with compile_guard.forbid_recompile(
+            "the fleet layer must add no programs or dispatches to the "
+            "single-process service path at warmed shapes"):
+        results = serve("pinned")
+    assert results["a"].n_failed == 0
+    _assert_bit_identical(os.path.join(str(tmp_path / "pinned"), "a"),
+                          chaos_file_set, fleet_refs["a"])
+
+
+# --------------------------------------------------- the slow kill matrix
+
+def _write_fleet_config(tmp_path, tenants, root, **kw):
+    cfg = {
+        "tenants": tenants, "root": root, "workers": 2,
+        "health_interval_s": 0.25, "probe_timeout_s": 1.5,
+        "dead_after": 3, "spawn_timeout_s": 240.0,
+        "drain_timeout_s": 60.0, "cost_cards": False,
+        "worker_env": _worker_env(),
+    }
+    cfg.update(kw)
+    path = str(tmp_path / "fleet_config.json")
+    with open(path, "w") as fh:
+        json.dump(cfg, fh)
+    return path
+
+
+def _launch_driver(cfg_path, timeout_s=300):
+    proc = subprocess.Popen(
+        [sys.executable, DRIVER, cfg_path, str(timeout_s)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=_worker_env(), cwd=ROOT,
+    )
+    line = proc.stdout.readline()
+    try:
+        status = json.loads(line)
+    except ValueError:
+        out, err = proc.communicate(timeout=30)
+        raise AssertionError(
+            f"driver died before fleet-up: {line!r} {out!r} {err!r}")
+    return proc, status
+
+
+def _fleet_worker_pids(router_url):
+    with urllib.request.urlopen(f"{router_url}/fleet", timeout=10) as r:
+        st = json.loads(r.read())
+    return {w["name"]: w["pid"] for w in st["workers"] if w["up"]}
+
+
+@pytest.fixture(scope="module")
+def matrix_files(tmp_path_factory):
+    return {
+        "a": _make_files(tmp_path_factory, 3, 800, "ma"),
+        "b": _make_files(tmp_path_factory, 3, 820, "mb"),
+        "c": _make_files(tmp_path_factory, 3, 840, "mc"),
+    }
+
+
+@pytest.fixture(scope="module")
+def matrix_refs(matrix_files, tmp_path_factory):
+    base = tmp_path_factory.mktemp("matrixref")
+    refs = {}
+    for name, files in matrix_files.items():
+        res = run_campaign_batched(files, SEL, str(base / name), batch=2,
+                                   bucket="exact", persistent_cache=False)
+        assert res.n_failed == 0
+        refs[name] = {r.path: load_picks(r.picks_file)
+                      for r in res.records if r.status == "done"}
+    return refs
+
+
+def _assert_matrix_converged(root, matrix_files, matrix_refs):
+    for name, files in matrix_files.items():
+        outdir = os.path.join(root, "tenants", name)
+        _assert_bit_identical(outdir, files, matrix_refs[name])
+        _assert_fsck_clean(outdir)
+    assert artifacts.sweep_orphan_tmps(root, remove=False) == []
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("victim", ["w0", "w1", "wedge", "supervisor"])
+def test_fleet_kill_matrix(victim, matrix_files, matrix_refs, tmp_path):
+    """The full chaos matrix (2 workers × 3 tenants, paced replay):
+    SIGKILL each worker in turn, SIGSTOP-wedge one, and SIGKILL the
+    supervisor itself (drill: restart over the same root replays the
+    ledger, fences the orphaned workers, resumes). Every scenario must
+    converge to the same place: exactly-once, bit-identical, fsck
+    clean."""
+    tenants = [_tenant(n, f, realtime_factor=4.0)
+               for n, f in matrix_files.items()]
+    root = str(tmp_path / "fleet")
+    cfg_path = _write_fleet_config(tmp_path, tenants, root)
+    proc, status = _launch_driver(cfg_path)
+    try:
+        router_url = status["router"]
+        pids = _fleet_worker_pids(router_url)
+        if victim in ("w0", "w1"):
+            os.kill(pids[victim], signal.SIGKILL)
+        elif victim == "wedge":
+            # a wedged (stopped) worker: probes time out, the streak
+            # declares it dead, the supervisor fences it with SIGKILL
+            os.kill(pids["w0"], signal.SIGSTOP)
+        else:
+            # kill the control plane mid-serving; orphaned workers keep
+            # writing until the restarted supervisor fences them
+            time.sleep(1.0)
+            proc.kill()
+            proc.wait(timeout=30)
+            proc, status = _launch_driver(cfg_path)
+        out, err = proc.communicate(timeout=420)
+        assert proc.returncode == 0, (victim, out, err)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+    _assert_matrix_converged(root, matrix_files, matrix_refs)
+    if victim != "supervisor":
+        dead = [r for r in artifacts.read_records(
+            os.path.join(root, "fleet.jsonl")) if r.get("event") == "dead"]
+        assert dead, "the health loop never declared the victim dead"
